@@ -1,0 +1,22 @@
+"""demi_tpu: a TPU-native framework for fuzzing and minimizing
+message-delivery schedules of distributed (actor-model) systems.
+
+Capability-equivalent re-design of NetSys/demi (DEMi, NSDI'16) — see
+SURVEY.md for the structural map. Two tiers:
+
+  - Host tier: event/trace model, controlled sequential actor runtime
+    (the oracle), schedulers, minimization logic, persistence.
+  - Device tier (demi_tpu.device / demi_tpu.parallel): actor state and
+    pending-message pools as tensors; vmapped jitted transition kernels
+    advance thousands of candidate schedules in lockstep, sharded over a
+    TPU mesh.
+"""
+
+__version__ = "0.1.0"
+
+from . import events, external_events, fingerprints, trace, config, dsl  # noqa: F401
+from .config import SchedulerConfig
+from .trace import EventTrace
+from .events import Unique
+
+__all__ = ["SchedulerConfig", "EventTrace", "Unique", "__version__"]

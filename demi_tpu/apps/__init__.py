@@ -1,0 +1,3 @@
+from .common import make_host_invariant, dsl_start_events, DSLSendGenerator
+
+__all__ = ["make_host_invariant", "dsl_start_events", "DSLSendGenerator"]

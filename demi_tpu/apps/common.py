@@ -1,0 +1,84 @@
+"""Glue between DSL apps and the host tier: invariant adaptation, Start
+prefixes, and fuzzer message generation.
+
+The device tier evaluates ``app.invariant(states, alive)`` directly as a
+jitted predicate; here we adapt the same function to the host oracle's
+checkpoint-based invariant signature (externals, {name -> CheckpointReply})
+(reference signature: TestOracle.scala:27).
+"""
+
+from __future__ import annotations
+
+import random as _random
+from typing import Callable, List, Optional, Sequence
+
+import numpy as np
+
+from ..dsl import DSLApp
+from ..external_events import MessageConstructor, Send, Start
+from ..minimization.test_oracle import IntViolation
+from ..runtime.actor import dsl_actor_factory
+
+_INV_CACHE: dict = {}
+
+
+def _jitted_invariant(app: DSLApp):
+    fn = _INV_CACHE.get(id(app))
+    if fn is None:
+        from ..utils.hostjit import host_jit
+
+        fn = host_jit(app.invariant)
+        _INV_CACHE[id(app)] = fn
+    return fn
+
+
+def make_host_invariant(app: DSLApp) -> Callable:
+    """Adapt the app's jitted (states, alive) -> int32 predicate to the host
+    checkpoint-based invariant. Actors absent/crashed/isolated -> not alive."""
+    assert app.invariant is not None
+
+    def invariant(externals, checkpoint) -> Optional[IntViolation]:
+        states = np.zeros((app.num_actors, app.state_width), np.int32)
+        alive = np.zeros(app.num_actors, bool)
+        for i in range(app.num_actors):
+            reply = checkpoint.get(app.actor_name(i))
+            if reply is not None and reply.data is not None:
+                states[i] = np.asarray(reply.data, np.int32)
+                alive[i] = True
+        code = int(_jitted_invariant(app)(states, alive))
+        if code != 0:
+            affected = tuple(
+                app.actor_name(i) for i in range(app.num_actors) if alive[i]
+            )
+            return IntViolation(code, affected)
+        return None
+
+    return invariant
+
+
+def dsl_start_events(app: DSLApp) -> List[Start]:
+    """Start prefix spawning every actor of the app."""
+    return [
+        Start(app.actor_name(i), ctor=dsl_actor_factory(app, i))
+        for i in range(app.num_actors)
+    ]
+
+
+class DSLSendGenerator:
+    """Fuzzer message generator sending app-provided messages to random alive
+    actors. ``make_msg(rng, counter) -> tuple`` builds the payload."""
+
+    def __init__(self, app: DSLApp, make_msg: Callable[[_random.Random, int], tuple]):
+        self.app = app
+        self.make_msg = make_msg
+        self._counter = 0
+
+    def generate(self, rng: _random.Random, alive: Sequence[str]) -> Optional[Send]:
+        if not alive:
+            return None
+        self._counter += 1
+        msg = self.make_msg(rng, self._counter)
+        if msg is None:
+            return None
+        target = rng.choice(list(alive))
+        return Send(target, MessageConstructor(lambda m=msg: m))

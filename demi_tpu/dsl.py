@@ -1,0 +1,98 @@
+"""The dual-tier application DSL: write an app once, run it on the host
+oracle *and* inside the vmapped device kernels.
+
+The reference tests arbitrary JVM applications by weaving interposition into
+them (WeaveActor.aj). A TPU-native framework cannot interpose on arbitrary
+Python, and more importantly the hot path — thousands of schedules advancing
+in lockstep — requires actor handlers that XLA can trace. So in-framework
+applications are written against this restricted DSL:
+
+  - Actor state is a fixed-width ``int32[state_width]`` vector.
+  - A message is a fixed-width ``int32[msg_width]`` record; ``msg[0]`` is the
+    tag. On the host tier messages appear as plain int tuples.
+  - The handler is a *pure, jax-traceable* function
+        handler(actor_id, state, snd_id, msg) -> (state', outbox)
+    with ``outbox: int32[max_outbox, 2 + msg_width]`` rows of
+    ``(valid, dst, msg...)``. No Python control flow on traced values —
+    use jnp.where / lax.switch.
+  - Timers are self-sends whose tag is in ``timer_tags``; the runtime holds
+    them as always-deliverable scheduler-controlled events (the reference
+    converts JVM timers the same way, WeaveActor.aj:234-335). Delivering a
+    timer consumes it; handlers re-arm by re-emitting.
+  - The safety invariant is a jax-traceable predicate over all actor states
+    returning an int32 violation fingerprint (0 = no violation).
+
+The same handler drives both tiers, so host-vs-device differences isolate
+engine bugs, not app bugs (the test strategy SURVEY.md §4 calls for).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Optional, Sequence, Tuple
+
+import numpy as np
+
+# Outbox row layout: (valid, dst, msg[0..W-1])
+OUT_VALID = 0
+OUT_DST = 1
+OUT_MSG = 2
+
+
+@dataclass(frozen=True)
+class DSLApp:
+    """A complete application-under-test definition."""
+
+    name: str
+    num_actors: int
+    state_width: int
+    msg_width: int
+    max_outbox: int
+    # init_state(actor_id: int) -> int32[state_width]  (static python int id)
+    init_state: Callable[[int], np.ndarray]
+    # handler(actor_id, state, snd_id, msg) -> (state', outbox)
+    handler: Callable
+    # initial_msgs(actor_id: int) -> int32[k, 2+msg_width] rows emitted at spawn
+    initial_msgs: Optional[Callable[[int], np.ndarray]] = None
+    # invariant(states: int32[N, S], alive: bool[N]) -> int32 fingerprint (0 = ok)
+    invariant: Optional[Callable] = None
+    timer_tags: Tuple[int, ...] = ()
+    tag_names: Tuple[str, ...] = ()  # for pretty-printing
+
+    # -- naming ------------------------------------------------------------
+    def actor_name(self, actor_id: int) -> str:
+        return f"{self.name}{actor_id}"
+
+    def actor_id(self, name: str) -> int:
+        prefix = self.name
+        if not name.startswith(prefix):
+            raise KeyError(name)
+        return int(name[len(prefix):])
+
+    def actor_names(self) -> Tuple[str, ...]:
+        return tuple(self.actor_name(i) for i in range(self.num_actors))
+
+    def is_timer_msg(self, msg) -> bool:
+        return int(msg[0]) in self.timer_tags
+
+    def tag_name(self, tag: int) -> str:
+        if 0 <= tag < len(self.tag_names):
+            return self.tag_names[tag]
+        return str(tag)
+
+
+def outbox_rows(max_outbox: int, msg_width: int, *rows: Sequence[int]) -> np.ndarray:
+    """Helper for building a padded outbox array eagerly (init/initial_msgs)."""
+    out = np.zeros((max_outbox, 2 + msg_width), dtype=np.int32)
+    for i, row in enumerate(rows):
+        out[i, OUT_VALID] = 1
+        out[i, OUT_DST] = row[0]
+        msg = row[1:]
+        out[i, OUT_MSG : OUT_MSG + len(msg)] = msg
+    return out
+
+
+# Sender-id sentinel for externally injected messages (device encoding uses
+# num_actors for EXTERNAL; host adapters translate).
+def external_sender_id(app: DSLApp) -> int:
+    return app.num_actors

@@ -1,0 +1,206 @@
+"""User-facing external event vocabulary: the fault/input language.
+
+Reference: src/main/scala/verification/ExternalEvents.scala (202 LoC).
+External events are what the fuzzer generates and what DDMin minimizes.
+Each instance carries a unique ``eid`` (reference: UniqueExternalEvent,
+ExternalEvents.scala:14-31) so that structurally-equal events at different
+trace positions stay distinguishable across subsequence trials.
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, field
+from typing import Any, Callable, List, Optional, Sequence
+
+_eid_counter = itertools.count(1)
+
+
+def _next_eid() -> int:
+    return next(_eid_counter)
+
+
+class MessageConstructor:
+    """Late-bound constructor for externally injected messages.
+
+    Reference: ExternalMessageConstructor (ExternalEvents.scala:43-55). Late
+    binding lets replays rebuild messages that close over live actor handles,
+    and ``mask_components`` supports payload shrinking
+    (RunnerUtils.shrinkSendContents, RunnerUtils.scala:1007-1094): a
+    constructor may expose sub-components (e.g. a membership list) that the
+    minimizer can mask out one at a time.
+    """
+
+    def __init__(self, fn: Callable[[], Any], components: Optional[Sequence[Any]] = None):
+        self._fn = fn
+        self._components = list(components) if components is not None else []
+        self._masked: frozenset = frozenset()
+
+    def __call__(self) -> Any:
+        return self.construct()
+
+    def construct(self) -> Any:
+        if self._masked and self._components:
+            return self._fn_with_mask()
+        return self._fn()
+
+    # -- shrinking support -------------------------------------------------
+    @property
+    def components(self) -> List[Any]:
+        return list(self._components)
+
+    def masked(self, masked_indices) -> "MessageConstructor":
+        clone = MessageConstructor(self._fn, self._components)
+        clone._masked = frozenset(masked_indices)
+        return clone
+
+    def _fn_with_mask(self):
+        kept = [c for i, c in enumerate(self._components) if i not in self._masked]
+        return self._fn(kept) if _accepts_arg(self._fn) else self._fn()
+
+    def __repr__(self):
+        return f"MessageConstructor(masked={sorted(self._masked)})"
+
+
+def _accepts_arg(fn) -> bool:
+    try:
+        import inspect
+
+        sig = inspect.signature(fn)
+        return len(sig.parameters) >= 1
+    except (TypeError, ValueError):
+        return False
+
+
+def constant_message(msg: Any) -> MessageConstructor:
+    return MessageConstructor(lambda: msg)
+
+
+@dataclass(frozen=True, eq=False)
+class ExternalEvent:
+    """Base class. Identity (eid) equality: minimization must distinguish
+    equal-looking events at different positions."""
+
+    eid: int = field(default_factory=_next_eid, init=False)
+
+    # Identity semantics but stable hashing across pickling.
+    def __eq__(self, other):
+        return isinstance(other, ExternalEvent) and self.eid == other.eid
+
+    def __hash__(self):
+        return hash(self.eid)
+
+    @property
+    def label(self) -> str:
+        return f"e{self.eid}"
+
+
+@dataclass(frozen=True, eq=False)
+class Start(ExternalEvent):
+    """Spawn (or respawn, re-enabling traffic) an actor by name.
+
+    Reference: ExternalEvents.scala Start(propCtor, name); a later Start for
+    a previously Killed name acts as recovery (EventOrchestrator.trigger_start).
+    """
+
+    name: str = ""
+    ctor: Optional[Callable[[], Any]] = field(default=None, compare=False, repr=False)
+
+
+@dataclass(frozen=True, eq=False)
+class Kill(ExternalEvent):
+    """Isolate an actor: all of its traffic is dropped, but it is not stopped
+    (reference semantics: Kill = isolation, EventOrchestrator.scala:51-59)."""
+
+    name: str = ""
+
+
+@dataclass(frozen=True, eq=False)
+class HardKill(ExternalEvent):
+    """Actually stop the actor and scrub its pending state
+    (reference: EventOrchestrator.trigger_hard_kill:243-312)."""
+
+    name: str = ""
+
+
+@dataclass(frozen=True, eq=False)
+class Send(ExternalEvent):
+    name: str = ""
+    msg_ctor: MessageConstructor = field(default=None, compare=False, repr=False)
+
+    def message(self) -> Any:
+        return self.msg_ctor.construct()
+
+
+@dataclass(frozen=True, eq=False)
+class WaitQuiescence(ExternalEvent):
+    """Block injection until no deliverable messages remain."""
+
+
+@dataclass(frozen=True, eq=False)
+class WaitCondition(ExternalEvent):
+    """Block injection until a host-side condition holds
+    (reference: ExternalEventInjector.scala:541-580 re-arm semantics)."""
+
+    cond: Callable[[], bool] = field(default=None, compare=False, repr=False)
+
+
+@dataclass(frozen=True, eq=False)
+class Partition(ExternalEvent):
+    a: str = ""
+    b: str = ""
+
+
+@dataclass(frozen=True, eq=False)
+class UnPartition(ExternalEvent):
+    a: str = ""
+    b: str = ""
+
+
+@dataclass(frozen=True, eq=False)
+class CodeBlock(ExternalEvent):
+    """Run an arbitrary host-side block atomically at this point."""
+
+    block: Callable[[], None] = field(default=None, compare=False, repr=False)
+    label: str = ""
+
+
+def externals_summary(events: Sequence[ExternalEvent]) -> str:
+    parts = []
+    for e in events:
+        if isinstance(e, Start):
+            parts.append(f"Start({e.name})")
+        elif isinstance(e, Kill):
+            parts.append(f"Kill({e.name})")
+        elif isinstance(e, HardKill):
+            parts.append(f"HardKill({e.name})")
+        elif isinstance(e, Send):
+            parts.append(f"Send({e.name})")
+        elif isinstance(e, WaitQuiescence):
+            parts.append("WaitQuiescence")
+        elif isinstance(e, WaitCondition):
+            parts.append("WaitCondition")
+        elif isinstance(e, Partition):
+            parts.append(f"Partition({e.a},{e.b})")
+        elif isinstance(e, UnPartition):
+            parts.append(f"UnPartition({e.a},{e.b})")
+        elif isinstance(e, CodeBlock):
+            parts.append(f"CodeBlock({e.label})")
+        else:
+            parts.append(type(e).__name__)
+    return " ".join(parts)
+
+
+def sanity_check_externals(events: Sequence[ExternalEvent]) -> None:
+    """Reject trivially malformed fuzz tests: sends/kills of never-started
+    actors (reference: Fuzzer.validateFuzzTest, Fuzzer.scala:126-133)."""
+    started = set()
+    for e in events:
+        if isinstance(e, Start):
+            started.add(e.name)
+        elif isinstance(e, (Kill, HardKill)):
+            if e.name not in started:
+                raise ValueError(f"{e} targets never-started actor {e.name}")
+        elif isinstance(e, Send):
+            if e.name not in started:
+                raise ValueError(f"{e} targets never-started actor {e.name}")

@@ -1,0 +1,108 @@
+"""Pluggable message equivalence (fingerprinting).
+
+Reference: src/main/scala/verification/MessageFingerprints.scala (124 LoC).
+A fingerprint is any hashable value standing for "this message, up to
+irrelevant detail" — replay matches deliveries by (snd, rcv, fingerprint),
+and minimization clusters deliveries by fingerprint-derived logical clocks.
+
+The device tier never calls into this module: device-DSL messages are already
+fixed-width integer records whose fingerprint is the record itself (or a
+masked view of it, see demi_tpu/device/encoding.py).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Callable, List, Optional
+
+
+class MessageFingerprinter:
+    """One link in the fingerprinter chain. Return None to pass to the next.
+
+    Also exposes the logical-clock hooks used by the ClockClusterizer
+    (reference: MessageFingerprints.scala:103-123)."""
+
+    def fingerprint(self, msg: Any) -> Optional[Any]:
+        return None
+
+    def causes_clock_increment(self, msg: Any) -> bool:
+        return False
+
+    def get_logical_clock(self, msg: Any) -> Optional[int]:
+        return None
+
+
+_ADDR_RE = re.compile(r"0x[0-9a-fA-F]+|at 0x[0-9a-fA-F]+|object at .*?>")
+
+
+class BaseFingerprinter(MessageFingerprinter):
+    """Last-resort fingerprinter: structural for tuples/dataclasses, scrubbed
+    repr otherwise (reference: BasicFingerprint regex scrub,
+    MessageFingerprints.scala:39-52)."""
+
+    def fingerprint(self, msg: Any) -> Any:
+        if isinstance(msg, (int, float, str, bool, type(None), bytes)):
+            return msg
+        if isinstance(msg, tuple):
+            return tuple(self.fingerprint(m) for m in msg)
+        if hasattr(msg, "__dataclass_fields__"):
+            return (type(msg).__name__,) + tuple(
+                self.fingerprint(getattr(msg, f)) for f in msg.__dataclass_fields__
+            )
+        return _ADDR_RE.sub("<addr>", repr(msg))
+
+
+class LambdaFingerprinter(MessageFingerprinter):
+    def __init__(
+        self,
+        fingerprint_fn: Callable[[Any], Optional[Any]],
+        clock_increment_fn: Optional[Callable[[Any], bool]] = None,
+        logical_clock_fn: Optional[Callable[[Any], Optional[int]]] = None,
+    ):
+        self._fp = fingerprint_fn
+        self._inc = clock_increment_fn
+        self._clk = logical_clock_fn
+
+    def fingerprint(self, msg):
+        return self._fp(msg)
+
+    def causes_clock_increment(self, msg):
+        return bool(self._inc(msg)) if self._inc else False
+
+    def get_logical_clock(self, msg):
+        return self._clk(msg) if self._clk else None
+
+
+class FingerprintFactory:
+    """Chain of fingerprinters; app-specific first, BaseFingerprinter last.
+
+    Reference: FingerprintFactory (MessageFingerprints.scala:83-124)."""
+
+    def __init__(self):
+        self._chain: List[MessageFingerprinter] = []
+        self._base = BaseFingerprinter()
+
+    def register(self, fp: MessageFingerprinter) -> "FingerprintFactory":
+        self._chain.append(fp)
+        return self
+
+    def fingerprint(self, msg: Any) -> Any:
+        for fp in self._chain:
+            result = fp.fingerprint(msg)
+            if result is not None:
+                return result
+        return self._base.fingerprint(msg)
+
+    def causes_clock_increment(self, msg: Any) -> bool:
+        return any(fp.causes_clock_increment(msg) for fp in self._chain)
+
+    def get_logical_clock(self, msg: Any) -> Optional[int]:
+        for fp in self._chain:
+            clock = fp.get_logical_clock(msg)
+            if clock is not None:
+                return clock
+        return None
+
+
+def default_fingerprint_factory() -> FingerprintFactory:
+    return FingerprintFactory()

@@ -1,0 +1,3 @@
+from .fuzzer import Fuzzer, FuzzerWeights, MessageGenerator
+
+__all__ = ["Fuzzer", "FuzzerWeights", "MessageGenerator"]

@@ -1,0 +1,132 @@
+"""Fuzzer: generates external-event programs (fuzz tests).
+
+Reference: src/main/scala/verification/fuzzing/Fuzzer.scala (194 LoC).
+A fuzz test is: prefix (Starts + app bootstrap) ++ weighted random
+choice among {Kill, Send, Partition, UnPartition, WaitQuiescence} ++ postfix,
+always ending in WaitQuiescence, never two consecutive WaitQuiescence
+(Fuzzer.scala:122-175). Seeding is explicit (the reference seeds from wall
+clock, Fuzzer.scala:67 — fixed here for reproducibility).
+"""
+
+from __future__ import annotations
+
+import random as _random
+from dataclasses import dataclass
+from typing import Callable, List, Optional, Sequence
+
+from ..external_events import (
+    ExternalEvent,
+    Kill,
+    Partition,
+    Send,
+    Start,
+    UnPartition,
+    WaitQuiescence,
+    sanity_check_externals,
+)
+
+
+class MessageGenerator:
+    """App-supplied generator of external Send events
+    (reference: Fuzzer.scala:8-10)."""
+
+    def generate(self, rng: _random.Random, alive: Sequence[str]) -> Optional[Send]:
+        raise NotImplementedError
+
+
+@dataclass
+class FuzzerWeights:
+    """Relative choice weights (reference: FuzzerWeights, Fuzzer.scala:24-58)."""
+
+    kill: float = 0.01
+    send: float = 0.3
+    wait_quiescence: float = 0.1
+    partition: float = 0.0
+    unpartition: float = 0.0
+
+
+class Fuzzer:
+    def __init__(
+        self,
+        num_events: int,
+        weights: FuzzerWeights,
+        message_gen: MessageGenerator,
+        prefix: Sequence[ExternalEvent],
+        postfix: Sequence[ExternalEvent] = (),
+        max_kills: Optional[int] = None,
+    ):
+        self.num_events = num_events
+        self.weights = weights
+        self.message_gen = message_gen
+        self.prefix = list(prefix)
+        self.postfix = list(postfix)
+        # Keeping a quorum alive is the app's concern; cap kills so fuzz runs
+        # don't trivially kill everyone (the reference relies on weights).
+        self.max_kills = max_kills
+
+    def generate_fuzz_test(self, seed: int) -> List[ExternalEvent]:
+        rng = _random.Random(seed)
+        names = [e.name for e in self.prefix if isinstance(e, Start)]
+        alive = list(names)
+        kills = 0
+        partitions: List[tuple] = []
+
+        events: List[ExternalEvent] = list(self.prefix)
+        choices = [
+            ("kill", self.weights.kill),
+            ("send", self.weights.send),
+            ("wait", self.weights.wait_quiescence),
+            ("partition", self.weights.partition),
+            ("unpartition", self.weights.unpartition),
+        ]
+        total = sum(w for _, w in choices)
+        generated = 0
+        while generated < self.num_events:
+            r = rng.uniform(0, total)
+            kind = "send"
+            for name, w in choices:
+                if r < w:
+                    kind = name
+                    break
+                r -= w
+            if kind == "kill":
+                can_kill = self.max_kills is None or kills < self.max_kills
+                if alive and can_kill:
+                    victim = rng.choice(alive)
+                    alive.remove(victim)
+                    kills += 1
+                    events.append(Kill(victim))
+                    generated += 1
+            elif kind == "send":
+                send = self.message_gen.generate(rng, alive)
+                if send is not None:
+                    events.append(send)
+                    generated += 1
+            elif kind == "wait":
+                if events and not isinstance(events[-1], WaitQuiescence):
+                    events.append(WaitQuiescence())
+                    generated += 1
+            elif kind == "partition":
+                pairs = [
+                    (a, b)
+                    for i, a in enumerate(alive)
+                    for b in alive[i + 1 :]
+                    if (a, b) not in partitions
+                ]
+                if pairs:
+                    pair = rng.choice(pairs)
+                    partitions.append(pair)
+                    events.append(Partition(*pair))
+                    generated += 1
+            elif kind == "unpartition":
+                if partitions:
+                    pair = rng.choice(partitions)
+                    partitions.remove(pair)
+                    events.append(UnPartition(*pair))
+                    generated += 1
+
+        events.extend(self.postfix)
+        if not events or not isinstance(events[-1], WaitQuiescence):
+            events.append(WaitQuiescence())
+        sanity_check_externals(events)
+        return events

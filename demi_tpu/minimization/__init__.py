@@ -1,0 +1,17 @@
+from .test_oracle import TestOracle, ViolationFingerprint, IntViolation, StatelessTestOracle
+from .stats import MinimizationStats
+from .event_dag import EventDag, AtomicEvent
+from .ddmin import DDMin
+from .one_at_a_time import LeftToRightRemoval
+
+__all__ = [
+    "TestOracle",
+    "ViolationFingerprint",
+    "IntViolation",
+    "StatelessTestOracle",
+    "MinimizationStats",
+    "EventDag",
+    "AtomicEvent",
+    "DDMin",
+    "LeftToRightRemoval",
+]

@@ -1,0 +1,101 @@
+"""Delta debugging (ddmin) over atomic external events.
+
+Reference: minification/DeltaDebugging.scala (110 LoC) — the binary-recursive
+variant of Zeller'99: test each half (plus the fixed remainder); if neither
+half alone reproduces, recurse into each half with the other as remainder
+("interference"). Oracle-agnostic; ``verify_mcs`` re-tests the final MCS.
+
+The batched device oracle (demi_tpu.device.batch_oracle) accelerates this by
+replaying a whole ddmin level's candidate subsequences as one vmapped batch.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence
+
+from ..trace import EventTrace
+from .event_dag import AtomicEvent, EventDag, UnmodifiedEventDag
+from .stats import MinimizationStats
+from .test_oracle import TestOracle
+
+
+class Minimizer:
+    """Reference: minification/Minimizer.scala:9-27."""
+
+    def minimize(self, dag: EventDag, violation_fingerprint: Any, init=None) -> EventDag:
+        raise NotImplementedError
+
+
+class DDMin(Minimizer):
+    def __init__(self, oracle: TestOracle, check_unmodified: bool = False,
+                 stats: Optional[MinimizationStats] = None):
+        self.oracle = oracle
+        self.check_unmodified = check_unmodified
+        self.stats = stats or MinimizationStats()
+        self.original_traces: List[EventTrace] = []  # violating traces seen
+        self._violation = None
+        self._init = None
+        self.total_tests = 0
+
+    def minimize(self, dag: EventDag, violation_fingerprint: Any, init=None) -> EventDag:
+        self.stats.update_strategy("DDMin", type(self.oracle).__name__)
+        self.stats.record_prune_start()
+        self._violation = violation_fingerprint
+        self._init = init
+        if self.check_unmodified:
+            if self._test(dag) is None:
+                raise RuntimeError("full external sequence does not reproduce")
+        result = self._ddmin2(dag.get_atomic_events(), dag, _empty_view(dag))
+        self.stats.record_prune_end()
+        mcs_events = [e for atom in result for e in atom.events]
+        full = dag.get_all_events()
+        order = {e.eid: i for i, e in enumerate(full)}
+        mcs_events.sort(key=lambda e: order[e.eid])
+        mcs = dag.remove_events(
+            [a for a in dag.get_atomic_events() if all(e.eid not in {m.eid for m in mcs_events} for e in a.events)]
+        )
+        self.stats.record_minimized_counts(0, len(mcs.get_all_events()), 0)
+        return mcs
+
+    def verify_mcs(self, mcs: EventDag, violation_fingerprint: Any, init=None) -> Optional[EventTrace]:
+        """Reference: DeltaDebugging.scala:64-71."""
+        return self.oracle.test(
+            mcs.get_all_events(), violation_fingerprint, stats=MinimizationStats(), init=init
+        )
+
+    # -- internals ---------------------------------------------------------
+    def _ddmin2(
+        self, atoms: List[AtomicEvent], dag: EventDag, remainder: EventDag
+    ) -> List[AtomicEvent]:
+        if len(atoms) <= 1:
+            return atoms
+        mid = len(atoms) // 2
+        left, right = atoms[:mid], atoms[mid:]
+        left_dag = dag.remove_events(right)
+        right_dag = dag.remove_events(left)
+
+        if self._test(left_dag.union(remainder)) is not None:
+            return self._ddmin2(left, left_dag, remainder)
+        if self._test(right_dag.union(remainder)) is not None:
+            return self._ddmin2(right, right_dag, remainder)
+        # Interference: minimize each half, keeping the other in place.
+        kept_left = self._ddmin2(left, left_dag, remainder.union(right_dag))
+        kept_right = self._ddmin2(right, right_dag, remainder.union(left_dag))
+        return kept_left + kept_right
+
+    def _test(self, candidate: EventDag) -> Optional[EventTrace]:
+        self.total_tests += 1
+        events = candidate.get_all_events()
+        self.stats.record_iteration_size(len(events))
+        trace = self.oracle.test(events, self._violation, stats=self.stats, init=self._init)
+        if trace is not None:
+            self.original_traces.append(trace)
+        return trace
+
+
+def _empty_view(dag: EventDag):
+    return dag.remove_events(dag.get_atomic_events())
+
+
+def make_dag(externals: Sequence) -> UnmodifiedEventDag:
+    return UnmodifiedEventDag(externals)

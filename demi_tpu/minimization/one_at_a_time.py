@@ -1,0 +1,45 @@
+"""LeftToRightRemoval: baseline external-event minimizer.
+
+Reference: minification/OneAtATime.scala (71 LoC) — try removing each atomic
+event left to right; keep removals after which the violation still
+reproduces.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from .ddmin import Minimizer
+from .event_dag import EventDag
+from .stats import MinimizationStats
+from .test_oracle import TestOracle
+
+
+class LeftToRightRemoval(Minimizer):
+    def __init__(self, oracle: TestOracle, stats: Optional[MinimizationStats] = None):
+        self.oracle = oracle
+        self.stats = stats or MinimizationStats()
+        self.total_tests = 0
+
+    def minimize(self, dag: EventDag, violation_fingerprint: Any, init=None) -> EventDag:
+        self.stats.update_strategy("LeftToRightRemoval", type(self.oracle).__name__)
+        self.stats.record_prune_start()
+        current = dag
+        changed = True
+        while changed:
+            changed = False
+            for atom in list(current.get_atomic_events()):
+                candidate = current.remove_events([atom])
+                self.total_tests += 1
+                self.stats.record_iteration_size(len(candidate.get_all_events()))
+                if (
+                    self.oracle.test(
+                        candidate.get_all_events(), violation_fingerprint, stats=self.stats, init=init
+                    )
+                    is not None
+                ):
+                    current = candidate
+                    changed = True
+        self.stats.record_prune_end()
+        self.stats.record_minimized_counts(0, len(current.get_all_events()), 0)
+        return current

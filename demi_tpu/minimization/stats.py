@@ -1,0 +1,130 @@
+"""MinimizationStats: the metrics system.
+
+Reference: minification/Minimizer.scala:30-237. Stats stack per
+(strategy, oracle) pair so a pipeline of minimizers appends stages; each
+stage records replay counts, per-iteration progress (external & internal
+event counts), and prune/replay wall-times. JSON round-trips for the
+experiment dir (minimization_stats.json) and the graphing tools.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Any, Dict, List, Optional
+
+
+class _Stage:
+    def __init__(self, strategy: str, oracle: str):
+        self.strategy = strategy
+        self.oracle = oracle
+        self.total_replays = 0
+        self.iteration_size: Dict[int, int] = {}  # replay# -> #externals
+        self.internal_iteration_size: Dict[int, int] = {}
+        self.prune_start: Optional[float] = None
+        self.prune_duration_seconds = 0.0
+        self.replay_start: Optional[float] = None
+        self.replay_duration_seconds = 0.0
+        self.minimized_deliveries = 0
+        self.minimized_externals = 0
+        self.minimized_timers = 0
+
+    def to_json(self) -> Dict[str, Any]:
+        return {
+            "strategy": self.strategy,
+            "oracle": self.oracle,
+            "total_replays": self.total_replays,
+            "iteration_size": {str(k): v for k, v in self.iteration_size.items()},
+            "internal_iteration_size": {
+                str(k): v for k, v in self.internal_iteration_size.items()
+            },
+            "prune_duration_seconds": self.prune_duration_seconds,
+            "replay_duration_seconds": self.replay_duration_seconds,
+            "minimized_deliveries": self.minimized_deliveries,
+            "minimized_externals": self.minimized_externals,
+            "minimized_timers": self.minimized_timers,
+        }
+
+    @classmethod
+    def from_json(cls, obj: Dict[str, Any]) -> "_Stage":
+        stage = cls(obj["strategy"], obj["oracle"])
+        stage.total_replays = obj.get("total_replays", 0)
+        stage.iteration_size = {
+            int(k): v for k, v in obj.get("iteration_size", {}).items()
+        }
+        stage.internal_iteration_size = {
+            int(k): v for k, v in obj.get("internal_iteration_size", {}).items()
+        }
+        stage.prune_duration_seconds = obj.get("prune_duration_seconds", 0.0)
+        stage.replay_duration_seconds = obj.get("replay_duration_seconds", 0.0)
+        stage.minimized_deliveries = obj.get("minimized_deliveries", 0)
+        stage.minimized_externals = obj.get("minimized_externals", 0)
+        stage.minimized_timers = obj.get("minimized_timers", 0)
+        return stage
+
+
+class MinimizationStats:
+    def __init__(self):
+        self.stages: List[_Stage] = []
+
+    # -- stage management --------------------------------------------------
+    def update_strategy(self, strategy: str, oracle: str) -> None:
+        self.stages.append(_Stage(strategy, oracle))
+
+    @property
+    def current(self) -> _Stage:
+        if not self.stages:
+            self.update_strategy("unknown", "unknown")
+        return self.stages[-1]
+
+    # -- recording ---------------------------------------------------------
+    def record_replay(self) -> None:
+        self.current.total_replays += 1
+
+    def record_iteration_size(self, n_externals: int) -> None:
+        stage = self.current
+        stage.iteration_size[stage.total_replays] = n_externals
+
+    def record_internal_size(self, n_internals: int) -> None:
+        stage = self.current
+        stage.internal_iteration_size[stage.total_replays] = n_internals
+
+    def record_prune_start(self) -> None:
+        self.current.prune_start = time.monotonic()
+
+    def record_prune_end(self) -> None:
+        stage = self.current
+        if stage.prune_start is not None:
+            stage.prune_duration_seconds += time.monotonic() - stage.prune_start
+            stage.prune_start = None
+
+    def record_replay_start(self) -> None:
+        self.current.replay_start = time.monotonic()
+
+    def record_replay_end(self) -> None:
+        stage = self.current
+        if stage.replay_start is not None:
+            stage.replay_duration_seconds += time.monotonic() - stage.replay_start
+            stage.replay_start = None
+
+    def record_minimized_counts(
+        self, deliveries: int, externals: int, timers: int
+    ) -> None:
+        stage = self.current
+        stage.minimized_deliveries = deliveries
+        stage.minimized_externals = externals
+        stage.minimized_timers = timers
+
+    # -- persistence -------------------------------------------------------
+    def to_json(self) -> str:
+        return json.dumps([s.to_json() for s in self.stages], indent=2)
+
+    @classmethod
+    def from_json(cls, text: str) -> "MinimizationStats":
+        stats = cls()
+        stats.stages = [_Stage.from_json(o) for o in json.loads(text)]
+        return stats
+
+    @property
+    def total_replays(self) -> int:
+        return sum(s.total_replays for s in self.stages)

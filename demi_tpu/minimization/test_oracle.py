@@ -1,0 +1,68 @@
+"""TestOracle + ViolationFingerprint: the L4→L5 interface.
+
+Reference: src/main/scala/verification/minification/TestOracle.scala (93 LoC).
+An oracle answers one question: does this external-event subsequence still
+reproduce the target violation? Minimizers are oracle-agnostic; oracles are
+schedulers (STS replay, random, DPOR) or batched device kernels.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable, Optional, Sequence, Tuple
+
+from ..external_events import ExternalEvent
+from ..trace import EventTrace
+
+
+class ViolationFingerprint:
+    """Identity of a safety violation, up to irrelevant detail
+    (reference: TestOracle.scala:9-13)."""
+
+    def matches(self, other: "ViolationFingerprint") -> bool:
+        return self == other
+
+    def affected_nodes(self) -> Tuple[str, ...]:
+        return ()
+
+
+@dataclass(frozen=True)
+class IntViolation(ViolationFingerprint):
+    """Violation identified by an integer code — the device tier's native
+    violation representation (jitted invariants return int32 fingerprints)."""
+
+    code: int
+    nodes: Tuple[str, ...] = ()
+
+    def matches(self, other) -> bool:
+        return isinstance(other, IntViolation) and self.code == other.code
+
+    def affected_nodes(self) -> Tuple[str, ...]:
+        return self.nodes
+
+
+class TestOracle:
+    """test() returns the violating EventTrace if the violation was
+    reproduced with this subsequence, else None
+    (reference: TestOracle.scala:30-55)."""
+
+    def test(
+        self,
+        externals: Sequence[ExternalEvent],
+        violation_fingerprint: Any,
+        stats=None,
+        init: Optional[str] = None,
+    ) -> Optional[EventTrace]:
+        raise NotImplementedError
+
+
+class StatelessTestOracle(TestOracle):
+    """Reconstruct the underlying oracle on every test() call to dodge state
+    leaks between replays (reference: TestOracle.scala:69-93)."""
+
+    def __init__(self, oracle_ctor: Callable[[], TestOracle]):
+        self.oracle_ctor = oracle_ctor
+
+    def test(self, externals, violation_fingerprint, stats=None, init=None):
+        oracle = self.oracle_ctor()
+        return oracle.test(externals, violation_fingerprint, stats=stats, init=init)

@@ -1,0 +1,27 @@
+from .actor import Actor, Context, DSLActorAdapter
+from .system import ControlledActorSystem, PendingEntry, Network
+from .failure_detector import (
+    FDMessageOrchestrator,
+    NodeReachable,
+    NodeUnreachable,
+    ReachableGroup,
+    QueryReachableGroup,
+)
+from .checkpoints import CheckpointRequest, CheckpointReply, CheckpointCollector
+
+__all__ = [
+    "Actor",
+    "Context",
+    "DSLActorAdapter",
+    "ControlledActorSystem",
+    "PendingEntry",
+    "Network",
+    "FDMessageOrchestrator",
+    "NodeReachable",
+    "NodeUnreachable",
+    "ReachableGroup",
+    "QueryReachableGroup",
+    "CheckpointRequest",
+    "CheckpointReply",
+    "CheckpointCollector",
+]

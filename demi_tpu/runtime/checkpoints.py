@@ -1,0 +1,53 @@
+"""Application-state checkpoint protocol for invariant checking.
+
+Reference: src/main/scala/verification/CheckpointCollector.scala (57 LoC).
+The reference broadcasts a ``CheckpointRequest`` message to every live actor
+and collects ``CheckpointReply(data)`` at a placeholder sink. Because our
+runtime is sequential *by construction* (no JVM dispatcher threads to drain),
+the collector can call each live actor's ``checkpoint_state()`` synchronously
+at the point the scheduler requests it — identical observable semantics
+(a snapshot between deliveries), none of the blocking-semaphore protocol
+(reference: ExternalEventInjector.scala:452-485).
+
+Crashed actors map to None (reference: CheckpointCollector.scala:39-49).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, Optional
+
+
+@dataclass(frozen=True)
+class CheckpointRequest:
+    pass
+
+
+@dataclass(frozen=True)
+class CheckpointReply:
+    data: Any
+
+
+def is_checkpoint_message(msg) -> bool:
+    return isinstance(msg, (CheckpointRequest, CheckpointReply))
+
+
+class CheckpointCollector:
+    def collect(self, system) -> Dict[str, Optional[CheckpointReply]]:
+        """Snapshot every active actor's application state.
+
+        Returns {actor name -> CheckpointReply(data) | None}, the shape
+        invariants consume (reference: TestOracle.scala:27). Crashed actors
+        map to None (reference: CheckpointCollector.scala:39-49); so do
+        Kill-isolated ones — they are "failed" from the invariant's point of
+        view (the orchestrator treats Kill as node death,
+        EventOrchestrator.scala:51-59).
+        """
+        out: Dict[str, Optional[CheckpointReply]] = {}
+        for name in system.actor_names():
+            if system.is_crashed(name) or name in system.network.isolated:
+                out[name] = None
+            else:
+                actor = system.actor(name)
+                out[name] = CheckpointReply(actor.checkpoint_state())
+        return out

@@ -1,0 +1,102 @@
+"""Perfect failure detector, simulated by the scheduler.
+
+Reference: src/main/scala/verification/FailureDetector.scala (149 LoC).
+Applications that need failure notifications receive them as ordinary
+messages from the ``__fd__`` endpoint; the "detector" itself is not an actor
+but scheduler-side bookkeeping that enqueues notifications on every
+start/kill/partition event. Being scheduler-driven makes it *perfect*:
+notifications exactly track the orchestrated network state.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Set, Tuple
+
+from ..events import FAILURE_DETECTOR
+
+
+@dataclass(frozen=True)
+class NodeReachable:
+    name: str
+
+
+@dataclass(frozen=True)
+class NodeUnreachable:
+    name: str
+
+
+@dataclass(frozen=True)
+class ReachableGroup:
+    names: Tuple[str, ...]
+
+
+@dataclass(frozen=True)
+class QueryReachableGroup:
+    """Sent by an app actor to __fd__ to ask for the current membership."""
+
+
+_FD_TYPES = (NodeReachable, NodeUnreachable, ReachableGroup, QueryReachableGroup)
+
+
+def is_fd_message(msg) -> bool:
+    return isinstance(msg, _FD_TYPES)
+
+
+class FDMessageOrchestrator:
+    """Tracks per-node reachability and enqueues FD notifications.
+
+    Reference: FDMessageOrchestrator (FailureDetector.scala:44-149). The
+    ``enqueue`` callback injects a message (snd=__fd__) into the controlled
+    system; notifications therefore interleave with the schedule like any
+    other pending message.
+    """
+
+    def __init__(self, enqueue: Callable[[str, str, object], None]):
+        self._enqueue = enqueue
+        self.active: Set[str] = set()
+        self.partitioned: Set[frozenset] = set()
+
+    # -- event hooks (called by the event orchestrator) --------------------
+    def handle_start_event(self, name: str) -> None:
+        for other in sorted(self.active):
+            if other != name:
+                self._enqueue(FAILURE_DETECTOR, other, NodeReachable(name))
+        self.active.add(name)
+        self._send_group(name)
+
+    def handle_kill_event(self, name: str) -> None:
+        self.active.discard(name)
+        for other in sorted(self.active):
+            self._enqueue(FAILURE_DETECTOR, other, NodeUnreachable(name))
+
+    def handle_partition_event(self, a: str, b: str) -> None:
+        self.partitioned.add(frozenset((a, b)))
+        if b in self.active:
+            self._enqueue(FAILURE_DETECTOR, a, NodeUnreachable(b))
+        if a in self.active:
+            self._enqueue(FAILURE_DETECTOR, b, NodeUnreachable(a))
+
+    def handle_unpartition_event(self, a: str, b: str) -> None:
+        self.partitioned.discard(frozenset((a, b)))
+        if b in self.active:
+            self._enqueue(FAILURE_DETECTOR, a, NodeReachable(b))
+        if a in self.active:
+            self._enqueue(FAILURE_DETECTOR, b, NodeReachable(a))
+
+    def handle_query(self, requester: str) -> None:
+        self._send_group(requester)
+
+    def _send_group(self, to: str) -> None:
+        reachable = tuple(
+            sorted(
+                n
+                for n in self.active
+                if frozenset((to, n)) not in self.partitioned or n == to
+            )
+        )
+        self._enqueue(FAILURE_DETECTOR, to, ReachableGroup(reachable))
+
+    def clear(self) -> None:
+        self.active.clear()
+        self.partitioned.clear()

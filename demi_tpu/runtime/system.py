@@ -1,0 +1,261 @@
+"""The controlled actor system: a sequential, fully-interposed event loop.
+
+This is the L2 equivalent of the reference's ``Instrumenter``
+(verification/Instrumenter.scala, 1388 LoC) — with the crucial design
+inversion SURVEY.md §7.1 calls for: the reference *reclaims* control from a
+concurrent JVM dispatcher via weaving, semaphores, and a TellEnqueue
+linearization protocol (AuxilaryTypes.scala:120-145); here the framework
+*owns* the event loop outright, so one-delivery-at-a-time semantics hold by
+construction and none of that machinery exists.
+
+What a delivery does:
+    scheduler picks a PendingEntry -> system.deliver(entry) -> the actor's
+    receive() runs; every send/timer it performs is captured into the
+    returned list of new PendingEntry records (never delivered inline).
+
+Schedulers own the pending-event structures and trace recording (as in the
+reference, Scheduler.scala:13-104); the system owns actors, the simulated
+network, vector clocks, and crash state.
+"""
+
+from __future__ import annotations
+
+import copy
+import itertools
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Set, Tuple
+
+from ..events import EXTERNAL, IdGenerator
+from .actor import Actor, Context
+
+
+@dataclass
+class PendingEntry:
+    """One captured, undelivered event (message send or armed timer).
+
+    ``uid`` links the MsgSend record to its eventual MsgEvent record in the
+    trace (reference: UniqueMsgSend/UniqueMsgEvent sharing ids,
+    EventTrace.scala:16-18)."""
+
+    uid: int
+    snd: str
+    rcv: str
+    msg: Any
+    is_timer: bool = False
+    # Sender's vector clock snapshot at send time (for ShiViz export).
+    vc: Optional[Dict[str, int]] = field(default=None, compare=False, repr=False)
+
+    @property
+    def is_external(self) -> bool:
+        return self.snd == EXTERNAL
+
+    def key(self) -> Tuple[str, str]:
+        return (self.snd, self.rcv)
+
+
+class Network:
+    """Simulated network state: symmetric link cuts + isolated ("Kill"ed)
+    actors. Reference: EventOrchestrator.scala:51-59 (partitioned/
+    inaccessible sets) and crosses_partition:345-351."""
+
+    def __init__(self):
+        self.cut: Set[frozenset] = set()
+        self.isolated: Set[str] = set()
+
+    def partition(self, a: str, b: str) -> None:
+        self.cut.add(frozenset((a, b)))
+
+    def unpartition(self, a: str, b: str) -> None:
+        self.cut.discard(frozenset((a, b)))
+
+    def isolate(self, name: str) -> None:
+        self.isolated.add(name)
+
+    def unisolate(self, name: str) -> None:
+        self.isolated.discard(name)
+
+    def crosses_partition(self, snd: str, rcv: str) -> bool:
+        if snd in self.isolated or rcv in self.isolated:
+            return True
+        return frozenset((snd, rcv)) in self.cut
+
+    def snapshot(self):
+        return (set(self.cut), set(self.isolated))
+
+    def restore(self, snap) -> None:
+        self.cut, self.isolated = set(snap[0]), set(snap[1])
+
+
+class ControlledActorSystem:
+    """Owns the application actors and executes single deliveries on demand."""
+
+    def __init__(self, id_gen: Optional[IdGenerator] = None):
+        self.id_gen = id_gen or IdGenerator()
+        self.actors: Dict[str, Actor] = {}
+        self.crashed: Set[str] = set()
+        self.stopped: Set[str] = set()  # HardKilled names (may be re-Started)
+        self.network = Network()
+        self.vector_clocks: Dict[str, Dict[str, int]] = {}
+        self.log_listener: Optional[Callable[[str, str], None]] = None
+        # Send-capture buffer, active only inside deliver()/spawn().
+        self._capturing: Optional[List[PendingEntry]] = None
+        self._cancelled_timers: List[Tuple[str, Any]] = []
+
+    # -- introspection -----------------------------------------------------
+    def actor_names(self) -> List[str]:
+        return sorted(self.actors.keys())
+
+    def actor(self, name: str) -> Actor:
+        return self.actors[name]
+
+    def is_alive(self, name: str) -> bool:
+        return name in self.actors and name not in self.crashed
+
+    def is_crashed(self, name: str) -> bool:
+        return name in self.crashed
+
+    def deliverable(self, entry: PendingEntry) -> bool:
+        """Would delivering this entry have any effect right now?
+
+        Mirrors the drop-predicate schedulers consult in the reference
+        (RandomScheduler.scala:292, STSScheduler.scala:608)."""
+        if entry.rcv not in self.actors or entry.rcv in self.crashed:
+            return False
+        if entry.is_timer or entry.is_external:
+            return entry.rcv not in self.network.isolated
+        return not self.network.crosses_partition(entry.snd, entry.rcv)
+
+    # -- lifecycle ---------------------------------------------------------
+    def spawn(self, name: str, factory: Callable[[], Actor]) -> List[PendingEntry]:
+        """Create (or re-create after HardKill) an actor; runs on_start with
+        send capture. Returns entries produced during on_start."""
+        if name in self.actors and name not in self.stopped:
+            # Re-Start of an isolated actor = recovery: just un-isolate
+            # (reference: EventOrchestrator.trigger_start:219-231).
+            self.network.unisolate(name)
+            return []
+        self.actors[name] = factory()
+        self.stopped.discard(name)
+        self.crashed.discard(name)
+        self.network.unisolate(name)
+        self.vector_clocks.setdefault(name, {})
+        return self._with_capture(
+            name, lambda ctx: self.actors[name].on_start(ctx)
+        )
+
+    def hard_kill(self, name: str) -> None:
+        """Actually stop the actor (reference:
+        EventOrchestrator.trigger_hard_kill:243-312). The scheduler must
+        scrub its own pending state via Scheduler.actor_terminated."""
+        self.actors.pop(name, None)
+        self.stopped.add(name)
+        self.crashed.discard(name)
+
+    # -- the one delivery --------------------------------------------------
+    def deliver(self, entry: PendingEntry) -> List[PendingEntry]:
+        """Run the receiver's handler for this entry, capturing its effects.
+
+        Raising handlers mark the actor crashed (reference:
+        Instrumenter.actorCrashed:184-199); effects captured before the
+        crash are kept."""
+        assert self.deliverable(entry), f"undeliverable entry {entry!r}"
+        actor = self.actors[entry.rcv]
+        self._merge_vector_clock(entry)
+        try:
+            return self._with_capture(
+                entry.rcv, lambda ctx: actor.receive(ctx, entry.snd, entry.msg)
+            )
+        except Exception:
+            self.crashed.add(entry.rcv)
+            captured = self._capturing or []
+            self._capturing = None
+            return captured
+
+    def run_code_block(self, block: Callable[[], None]) -> List[PendingEntry]:
+        """Execute an external CodeBlock with send capture attributed to
+        EXTERNAL (reference: Instrumenter.scala:934-955)."""
+        return self._with_capture(EXTERNAL, lambda ctx: block())
+
+    # -- send capture ------------------------------------------------------
+    def inject(self, rcv: str, msg: Any) -> PendingEntry:
+        """An externally-injected message (snd = EXTERNAL)."""
+        return PendingEntry(self.id_gen.next(), EXTERNAL, rcv, msg, vc={})
+
+    def inject_from(self, snd: str, rcv: str, msg: Any) -> PendingEntry:
+        """Synthetic-endpoint traffic (failure detector, etc.)."""
+        return PendingEntry(self.id_gen.next(), snd, rcv, msg, vc={})
+
+    def _with_capture(self, name: str, fn: Callable[[Context], None]) -> List[PendingEntry]:
+        assert self._capturing is None, "re-entrant delivery"
+        self._capturing = []
+        ctx = Context(self, name)
+        try:
+            fn(ctx)
+        finally:
+            captured = self._capturing
+            self._capturing = None
+        return captured
+
+    def _capture_send(self, snd: str, rcv: str, msg: Any) -> None:
+        assert self._capturing is not None, "send outside a delivery"
+        vc = dict(self.vector_clocks.get(snd, {}))
+        self._capturing.append(
+            PendingEntry(self.id_gen.next(), snd, rcv, msg, vc=vc)
+        )
+
+    def _capture_timer(self, name: str, msg: Any) -> None:
+        assert self._capturing is not None, "timer armed outside a delivery"
+        self._capturing.append(
+            PendingEntry(self.id_gen.next(), name, name, msg, is_timer=True)
+        )
+
+    def _cancel_timer(self, name: str, msg: Any) -> None:
+        # Also retract it from the capture buffer if armed in this delivery.
+        if self._capturing is not None:
+            self._capturing[:] = [
+                e
+                for e in self._capturing
+                if not (e.is_timer and e.rcv == name and e.msg == msg)
+            ]
+        self._cancelled_timers.append((name, msg))
+
+    def drain_cancelled_timers(self) -> List[Tuple[str, Any]]:
+        """Scheduler hook: timer cancellations since last drain (reference:
+        Scheduler.notify_timer_cancel)."""
+        out = self._cancelled_timers
+        self._cancelled_timers = []
+        return out
+
+    def _capture_log(self, name: str, line: str) -> None:
+        if self.log_listener is not None:
+            self.log_listener(name, line)
+
+    # -- vector clocks (ShiViz export; reference: Util.scala:202-233) ------
+    def _merge_vector_clock(self, entry: PendingEntry) -> None:
+        rcv_clock = self.vector_clocks.setdefault(entry.rcv, {})
+        for actor, t in (entry.vc or {}).items():
+            rcv_clock[actor] = max(rcv_clock.get(actor, 0), t)
+        rcv_clock[entry.rcv] = rcv_clock.get(entry.rcv, 0) + 1
+
+    # -- whole-system checkpoint (for STSSched Peek; reference:
+    # Instrumenter.scala:63-75,1230-1286) -------------------------------
+    def checkpoint(self):
+        return copy.deepcopy(
+            (
+                self.actors,
+                self.crashed,
+                self.stopped,
+                self.network.snapshot(),
+                self.vector_clocks,
+                self.id_gen.state(),
+            )
+        )
+
+    def restore(self, snap) -> None:
+        actors, crashed, stopped, net, vcs, idstate = copy.deepcopy(snap)
+        self.actors = actors
+        self.crashed = crashed
+        self.stopped = stopped
+        self.network.restore(net)
+        self.vector_clocks = vcs
+        self.id_gen.restore(idstate)

@@ -1,0 +1,11 @@
+from .base import BaseScheduler, ExecutionResult, ScheduleHalt
+from .random import RandomScheduler, FullyRandom, SrcDstFIFO
+
+__all__ = [
+    "BaseScheduler",
+    "ExecutionResult",
+    "ScheduleHalt",
+    "RandomScheduler",
+    "FullyRandom",
+    "SrcDstFIFO",
+]

@@ -1,0 +1,315 @@
+"""Scheduler base: the template every delivery policy plugs into.
+
+In the reference, schedulers implement the ``Scheduler`` trait
+(schedulers/Scheduler.scala:13-104) and mix in ``ExternalEventInjector``
+(schedulers/ExternalEventInjector.scala) which owns an ``EventOrchestrator``
+(schedulers/EventOrchestrator.scala). Because our runtime is sequential by
+construction, all three collapse into one straight-line template here:
+
+    execute(externals):
+        repeat:
+            inject external events until a WaitQuiescence/WaitCondition
+            dispatch: loop { choose_next() -> deliver -> capture new pending }
+            on quiescence: advance to the next external segment
+
+Subclasses supply the *policy*: how pending events are stored and which one
+``choose_next`` picks. The base records the EventTrace, runs the failure
+detector, applies Kill/HardKill/Partition semantics, and performs periodic
+invariant checks.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from ..config import SchedulerConfig
+from ..events import (
+    EXTERNAL,
+    BeginWaitQuiescence,
+    CodeBlockEvent,
+    HardKillEvent,
+    KillEvent,
+    MsgEvent,
+    MsgSend,
+    PartitionEvent,
+    Quiescence,
+    SpawnEvent,
+    TimerDelivery,
+    UnPartitionEvent,
+    Unique,
+)
+from ..external_events import (
+    CodeBlock,
+    ExternalEvent,
+    HardKill,
+    Kill,
+    Partition,
+    Send,
+    Start,
+    UnPartition,
+    WaitCondition,
+    WaitQuiescence,
+)
+from ..runtime.checkpoints import CheckpointCollector
+from ..runtime.failure_detector import FDMessageOrchestrator, QueryReachableGroup
+from ..runtime.system import ControlledActorSystem, PendingEntry
+from ..trace import EventTrace
+
+
+class ScheduleHalt(Exception):
+    """Raised by policies to abort the current execution."""
+
+
+@dataclass
+class ExecutionResult:
+    trace: EventTrace
+    violation: Optional[Any]  # ViolationFingerprint or None
+    deliveries: int
+    quiescent: bool  # ended at quiescence (vs. hitting a cap)
+
+
+class BaseScheduler:
+    """Template-method scheduler over a ControlledActorSystem."""
+
+    def __init__(self, config: SchedulerConfig, max_messages: int = 10_000,
+                 invariant_check_interval: int = 0):
+        self.config = config
+        self.max_messages = max_messages
+        # 0 = only check at quiescence / end (reference default behavior;
+        # RandomScheduler's interval checks via setInvariantCheckInterval).
+        self.invariant_check_interval = invariant_check_interval
+        self.system: Optional[ControlledActorSystem] = None
+        self.trace = EventTrace()
+        self.fd: Optional[FDMessageOrchestrator] = None
+        self.checkpointer = CheckpointCollector()
+        self.actor_factories: Dict[str, Callable[[], Any]] = {}
+        self.deliveries = 0
+        self._current_externals: Sequence[ExternalEvent] = ()
+        self.logs: List[Tuple[str, str]] = []
+
+    # ------------------------------------------------------------------
+    # Policy hooks (subclass responsibility)
+    # ------------------------------------------------------------------
+    def add_pending(self, entry: PendingEntry) -> None:
+        raise NotImplementedError
+
+    def choose_next(self) -> Optional[PendingEntry]:
+        """Pick the next entry to deliver, or None for quiescence. Must only
+        return entries that are currently deliverable."""
+        raise NotImplementedError
+
+    def pending_entries(self) -> List[PendingEntry]:
+        """All currently pending entries (for divergence diagnostics)."""
+        raise NotImplementedError
+
+    def actor_terminated(self, name: str) -> None:
+        """Scrub pending state for a HardKilled actor (reference:
+        Scheduler.actorTerminated; RandomScheduler.scala:536-547)."""
+        raise NotImplementedError
+
+    def reset_pending(self) -> None:
+        raise NotImplementedError
+
+    # Optional hooks ----------------------------------------------------
+    def on_delivery(self, unique: Unique, entry: PendingEntry) -> None:
+        pass
+
+    def on_new_pending(self, unique_send: Optional[Unique], entry: PendingEntry) -> None:
+        pass
+
+    def on_quiescence(self) -> None:
+        pass
+
+    # ------------------------------------------------------------------
+    # The engine
+    # ------------------------------------------------------------------
+    def prepare(self, externals: Sequence[ExternalEvent]) -> None:
+        self.system = ControlledActorSystem()
+        self.system.log_listener = self._on_log
+        self.trace = EventTrace(original_externals=list(externals))
+        self.deliveries = 0
+        self.logs = []
+        self.reset_pending()
+        self._current_externals = list(externals)
+        if self.config.enable_failure_detector:
+            self.fd = FDMessageOrchestrator(self._fd_enqueue)
+        else:
+            self.fd = None
+
+    def execute(self, externals: Sequence[ExternalEvent]) -> ExecutionResult:
+        """Run the full external-event program to completion (or a cap),
+        recording the trace; returns the final invariant verdict."""
+        self.prepare(externals)
+        violation = self._run_program(list(externals))
+        if violation is None:
+            violation = self.check_invariant()
+        return ExecutionResult(
+            trace=self.trace,
+            violation=violation,
+            deliveries=self.deliveries,
+            quiescent=self.deliveries < self.max_messages,
+        )
+
+    def _run_program(self, program: List[ExternalEvent]) -> Optional[Any]:
+        cursor = 0
+        violation: Optional[Any] = None
+        while True:
+            cursor, waiting_cond = self._inject_until_wait(program, cursor)
+            violation = self._dispatch_until_quiescence(waiting_cond)
+            self.trace.append(self._unique(Quiescence()))
+            self.on_quiescence()
+            if violation is not None:
+                return violation
+            if cursor >= len(program):
+                return None
+            if self.deliveries >= self.max_messages:
+                return None
+
+    # -- injection phase -------------------------------------------------
+    def _inject_until_wait(
+        self, program: List[ExternalEvent], cursor: int
+    ) -> Tuple[int, Optional[Callable[[], bool]]]:
+        """Interpret external events until a blocking one.
+
+        Reference: EventOrchestrator.inject_until_quiescence
+        (EventOrchestrator.scala:132-189)."""
+        while cursor < len(program):
+            event = program[cursor]
+            cursor += 1
+            if isinstance(event, WaitQuiescence):
+                self.trace.append(self._unique(BeginWaitQuiescence()))
+                return cursor, None
+            if isinstance(event, WaitCondition):
+                return cursor, event.cond
+            self._inject_one(event)
+        return cursor, None
+
+    def _inject_one(self, event: ExternalEvent) -> None:
+        system = self.system
+        if isinstance(event, Start):
+            factory = event.ctor or self.actor_factories.get(event.name)
+            if factory is None:
+                raise ValueError(f"no actor factory for Start({event.name})")
+            self.actor_factories[event.name] = factory
+            new = system.spawn(event.name, factory)
+            self.trace.append(self._unique(SpawnEvent(EXTERNAL, event.name, ctor=factory)))
+            self._absorb(new)
+            if self.fd:
+                self.fd.handle_start_event(event.name)
+        elif isinstance(event, Kill):
+            system.network.isolate(event.name)
+            self.trace.append(self._unique(KillEvent(event.name)))
+            if self.fd:
+                self.fd.handle_kill_event(event.name)
+        elif isinstance(event, HardKill):
+            system.hard_kill(event.name)
+            self.actor_terminated(event.name)
+            self.trace.append(self._unique(HardKillEvent(event.name)))
+            if self.fd:
+                self.fd.handle_kill_event(event.name)
+        elif isinstance(event, Send):
+            entry = system.inject(event.name, event.message())
+            self._record_send(entry)
+        elif isinstance(event, Partition):
+            system.network.partition(event.a, event.b)
+            self.trace.append(self._unique(PartitionEvent(event.a, event.b)))
+            if self.fd:
+                self.fd.handle_partition_event(event.a, event.b)
+        elif isinstance(event, UnPartition):
+            system.network.unpartition(event.a, event.b)
+            self.trace.append(self._unique(UnPartitionEvent(event.a, event.b)))
+            if self.fd:
+                self.fd.handle_unpartition_event(event.a, event.b)
+        elif isinstance(event, CodeBlock):
+            new = system.run_code_block(event.block)
+            self.trace.append(self._unique(CodeBlockEvent(event.label, event.block)))
+            self._absorb(new)
+        else:
+            raise TypeError(f"unknown external event {event!r}")
+
+    # -- dispatch phase --------------------------------------------------
+    def _dispatch_until_quiescence(
+        self, waiting_cond: Optional[Callable[[], bool]]
+    ) -> Optional[Any]:
+        while True:
+            if waiting_cond is not None and waiting_cond():
+                return None  # condition satisfied; next external segment
+            if self.deliveries >= self.max_messages:
+                return None
+            try:
+                entry = self.choose_next()
+            except ScheduleHalt:
+                return None
+            if entry is None:
+                return None
+            self._deliver(entry)
+            if (
+                self.invariant_check_interval
+                and self.deliveries % self.invariant_check_interval == 0
+            ):
+                violation = self.check_invariant()
+                if violation is not None:
+                    return violation
+
+    def _deliver(self, entry: PendingEntry) -> None:
+        system = self.system
+        if entry.is_timer:
+            unique = Unique(TimerDelivery(entry.rcv, entry.msg,
+                                          self.config.fingerprinter.fingerprint(entry.msg)),
+                            entry.uid)
+        else:
+            unique = Unique(MsgEvent(entry.snd, entry.rcv, entry.msg), entry.uid)
+        self.trace.append(unique)
+        self.deliveries += 1
+        if entry.rcv == "__fd__" and self.fd is not None:
+            # Queries addressed to the failure detector are answered by the
+            # scheduler itself (reference: FailureDetector.scala:44-149).
+            if isinstance(entry.msg, QueryReachableGroup):
+                self.fd.handle_query(entry.snd)
+            self.on_delivery(unique, entry)
+            return
+        new = system.deliver(entry)
+        self.on_delivery(unique, entry)
+        self._absorb(new)
+        for name, msg in system.drain_cancelled_timers():
+            self.notify_timer_cancel(name, msg)
+
+    def _absorb(self, new_entries: List[PendingEntry]) -> None:
+        for entry in new_entries:
+            if entry.is_timer:
+                if self.config.ignore_timers:
+                    continue
+                self.add_pending(entry)
+                self.on_new_pending(None, entry)
+            else:
+                self._record_send(entry)
+
+    def _record_send(self, entry: PendingEntry) -> None:
+        unique = Unique(MsgSend(entry.snd, entry.rcv, entry.msg), entry.uid)
+        self.trace.append(unique)
+        self.add_pending(entry)
+        self.on_new_pending(unique, entry)
+
+    def _fd_enqueue(self, snd: str, rcv: str, msg: Any) -> None:
+        entry = self.system.inject_from(snd, rcv, msg)
+        self._record_send(entry)
+
+    def notify_timer_cancel(self, name: str, msg: Any) -> None:
+        """Default: drop the first matching pending timer."""
+        # Subclasses with custom structures override; default uses
+        # pending_entries + a remove hook if provided.
+        pass
+
+    # -- invariant checking ----------------------------------------------
+    def check_invariant(self) -> Optional[Any]:
+        if self.config.invariant_check is None:
+            return None
+        checkpoint = self.checkpointer.collect(self.system)
+        return self.config.invariant_check(self._current_externals, checkpoint)
+
+    def _unique(self, event) -> Unique:
+        return Unique(event, self.system.id_gen.next())
+
+    def _on_log(self, name: str, line: str) -> None:
+        self.logs.append((name, line))

@@ -1,0 +1,381 @@
+"""EventTrace: the recorded execution, plus the trace surgeries minimization needs.
+
+Reference: src/main/scala/verification/EventTrace.scala (568 LoC). The trace
+is an ordered sequence of ``Unique``-wrapped internal events. The key
+operations, all re-derived here:
+
+  - ``subsequence_intersection``: project the original trace onto a DDMin
+    external-event subsequence (EventTrace.scala:290-380).
+  - ``filter_sends``: prune external sends not in the subsequence, by FIFO
+    index against original_externals (EventTrace.scala:382-452).
+  - ``filter_known_absent_internals``: a-priori prune internals that cannot
+    occur (dead senders/receivers, cut links, pruned sends)
+    (EventTrace.scala:458-534). NOTE: the reference flips the partitioned
+    flag's polarity there (PartitionEvent marks the pair *reachable*); we
+    implement the evidently-intended semantics and track pairs symmetrically.
+  - ``recompute_external_msg_sends``: re-bind late-bound Send constructors on
+    replay (EventTrace.scala:235-285).
+  - ``intersection``: apply provenance pruning results (EventTrace.scala:120-180).
+
+The device tier consumes a lowered view of this (integer delivery records);
+see demi_tpu/device/encoding.py.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Iterable, Iterator, List, Optional, Sequence, Set, Tuple
+
+from .events import (
+    EXTERNAL,
+    BeginUnignorableEvents,
+    BeginWaitQuiescence,
+    CodeBlockEvent,
+    EndUnignorableEvents,
+    Event,
+    HardKillEvent,
+    KillEvent,
+    MsgEvent,
+    MsgSend,
+    PartitionEvent,
+    Quiescence,
+    SpawnEvent,
+    TimerDelivery,
+    UnPartitionEvent,
+    Unique,
+    is_meta_event,
+)
+from .external_events import (
+    CodeBlock,
+    ExternalEvent,
+    HardKill,
+    Kill,
+    Partition,
+    Send,
+    Start,
+    UnPartition,
+)
+from .fingerprints import FingerprintFactory
+
+
+class EventTrace:
+    """Ordered sequence of Unique(event) records + the external events that
+    produced it."""
+
+    def __init__(
+        self,
+        events: Optional[Iterable[Unique]] = None,
+        original_externals: Optional[Sequence[ExternalEvent]] = None,
+    ):
+        self.events: List[Unique] = list(events) if events is not None else []
+        self.original_externals: Optional[Sequence[ExternalEvent]] = original_externals
+
+    # -- construction ------------------------------------------------------
+    def append(self, unique: Unique) -> "EventTrace":
+        self.events.append(unique)
+        return self
+
+    def set_original_externals(self, externals: Sequence[ExternalEvent]) -> None:
+        self.original_externals = externals
+
+    def copy(self) -> "EventTrace":
+        return EventTrace(list(self.events), list(self.original_externals or []))
+
+    # -- access ------------------------------------------------------------
+    def __len__(self) -> int:
+        return len(self.events)
+
+    def __iter__(self) -> Iterator[Event]:
+        return (u.event for u in self.events)
+
+    def get_events(self) -> List[Event]:
+        return [u.event for u in self.events]
+
+    @property
+    def last_non_meta_event(self) -> Optional[Unique]:
+        for u in reversed(self.events):
+            if not is_meta_event(u.event):
+                return u
+        return None
+
+    def deliveries(self) -> List[Unique]:
+        return [u for u in self.events if isinstance(u.event, (MsgEvent, TimerDelivery))]
+
+    def pending_msg_sends(self) -> Set[Tuple[str, str, Any]]:
+        """Sends never delivered — sitting in the pool at the end
+        (reference: getPendingMsgSends, EventTrace.scala:61-72)."""
+        delivered_ids = {u.id for u in self.events if isinstance(u.event, MsgEvent)}
+        return {
+            (u.event.snd, u.event.rcv, u.event.msg)
+            for u in self.events
+            if isinstance(u.event, MsgSend) and u.id not in delivered_ids
+        }
+
+    # -- filters -----------------------------------------------------------
+    def filter_failure_detector_messages(self) -> "EventTrace":
+        """Scrub FD traffic: divergent executions need fresh FD responses
+        (reference: EventTrace.scala:192-213)."""
+        from .runtime.failure_detector import is_fd_message
+        from .events import FAILURE_DETECTOR
+
+        def is_fd(event: Event) -> bool:
+            if isinstance(event, (MsgSend, MsgEvent)):
+                if event.rcv == FAILURE_DETECTOR:
+                    return True
+                return event.snd in (EXTERNAL, FAILURE_DETECTOR) and is_fd_message(event.msg)
+            return False
+
+        return EventTrace(
+            [u for u in self.events if not is_fd(u.event)], self.original_externals
+        )
+
+    def filter_checkpoint_messages(self) -> "EventTrace":
+        from .runtime.checkpoints import is_checkpoint_message
+
+        def is_ckpt(event: Event) -> bool:
+            return isinstance(event, (MsgSend, MsgEvent)) and is_checkpoint_message(
+                event.msg
+            )
+
+        return EventTrace(
+            [u for u in self.events if not is_ckpt(u.event)], self.original_externals
+        )
+
+    # -- subsequence projection (the heart of DDMin replay) ----------------
+    def subsequence_intersection(
+        self,
+        subseq: Sequence[ExternalEvent],
+        filter_known_absents: bool = True,
+    ) -> "EventTrace":
+        """Project this trace onto an external-event subsequence: drop
+        external events not in ``subseq`` (matched in order), keep all
+        internal events, then prune sends/deliveries that provably cannot
+        happen. Reference: EventTrace.scala:290-380."""
+        remaining: List[ExternalEvent] = [e for e in subseq if not isinstance(e, Send)]
+        result: List[Unique] = []
+
+        for u in self.events:
+            event = u.event
+            if not remaining:
+                # All non-Send externals matched; keep message events and
+                # internal events only.
+                if isinstance(event, (MsgSend, MsgEvent, TimerDelivery)):
+                    result.append(u)
+                elif not _is_external_marker(event):
+                    result.append(u)
+                continue
+
+            head = remaining[0]
+            matched = False
+            if isinstance(event, KillEvent) and isinstance(head, Kill):
+                matched = event.name == head.name
+            elif isinstance(event, HardKillEvent) and isinstance(head, HardKill):
+                matched = event.name == head.name
+            elif isinstance(event, PartitionEvent) and isinstance(head, Partition):
+                matched = (event.a, event.b) == (head.a, head.b)
+            elif isinstance(event, UnPartitionEvent) and isinstance(head, UnPartition):
+                matched = (event.a, event.b) == (head.a, head.b)
+            elif isinstance(event, SpawnEvent) and isinstance(head, Start):
+                matched = event.name == head.name
+            elif isinstance(event, CodeBlockEvent) and isinstance(head, CodeBlock):
+                matched = event.label == head.label
+
+            if matched:
+                result.append(u)
+                remaining.pop(0)
+            elif _is_external_marker(event):
+                pass  # pruned external
+            else:
+                result.append(u)
+
+        filtered = self._filter_sends(result, subseq, filter_known_absents)
+        return EventTrace(filtered, self.original_externals)
+
+    def _filter_sends(
+        self,
+        events: List[Unique],
+        subseq: Sequence[ExternalEvent],
+        filter_known_absents: bool,
+    ) -> List[Unique]:
+        """Prune external MsgSend/MsgEvent pairs whose Send was removed.
+        External sends are FIFO-matched against original_externals by index
+        (reference: EventTrace.scala:382-452)."""
+        if self.original_externals is None:
+            raise ValueError("original_externals must be set before filtering sends")
+
+        original_sends = [e for e in self.original_externals if isinstance(e, Send)]
+        subseq_send_eids = {e.eid for e in subseq if isinstance(e, Send)}
+        missing_indices = {
+            i for i, s in enumerate(original_sends) if s.eid not in subseq_send_eids
+        }
+
+        msg_send_idx = -1
+        pruned_ids: Set[int] = set()
+        remaining: List[Unique] = []
+        for u in events:
+            event = u.event
+            if isinstance(event, MsgSend) and event.is_external:
+                msg_send_idx += 1
+                if msg_send_idx in missing_indices:
+                    pruned_ids.add(u.id)
+                else:
+                    remaining.append(u)
+            elif isinstance(event, MsgEvent):
+                if u.id not in pruned_ids:
+                    remaining.append(u)
+            else:
+                remaining.append(u)
+
+        if filter_known_absents:
+            return self._filter_known_absent_internals(remaining)
+        return remaining
+
+    @staticmethod
+    def _filter_known_absent_internals(events: List[Unique]) -> List[Unique]:
+        """A-priori prune internals that cannot occur in the subsequence
+        execution: traffic of never-started/killed actors, traffic across
+        cut links, and deliveries of pruned sends
+        (reference: EventTrace.scala:458-534, with the partition-flag
+        polarity corrected and links tracked symmetrically)."""
+        alive: Dict[str, bool] = {EXTERNAL: True}
+        cut: Set[frozenset] = set()
+        pruned_send_ids: Set[int] = set()
+
+        def sendable(snd: str, rcv: str) -> bool:
+            if not alive.get(snd, snd == EXTERNAL):
+                return False
+            return frozenset((snd, rcv)) not in cut
+
+        def deliverable(snd: str, rcv: str, uid: int) -> bool:
+            if not alive.get(rcv, False):
+                return False
+            return frozenset((snd, rcv)) not in cut and uid not in pruned_send_ids
+
+        result: List[Unique] = []
+        for u in events:
+            event = u.event
+            if isinstance(event, MsgSend):
+                if sendable(event.snd, event.rcv):
+                    result.append(u)
+                else:
+                    pruned_send_ids.add(u.id)
+            elif isinstance(event, TimerDelivery):
+                if alive.get(event.rcv, False):
+                    result.append(u)
+            elif isinstance(event, MsgEvent):
+                if deliverable(event.snd, event.rcv, u.id):
+                    result.append(u)
+            elif isinstance(event, SpawnEvent):
+                alive[event.name] = True
+                result.append(u)
+            elif isinstance(event, (KillEvent, HardKillEvent)):
+                alive[event.name] = False
+                result.append(u)
+            elif isinstance(event, PartitionEvent):
+                cut.add(frozenset((event.a, event.b)))
+                result.append(u)
+            elif isinstance(event, UnPartitionEvent):
+                cut.discard(frozenset((event.a, event.b)))
+                result.append(u)
+            else:
+                result.append(u)
+        return result
+
+    # -- replay support ----------------------------------------------------
+    def recompute_external_msg_sends(
+        self, externals: Sequence[ExternalEvent]
+    ) -> List[Event]:
+        """Rebuild external Send payloads via their (possibly masked)
+        late-bound constructors, in FIFO order
+        (reference: EventTrace.scala:235-285)."""
+        sends = [e for e in externals if isinstance(e, Send)]
+        if not sends:
+            return self.get_events()
+        queue = list(sends)
+        result: List[Event] = []
+        for u in self.events:
+            event = u.event
+            if isinstance(event, MsgSend) and event.is_external:
+                if not queue:
+                    raise ValueError(
+                        f"external sends exhausted, yet trace contains {u!r}"
+                    )
+                send = queue.pop(0)
+                result.append(MsgSend(event.snd, event.rcv, send.message()))
+            else:
+                result.append(event)
+        return result
+
+    # -- provenance pruning ------------------------------------------------
+    def intersection(
+        self, kept: Sequence[Unique], fingerprinter: FingerprintFactory
+    ) -> "EventTrace":
+        """Keep only MsgEvents present (in order, by (snd,rcv,fingerprint))
+        in ``kept`` — the output of provenance pruning
+        (reference: EventTrace.scala:120-180)."""
+        want = [
+            (u.event.snd, u.event.rcv, fingerprinter.fingerprint(u.event.msg))
+            for u in kept
+            if isinstance(u.event, MsgEvent) and u.id != 0
+        ]
+        pruned_ids: Set[int] = set()
+        filtered: List[Unique] = []
+        for u in self.events:
+            event = u.event
+            if isinstance(event, MsgEvent):
+                key = (event.snd, event.rcv, fingerprinter.fingerprint(event.msg))
+                if want and key == want[0]:
+                    want.pop(0)
+                    filtered.append(u)
+                else:
+                    pruned_ids.add(u.id)
+            else:
+                filtered.append(u)
+        filtered = [
+            u
+            for u in filtered
+            if not (isinstance(u.event, MsgSend) and u.id in pruned_ids)
+        ]
+        return EventTrace(filtered, self.original_externals)
+
+    def __repr__(self) -> str:
+        return f"EventTrace({len(self.events)} events)"
+
+
+def _is_external_marker(event: Event) -> bool:
+    """Events that are the internal record of an external event."""
+    return isinstance(
+        event,
+        (
+            SpawnEvent,
+            KillEvent,
+            HardKillEvent,
+            PartitionEvent,
+            UnPartitionEvent,
+            CodeBlockEvent,
+        ),
+    )
+
+
+class MetaEventTrace:
+    """EventTrace + violation flag + per-event captured log output
+    (reference: EventTrace.scala:542-568; consumed by Synoptic-style
+    state-machine inference)."""
+
+    def __init__(self, trace: EventTrace):
+        self.trace = trace
+        self.caused_violation = False
+        self.event_to_log_output: Dict[int, List[str]] = {}
+
+    def set_caused_violation(self) -> None:
+        self.caused_violation = True
+
+    def append_log_output(self, msg: str) -> None:
+        last = self.trace.last_non_meta_event
+        key = last.id if last is not None else -1
+        self.event_to_log_output.setdefault(key, []).append(msg)
+
+    def get_ordered_log_output(self) -> List[str]:
+        out: List[str] = []
+        for u in self.trace.events:
+            out.extend(self.event_to_log_output.get(u.id, []))
+        return out

@@ -1,0 +1,3 @@
+from .hostjit import host_jit
+
+__all__ = ["host_jit"]

@@ -1,0 +1,31 @@
+"""host_jit: jit a function pinned to the host CPU backend.
+
+The host oracle runs DSL handlers eagerly, one delivery at a time; compiling
+them for CPU keeps the oracle fast and — crucially — keeps it off the TPU so
+oracle runs never serialize against device-tier sweeps.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+
+@functools.lru_cache(maxsize=1)
+def _cpu_device():
+    import jax
+
+    return jax.local_devices(backend="cpu")[0]
+
+
+def host_jit(fn: Callable) -> Callable:
+    import jax
+
+    jitted = jax.jit(fn)
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        with jax.default_device(_cpu_device()):
+            return jitted(*args, **kwargs)
+
+    return wrapper

@@ -1,0 +1,162 @@
+"""Unit tests for the event model and trace surgeries."""
+
+import pytest
+
+from demi_tpu.events import (
+    EXTERNAL,
+    IdGenerator,
+    KillEvent,
+    MsgEvent,
+    MsgSend,
+    Quiescence,
+    SpawnEvent,
+    Unique,
+    is_meta_event,
+)
+from demi_tpu.external_events import (
+    Kill,
+    MessageConstructor,
+    Send,
+    Start,
+    WaitQuiescence,
+    sanity_check_externals,
+)
+from demi_tpu.fingerprints import FingerprintFactory
+from demi_tpu.trace import EventTrace
+
+
+def test_id_generator_checkpoint():
+    gen = IdGenerator()
+    assert gen.next() == 1
+    state = gen.state()
+    assert gen.next() == 2
+    gen.restore(state)
+    assert gen.next() == 2
+
+
+def test_external_event_identity():
+    k1, k2 = Kill("a"), Kill("a")
+    assert k1 != k2  # identity semantics: same shape, different position
+    assert k1 == k1
+    assert len({k1, k2}) == 2
+
+
+def test_meta_events():
+    assert is_meta_event(Quiescence())
+    assert not is_meta_event(MsgEvent("a", "b", 1))
+
+
+def test_sanity_check_rejects_send_to_unstarted():
+    with pytest.raises(ValueError):
+        sanity_check_externals([Send("ghost", MessageConstructor(lambda: 1))])
+    sanity_check_externals([Start("a"), Send("a", MessageConstructor(lambda: 1))])
+
+
+def _mk_trace():
+    """original externals: Start(a), Start(b), Send(b, m0), Kill(a), Send(b, m1)
+    trace: spawns, ext sends, one internal send+delivery from b->a, kill."""
+    gen = IdGenerator()
+    starts = [Start("a"), Start("b")]
+    sends = [Send("b", MessageConstructor(lambda: ("m", 0))),
+             Send("b", MessageConstructor(lambda: ("m", 1)))]
+    kill = Kill("a")
+    externals = [starts[0], starts[1], sends[0], kill, sends[1], WaitQuiescence()]
+
+    trace = EventTrace(original_externals=externals)
+    trace.append(Unique(SpawnEvent(EXTERNAL, "a"), gen.next()))
+    trace.append(Unique(SpawnEvent(EXTERNAL, "b"), gen.next()))
+    s0 = gen.next()
+    trace.append(Unique(MsgSend(EXTERNAL, "b", ("m", 0)), s0))
+    trace.append(Unique(MsgEvent(EXTERNAL, "b", ("m", 0)), s0))
+    # b reacts by sending to a
+    i0 = gen.next()
+    trace.append(Unique(MsgSend("b", "a", ("reply", 0)), i0))
+    trace.append(Unique(MsgEvent("b", "a", ("reply", 0)), i0))
+    trace.append(Unique(KillEvent("a"), gen.next()))
+    s1 = gen.next()
+    trace.append(Unique(MsgSend(EXTERNAL, "b", ("m", 1)), s1))
+    trace.append(Unique(MsgEvent(EXTERNAL, "b", ("m", 1)), s1))
+    trace.append(Unique(Quiescence(), gen.next()))
+    return trace, externals
+
+
+def test_subsequence_intersection_keeps_all_with_full_subseq():
+    trace, externals = _mk_trace()
+    projected = trace.subsequence_intersection(externals)
+    # Everything except nothing pruned => same message events survive
+    kinds = [type(e).__name__ for e in projected.get_events()]
+    assert kinds.count("MsgEvent") == 3
+    assert kinds.count("SpawnEvent") == 2
+    assert kinds.count("KillEvent") == 1
+
+
+def test_subsequence_intersection_prunes_send():
+    trace, externals = _mk_trace()
+    # Remove the first Send: its MsgSend/MsgEvent pair must vanish.
+    subseq = [e for e in externals if not (isinstance(e, Send) and e.message() == ("m", 0))]
+    projected = trace.subsequence_intersection(subseq)
+    msgs = [e.msg for e in projected.get_events() if isinstance(e, MsgEvent)]
+    assert ("m", 0) not in msgs
+    assert ("m", 1) in msgs
+
+
+def test_subsequence_intersection_prunes_killed_actor_traffic():
+    trace, externals = _mk_trace()
+    # Remove Start(a): all traffic to a is known-absent.
+    subseq = [e for e in externals if not (isinstance(e, Start) and e.name == "a")]
+    projected = trace.subsequence_intersection(subseq)
+    # Deliveries to the never-started actor are known-absent (sends from live
+    # actors still occur — only their delivery can't).
+    for e in projected.get_events():
+        if isinstance(e, MsgEvent):
+            assert e.rcv != "a"
+
+
+def test_subsequence_intersection_prunes_unmatched_kill():
+    trace, externals = _mk_trace()
+    subseq = [e for e in externals if not isinstance(e, Kill)]
+    projected = trace.subsequence_intersection(subseq)
+    assert not any(isinstance(e, KillEvent) for e in projected.get_events())
+    # With Kill(a) gone, replies to a still occur
+    msgs = [e.msg for e in projected.get_events() if isinstance(e, MsgEvent)]
+    assert ("reply", 0) in msgs
+
+
+def test_recompute_external_msg_sends_rebinds():
+    trace, externals = _mk_trace()
+    # Mask: rebuild with a different payload
+    new_sends = [
+        Send("b", MessageConstructor(lambda: ("m", 100))),
+        Send("b", MessageConstructor(lambda: ("m", 101))),
+    ]
+    new_externals = []
+    si = 0
+    for e in externals:
+        if isinstance(e, Send):
+            new_externals.append(new_sends[si])
+            si += 1
+        else:
+            new_externals.append(e)
+    events = trace.recompute_external_msg_sends(new_externals)
+    sends = [e.msg for e in events if isinstance(e, MsgSend) and e.snd == EXTERNAL]
+    assert sends == [("m", 100), ("m", 101)]
+
+
+def test_pending_msg_sends():
+    trace, _ = _mk_trace()
+    gen = IdGenerator(1000)
+    trace.append(Unique(MsgSend("b", "a", ("lost", 9)), gen.next()))
+    assert ("b", "a", ("lost", 9)) in trace.pending_msg_sends()
+
+
+def test_fingerprint_factory_chain():
+    ff = FingerprintFactory()
+    assert ff.fingerprint((1, 2)) == (1, 2)
+    assert ff.fingerprint("x") == "x"
+
+    class Obj:
+        pass
+
+    fp1 = ff.fingerprint(Obj())
+    fp2 = ff.fingerprint(Obj())
+    assert fp1 == fp2  # addresses scrubbed

@@ -1,0 +1,136 @@
+"""End-to-end host-tier tests: controlled runtime + RandomScheduler fuzzing
+the broadcast app, reproducing the seeded bug."""
+
+import pytest
+
+from demi_tpu.apps.broadcast import (
+    TAG_BCAST,
+    broadcast_send_generator,
+    make_broadcast_app,
+)
+from demi_tpu.apps.common import dsl_start_events, make_host_invariant
+from demi_tpu.config import SchedulerConfig
+from demi_tpu.external_events import (
+    Kill,
+    MessageConstructor,
+    Send,
+    Start,
+    WaitQuiescence,
+)
+from demi_tpu.events import MsgEvent
+from demi_tpu.fuzzing import Fuzzer, FuzzerWeights
+from demi_tpu.schedulers import RandomScheduler
+
+
+def _config(app):
+    return SchedulerConfig(invariant_check=make_host_invariant(app))
+
+
+def test_correct_broadcast_no_violation():
+    app = make_broadcast_app(4, reliable=True)
+    sched = RandomScheduler(_config(app), seed=7)
+    program = dsl_start_events(app) + [
+        Send(app.actor_name(0), MessageConstructor(lambda: (TAG_BCAST, 0))),
+        WaitQuiescence(),
+    ]
+    result = sched.execute(program)
+    assert result.violation is None
+    # All 4 actors delivered: 1 external delivery + 3 relays (plus relay
+    # duplicates delivered but ignored)
+    deliveries = [e for e in result.trace.get_events() if isinstance(e, MsgEvent)]
+    assert len(deliveries) >= 4
+
+
+def test_unreliable_broadcast_killed_origin_violates():
+    app = make_broadcast_app(4, reliable=False)
+    sched = RandomScheduler(_config(app), seed=3)
+    # Origin gets the broadcast, relays nothing (bug); kill a receiver's copy
+    # by killing... actually: without relay, only the direct receiver
+    # delivers; everyone else never hears => disagreement at quiescence.
+    program = dsl_start_events(app) + [
+        Send(app.actor_name(0), MessageConstructor(lambda: (TAG_BCAST, 0))),
+        WaitQuiescence(),
+    ]
+    result = sched.execute(program)
+    assert result.violation is not None
+
+
+def test_kill_before_dispatch_drops_external_send():
+    """Injection semantics (matching the reference): consecutive externals
+    inject atomically before dispatch resumes, so Send(n0);Kill(n0) always
+    drops the send — no delivery, no violation, and the isolated actor is
+    excluded from the invariant."""
+    app = make_broadcast_app(4, reliable=True)
+    sched = RandomScheduler(_config(app), seed=11)
+    program = dsl_start_events(app) + [
+        Send(app.actor_name(0), MessageConstructor(lambda: (TAG_BCAST, 0))),
+        Kill(app.actor_name(0)),
+        WaitQuiescence(),
+    ]
+    result = sched.execute(program)
+    assert result.violation is None
+    deliveries = [e for e in result.trace.get_events() if isinstance(e, MsgEvent)]
+    assert len(deliveries) == 0
+
+
+def test_fuzzer_generates_valid_programs():
+    app = make_broadcast_app(3, reliable=True)
+    fuzzer = Fuzzer(
+        num_events=20,
+        weights=FuzzerWeights(kill=0.1, send=0.5, wait_quiescence=0.2),
+        message_gen=broadcast_send_generator(app),
+        prefix=dsl_start_events(app),
+        max_kills=1,
+    )
+    program = fuzzer.generate_fuzz_test(seed=42)
+    assert isinstance(program[-1], WaitQuiescence)
+    assert sum(isinstance(e, Start) for e in program) == 3
+
+
+def test_fuzz_finds_seeded_bug():
+    """The minimum end-to-end fuzz slice: Fuzzer + RandomScheduler discover
+    the unreliable-broadcast disagreement."""
+    app = make_broadcast_app(3, reliable=False)
+    fuzzer = Fuzzer(
+        num_events=12,
+        weights=FuzzerWeights(kill=0.05, send=0.6, wait_quiescence=0.15),
+        message_gen=broadcast_send_generator(app),
+        prefix=dsl_start_events(app),
+        max_kills=1,
+    )
+    sched = RandomScheduler(_config(app), seed=0)
+    found = None
+    for trial in range(10):
+        program = fuzzer.generate_fuzz_test(seed=trial)
+        result = sched.execute(program)
+        if result.violation is not None:
+            found = result
+            break
+    assert found is not None
+
+
+def test_determinism_same_seed_same_trace():
+    app = make_broadcast_app(4, reliable=True)
+    program = dsl_start_events(app) + [
+        Send(app.actor_name(1), MessageConstructor(lambda: (TAG_BCAST, 2))),
+        WaitQuiescence(),
+    ]
+    r1 = RandomScheduler(_config(app), seed=99).execute(program)
+    r2 = RandomScheduler(_config(app), seed=99).execute(program)
+    e1 = [(type(e).__name__, getattr(e, "snd", None), getattr(e, "rcv", None))
+          for e in r1.trace.get_events()]
+    e2 = [(type(e).__name__, getattr(e, "snd", None), getattr(e, "rcv", None))
+          for e in r2.trace.get_events()]
+    assert e1 == e2
+
+
+def test_srcdst_fifo_strategy_runs():
+    app = make_broadcast_app(4, reliable=True)
+    sched = RandomScheduler(_config(app), seed=5, strategy="srcdst_fifo")
+    program = dsl_start_events(app) + [
+        Send(app.actor_name(0), MessageConstructor(lambda: (TAG_BCAST, 1))),
+        WaitQuiescence(),
+    ]
+    result = sched.execute(program)
+    assert result.violation is None
+    assert result.deliveries >= 4
