@@ -88,6 +88,10 @@ def make_broadcast_app(
 
 def broadcast_send_generator(app: DSLApp) -> DSLSendGenerator:
     def make_msg(rng: _random.Random, counter: int) -> Optional[Tuple[int, int]]:
+        # Ids must stay distinct within one program (aliased ids would mask
+        # stranded broadcasts from the agreement invariant); the generator
+        # resets per program, and the fuzzer's futile-guard handles a dry
+        # generator gracefully.
         if counter > MAX_IDS:
             return None
         return (TAG_BCAST, counter - 1)

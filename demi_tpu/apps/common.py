@@ -73,6 +73,9 @@ class DSLSendGenerator:
         self.make_msg = make_msg
         self._counter = 0
 
+    def reset(self) -> None:
+        self._counter = 0
+
     def generate(self, rng: _random.Random, alive: Sequence[str]) -> Optional[Send]:
         if not alive:
             return None

@@ -1,0 +1,11 @@
+from .core import DeviceConfig, ScheduleState
+from .explore import make_explore_kernel, make_single_lane_trace_kernel
+from .replay import make_replay_kernel
+
+__all__ = [
+    "DeviceConfig",
+    "ScheduleState",
+    "make_explore_kernel",
+    "make_single_lane_trace_kernel",
+    "make_replay_kernel",
+]

@@ -1,0 +1,432 @@
+"""Device-tier core: tensor encoding of one schedule's full state, and the
+shared transition machinery (external-op injection, message delivery, pool
+maintenance).
+
+This is the TPU-native replacement for the reference's per-message JVM
+dispatch cycle (SURVEY.md §3.1 hot loop, Instrumenter.scala:913-1109): a
+schedule's *entire* interposition state — actor states, the pending-message
+pool, partitions, timers — lives in fixed-shape int32/bool arrays, and one
+``step`` advances one schedule by one event. ``vmap(step)`` advances
+thousands of candidate interleavings in lockstep; ``lax.scan`` drives the
+step loop under jit.
+
+Dynamic structures become capacity-bounded arrays + masks (SURVEY.md §7.3):
+pool overflow surfaces as an explicit per-lane abort status, never silent
+truncation.
+
+Record encoding (shared by explore *output* traces and replay *input*
+schedules): int32 rows ``(kind, a, b, msg[W])`` with
+  kind 0            = none / padding
+  kind 1            = message delivery   (a=src, b=dst)
+  kind 2            = timer delivery     (a=b=dst)
+  kind 10+op        = external op applied (a, b = op args)
+Host-side lowering lives in demi_tpu/device/encoding.py.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..dsl import DSLApp
+
+# External-op codes (device program encoding of ExternalEvents; WaitCondition
+# and CodeBlock are host-tier-only features — see demi_tpu/dsl.py docstring).
+OP_END = 0
+OP_START = 1
+OP_KILL = 2
+OP_SEND = 3
+OP_WAIT = 4
+OP_PARTITION = 5
+OP_UNPARTITION = 6
+OP_HARDKILL = 7
+
+# Record kinds.
+REC_NONE = 0
+REC_DELIVERY = 1
+REC_TIMER = 2
+REC_EXT_BASE = 10  # REC_EXT_BASE + op
+
+# Lane status.
+ST_DISPATCH = 0
+ST_INJECT = 1
+ST_DONE = 2
+ST_VIOLATION = 3
+ST_OVERFLOW = 4
+
+
+@dataclasses.dataclass(frozen=True)
+class DeviceConfig:
+    """Static shapes/capacities for the device kernels."""
+
+    num_actors: int
+    state_width: int
+    msg_width: int
+    max_outbox: int
+    pool_capacity: int = 256
+    max_external_ops: int = 64
+    max_steps: int = 512
+    invariant_interval: int = 0  # 0 = only at completion
+    record_trace: bool = False
+
+    @property
+    def rec_width(self) -> int:
+        return 3 + self.msg_width
+
+    @staticmethod
+    def for_app(app: DSLApp, **overrides) -> "DeviceConfig":
+        defaults = dict(
+            num_actors=app.num_actors,
+            state_width=app.state_width,
+            msg_width=app.msg_width,
+            max_outbox=app.max_outbox,
+        )
+        defaults.update(overrides)
+        return DeviceConfig(**defaults)
+
+
+class ScheduleState(NamedTuple):
+    """Complete state of one schedule (one lane). All arrays, fixed shapes."""
+
+    actor_state: jnp.ndarray  # [N, S] int32
+    started: jnp.ndarray  # [N] bool
+    isolated: jnp.ndarray  # [N] bool (Kill = isolation)
+    stopped: jnp.ndarray  # [N] bool (HardKill)
+    cut: jnp.ndarray  # [N, N] bool, symmetric partition matrix
+    # Pending pool.
+    pool_valid: jnp.ndarray  # [P] bool
+    pool_src: jnp.ndarray  # [P] int32 (num_actors = EXTERNAL)
+    pool_dst: jnp.ndarray  # [P] int32
+    pool_timer: jnp.ndarray  # [P] bool
+    pool_parked: jnp.ndarray  # [P] bool (timer loop-avoidance)
+    pool_msg: jnp.ndarray  # [P, W] int32
+    pool_seq: jnp.ndarray  # [P] int32 arrival order (FIFO matching)
+    # Timer-parking memory (host: justScheduledTimers keyed (rcv, fp);
+    # device: one remembered timer per actor).
+    timer_mem: jnp.ndarray  # [N, W] int32
+    timer_mem_valid: jnp.ndarray  # [N] bool
+    # Program + bookkeeping.
+    ext_cursor: jnp.ndarray  # int32: next external op
+    seq_counter: jnp.ndarray  # int32
+    deliveries: jnp.ndarray  # int32
+    status: jnp.ndarray  # int32 (ST_*)
+    violation: jnp.ndarray  # int32 fingerprint (0 = none)
+    rng: jnp.ndarray  # PRNG key
+    # Optional trace recording.
+    trace: jnp.ndarray  # [T, rec_width] int32 (or [0,0] when disabled)
+    trace_len: jnp.ndarray  # int32
+
+
+def init_state(app: DSLApp, cfg: DeviceConfig, key) -> ScheduleState:
+    n, s, w, p = cfg.num_actors, cfg.state_width, cfg.msg_width, cfg.pool_capacity
+    init_states = np.stack(
+        [np.asarray(app.init_state(i), np.int32) for i in range(n)]
+    )
+    trace_shape = (cfg.max_steps, cfg.rec_width) if cfg.record_trace else (0, 0)
+    return ScheduleState(
+        actor_state=jnp.asarray(init_states),
+        started=jnp.zeros(n, bool),
+        isolated=jnp.zeros(n, bool),
+        stopped=jnp.zeros(n, bool),
+        cut=jnp.zeros((n, n), bool),
+        pool_valid=jnp.zeros(p, bool),
+        pool_src=jnp.zeros(p, jnp.int32),
+        pool_dst=jnp.zeros(p, jnp.int32),
+        pool_timer=jnp.zeros(p, bool),
+        pool_parked=jnp.zeros(p, bool),
+        pool_msg=jnp.zeros((p, w), jnp.int32),
+        pool_seq=jnp.zeros(p, jnp.int32),
+        timer_mem=jnp.zeros((n, w), jnp.int32),
+        timer_mem_valid=jnp.zeros(n, bool),
+        ext_cursor=jnp.int32(0),
+        seq_counter=jnp.int32(0),
+        deliveries=jnp.int32(0),
+        status=jnp.int32(ST_INJECT),
+        violation=jnp.int32(0),
+        rng=key,
+        trace=jnp.zeros(trace_shape, jnp.int32),
+        trace_len=jnp.int32(0),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Masks
+# ---------------------------------------------------------------------------
+
+def deliverable_mask(state: ScheduleState, cfg: DeviceConfig) -> jnp.ndarray:
+    """Which pool entries could be delivered right now. Mirrors the host
+    ControlledActorSystem.deliverable predicate exactly."""
+    n = cfg.num_actors
+    dst = state.pool_dst
+    src = state.pool_src
+    dst_ok = state.started[dst] & ~state.stopped[dst]
+    dst_reachable = ~state.isolated[dst]
+    src_is_external = src >= n
+    src_clamped = jnp.minimum(src, n - 1)
+    link_cut = state.cut[src_clamped, dst] | state.isolated[src_clamped]
+    # timers/externals only need the receiver un-isolated; internal messages
+    # must not cross a partition (either endpoint isolated or link cut).
+    passes_network = jnp.where(
+        state.pool_timer | src_is_external, True, ~link_cut
+    ) & dst_reachable
+    return state.pool_valid & ~state.pool_parked & dst_ok & passes_network
+
+
+def alive_mask(state: ScheduleState) -> jnp.ndarray:
+    """Actors the invariant should consider (started, not isolated/stopped;
+    host: checkpoint replies None for crashed/isolated actors)."""
+    return state.started & ~state.isolated & ~state.stopped
+
+
+# ---------------------------------------------------------------------------
+# Pool maintenance
+# ---------------------------------------------------------------------------
+
+def insert_rows(
+    state: ScheduleState,
+    cfg: DeviceConfig,
+    row_valid: jnp.ndarray,  # [K] bool
+    row_src: jnp.ndarray,  # [K] int32
+    row_dst: jnp.ndarray,  # [K] int32
+    row_timer: jnp.ndarray,  # [K] bool
+    row_parked: jnp.ndarray,  # [K] bool
+    row_msg: jnp.ndarray,  # [K, W] int32
+) -> ScheduleState:
+    """Scatter up to K new entries into free pool slots. Overflow (more valid
+    rows than free slots) flips the lane status to ST_OVERFLOW."""
+    free = ~state.pool_valid
+    # rank among free slots: 1-indexed prefix count
+    prefix = jnp.cumsum(free.astype(jnp.int32))
+    want = jnp.cumsum(row_valid.astype(jnp.int32))  # i-th valid row wants want[i]-th free slot
+    # slot index for each row: first index where prefix == want[i] and free
+    slots = jnp.searchsorted(prefix, want, side="left")  # [K]
+    n_free = prefix[-1]
+    overflow = jnp.any(row_valid & (want > n_free))
+    ok = row_valid & (want <= n_free)
+    slots = jnp.where(ok, slots, cfg.pool_capacity)  # out-of-range => dropped
+
+    seqs = state.seq_counter + want  # arrival order follows row order
+    new_state = state._replace(
+        pool_valid=state.pool_valid.at[slots].set(True, mode="drop"),
+        pool_src=state.pool_src.at[slots].set(row_src, mode="drop"),
+        pool_dst=state.pool_dst.at[slots].set(row_dst, mode="drop"),
+        pool_timer=state.pool_timer.at[slots].set(row_timer, mode="drop"),
+        pool_parked=state.pool_parked.at[slots].set(row_parked, mode="drop"),
+        pool_msg=state.pool_msg.at[slots].set(row_msg, mode="drop"),
+        pool_seq=state.pool_seq.at[slots].set(seqs, mode="drop"),
+        seq_counter=state.seq_counter + want[-1],
+        status=jnp.where(overflow, jnp.int32(ST_OVERFLOW), state.status),
+    )
+    return new_state
+
+
+def purge_actor(state: ScheduleState, actor: jnp.ndarray) -> ScheduleState:
+    """Invalidate all pool entries touching ``actor`` (HardKill scrub)."""
+    touch = (state.pool_src == actor) | (state.pool_dst == actor)
+    return state._replace(pool_valid=state.pool_valid & ~touch)
+
+
+# ---------------------------------------------------------------------------
+# Delivery
+# ---------------------------------------------------------------------------
+
+def deliver_index(
+    state: ScheduleState, cfg: DeviceConfig, app: DSLApp, idx: jnp.ndarray
+) -> ScheduleState:
+    """Deliver pool entry ``idx``: run the app handler for the receiver,
+    absorb its outbox (with timer parking), consume the entry.
+
+    ``idx`` must point at a deliverable entry; delivering with an invalid
+    index (== pool_capacity) is a no-op enabled by the caller's masking."""
+    n = cfg.num_actors
+    valid_idx = idx < cfg.pool_capacity
+    safe_idx = jnp.minimum(idx, cfg.pool_capacity - 1)
+    src = state.pool_src[safe_idx]
+    dst = state.pool_dst[safe_idx]
+    msg = state.pool_msg[safe_idx]
+    is_timer = state.pool_timer[safe_idx]
+
+    handler_state = state.actor_state[dst]
+    new_row, outbox = app.handler(dst, handler_state, src, msg)
+    # outbox: [K, 2+W] (valid, dst, msg...)
+    k = outbox.shape[0]
+    ob_valid = (outbox[:, 0] != 0) & valid_idx
+    ob_dst = jnp.clip(outbox[:, 1], 0, n - 1)
+    ob_msg = outbox[:, 2:]
+    ob_src = jnp.full((k,), 0, jnp.int32) + dst
+    # Timer classification: self-send with a timer tag.
+    if app.timer_tags:
+        tags = jnp.asarray(list(app.timer_tags), jnp.int32)
+        is_timer_tag = jnp.any(ob_msg[:, 0:1] == tags[None, :], axis=1)
+    else:
+        is_timer_tag = jnp.zeros(k, bool)
+    ob_timer = is_timer_tag & (ob_dst == dst)
+    # Park re-armed copies of the remembered timer (loop avoidance).
+    mem_match = jnp.all(ob_msg == state.timer_mem[ob_dst], axis=1) & state.timer_mem_valid[ob_dst]
+    ob_parked = ob_timer & mem_match
+
+    # Apply handler effects only when the delivery really happened.
+    new_actor_state = state.actor_state.at[dst].set(
+        jnp.where(valid_idx, new_row, handler_state)
+    )
+    # Consume the entry.
+    state = state._replace(
+        actor_state=new_actor_state,
+        pool_valid=state.pool_valid.at[safe_idx].set(
+            jnp.where(valid_idx, False, state.pool_valid[safe_idx])
+        ),
+        deliveries=state.deliveries + valid_idx.astype(jnp.int32),
+    )
+
+    # Timer memory update: delivering a timer remembers it; delivering a
+    # non-timer clears all memory and unparks everything (host semantics:
+    # justScheduledTimers cleared + timersToResend flushed on non-timer
+    # delivery, RandomScheduler.scala:100-117).
+    delivered_timer = is_timer & valid_idx
+    timer_mem = jnp.where(
+        delivered_timer,
+        state.timer_mem.at[dst].set(msg),
+        jnp.where(valid_idx & ~is_timer, jnp.zeros_like(state.timer_mem), state.timer_mem),
+    )
+    timer_mem_valid = jnp.where(
+        delivered_timer,
+        state.timer_mem_valid.at[dst].set(True),
+        jnp.where(
+            valid_idx & ~is_timer,
+            jnp.zeros_like(state.timer_mem_valid),
+            state.timer_mem_valid,
+        ),
+    )
+    pool_parked = jnp.where(
+        valid_idx & ~is_timer, jnp.zeros_like(state.pool_parked), state.pool_parked
+    )
+    state = state._replace(
+        timer_mem=timer_mem, timer_mem_valid=timer_mem_valid, pool_parked=pool_parked
+    )
+
+    state = insert_rows(state, cfg, ob_valid, ob_src, ob_dst, ob_timer, ob_parked, ob_msg)
+    if cfg.record_trace:
+        kind = jnp.where(is_timer, REC_TIMER, REC_DELIVERY)
+        rec = jnp.concatenate(
+            [jnp.stack([kind, src, dst]), msg]
+        )
+        state = _append_record(state, cfg, rec, valid_idx)
+    return state
+
+
+def _append_record(state: ScheduleState, cfg: DeviceConfig, rec, enabled) -> ScheduleState:
+    pos = jnp.minimum(state.trace_len, cfg.max_steps - 1)
+    new_trace = state.trace.at[pos].set(
+        jnp.where(enabled, rec, state.trace[pos])
+    )
+    return state._replace(
+        trace=new_trace, trace_len=state.trace_len + enabled.astype(jnp.int32)
+    )
+
+
+# ---------------------------------------------------------------------------
+# External-op injection
+# ---------------------------------------------------------------------------
+
+def apply_external_op(
+    state: ScheduleState,
+    cfg: DeviceConfig,
+    app: DSLApp,
+    initial_rows: jnp.ndarray,  # [N, K0, 2+W] precomputed initial_msgs per actor
+    init_states: jnp.ndarray,  # [N, S]
+    op: jnp.ndarray,
+    a: jnp.ndarray,
+    b: jnp.ndarray,
+    msg: jnp.ndarray,  # [W]
+) -> ScheduleState:
+    """Apply one external op (Start/Kill/Send/Partition/...) to the lane.
+    Mirrors BaseScheduler._inject_one."""
+    n = cfg.num_actors
+    a_c = jnp.clip(a, 0, n - 1)
+    b_c = jnp.clip(b, 0, n - 1)
+
+    is_start = op == OP_START
+    is_kill = op == OP_KILL
+    is_hardkill = op == OP_HARDKILL
+    is_send = op == OP_SEND
+    is_partition = op == OP_PARTITION
+    is_unpartition = op == OP_UNPARTITION
+
+    was_started = state.started[a_c]
+    was_stopped = state.stopped[a_c]
+    # Fresh start = first Start or restart after HardKill; a Start for a
+    # merely isolated actor is recovery (un-isolate, keep state, no re-emit)
+    # — host semantics: ControlledActorSystem.spawn.
+    fresh_start = is_start & (~was_started | was_stopped)
+    # Start: begin (or recover) actor a.
+    started = state.started.at[a_c].set(
+        jnp.where(is_start, True, state.started[a_c])
+    )
+    isolated = state.isolated.at[a_c].set(
+        jnp.where(is_start, False, jnp.where(is_kill, True, state.isolated[a_c]))
+    )
+    stopped = state.stopped.at[a_c].set(
+        jnp.where(is_start, False, jnp.where(is_hardkill, True, state.stopped[a_c]))
+    )
+    # Start after HardKill resets app state.
+    actor_state = state.actor_state.at[a_c].set(
+        jnp.where(fresh_start, init_states[a_c], state.actor_state[a_c])
+    )
+    cut_val = jnp.where(is_partition, True, jnp.where(is_unpartition, False, state.cut[a_c, b_c]))
+    cut = state.cut.at[a_c, b_c].set(cut_val)
+    cut = cut.at[b_c, a_c].set(cut_val)
+
+    state = state._replace(
+        started=started, isolated=isolated, stopped=stopped,
+        actor_state=actor_state, cut=cut,
+    )
+    state = jax.lax.cond(
+        is_hardkill, lambda s: purge_actor(s, a_c), lambda s: s, state
+    )
+
+    # Start emits the actor's initial rows (fresh-start only, matching host
+    # spawn-on_start; recovery of an isolated actor re-emits nothing).
+    k0 = initial_rows.shape[1]
+    if k0 > 0:
+        rows = initial_rows[a_c]
+        r_valid = (rows[:, 0] != 0) & fresh_start
+        r_dst = jnp.clip(rows[:, 1], 0, n - 1)
+        r_msg = rows[:, 2:]
+        if app.timer_tags:
+            tags = jnp.asarray(list(app.timer_tags), jnp.int32)
+            r_timer = jnp.any(r_msg[:, 0:1] == tags[None, :], axis=1) & (r_dst == a_c)
+        else:
+            r_timer = jnp.zeros(k0, bool)
+        state = insert_rows(
+            state, cfg, r_valid, jnp.full((k0,), a_c), r_dst, r_timer,
+            jnp.zeros(k0, bool), r_msg,
+        )
+
+    # Send: inject external message to actor a.
+    send_valid = jnp.asarray([True])
+    state = insert_rows(
+        state,
+        cfg,
+        send_valid & is_send,
+        jnp.asarray([n], jnp.int32),  # EXTERNAL sender id
+        a_c[None],
+        jnp.asarray([False]),
+        jnp.asarray([False]),
+        msg[None, :],
+    )
+
+    if cfg.record_trace:
+        rec = jnp.concatenate([jnp.stack([REC_EXT_BASE + op, a, b]), msg])
+        enabled = (op != OP_END) & (op != OP_WAIT)
+        state = _append_record(state, cfg, rec, enabled)
+    return state
+
+
+def check_invariant(
+    state: ScheduleState, app: DSLApp
+) -> jnp.ndarray:
+    return app.invariant(state.actor_state, alive_mask(state))

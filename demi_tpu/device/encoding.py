@@ -1,0 +1,195 @@
+"""Host↔device lowering: external-event programs, expected traces, and
+device-trace reconstruction.
+
+The host tier owns trace surgery (subsequence intersection, wildcarding);
+this module lowers its outputs to the int32 record/op encodings the kernels
+consume, and lifts device explore traces back into host EventTraces (by
+guided re-execution on the host oracle, so the lifted trace carries proper
+Unique ids, MsgSends, and markers for the minimization stack).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..dsl import DSLApp
+from ..events import (
+    BeginWaitCondition,
+    BeginWaitQuiescence,
+    HardKillEvent,
+    KillEvent,
+    MsgEvent,
+    MsgSend,
+    PartitionEvent,
+    Quiescence,
+    SpawnEvent,
+    TimerDelivery,
+    UnPartitionEvent,
+)
+from ..external_events import (
+    ExternalEvent,
+    HardKill,
+    Kill,
+    Partition,
+    Send,
+    Start,
+    UnPartition,
+    WaitQuiescence,
+)
+from ..trace import EventTrace
+from .core import (
+    OP_END,
+    OP_HARDKILL,
+    OP_KILL,
+    OP_PARTITION,
+    OP_SEND,
+    OP_START,
+    OP_UNPARTITION,
+    OP_WAIT,
+    REC_DELIVERY,
+    REC_EXT_BASE,
+    REC_NONE,
+    REC_TIMER,
+    DeviceConfig,
+)
+from .explore import ExtProgram
+
+
+def _msg_row(app: DSLApp, msg, width: int) -> List[int]:
+    row = list(int(x) for x in msg)
+    assert len(row) <= width, f"message {msg!r} wider than msg_width={width}"
+    return row + [0] * (width - len(row))
+
+
+def lower_program(
+    app: DSLApp, cfg: DeviceConfig, externals: Sequence[ExternalEvent]
+) -> ExtProgram:
+    """Lower an external-event program to op arrays. WaitCondition/CodeBlock
+    are host-tier-only and rejected here."""
+    e, w = cfg.max_external_ops, cfg.msg_width
+    ops = np.zeros(e, np.int32)
+    a = np.zeros(e, np.int32)
+    b = np.zeros(e, np.int32)
+    msg = np.zeros((e, w), np.int32)
+    if len(externals) > e:
+        raise ValueError(f"program length {len(externals)} > max_external_ops {e}")
+    for i, ev in enumerate(externals):
+        if isinstance(ev, Start):
+            ops[i], a[i] = OP_START, app.actor_id(ev.name)
+        elif isinstance(ev, Kill):
+            ops[i], a[i] = OP_KILL, app.actor_id(ev.name)
+        elif isinstance(ev, HardKill):
+            ops[i], a[i] = OP_HARDKILL, app.actor_id(ev.name)
+        elif isinstance(ev, Send):
+            ops[i], a[i] = OP_SEND, app.actor_id(ev.name)
+            msg[i] = _msg_row(app, ev.message(), w)
+        elif isinstance(ev, WaitQuiescence):
+            ops[i] = OP_WAIT
+        elif isinstance(ev, Partition):
+            ops[i], a[i], b[i] = OP_PARTITION, app.actor_id(ev.a), app.actor_id(ev.b)
+        elif isinstance(ev, UnPartition):
+            ops[i], a[i], b[i] = OP_UNPARTITION, app.actor_id(ev.a), app.actor_id(ev.b)
+        else:
+            raise TypeError(f"{type(ev).__name__} is not lowerable to the device tier")
+    return ExtProgram(op=ops, a=a, b=b, msg=msg)
+
+
+def stack_programs(programs: Sequence[ExtProgram]) -> ExtProgram:
+    return ExtProgram(
+        op=np.stack([p.op for p in programs]),
+        a=np.stack([p.a for p in programs]),
+        b=np.stack([p.b for p in programs]),
+        msg=np.stack([p.msg for p in programs]),
+    )
+
+
+def _actor_or_external(app: DSLApp, name: str) -> int:
+    try:
+        return app.actor_id(name)
+    except (KeyError, ValueError):
+        return app.num_actors
+
+
+def lower_expected_trace(
+    app: DSLApp,
+    cfg: DeviceConfig,
+    trace: EventTrace,
+    externals: Sequence[ExternalEvent],
+    max_records: int,
+) -> np.ndarray:
+    """Lower a projected/filtered EventTrace (the output of
+    subsequence_intersection) into replay records [max_records, rec_width].
+
+    External Send payloads are re-bound via their constructors first, and
+    the corresponding delivery records carry the re-bound payload (uid
+    linkage), so payload shrinking composes with device replay."""
+    w = cfg.msg_width
+    rebound = trace.recompute_external_msg_sends(externals)
+    recs: List[List[int]] = []
+    uid_payload = {}
+    for u, ev in zip(trace.events, rebound):
+        if isinstance(ev, SpawnEvent):
+            recs.append([REC_EXT_BASE + OP_START, app.actor_id(ev.name), 0] + [0] * w)
+        elif isinstance(ev, KillEvent):
+            recs.append([REC_EXT_BASE + OP_KILL, app.actor_id(ev.name), 0] + [0] * w)
+        elif isinstance(ev, HardKillEvent):
+            recs.append([REC_EXT_BASE + OP_HARDKILL, app.actor_id(ev.name), 0] + [0] * w)
+        elif isinstance(ev, PartitionEvent):
+            recs.append(
+                [REC_EXT_BASE + OP_PARTITION, app.actor_id(ev.a), app.actor_id(ev.b)]
+                + [0] * w
+            )
+        elif isinstance(ev, UnPartitionEvent):
+            recs.append(
+                [REC_EXT_BASE + OP_UNPARTITION, app.actor_id(ev.a), app.actor_id(ev.b)]
+                + [0] * w
+            )
+        elif isinstance(ev, MsgSend):
+            if ev.is_external:
+                payload = _msg_row(app, ev.msg, w)
+                uid_payload[u.id] = payload
+                recs.append(
+                    [REC_EXT_BASE + OP_SEND, app.actor_id(ev.rcv), 0] + payload
+                )
+            # internal sends re-occur as delivery side effects
+        elif isinstance(ev, MsgEvent):
+            src = _actor_or_external(app, ev.snd)
+            payload = uid_payload.get(u.id, None)
+            if payload is None:
+                payload = _msg_row(app, ev.msg, w)
+            recs.append([REC_DELIVERY, src, app.actor_id(ev.rcv)] + payload)
+        elif isinstance(ev, TimerDelivery):
+            rid = app.actor_id(ev.rcv)
+            recs.append([REC_TIMER, rid, rid] + _msg_row(app, ev.msg, w))
+        # Quiescence / wait markers have no device meaning in replay.
+    if len(recs) > max_records:
+        raise ValueError(f"expected trace has {len(recs)} records > {max_records}")
+    out = np.zeros((max_records, 3 + w), np.int32)
+    for i, r in enumerate(recs):
+        out[i] = r
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Lifting device explore traces back to host EventTraces
+# ---------------------------------------------------------------------------
+
+def device_trace_to_guide(
+    app: DSLApp, records: np.ndarray, trace_len: int
+) -> List[Tuple]:
+    """Decode a device-recorded trace into a host guide: a list of
+    ("ext", op, a, b, msg) / ("deliver", src, dst, msg, is_timer) steps."""
+    guide: List[Tuple] = []
+    for i in range(int(trace_len)):
+        rec = records[i]
+        kind = int(rec[0])
+        msg = tuple(int(x) for x in rec[3:])
+        if kind == REC_NONE:
+            continue
+        if kind in (REC_DELIVERY, REC_TIMER):
+            guide.append(("deliver", int(rec[1]), int(rec[2]), msg, kind == REC_TIMER))
+        elif kind >= REC_EXT_BASE:
+            guide.append(("ext", kind - REC_EXT_BASE, int(rec[1]), int(rec[2]), msg))
+    return guide

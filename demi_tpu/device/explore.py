@@ -1,0 +1,201 @@
+"""Vmapped random schedule exploration: the device-tier RandomScheduler.
+
+One lane = one candidate schedule. Each scan step either injects one
+external op (injection segments are atomic w.r.t. dispatch, matching the
+host BaseScheduler) or delivers one uniformly-chosen deliverable pool entry.
+``vmap`` advances a whole batch of lanes per XLA step; the driver shards the
+batch axis over the TPU mesh (demi_tpu/parallel).
+
+Replaces the reference hot loop (SURVEY.md §3.1: ~1 ms/message of JVM
+synchronization) with a few fused gathers/scatters per delivered message
+across thousands of lanes at once.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..dsl import DSLApp
+from .core import (
+    OP_END,
+    OP_WAIT,
+    ST_DISPATCH,
+    ST_DONE,
+    ST_INJECT,
+    ST_VIOLATION,
+    DeviceConfig,
+    ScheduleState,
+    apply_external_op,
+    check_invariant,
+    deliver_index,
+    deliverable_mask,
+    init_state,
+)
+
+
+class ExtProgram(NamedTuple):
+    """Per-lane external program, op-encoded (see core.py)."""
+
+    op: jnp.ndarray  # [E] int32
+    a: jnp.ndarray  # [E] int32
+    b: jnp.ndarray  # [E] int32
+    msg: jnp.ndarray  # [E, W] int32
+
+
+class LaneResult(NamedTuple):
+    status: jnp.ndarray  # int32
+    violation: jnp.ndarray  # int32 (0 = none)
+    deliveries: jnp.ndarray  # int32
+    trace: jnp.ndarray  # [T, rec_width] (zero-size when not recording)
+    trace_len: jnp.ndarray  # int32
+
+
+def _precomputed(app: DSLApp, cfg: DeviceConfig):
+    n = cfg.num_actors
+    init_states = np.stack(
+        [np.asarray(app.init_state(i), np.int32) for i in range(n)]
+    )
+    if app.initial_msgs is not None:
+        rows = [np.asarray(app.initial_msgs(i), np.int32) for i in range(n)]
+        k0 = max(r.shape[0] for r in rows)
+        initial_rows = np.zeros((n, k0, 2 + cfg.msg_width), np.int32)
+        for i, r in enumerate(rows):
+            initial_rows[i, : r.shape[0]] = r
+    else:
+        initial_rows = np.zeros((n, 0, 2 + cfg.msg_width), np.int32)
+    return jnp.asarray(init_states), jnp.asarray(initial_rows)
+
+
+def _inject_step(state: ScheduleState, prog: ExtProgram, app, cfg, init_states, initial_rows):
+    e = prog.op.shape[0]
+    cur = jnp.clip(state.ext_cursor, 0, e - 1)
+    op = prog.op[cur]
+    exhausted = state.ext_cursor >= e
+    op = jnp.where(exhausted, OP_END, op)
+    state = apply_external_op(
+        state, cfg, app, initial_rows, init_states, op, prog.a[cur], prog.b[cur], prog.msg[cur]
+    )
+    new_cursor = state.ext_cursor + jnp.where(exhausted, 0, 1).astype(jnp.int32)
+    to_dispatch = (op == OP_WAIT) | (op == OP_END) | (new_cursor >= e)
+    status = jnp.where(
+        state.status == ST_INJECT,
+        jnp.where(to_dispatch, ST_DISPATCH, ST_INJECT),
+        state.status,  # preserve overflow aborts from apply_external_op
+    )
+    return state._replace(ext_cursor=new_cursor, status=status)
+
+
+def _finalize(state: ScheduleState, app, cfg) -> ScheduleState:
+    code = check_invariant(state, app)
+    return state._replace(
+        status=jnp.where(code != 0, ST_VIOLATION, ST_DONE).astype(jnp.int32),
+        violation=code.astype(jnp.int32),
+    )
+
+
+def _dispatch_step(state: ScheduleState, prog: ExtProgram, app, cfg):
+    e = prog.op.shape[0]
+    mask = deliverable_mask(state, cfg)
+    count = jnp.sum(mask.astype(jnp.int32))
+    any_deliverable = count > 0
+
+    key, sub = jax.random.split(state.rng)
+    u = jax.random.uniform(sub)
+    k = jnp.minimum((u * count).astype(jnp.int32), jnp.maximum(count - 1, 0))
+    cum = jnp.cumsum(mask.astype(jnp.int32))
+    idx = jnp.searchsorted(cum, k + 1, side="left").astype(jnp.int32)
+    idx = jnp.where(any_deliverable, idx, jnp.int32(cfg.pool_capacity))
+    state = state._replace(rng=key)
+    state = deliver_index(state, cfg, app, idx)
+
+    if cfg.invariant_interval:
+        due = (state.deliveries % cfg.invariant_interval) == 0
+        code = jnp.where(
+            due & any_deliverable, check_invariant(state, app), jnp.int32(0)
+        )
+        state = state._replace(
+            status=jnp.where(code != 0, jnp.int32(ST_VIOLATION), state.status),
+            violation=jnp.where(code != 0, code.astype(jnp.int32), state.violation),
+        )
+
+    # Quiescence handling (only when nothing was deliverable).
+    cur = jnp.clip(state.ext_cursor, 0, e - 1)
+    program_over = (state.ext_cursor >= e) | (prog.op[cur] == OP_END)
+    quiescent = ~any_deliverable & (state.status == ST_DISPATCH)
+    state = jax.lax.cond(
+        quiescent & program_over,
+        lambda s: _finalize(s, app, cfg),
+        lambda s: s._replace(
+            status=jnp.where(
+                quiescent, jnp.int32(ST_INJECT), s.status
+            )
+        ),
+        state,
+    )
+    return state
+
+
+def make_step_fn(app: DSLApp, cfg: DeviceConfig):
+    init_states, initial_rows = _precomputed(app, cfg)
+
+    def step(state: ScheduleState, prog: ExtProgram) -> ScheduleState:
+        def active(state):
+            return jax.lax.cond(
+                state.status == ST_INJECT,
+                lambda s: _inject_step(s, prog, app, cfg, init_states, initial_rows),
+                lambda s: _dispatch_step(s, prog, app, cfg),
+                state,
+            )
+
+        return jax.lax.cond(state.status >= ST_DONE, lambda s: s, active, state)
+
+    return step
+
+
+def make_run_lane(app: DSLApp, cfg: DeviceConfig):
+    """One lane, program to completion (or step cap): the single source of
+    lane semantics shared by the batch explore kernel and the single-lane
+    trace kernel (the pair whose agreement the device→host lift relies on)."""
+    step = make_step_fn(app, cfg)
+
+    def run_lane(prog: ExtProgram, key) -> LaneResult:
+        state = init_state(app, cfg, key)
+
+        def body(state, _):
+            return step(state, prog), None
+
+        state, _ = jax.lax.scan(body, state, None, length=cfg.max_steps)
+        # Lanes that ran out of steps mid-flight: evaluate the invariant on
+        # whatever was reached (parity: host caps via max_messages then
+        # checks).
+        state = jax.lax.cond(
+            state.status < ST_DONE, lambda s: _finalize(s, app, cfg), lambda s: s, state
+        )
+        return LaneResult(
+            status=state.status,
+            violation=state.violation,
+            deliveries=state.deliveries,
+            trace=state.trace,
+            trace_len=state.trace_len,
+        )
+
+    return run_lane
+
+
+def make_explore_kernel(app: DSLApp, cfg: DeviceConfig):
+    """Returns jitted ``kernel(progs: ExtProgram[B], keys[B]) -> LaneResult[B]``.
+
+    Each lane runs its external program to completion (or a cap) delivering
+    uniformly-random deliverable messages — the device RandomScheduler."""
+    return jax.jit(jax.vmap(make_run_lane(app, cfg)))
+
+
+def make_single_lane_trace_kernel(app: DSLApp, cfg: DeviceConfig):
+    """Single-lane explore with trace recording on: re-runs a violating
+    lane's seed to extract its full delivery record for host reconstruction."""
+    traced_cfg = DeviceConfig(**{**cfg.__dict__, "record_trace": True})
+    return jax.jit(make_run_lane(app, traced_cfg))

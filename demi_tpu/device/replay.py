@@ -1,0 +1,120 @@
+"""Batched schedule replay: the device-tier STS oracle.
+
+Each lane consumes a prescribed record sequence (the host-lowered expected
+trace of one DDMin candidate — see encoding.py): external records are
+applied directly; delivery records are matched against the pending pool by
+(src, dst, exact message) with FIFO (min arrival seq) disambiguation, and
+*skipped when absent* — the STS ignore-absent heuristic
+(reference: STSScheduler.scala:405-559) — so a whole minimization level
+replays as one vmapped batch.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from ..dsl import DSLApp
+from .core import (
+    REC_DELIVERY,
+    REC_EXT_BASE,
+    REC_TIMER,
+    ST_DONE,
+    ST_VIOLATION,
+    DeviceConfig,
+    ScheduleState,
+    apply_external_op,
+    check_invariant,
+    deliver_index,
+    deliverable_mask,
+    init_state,
+)
+from .explore import _precomputed
+
+
+class ReplayResult(NamedTuple):
+    status: jnp.ndarray
+    violation: jnp.ndarray  # int32 final invariant code
+    deliveries: jnp.ndarray
+    ignored_absent: jnp.ndarray  # int32: expected deliveries with no match
+
+
+def make_replay_kernel(app: DSLApp, cfg: DeviceConfig):
+    """Returns jitted ``kernel(records[B, R, rec_width], keys[B]) ->
+    ReplayResult[B]`` replaying each lane's prescribed schedule."""
+    init_states, initial_rows = _precomputed(app, cfg)
+    big = jnp.int32(2**30)
+
+    def replay_record(state: ScheduleState, rec) -> ScheduleState:
+        kind = rec[0]
+        a, b, msg = rec[1], rec[2], rec[3:]
+
+        def apply_ext(state):
+            return apply_external_op(
+                state, cfg, app, initial_rows, init_states,
+                kind - REC_EXT_BASE, a, b, msg,
+            )
+
+        def apply_delivery(state):
+            is_timer_rec = kind == REC_TIMER
+            mask = deliverable_mask(state, cfg)
+            match = (
+                mask
+                & (state.pool_dst == b)
+                & jnp.all(state.pool_msg == msg[None, :], axis=1)
+                & (state.pool_timer == is_timer_rec)
+            )
+            # Timers self-address; messages match on sender too.
+            match = match & (is_timer_rec | (state.pool_src == a))
+            any_match = jnp.any(match)
+            # FIFO: earliest arrival among matches.
+            seqs = jnp.where(match, state.pool_seq, big)
+            idx = jnp.argmin(seqs).astype(jnp.int32)
+            idx = jnp.where(any_match, idx, jnp.int32(cfg.pool_capacity))
+            return deliver_index(state, cfg, app, idx)
+
+        is_ext = kind >= REC_EXT_BASE
+        is_delivery = (kind == REC_DELIVERY) | (kind == REC_TIMER)
+        state = jax.lax.cond(
+            is_ext,
+            apply_ext,
+            lambda s: jax.lax.cond(is_delivery, apply_delivery, lambda x: x, s),
+            state,
+        )
+        return state
+
+    def run_lane(records, key) -> ReplayResult:
+        state = init_state(app, cfg, key)
+
+        def body(carry, rec):
+            state, ignored = carry
+            before = state.deliveries
+            state = jax.lax.cond(
+                state.status >= ST_DONE, lambda s: s, lambda s: replay_record(s, rec), state
+            )
+            was_delivery = (rec[0] == REC_DELIVERY) | (rec[0] == REC_TIMER)
+            skipped = was_delivery & (state.deliveries == before) & (state.status < ST_DONE)
+            return (state, ignored + skipped.astype(jnp.int32)), None
+
+        (state, ignored), _ = jax.lax.scan(body, (state, jnp.int32(0)), records)
+        # Aborted lanes (overflow) must not report a verdict computed from
+        # truncated state — mask their violation to 0 so batched-oracle
+        # consumers reading only `violation` never count them as
+        # reproducing.
+        aborted = state.status >= ST_DONE
+        code = jnp.where(aborted, jnp.int32(0), check_invariant(state, app))
+        status = jnp.where(
+            aborted,
+            state.status,
+            jnp.where(code != 0, ST_VIOLATION, ST_DONE),
+        ).astype(jnp.int32)
+        return ReplayResult(
+            status=status,
+            violation=code.astype(jnp.int32),
+            deliveries=state.deliveries,
+            ignored_absent=ignored,
+        )
+
+    return jax.jit(jax.vmap(run_lane))

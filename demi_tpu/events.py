@@ -159,6 +159,11 @@ class BeginWaitQuiescence(Event):
 
 
 @dataclass(frozen=True)
+class BeginWaitCondition(Event):
+    """Marker: an external WaitCondition began here."""
+
+
+@dataclass(frozen=True)
 class BeginUnignorableEvents(Event):
     """Events until the matching End must not be skipped by ignore-absent
     replay (reference: AuxilaryTypes.scala BeginUnignorableEvents)."""
@@ -183,6 +188,7 @@ class EndExternalAtomicBlock(Event):
 META_EVENT_TYPES = (
     Quiescence,
     BeginWaitQuiescence,
+    BeginWaitCondition,
     BeginUnignorableEvents,
     EndUnignorableEvents,
     BeginExternalAtomicBlock,

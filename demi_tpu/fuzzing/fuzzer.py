@@ -33,6 +33,10 @@ class MessageGenerator:
     def generate(self, rng: _random.Random, alive: Sequence[str]) -> Optional[Send]:
         raise NotImplementedError
 
+    def reset(self) -> None:
+        """Called at the start of each generated program; stateful
+        generators (counters etc.) restart here."""
+
 
 @dataclass
 class FuzzerWeights:
@@ -66,6 +70,7 @@ class Fuzzer:
 
     def generate_fuzz_test(self, seed: int) -> List[ExternalEvent]:
         rng = _random.Random(seed)
+        self.message_gen.reset()
         names = [e.name for e in self.prefix if isinstance(e, Start)]
         alive = list(names)
         kills = 0
@@ -81,7 +86,13 @@ class Fuzzer:
         ]
         total = sum(w for _, w in choices)
         generated = 0
+        futile = 0
         while generated < self.num_events:
+            if futile > 1000:
+                # Every choice is exhausted (send generator dry, kills
+                # capped, ...) — stop with what we have rather than spin.
+                break
+            before = generated
             r = rng.uniform(0, total)
             kind = "send"
             for name, w in choices:
@@ -124,6 +135,7 @@ class Fuzzer:
                     partitions.remove(pair)
                     events.append(UnPartition(*pair))
                     generated += 1
+            futile = futile + 1 if generated == before else 0
 
         events.extend(self.postfix)
         if not events or not isinstance(events[-1], WaitQuiescence):

@@ -45,15 +45,8 @@ class DDMin(Minimizer):
         if self.check_unmodified:
             if self._test(dag) is None:
                 raise RuntimeError("full external sequence does not reproduce")
-        result = self._ddmin2(dag.get_atomic_events(), dag, _empty_view(dag))
+        mcs = self._ddmin2(dag, _empty_view(dag))
         self.stats.record_prune_end()
-        mcs_events = [e for atom in result for e in atom.events]
-        full = dag.get_all_events()
-        order = {e.eid: i for i, e in enumerate(full)}
-        mcs_events.sort(key=lambda e: order[e.eid])
-        mcs = dag.remove_events(
-            [a for a in dag.get_atomic_events() if all(e.eid not in {m.eid for m in mcs_events} for e in a.events)]
-        )
         self.stats.record_minimized_counts(0, len(mcs.get_all_events()), 0)
         return mcs
 
@@ -64,24 +57,31 @@ class DDMin(Minimizer):
         )
 
     # -- internals ---------------------------------------------------------
-    def _ddmin2(
-        self, atoms: List[AtomicEvent], dag: EventDag, remainder: EventDag
-    ) -> List[AtomicEvent]:
+    def _ddmin2(self, dag: EventDag, remainder: EventDag) -> EventDag:
+        """Invariant: test(dag ∪ remainder) reproduces. Returns a sub-dag d'
+        with test(d' ∪ remainder) reproducing.
+
+        Departure from the reference (DeltaDebugging.scala:104-108): in the
+        interference case the *minimized* left half feeds the right half's
+        remainder, which preserves the invariant by induction even for
+        non-monotone oracles (e.g. invariants whose aliveness set shifts when
+        a Kill is pruned) — so the returned MCS always reproduces, rather
+        than needing a post-hoc verify_mcs warning."""
+        atoms = dag.get_atomic_events()
         if len(atoms) <= 1:
-            return atoms
+            return dag
         mid = len(atoms) // 2
-        left, right = atoms[:mid], atoms[mid:]
-        left_dag = dag.remove_events(right)
-        right_dag = dag.remove_events(left)
+        left_dag = dag.remove_events(atoms[mid:])
+        right_dag = dag.remove_events(atoms[:mid])
 
         if self._test(left_dag.union(remainder)) is not None:
-            return self._ddmin2(left, left_dag, remainder)
+            return self._ddmin2(left_dag, remainder)
         if self._test(right_dag.union(remainder)) is not None:
-            return self._ddmin2(right, right_dag, remainder)
-        # Interference: minimize each half, keeping the other in place.
-        kept_left = self._ddmin2(left, left_dag, remainder.union(right_dag))
-        kept_right = self._ddmin2(right, right_dag, remainder.union(left_dag))
-        return kept_left + kept_right
+            return self._ddmin2(right_dag, remainder)
+        # Interference.
+        left_min = self._ddmin2(left_dag, right_dag.union(remainder))
+        right_min = self._ddmin2(right_dag, left_min.union(remainder))
+        return left_min.union(right_min)
 
     def _test(self, candidate: EventDag) -> Optional[EventTrace]:
         self.total_tests += 1

@@ -1,5 +1,12 @@
 from .base import BaseScheduler, ExecutionResult, ScheduleHalt
 from .random import RandomScheduler, FullyRandom, SrcDstFIFO
+from .replay import (
+    ReplayException,
+    ReplayScheduler,
+    STSScheduler,
+    TraceFollowingScheduler,
+    sts_oracle,
+)
 
 __all__ = [
     "BaseScheduler",
@@ -8,4 +15,9 @@ __all__ = [
     "RandomScheduler",
     "FullyRandom",
     "SrcDstFIFO",
+    "ReplayException",
+    "ReplayScheduler",
+    "STSScheduler",
+    "TraceFollowingScheduler",
+    "sts_oracle",
 ]

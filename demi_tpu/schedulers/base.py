@@ -26,6 +26,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 from ..config import SchedulerConfig
 from ..events import (
     EXTERNAL,
+    BeginWaitCondition,
     BeginWaitQuiescence,
     CodeBlockEvent,
     HardKillEvent,
@@ -181,6 +182,7 @@ class BaseScheduler:
                 self.trace.append(self._unique(BeginWaitQuiescence()))
                 return cursor, None
             if isinstance(event, WaitCondition):
+                self.trace.append(self._unique(BeginWaitCondition()))
                 return cursor, event.cond
             self._inject_one(event)
         return cursor, None

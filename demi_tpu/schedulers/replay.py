@@ -1,0 +1,352 @@
+"""Trace-following schedulers: strict replay and STS-style ignore-absent
+replay.
+
+Reference: schedulers/ReplayScheduler.scala (408 LoC) — exact replay that
+dies on nondeterminism — and schedulers/STSScheduler.scala (920 LoC) — the
+workhorse TestOracle for minimization, which *skips* expected-but-absent
+events (the STS heuristic, STSScheduler.scala:74-83,405-559).
+
+Matching policy:
+  - external deliveries are matched to their re-injected sends by the
+    recorded uid linkage (robust to payload re-binding by
+    recompute_external_msg_sends / shrinkSendContents);
+  - internal deliveries by (snd, rcv, fingerprint) FIFO
+    (reference: ReplayScheduler.scala:49-50);
+  - timers by (rcv, fingerprint);
+  - WildCardMatch expected events by selector over the pending pool
+    (reference: STSScheduler.scala:696-708).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from ..config import SchedulerConfig
+from ..events import (
+    EXTERNAL,
+    BeginUnignorableEvents,
+    BeginWaitCondition,
+    BeginWaitQuiescence,
+    CodeBlockEvent,
+    EndUnignorableEvents,
+    Event,
+    HardKillEvent,
+    KillEvent,
+    MsgEvent,
+    MsgSend,
+    PartitionEvent,
+    Quiescence,
+    SpawnEvent,
+    TimerDelivery,
+    UnPartitionEvent,
+    Unique,
+    WildCardMatch,
+)
+from ..external_events import ExternalEvent
+from ..minimization.test_oracle import TestOracle, StatelessTestOracle
+from ..runtime.system import PendingEntry
+from ..trace import EventTrace
+from .base import BaseScheduler, ExecutionResult
+from .random import _violation_matches
+
+
+class ReplayException(Exception):
+    """Nondeterminism detected during strict replay
+    (reference: ReplayScheduler.scala:24-25)."""
+
+
+class _ReplayPending:
+    """Pending pool with the three matching indexes described above."""
+
+    def __init__(self, fingerprinter):
+        self.fingerprinter = fingerprinter
+        self.by_key: Dict[Tuple[str, str, Any], List[PendingEntry]] = {}
+        self.timers: Dict[Tuple[str, Any], List[PendingEntry]] = {}
+        self.by_external_uid: Dict[int, PendingEntry] = {}
+        # Reverse link for O(1) discard (entry identity -> recorded uid).
+        self._ext_uid_of: Dict[int, int] = {}
+        self.all: List[PendingEntry] = []
+
+    def add(self, entry: PendingEntry, external_uid: Optional[int] = None) -> None:
+        self.all.append(entry)
+        if entry.is_timer:
+            key = (entry.rcv, self.fingerprinter.fingerprint(entry.msg))
+            self.timers.setdefault(key, []).append(entry)
+        else:
+            key = (entry.snd, entry.rcv, self.fingerprinter.fingerprint(entry.msg))
+            self.by_key.setdefault(key, []).append(entry)
+            if external_uid is not None:
+                self.by_external_uid[external_uid] = entry
+                self._ext_uid_of[id(entry)] = external_uid
+
+    def _discard(self, entry: PendingEntry) -> None:
+        self.all.remove(entry)
+        if entry.is_timer:
+            key = (entry.rcv, self.fingerprinter.fingerprint(entry.msg))
+            self.timers[key].remove(entry)
+        else:
+            key = (entry.snd, entry.rcv, self.fingerprinter.fingerprint(entry.msg))
+            self.by_key[key].remove(entry)
+            ext_uid = self._ext_uid_of.pop(id(entry), None)
+            if ext_uid is not None:
+                self.by_external_uid.pop(ext_uid, None)
+
+    def pop_external(self, recorded_uid: int) -> Optional[PendingEntry]:
+        entry = self.by_external_uid.get(recorded_uid)
+        if entry is not None:
+            self._discard(entry)
+        return entry
+
+    def pop_internal(self, snd: str, rcv: str, msg: Any) -> Optional[PendingEntry]:
+        key = (snd, rcv, self.fingerprinter.fingerprint(msg))
+        queue = self.by_key.get(key)
+        if queue:
+            entry = queue[0]
+            self._discard(entry)
+            return entry
+        return None
+
+    def pop_timer(self, rcv: str, msg: Any) -> Optional[PendingEntry]:
+        key = (rcv, self.fingerprinter.fingerprint(msg))
+        queue = self.timers.get(key)
+        if queue:
+            entry = queue[0]
+            self._discard(entry)
+            return entry
+        return None
+
+    def pop_wildcard(self, rcv: str, wc: WildCardMatch) -> Optional[PendingEntry]:
+        candidates = [
+            e for e in self.all if e.rcv == rcv and wc.matches(e.msg, self.fingerprinter)
+        ]
+        if not candidates:
+            return None
+        if wc.selector is not None:
+            idx = wc.selector([e.msg for e in candidates])
+            if idx is None:
+                return None
+            entry = candidates[idx]
+        elif wc.policy == "last":
+            entry = candidates[-1]
+        else:
+            entry = candidates[0]
+        self._discard(entry)
+        return entry
+
+    def remove_for_actor(self, name: str) -> None:
+        for entry in [e for e in self.all if e.rcv == name or e.snd == name]:
+            self._discard(entry)
+
+
+class TraceFollowingScheduler(BaseScheduler):
+    """Shared engine for Replay/STS: walk the expected trace, applying
+    external records and delivering matching pending entries."""
+
+    #: what to do when an expected delivery has no pending match:
+    #: "raise" (strict replay) or "ignore" (STS).
+    absent_policy = "raise"
+
+    def __init__(self, config: SchedulerConfig, max_messages: int = 100_000):
+        super().__init__(config, max_messages)
+        self.rpending: Optional[_ReplayPending] = None
+        self.ignored_absent: List[Unique] = []
+        self._unignorable_depth = 0
+
+    # BaseScheduler policy hooks (we bypass its dispatch loop but reuse
+    # prepare/_deliver/_absorb/_record_send plumbing).
+    def reset_pending(self) -> None:
+        self.rpending = _ReplayPending(self.config.fingerprinter)
+        self.ignored_absent = []
+        self._unignorable_depth = 0
+        self._next_external_uid: Optional[int] = None
+
+    def add_pending(self, entry: PendingEntry) -> None:
+        self.rpending.add(entry, external_uid=self._next_external_uid)
+        self._next_external_uid = None
+
+    def pending_entries(self) -> List[PendingEntry]:
+        return list(self.rpending.all)
+
+    def actor_terminated(self, name: str) -> None:
+        self.rpending.remove_for_actor(name)
+
+    def choose_next(self):  # not used by trace-following dispatch
+        return None
+
+    # -- the replay loop ---------------------------------------------------
+    def replay(
+        self,
+        trace: EventTrace,
+        externals: Sequence[ExternalEvent],
+    ) -> ExecutionResult:
+        self.prepare(externals)
+        rebound = trace.recompute_external_msg_sends(externals)
+        expected: List[Unique] = [
+            Unique(ev, u.id) for ev, u in zip(rebound, trace.events)
+        ]
+        violation = None
+        for exp in expected:
+            self._step(exp)
+            if self.deliveries >= self.max_messages:
+                break
+        violation = self.check_invariant()
+        return ExecutionResult(
+            trace=self.trace,
+            violation=violation,
+            deliveries=self.deliveries,
+            quiescent=True,
+        )
+
+    def _step(self, exp: Unique) -> None:
+        event = exp.event
+        if isinstance(event, SpawnEvent):
+            factory = event.ctor or self.actor_factories.get(event.name)
+            if factory is None:
+                raise ReplayException(f"no factory recorded for {event.name}")
+            self.actor_factories[event.name] = factory
+            new = self.system.spawn(event.name, factory)
+            self.trace.append(self._unique(SpawnEvent(EXTERNAL, event.name, ctor=factory)))
+            self._absorb(new)
+            if self.fd:
+                self.fd.handle_start_event(event.name)
+        elif isinstance(event, KillEvent):
+            self.system.network.isolate(event.name)
+            self.trace.append(self._unique(KillEvent(event.name)))
+            if self.fd:
+                self.fd.handle_kill_event(event.name)
+        elif isinstance(event, HardKillEvent):
+            self.system.hard_kill(event.name)
+            self.actor_terminated(event.name)
+            self.trace.append(self._unique(HardKillEvent(event.name)))
+            if self.fd:
+                self.fd.handle_kill_event(event.name)
+        elif isinstance(event, PartitionEvent):
+            self.system.network.partition(event.a, event.b)
+            self.trace.append(self._unique(PartitionEvent(event.a, event.b)))
+            if self.fd:
+                self.fd.handle_partition_event(event.a, event.b)
+        elif isinstance(event, UnPartitionEvent):
+            self.system.network.unpartition(event.a, event.b)
+            self.trace.append(self._unique(UnPartitionEvent(event.a, event.b)))
+            if self.fd:
+                self.fd.handle_unpartition_event(event.a, event.b)
+        elif isinstance(event, CodeBlockEvent):
+            if event.block is not None:
+                new = self.system.run_code_block(event.block)
+                self._absorb(new)
+            self.trace.append(self._unique(CodeBlockEvent(event.label, event.block)))
+        elif isinstance(event, MsgSend):
+            if event.is_external:
+                entry = self.system.inject(event.rcv, event.msg)
+                self._next_external_uid = exp.id
+                self._record_send(entry)
+            # internal sends re-occur as delivery side effects; skip.
+        elif isinstance(event, MsgEvent):
+            self._replay_delivery(exp, event)
+        elif isinstance(event, TimerDelivery):
+            entry = self.rpending.pop_timer(event.rcv, event.msg)
+            if entry is None:
+                self._handle_absent(exp)
+            elif self.system.deliverable(entry):
+                self._deliver(entry)
+        elif isinstance(event, Quiescence):
+            self.trace.append(self._unique(Quiescence()))
+        elif isinstance(event, BeginWaitQuiescence):
+            self.trace.append(self._unique(BeginWaitQuiescence()))
+        elif isinstance(event, BeginWaitCondition):
+            self.trace.append(self._unique(BeginWaitCondition()))
+        elif isinstance(event, BeginUnignorableEvents):
+            self._unignorable_depth += 1
+            self.trace.append(self._unique(event))
+        elif isinstance(event, EndUnignorableEvents):
+            self._unignorable_depth = max(0, self._unignorable_depth - 1)
+            self.trace.append(self._unique(event))
+        # other meta events: ignore
+
+    def _replay_delivery(self, exp: Unique, event: MsgEvent) -> None:
+        if isinstance(event.msg, WildCardMatch):
+            entry = self.rpending.pop_wildcard(event.rcv, event.msg)
+        elif event.is_external:
+            entry = self.rpending.pop_external(exp.id)
+        else:
+            entry = self.rpending.pop_internal(event.snd, event.rcv, event.msg)
+        if entry is None:
+            self._handle_absent(exp)
+            return
+        if self.system.deliverable(entry):
+            self._deliver(entry)
+        # Undeliverable (partitioned/killed receiver): dropped, as recorded
+        # kills/partitions dictate.
+
+    def _handle_absent(self, exp: Unique) -> None:
+        if self.absent_policy == "raise" or self._unignorable_depth > 0:
+            raise ReplayException(
+                f"expected event has no pending match: {exp!r}; "
+                f"pending={[(e.snd, e.rcv) for e in self.rpending.all]!r}"
+            )
+        self.ignored_absent.append(exp)
+
+
+class ReplayScheduler(TraceFollowingScheduler):
+    """Strict deterministic replay (reference: ReplayScheduler.scala)."""
+
+    absent_policy = "raise"
+
+
+class STSScheduler(TraceFollowingScheduler, TestOracle):
+    """STS-style TestOracle: project the original trace onto the candidate
+    external subsequence, replay it skipping expected-but-absent events, and
+    check whether the target violation reappears
+    (reference: STSScheduler.test, STSScheduler.scala:199-310)."""
+
+    absent_policy = "ignore"
+
+    def __init__(
+        self,
+        config: SchedulerConfig,
+        original_trace: EventTrace,
+        max_messages: int = 100_000,
+    ):
+        super().__init__(config, max_messages)
+        self.original_trace = original_trace
+
+    def test(
+        self,
+        externals: Sequence[ExternalEvent],
+        violation_fingerprint: Any,
+        stats=None,
+        init: Optional[str] = None,
+    ) -> Optional[EventTrace]:
+        if stats is not None:
+            stats.record_replay()
+            stats.record_replay_start()
+        filtered = (
+            self.original_trace.filter_failure_detector_messages()
+            .filter_checkpoint_messages()
+            .subsequence_intersection(
+                externals, filter_known_absents=self.config.filter_known_absents
+            )
+        )
+        try:
+            result = self.replay(filtered, externals)
+        except ReplayException:
+            return None
+        finally:
+            if stats is not None:
+                stats.record_replay_end()
+        if result.violation is not None and _violation_matches(
+            violation_fingerprint, result.violation
+        ):
+            result.trace.set_original_externals(list(externals))
+            return result.trace
+        return None
+
+
+def sts_oracle(
+    config: SchedulerConfig, original_trace: EventTrace, **kwargs
+) -> StatelessTestOracle:
+    """Fresh STSScheduler per test() call (state-leak hygiene; reference:
+    StatelessTestOracle, TestOracle.scala:69-93)."""
+    return StatelessTestOracle(
+        lambda: STSScheduler(config, original_trace, **kwargs)
+    )

@@ -28,6 +28,7 @@ from typing import Any, Callable, Dict, Iterable, Iterator, List, Optional, Sequ
 from .events import (
     EXTERNAL,
     BeginUnignorableEvents,
+    BeginWaitCondition,
     BeginWaitQuiescence,
     CodeBlockEvent,
     EndUnignorableEvents,
@@ -53,6 +54,8 @@ from .external_events import (
     Send,
     Start,
     UnPartition,
+    WaitCondition,
+    WaitQuiescence,
 )
 from .fingerprints import FingerprintFactory
 
@@ -157,15 +160,36 @@ class EventTrace:
             event = u.event
             if not remaining:
                 # All non-Send externals matched; keep message events and
-                # internal events only.
+                # internal events only. Wait markers seen here belong to
+                # pruned WaitQuiescence/WaitCondition externals (kept ones
+                # were consumed above) — drop them like other pruned
+                # external records.
                 if isinstance(event, (MsgSend, MsgEvent, TimerDelivery)):
                     result.append(u)
+                elif isinstance(event, (BeginWaitQuiescence, BeginWaitCondition)):
+                    pass
                 elif not _is_external_marker(event):
                     result.append(u)
                 continue
 
             head = remaining[0]
             matched = False
+            # WaitQuiescence/WaitCondition externals are consumed by their
+            # recorded markers — without this the match queue wedges and all
+            # later externals get dropped from the expected trace (a latent
+            # bug in the reference: EventTrace.scala:290-380 has no case
+            # consuming WaitQuiescence from `remaining`).
+            if isinstance(event, BeginWaitQuiescence) and isinstance(head, WaitQuiescence):
+                remaining.pop(0)
+                result.append(u)
+                continue
+            if isinstance(event, BeginWaitCondition) and isinstance(head, WaitCondition):
+                remaining.pop(0)
+                result.append(u)
+                continue
+            if isinstance(event, (BeginWaitQuiescence, BeginWaitCondition)):
+                # Marker whose external was pruned from the subsequence.
+                continue
             if isinstance(event, KillEvent) and isinstance(head, Kill):
                 matched = event.name == head.name
             elif isinstance(event, HardKillEvent) and isinstance(head, HardKill):

@@ -1,0 +1,179 @@
+"""Device-tier tests: vmapped explore kernel, batched replay kernel, and
+device↔host parity via guided re-execution."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from demi_tpu.apps.broadcast import TAG_BCAST, make_broadcast_app
+from demi_tpu.apps.common import dsl_start_events, make_host_invariant
+from demi_tpu.config import SchedulerConfig
+from demi_tpu.device import DeviceConfig, make_explore_kernel, make_replay_kernel
+from demi_tpu.device.core import ST_DISPATCH, ST_DONE, ST_OVERFLOW, ST_VIOLATION
+from demi_tpu.device.encoding import (
+    device_trace_to_guide,
+    lower_expected_trace,
+    lower_program,
+    stack_programs,
+)
+from demi_tpu.device.explore import make_single_lane_trace_kernel
+from demi_tpu.external_events import (
+    Kill,
+    MessageConstructor,
+    Send,
+    WaitQuiescence,
+)
+from demi_tpu.schedulers import RandomScheduler, sts_oracle
+from demi_tpu.schedulers.guided import GuidedScheduler
+
+
+def _program(app, *extra):
+    return dsl_start_events(app) + list(extra) + [WaitQuiescence()]
+
+
+def _send(app, actor, bid):
+    return Send(app.actor_name(actor), MessageConstructor(lambda: (TAG_BCAST, bid)))
+
+
+def test_explore_unreliable_all_lanes_violate():
+    app = make_broadcast_app(3, reliable=False)
+    cfg = DeviceConfig.for_app(app, pool_capacity=64, max_steps=64, max_external_ops=8)
+    kernel = make_explore_kernel(app, cfg)
+    prog = lower_program(app, cfg, _program(app, _send(app, 0, 0)))
+    batch = 32
+    progs = stack_programs([prog] * batch)
+    keys = jax.random.split(jax.random.PRNGKey(0), batch)
+    res = kernel(progs, keys)
+    assert np.all(np.asarray(res.status) == ST_VIOLATION)
+    assert np.all(np.asarray(res.violation) == 1)
+    assert np.all(np.asarray(res.deliveries) == 1)
+
+
+def test_explore_reliable_no_violation():
+    app = make_broadcast_app(3, reliable=True)
+    cfg = DeviceConfig.for_app(app, pool_capacity=64, max_steps=64, max_external_ops=8)
+    kernel = make_explore_kernel(app, cfg)
+    prog = lower_program(app, cfg, _program(app, _send(app, 0, 0), _send(app, 1, 1)))
+    batch = 32
+    progs = stack_programs([prog] * batch)
+    keys = jax.random.split(jax.random.PRNGKey(1), batch)
+    res = kernel(progs, keys)
+    assert np.all(np.asarray(res.status) == ST_DONE)
+    assert np.all(np.asarray(res.violation) == 0)
+    # 2 broadcasts fully relayed among 3 actors: 2 * (1 + 2 relays delivered
+    # + duplicate relays) — at least 6 deliveries.
+    assert np.all(np.asarray(res.deliveries) >= 6)
+
+
+def test_explore_matches_host_on_deterministic_program():
+    """Single possible interleaving → device and host must agree exactly."""
+    app = make_broadcast_app(2, reliable=False)
+    cfg = DeviceConfig.for_app(app, pool_capacity=32, max_steps=32, max_external_ops=8)
+    program = _program(app, _send(app, 1, 3))
+    host = RandomScheduler(
+        SchedulerConfig(invariant_check=make_host_invariant(app)), seed=5
+    ).execute(program)
+    kernel = make_explore_kernel(app, cfg)
+    progs = stack_programs([lower_program(app, cfg, program)])
+    res = kernel(progs, jax.random.split(jax.random.PRNGKey(2), 1))
+    host_code = host.violation.code if host.violation else 0
+    assert int(res.violation[0]) == host_code == 1
+    assert int(res.deliveries[0]) == host.deliveries == 1
+
+
+def test_traced_lane_lifts_to_host_and_agrees():
+    """Explore with kills; re-run a violating lane traced; guided host
+    re-execution must reach the same violation."""
+    app = make_broadcast_app(4, reliable=True)
+    cfg = DeviceConfig.for_app(app, pool_capacity=128, max_steps=128, max_external_ops=16)
+    kernel = make_explore_kernel(app, cfg)
+    # Kill n1 after a quiescent period in which it may have partially relayed.
+    program = dsl_start_events(app) + [
+        _send(app, 1, 0),
+        WaitQuiescence(),
+        _send(app, 2, 1),
+        Kill(app.actor_name(2)),
+        WaitQuiescence(),
+    ]
+    batch = 64
+    progs = stack_programs([lower_program(app, cfg, program)] * batch)
+    keys = jax.random.split(jax.random.PRNGKey(7), batch)
+    res = kernel(progs, keys)
+    statuses = np.asarray(res.status)
+    assert set(statuses.tolist()) <= {ST_DONE, ST_VIOLATION}
+
+    # Every lane (violating or not) must lift cleanly and agree with host.
+    traced = make_single_lane_trace_kernel(app, cfg)
+    check = [int(i) for i in np.nonzero(statuses == ST_VIOLATION)[0][:2]]
+    check += [int(i) for i in np.nonzero(statuses == ST_DONE)[0][:2]]
+    assert check, "expected at least one lane to check"
+    for lane in check:
+        single = traced(
+            jax.tree_util.tree_map(lambda x: x[lane], progs), keys[lane]
+        )
+        assert int(single.violation) == int(res.violation[lane])
+        guide = device_trace_to_guide(
+            app, np.asarray(single.trace), int(single.trace_len)
+        )
+        gs = GuidedScheduler(
+            SchedulerConfig(invariant_check=make_host_invariant(app)), app
+        )
+        host_result = gs.execute_guide(guide)
+        host_code = host_result.violation.code if host_result.violation else 0
+        assert host_code == int(res.violation[lane])
+
+
+def test_replay_kernel_matches_host_sts_oracle():
+    """Lower DDMin-style candidates and compare device replay verdicts with
+    the host STS oracle."""
+    app = make_broadcast_app(3, reliable=False)
+    config = SchedulerConfig(invariant_check=make_host_invariant(app))
+    starts = dsl_start_events(app)
+    s0, s1 = _send(app, 0, 0), _send(app, 1, 1)
+    program = starts + [s0, s1, WaitQuiescence()]
+    result = RandomScheduler(config, seed=3).execute(program)
+    assert result.violation is not None
+
+    cfg = DeviceConfig.for_app(app, pool_capacity=64, max_steps=64, max_external_ops=8)
+    kernel = make_replay_kernel(app, cfg)
+    oracle = sts_oracle(config, result.trace)
+
+    candidates = [
+        program,  # full
+        starts + [s0, WaitQuiescence()],  # drop second send
+        starts[:2] + [s0, WaitQuiescence()],  # drop third actor + second send
+        starts[:1] + [s0, WaitQuiescence()],  # single actor: no disagreement
+    ]
+    records = np.stack(
+        [
+            lower_expected_trace(
+                app,
+                cfg,
+                result.trace.filter_failure_detector_messages()
+                .filter_checkpoint_messages()
+                .subsequence_intersection(c),
+                c,
+                max_records=64,
+            )
+            for c in candidates
+        ]
+    )
+    keys = jax.random.split(jax.random.PRNGKey(0), len(candidates))
+    res = kernel(records, keys)
+    device_verdicts = [int(v) == 1 for v in res.violation]
+    host_verdicts = [
+        oracle.test(c, result.violation) is not None for c in candidates
+    ]
+    assert device_verdicts == host_verdicts
+    assert device_verdicts == [True, True, True, False]
+
+
+def test_pool_overflow_flags_lane():
+    app = make_broadcast_app(8, reliable=True)
+    cfg = DeviceConfig.for_app(app, pool_capacity=8, max_steps=64, max_external_ops=16)
+    kernel = make_explore_kernel(app, cfg)
+    program = _program(app, _send(app, 0, 0))  # relays overflow an 8-slot pool
+    progs = stack_programs([lower_program(app, cfg, program)])
+    res = kernel(progs, jax.random.split(jax.random.PRNGKey(0), 1))
+    assert int(res.status[0]) == ST_OVERFLOW

@@ -1,0 +1,125 @@
+"""Replay determinism + DDMin-over-STS minimization end-to-end:
+the host-tier equivalent of SURVEY.md §7.4's minimum slice."""
+
+import pytest
+
+from demi_tpu.apps.broadcast import (
+    TAG_BCAST,
+    broadcast_send_generator,
+    make_broadcast_app,
+)
+from demi_tpu.apps.common import dsl_start_events, make_host_invariant
+from demi_tpu.config import SchedulerConfig
+from demi_tpu.events import MsgEvent
+from demi_tpu.external_events import (
+    Kill,
+    MessageConstructor,
+    Send,
+    Start,
+    WaitQuiescence,
+)
+from demi_tpu.fuzzing import Fuzzer, FuzzerWeights
+from demi_tpu.minimization import DDMin, LeftToRightRemoval, MinimizationStats
+from demi_tpu.minimization.ddmin import make_dag
+from demi_tpu.schedulers import RandomScheduler, ReplayScheduler, sts_oracle
+
+
+def _config(app):
+    return SchedulerConfig(invariant_check=make_host_invariant(app))
+
+
+def _find_violation(app, seeds=range(20), n_events=12):
+    fuzzer = Fuzzer(
+        num_events=n_events,
+        weights=FuzzerWeights(kill=0.05, send=0.6, wait_quiescence=0.15),
+        message_gen=broadcast_send_generator(app),
+        prefix=dsl_start_events(app),
+        max_kills=1,
+    )
+    sched = RandomScheduler(_config(app), seed=0)
+    for trial in seeds:
+        program = fuzzer.generate_fuzz_test(seed=trial)
+        result = sched.execute(program)
+        if result.violation is not None:
+            return program, result
+    raise AssertionError("fuzzing found no violation")
+
+
+def test_replay_reproduces_fuzzed_violation():
+    app = make_broadcast_app(3, reliable=False)
+    program, result = _find_violation(app)
+    replayer = ReplayScheduler(_config(app))
+    replayed = replayer.replay(result.trace, program)
+    assert replayed.violation is not None
+    assert replayed.violation.matches(result.violation)
+    # Same deliveries in the same order.
+    orig = [
+        (e.snd, e.rcv, e.msg)
+        for e in result.trace.get_events()
+        if isinstance(e, MsgEvent)
+    ]
+    new = [
+        (e.snd, e.rcv, e.msg)
+        for e in replayed.trace.get_events()
+        if isinstance(e, MsgEvent)
+    ]
+    assert orig == new
+
+
+def test_sts_oracle_reproduces_with_full_sequence():
+    app = make_broadcast_app(3, reliable=False)
+    program, result = _find_violation(app)
+    oracle = sts_oracle(_config(app), result.trace)
+    stats = MinimizationStats()
+    stats.update_strategy("noop", "STSScheduler")
+    trace = oracle.test(program, result.violation, stats=stats)
+    assert trace is not None
+    assert stats.total_replays == 1
+
+
+def test_ddmin_minimizes_broadcast_bug():
+    app = make_broadcast_app(3, reliable=False)
+    program, result = _find_violation(app)
+    oracle = sts_oracle(_config(app), result.trace)
+    ddmin = DDMin(oracle, check_unmodified=True)
+    dag = make_dag(program)
+    mcs = ddmin.minimize(dag, result.violation)
+    mcs_events = mcs.get_all_events()
+    # Minimal cause: two Starts (one deliverer, one non-deliverer) + one Send.
+    assert len(mcs_events) <= 4, mcs_events
+    sends = [e for e in mcs_events if isinstance(e, Send)]
+    starts = [e for e in mcs_events if isinstance(e, Start)]
+    assert len(sends) >= 1
+    assert len(starts) >= 2
+    # And the MCS must itself reproduce (verify_mcs).
+    assert ddmin.verify_mcs(mcs, result.violation) is not None
+
+
+def test_left_to_right_removal():
+    app = make_broadcast_app(3, reliable=False)
+    program, result = _find_violation(app)
+    oracle = sts_oracle(_config(app), result.trace)
+    minimizer = LeftToRightRemoval(oracle)
+    mcs = minimizer.minimize(make_dag(program), result.violation)
+    assert len(mcs.get_all_events()) <= len(program)
+    assert (
+        oracle.test(mcs.get_all_events(), result.violation, stats=MinimizationStats())
+        is not None
+    )
+
+
+def test_sts_prunes_and_still_reproduces_specific():
+    """Hand-built scenario: disagreement needs only Start(n0), Start(n1),
+    Send(n0). STS must reproduce after DDMin prunes the irrelevant kill."""
+    app = make_broadcast_app(3, reliable=False)
+    cfg = _config(app)
+    starts = dsl_start_events(app)
+    send0 = Send(app.actor_name(0), MessageConstructor(lambda: (TAG_BCAST, 0)))
+    kill2 = Kill(app.actor_name(2))
+    program = starts + [send0, kill2, WaitQuiescence()]
+    result = RandomScheduler(cfg, seed=1).execute(program)
+    assert result.violation is not None
+    oracle = sts_oracle(cfg, result.trace)
+    # Candidate without the kill (and its paired Start must stay).
+    subseq = starts + [send0, WaitQuiescence()]
+    assert oracle.test(subseq, result.violation) is not None
