@@ -1,0 +1,78 @@
+"""Benchmark: unique schedules explored per second per chip.
+
+Prints ONE JSON line {"metric", "value", "unit", "vs_baseline"}.
+
+Workload: random schedule exploration (fuzzing) of a 5-actor reliable-
+broadcast DSL app with fault injection in the program — the raft-class
+5-node workload class from BASELINE.md (switches to the Raft fixture once
+it lands). ``vs_baseline`` is value / 10,000 — the BASELINE.json north-star
+target of ≥10k schedules/sec/chip (the reference publishes no numbers and
+its JVM cannot run in this image; BASELINE.md records this).
+"""
+
+import json
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+
+    from demi_tpu.apps.broadcast import make_broadcast_app
+    from demi_tpu.apps.common import dsl_start_events
+    from demi_tpu.device import DeviceConfig, make_explore_kernel
+    from demi_tpu.device.encoding import lower_program, stack_programs
+    from demi_tpu.external_events import (
+        Kill,
+        MessageConstructor,
+        Send,
+        WaitQuiescence,
+    )
+
+    app = make_broadcast_app(5, reliable=True)
+    cfg = DeviceConfig.for_app(
+        app, pool_capacity=96, max_steps=96, max_external_ops=16
+    )
+    # A raft-class program: sends + a fault + quiescence barriers.
+    program = dsl_start_events(app) + [
+        Send(app.actor_name(0), MessageConstructor(lambda: (1, 0))),
+        WaitQuiescence(),
+        Send(app.actor_name(1), MessageConstructor(lambda: (1, 1))),
+        Kill(app.actor_name(1)),
+        WaitQuiescence(),
+        Send(app.actor_name(2), MessageConstructor(lambda: (1, 2))),
+        WaitQuiescence(),
+    ]
+    batch = 2048
+    kernel = make_explore_kernel(app, cfg)
+    progs = stack_programs([lower_program(app, cfg, program)] * batch)
+    keys = jax.random.split(jax.random.PRNGKey(0), batch)
+
+    # Warm-up / compile.
+    res = kernel(progs, keys)
+    jax.block_until_ready(res)
+
+    reps = 5
+    t0 = time.perf_counter()
+    for r in range(1, reps + 1):
+        keys_r = jax.random.split(jax.random.PRNGKey(r), batch)
+        res = kernel(progs, keys_r)
+    jax.block_until_ready(res)
+    elapsed = time.perf_counter() - t0
+
+    schedules_per_sec = reps * batch / elapsed
+    print(
+        json.dumps(
+            {
+                "metric": "unique schedules explored/sec/chip (5-actor broadcast fuzz, faults)",
+                "value": round(schedules_per_sec, 1),
+                "unit": "schedules/sec",
+                "vs_baseline": round(schedules_per_sec / 10_000.0, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -41,9 +41,9 @@ class ReplayResult(NamedTuple):
     ignored_absent: jnp.ndarray  # int32: expected deliveries with no match
 
 
-def make_replay_kernel(app: DSLApp, cfg: DeviceConfig):
-    """Returns jitted ``kernel(records[B, R, rec_width], keys[B]) ->
-    ReplayResult[B]`` replaying each lane's prescribed schedule."""
+def make_replay_run_lane(app: DSLApp, cfg: DeviceConfig):
+    """Unjitted single-lane replay ``run_lane(records, key) -> ReplayResult``
+    (composable with vmap/jit/shardings by callers)."""
     init_states, initial_rows = _precomputed(app, cfg)
     big = jnp.int32(2**30)
 
@@ -117,4 +117,10 @@ def make_replay_kernel(app: DSLApp, cfg: DeviceConfig):
             ignored_absent=ignored,
         )
 
-    return jax.jit(jax.vmap(run_lane))
+    return run_lane
+
+
+def make_replay_kernel(app: DSLApp, cfg: DeviceConfig):
+    """Returns jitted ``kernel(records[B, R, rec_width], keys[B]) ->
+    ReplayResult[B]`` replaying each lane's prescribed schedule."""
+    return jax.jit(jax.vmap(make_replay_run_lane(app, cfg)))

@@ -1,0 +1,15 @@
+from .mesh import (
+    make_mesh,
+    pad_batch_to_devices,
+    shard_explore_kernel,
+    shard_replay_kernel,
+    sweep_sharding,
+)
+
+__all__ = [
+    "make_mesh",
+    "pad_batch_to_devices",
+    "shard_explore_kernel",
+    "shard_replay_kernel",
+    "sweep_sharding",
+]

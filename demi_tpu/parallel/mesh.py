@@ -1,0 +1,76 @@
+"""Mesh sharding of schedule sweeps: the distributed backend.
+
+The reference's "distributed communication" is interposed Akka messaging in
+one JVM (SURVEY.md §2.9); its only scale-out is shell-looped experiments.
+Here the scale-out axes are real (SURVEY.md §2.8, BASELINE north star):
+
+  - ``lanes`` — the schedule batch. Embarrassingly parallel: each lane's
+    state (actor states + pool) lives resident on its device; XLA inserts
+    no collectives inside a lane. Sharding the batch over ICI scales
+    schedules/sec linearly with chips in a slice.
+  - multi-slice sweeps (DCN) are plain program-level splits: each slice
+    takes a disjoint seed/program range (see sweep.py); only violation
+    summaries return to host, so DCN traffic is O(batch), not O(state).
+
+A 2-D mesh (``replica`` × ``shard``) is supported by collapsing both axes
+onto the lane batch — the natural layout when embedding sweeps inside a
+larger job's mesh. Cross-lane reductions (e.g. "any violation in batch",
+violation histograms) are jnp reductions over the sharded axis, which XLA
+lowers to psum-style collectives over ICI.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..dsl import DSLApp
+from ..device.core import DeviceConfig
+from ..device.explore import make_run_lane
+
+
+LANES = "lanes"
+
+
+def make_mesh(devices: Optional[Sequence] = None, axis: str = LANES) -> Mesh:
+    devices = list(devices) if devices is not None else jax.devices()
+    return Mesh(np.asarray(devices), (axis,))
+
+
+def sweep_sharding(mesh: Mesh, axis: str = LANES) -> Tuple[NamedSharding, NamedSharding]:
+    """(batch-axis sharding, fully-replicated sharding) for a sweep."""
+    return NamedSharding(mesh, P(axis)), NamedSharding(mesh, P())
+
+
+def _shard_lane_kernel(run_lane, mesh: Mesh, axis: str):
+    """vmap a single-lane fn and shard its lane batch over the mesh: inputs
+    and outputs are sharded on their leading (lane) dimension; each device
+    advances its lane shard independently — the pjit/ICI scale-out."""
+    batch_sharding = NamedSharding(mesh, P(axis))
+    return jax.jit(
+        jax.vmap(run_lane),
+        in_shardings=(batch_sharding, batch_sharding),
+        out_shardings=batch_sharding,
+    )
+
+
+def shard_explore_kernel(app: DSLApp, cfg: DeviceConfig, mesh: Mesh, axis: str = LANES):
+    """Explore sweep with the lane batch sharded over the mesh."""
+    return _shard_lane_kernel(make_run_lane(app, cfg), mesh, axis)
+
+
+def shard_replay_kernel(app: DSLApp, cfg: DeviceConfig, mesh: Mesh, axis: str = LANES):
+    """Batched replay (minimization trials) sharded over the mesh: one
+    DDMin level's candidate subsequences spread across chips."""
+    from ..device.replay import make_replay_run_lane
+
+    return _shard_lane_kernel(make_replay_run_lane(app, cfg), mesh, axis)
+
+
+def pad_batch_to_devices(n: int, mesh: Mesh, axis: str = LANES) -> int:
+    """Round a batch size up to a multiple of the mesh axis size."""
+    size = mesh.shape[axis]
+    return ((n + size - 1) // size) * size
