@@ -72,6 +72,9 @@ class DeviceConfig:
     max_steps: int = 512
     invariant_interval: int = 0  # 0 = only at completion
     record_trace: bool = False
+    # Probability weight of picking a pending timer vs a message (host
+    # counterpart: FullyRandom.timer_weight). 1.0 = uniform over all.
+    timer_weight: float = 1.0
 
     @property
     def rec_width(self) -> int:
@@ -113,6 +116,10 @@ class ScheduleState(NamedTuple):
     ext_cursor: jnp.ndarray  # int32: next external op
     seq_counter: jnp.ndarray  # int32
     deliveries: jnp.ndarray  # int32
+    # Bounded-quiescence segment tracking (WaitQuiescence budgets):
+    seg_budget: jnp.ndarray  # int32, 0 = unlimited
+    seg_start: jnp.ndarray  # int32: deliveries when the segment began
+    final_seg: jnp.ndarray  # bool: this dispatch segment is the program's last
     status: jnp.ndarray  # int32 (ST_*)
     violation: jnp.ndarray  # int32 fingerprint (0 = none)
     rng: jnp.ndarray  # PRNG key
@@ -145,6 +152,9 @@ def init_state(app: DSLApp, cfg: DeviceConfig, key) -> ScheduleState:
         ext_cursor=jnp.int32(0),
         seq_counter=jnp.int32(0),
         deliveries=jnp.int32(0),
+        seg_budget=jnp.int32(0),
+        seg_start=jnp.int32(0),
+        final_seg=jnp.bool_(False),
         status=jnp.int32(ST_INJECT),
         violation=jnp.int32(0),
         rng=key,

@@ -87,6 +87,7 @@ def lower_program(
             msg[i] = _msg_row(app, ev.message(), w)
         elif isinstance(ev, WaitQuiescence):
             ops[i] = OP_WAIT
+            a[i] = ev.budget or 0  # field a carries the bounded-wait budget
         elif isinstance(ev, Partition):
             ops[i], a[i], b[i] = OP_PARTITION, app.actor_id(ev.a), app.actor_id(ev.b)
         elif isinstance(ev, UnPartition):

@@ -86,7 +86,33 @@ def _inject_step(state: ScheduleState, prog: ExtProgram, app, cfg, init_states, 
         jnp.where(to_dispatch, ST_DISPATCH, ST_INJECT),
         state.status,  # preserve overflow aborts from apply_external_op
     )
-    return state._replace(ext_cursor=new_cursor, status=status)
+    # Bounded quiescence: a WAIT op carries its budget in field `a`
+    # (0 = strict); a final drain — entered via OP_END *or* by running off
+    # the end of a full-length program — is unlimited (stale budgets must
+    # not cap it).
+    seg_budget = jnp.where(
+        op == OP_WAIT,
+        prog.a[cur],
+        jnp.where((op == OP_END) | (new_cursor >= e), 0, state.seg_budget),
+    ).astype(jnp.int32)
+    # Host-parity run-end semantics (reference: execution ends with the
+    # segment of the LAST external event): the segment we're entering is
+    # final if this op is OP_END / past-the-end, or a WAIT with nothing but
+    # OP_END after it.
+    next_cur = jnp.clip(new_cursor, 0, e - 1)
+    next_op = jnp.where(new_cursor >= e, OP_END, prog.op[next_cur])
+    final_seg = to_dispatch & (
+        (op == OP_END)
+        | (new_cursor >= e)
+        | ((op == OP_WAIT) & (next_op == OP_END))
+    )
+    return state._replace(
+        ext_cursor=new_cursor,
+        status=status,
+        seg_budget=seg_budget,
+        seg_start=jnp.where(to_dispatch, state.deliveries, state.seg_start).astype(jnp.int32),
+        final_seg=jnp.where(to_dispatch, final_seg, state.final_seg),
+    )
 
 
 def _finalize(state: ScheduleState, app, cfg) -> ScheduleState:
@@ -98,12 +124,29 @@ def _finalize(state: ScheduleState, app, cfg) -> ScheduleState:
 
 
 def _dispatch_step(state: ScheduleState, prog: ExtProgram, app, cfg):
-    e = prog.op.shape[0]
     mask = deliverable_mask(state, cfg)
     count = jnp.sum(mask.astype(jnp.int32))
     any_deliverable = count > 0
 
     key, sub = jax.random.split(state.rng)
+    if cfg.timer_weight != 1.0:
+        # Two-stage choice: class (timer vs message) by weighted counts,
+        # then uniform within class (host counterpart: FullyRandom with
+        # timer_weight).
+        tmask = mask & state.pool_timer
+        mmask = mask & ~state.pool_timer
+        tcount = jnp.sum(tmask.astype(jnp.int32))
+        mcount = jnp.sum(mmask.astype(jnp.int32))
+        sub, sub2 = jax.random.split(sub)
+        wt = cfg.timer_weight * tcount
+        p_timer = jnp.where(
+            (tcount > 0) & (mcount > 0),
+            wt / jnp.maximum(wt + mcount, 1e-9),
+            jnp.where(tcount > 0, 1.0, 0.0),
+        )
+        pick_timer = jax.random.uniform(sub2) < p_timer
+        mask = jnp.where(pick_timer, tmask, mmask)
+        count = jnp.where(pick_timer, tcount, mcount)
     u = jax.random.uniform(sub)
     k = jnp.minimum((u * count).astype(jnp.int32), jnp.maximum(count - 1, 0))
     cum = jnp.cumsum(mask.astype(jnp.int32))
@@ -122,12 +165,15 @@ def _dispatch_step(state: ScheduleState, prog: ExtProgram, app, cfg):
             violation=jnp.where(code != 0, code.astype(jnp.int32), state.violation),
         )
 
-    # Quiescence handling (only when nothing was deliverable).
-    cur = jnp.clip(state.ext_cursor, 0, e - 1)
-    program_over = (state.ext_cursor >= e) | (prog.op[cur] == OP_END)
-    quiescent = ~any_deliverable & (state.status == ST_DISPATCH)
+    # Quiescence handling: nothing deliverable, or the segment's
+    # bounded-wait budget expired. The run ends with its final segment
+    # (host/reference parity — no extra drain past a trailing wait).
+    budget_spent = (state.seg_budget > 0) & (
+        state.deliveries - state.seg_start >= state.seg_budget
+    )
+    quiescent = (~any_deliverable | budget_spent) & (state.status == ST_DISPATCH)
     state = jax.lax.cond(
-        quiescent & program_over,
+        quiescent & state.final_seg,
         lambda s: _finalize(s, app, cfg),
         lambda s: s._replace(
             status=jnp.where(

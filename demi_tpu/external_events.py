@@ -134,7 +134,21 @@ class Send(ExternalEvent):
 
 @dataclass(frozen=True, eq=False)
 class WaitQuiescence(ExternalEvent):
-    """Block injection until no deliverable messages remain."""
+    """Block injection until no deliverable messages remain.
+
+    ``budget`` bounds the wait: advance after quiescence OR after that many
+    deliveries in the segment, whichever first. Timer-driven apps (Raft
+    elections re-arm forever) never truly quiesce — the reference copes by
+    capping whole runs (RandomScheduler.setMaxMessages,
+    RandomScheduler.scala:54-57); a per-segment budget keeps multi-phase
+    programs progressing instead. None = strict quiescence; budget must be
+    >= 1 (0 would mean opposite things on the two tiers)."""
+
+    budget: Optional[int] = None
+
+    def __post_init__(self):
+        if self.budget is not None and self.budget < 1:
+            raise ValueError("WaitQuiescence budget must be None or >= 1")
 
 
 @dataclass(frozen=True, eq=False)

@@ -156,8 +156,8 @@ class BaseScheduler:
         cursor = 0
         violation: Optional[Any] = None
         while True:
-            cursor, waiting_cond = self._inject_until_wait(program, cursor)
-            violation = self._dispatch_until_quiescence(waiting_cond)
+            cursor, waiting_cond, budget = self._inject_until_wait(program, cursor)
+            violation = self._dispatch_until_quiescence(waiting_cond, budget)
             self.trace.append(self._unique(Quiescence()))
             self.on_quiescence()
             if violation is not None:
@@ -170,7 +170,7 @@ class BaseScheduler:
     # -- injection phase -------------------------------------------------
     def _inject_until_wait(
         self, program: List[ExternalEvent], cursor: int
-    ) -> Tuple[int, Optional[Callable[[], bool]]]:
+    ) -> Tuple[int, Optional[Callable[[], bool]], Optional[int]]:
         """Interpret external events until a blocking one.
 
         Reference: EventOrchestrator.inject_until_quiescence
@@ -180,12 +180,12 @@ class BaseScheduler:
             cursor += 1
             if isinstance(event, WaitQuiescence):
                 self.trace.append(self._unique(BeginWaitQuiescence()))
-                return cursor, None
+                return cursor, None, event.budget
             if isinstance(event, WaitCondition):
                 self.trace.append(self._unique(BeginWaitCondition()))
-                return cursor, event.cond
+                return cursor, event.cond, None
             self._inject_one(event)
-        return cursor, None
+        return cursor, None, None
 
     def _inject_one(self, event: ExternalEvent) -> None:
         system = self.system
@@ -232,11 +232,16 @@ class BaseScheduler:
 
     # -- dispatch phase --------------------------------------------------
     def _dispatch_until_quiescence(
-        self, waiting_cond: Optional[Callable[[], bool]]
+        self,
+        waiting_cond: Optional[Callable[[], bool]],
+        budget: Optional[int] = None,
     ) -> Optional[Any]:
+        segment_start = self.deliveries
         while True:
             if waiting_cond is not None and waiting_cond():
                 return None  # condition satisfied; next external segment
+            if budget is not None and self.deliveries - segment_start >= budget:
+                return None  # bounded wait expired; next segment
             if self.deliveries >= self.max_messages:
                 return None
             try:

@@ -59,10 +59,15 @@ class RandomizationStrategy:
 
 class FullyRandom(RandomizationStrategy):
     """Uniform over all pending events (reference:
-    RandomScheduler.scala:635-697, backed by a RandomizedHashSet)."""
+    RandomScheduler.scala:635-697, backed by a RandomizedHashSet).
 
-    def __init__(self, rng: _random.Random):
+    ``timer_weight`` scales the probability of picking a timer relative to
+    a message: timer-driven protocols (Raft elections) otherwise spend most
+    of the schedule churning timeouts. 1.0 = plain uniform."""
+
+    def __init__(self, rng: _random.Random, timer_weight: float = 1.0):
         super().__init__(rng)
+        self.timer_weight = timer_weight
         self._pool: List[PendingEntry] = []
 
     def add(self, entry: PendingEntry) -> None:
@@ -71,6 +76,19 @@ class FullyRandom(RandomizationStrategy):
     def pop(self) -> Optional[PendingEntry]:
         if not self._pool:
             return None
+        if self.timer_weight != 1.0:
+            timers, non_timers = [], []
+            for i, e in enumerate(self._pool):
+                (timers if e.is_timer else non_timers).append(i)
+            wt = self.timer_weight * len(timers)
+            total = wt + len(non_timers)
+            if total > 0 and timers and non_timers:
+                if self.rng.uniform(0, total) < wt:
+                    i = self.rng.choice(timers)
+                else:
+                    i = self.rng.choice(non_timers)
+                self._pool[i], self._pool[-1] = self._pool[-1], self._pool[i]
+                return self._pool.pop()
         # O(1) random removal: swap chosen with last, pop
         # (the reference's RandomizedHashSet trick, Util.scala:110-185).
         i = self.rng.randrange(len(self._pool))
@@ -146,10 +164,12 @@ class RandomScheduler(BaseScheduler):
         max_messages: int = 10_000,
         invariant_check_interval: int = 0,
         strategy: str = "fully_random",
+        timer_weight: float = 1.0,
     ):
         super().__init__(config, max_messages, invariant_check_interval)
         self.seed = seed
         self.strategy_name = strategy
+        self.timer_weight = timer_weight
         self.rng = _random.Random(seed)
         self.pending = self._make_strategy()
         self._just_delivered_timers: set = set()
@@ -157,7 +177,7 @@ class RandomScheduler(BaseScheduler):
 
     def _make_strategy(self) -> RandomizationStrategy:
         if self.strategy_name == "fully_random":
-            return FullyRandom(self.rng)
+            return FullyRandom(self.rng, timer_weight=self.timer_weight)
         if self.strategy_name == "srcdst_fifo":
             return SrcDstFIFO(self.rng)
         raise ValueError(f"unknown strategy {self.strategy_name}")
