@@ -2,12 +2,12 @@
 
 Prints ONE JSON line {"metric", "value", "unit", "vs_baseline"}.
 
-Workload: random schedule exploration (fuzzing) of a 5-actor reliable-
-broadcast DSL app with fault injection in the program — the raft-class
-5-node workload class from BASELINE.md (switches to the Raft fixture once
-it lands). ``vs_baseline`` is value / 10,000 — the BASELINE.json north-star
-target of ≥10k schedules/sec/chip (the reference publishes no numbers and
-its JVM cannot run in this image; BASELINE.md records this).
+Workload: BASELINE.json config 1/2 class — 5-node Raft, random schedule
+exploration with per-delivery safety-invariant checks (election safety +
+committed-prefix agreement) and client-command waves. Each schedule runs
+up to 120 deliveries. ``vs_baseline`` is value / 10,000 — the BASELINE.json
+north-star target of ≥10k schedules/sec/chip (the reference publishes no
+numbers and its JVM cannot run in this image; BASELINE.md records this).
 """
 
 import json
@@ -19,30 +19,33 @@ import numpy as np
 def main():
     import jax
 
-    from demi_tpu.apps.broadcast import make_broadcast_app
     from demi_tpu.apps.common import dsl_start_events
+    from demi_tpu.apps.raft import T_CLIENT, make_raft_app
     from demi_tpu.device import DeviceConfig, make_explore_kernel
     from demi_tpu.device.encoding import lower_program, stack_programs
     from demi_tpu.external_events import (
-        Kill,
         MessageConstructor,
         Send,
         WaitQuiescence,
     )
 
-    app = make_broadcast_app(5, reliable=True)
+    app = make_raft_app(5)
+    # Step budget: 12 injection ops + 2 x 60-delivery wait budgets + slack —
+    # every lane completes its program within the scan.
     cfg = DeviceConfig.for_app(
-        app, pool_capacity=96, max_steps=96, max_external_ops=16
+        app, pool_capacity=160, max_steps=144, max_external_ops=24,
+        invariant_interval=1, timer_weight=0.2,
     )
-    # A raft-class program: sends + a fault + quiescence barriers.
+
+    def cmd(node, v):
+        return Send(
+            app.actor_name(node),
+            MessageConstructor(lambda vv=v: (T_CLIENT, 0, vv, 0, 0, 0, 0)),
+        )
+
     program = dsl_start_events(app) + [
-        Send(app.actor_name(0), MessageConstructor(lambda: (1, 0))),
-        WaitQuiescence(),
-        Send(app.actor_name(1), MessageConstructor(lambda: (1, 1))),
-        Kill(app.actor_name(1)),
-        WaitQuiescence(),
-        Send(app.actor_name(2), MessageConstructor(lambda: (1, 2))),
-        WaitQuiescence(),
+        cmd(0, 10), cmd(1, 11), cmd(2, 12), WaitQuiescence(budget=60),
+        cmd(3, 20), cmd(4, 21), WaitQuiescence(budget=60),
     ]
     batch = 2048
     kernel = make_explore_kernel(app, cfg)
@@ -65,7 +68,7 @@ def main():
     print(
         json.dumps(
             {
-                "metric": "unique schedules explored/sec/chip (5-actor broadcast fuzz, faults)",
+                "metric": "unique schedules explored/sec/chip (5-node raft fuzz, per-delivery invariant checks)",
                 "value": round(schedules_per_sec, 1),
                 "unit": "schedules/sec",
                 "vs_baseline": round(schedules_per_sec / 10_000.0, 3),

@@ -1,0 +1,152 @@
+"""Device-batched minimization oracles.
+
+DDMin levels and internal-minimization rounds produce *sets* of candidate
+schedules; here each set becomes one vmapped replay batch (SURVEY.md §7.2
+step 6, BASELINE north star: "DDMin farms its replay-this-subsequence
+trials to the same batched kernel"). Verdicts come from the jitted
+invariant; only the adopted candidate is re-executed on the host oracle to
+produce the bookkeeping EventTrace.
+
+Record arrays are padded to one static shape so every round reuses the same
+compiled kernel.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional, Sequence
+
+import numpy as np
+
+import jax
+
+from ..config import SchedulerConfig
+from ..dsl import DSLApp
+from ..external_events import ExternalEvent
+from ..minimization.test_oracle import IntViolation, TestOracle
+from ..schedulers.replay import STSScheduler
+from ..trace import EventTrace
+from .core import DeviceConfig
+from .encoding import lower_expected_trace
+from .replay import make_replay_kernel
+
+
+class DeviceReplayChecker:
+    """Batched candidate checking for DSL apps: lower candidate expected
+    traces, replay them all at once, compare violation codes."""
+
+    def __init__(
+        self,
+        app: DSLApp,
+        cfg: DeviceConfig,
+        config: SchedulerConfig,
+    ):
+        self.app = app
+        self.cfg = cfg
+        self.config = config
+        self.kernel = make_replay_kernel(app, cfg)
+        self.max_records = cfg.max_steps + cfg.max_external_ops
+
+    def verdicts(
+        self,
+        candidates: Sequence[EventTrace],
+        externals_per_candidate: Sequence[Sequence[ExternalEvent]],
+        target_code: int,
+    ) -> List[bool]:
+        if not candidates:
+            return []
+        records = np.stack(
+            [
+                lower_expected_trace(
+                    self.app, self.cfg, cand, list(ext), self.max_records
+                )
+                for cand, ext in zip(candidates, externals_per_candidate)
+            ]
+        )
+        keys = jax.random.split(jax.random.PRNGKey(0), len(candidates))
+        res = self.kernel(records, keys)
+        codes = np.asarray(res.violation)
+        return [int(c) == target_code for c in codes]
+
+    def host_executed_trace(
+        self,
+        candidate: EventTrace,
+        externals: Sequence[ExternalEvent],
+        violation: Any,
+    ) -> Optional[EventTrace]:
+        sts = STSScheduler(self.config, candidate)
+        return sts.test_with_trace(candidate, list(externals), violation)
+
+
+def make_batched_internal_check(
+    checker: DeviceReplayChecker,
+    externals: Sequence[ExternalEvent],
+    violation: IntViolation,
+) -> Callable[[List[EventTrace]], List[Optional[EventTrace]]]:
+    """batch_check for BatchedInternalMinimizer: device verdicts for all
+    candidates, host execution only for the first reproducing one."""
+
+    def batch_check(candidates: List[EventTrace]) -> List[Optional[EventTrace]]:
+        verdicts = checker.verdicts(
+            candidates, [externals] * len(candidates), violation.code
+        )
+        out: List[Optional[EventTrace]] = [None] * len(candidates)
+        for i, ok in enumerate(verdicts):
+            if ok:
+                executed = checker.host_executed_trace(
+                    candidates[i], externals, violation
+                )
+                if executed is not None:
+                    out[i] = executed
+                    break
+        return out
+
+    return batch_check
+
+
+class DeviceSTSOracle(TestOracle):
+    """TestOracle for external-event DDMin backed by the device replay
+    kernel: each test() lowers the projected candidate and replays it on
+    device; positives are re-executed on the host for the bookkeeping trace.
+    ``test_batch`` checks a whole DDMin level at once."""
+
+    def __init__(
+        self,
+        app: DSLApp,
+        cfg: DeviceConfig,
+        config: SchedulerConfig,
+        original_trace: EventTrace,
+    ):
+        self.checker = DeviceReplayChecker(app, cfg, config)
+        self.original_trace = original_trace
+        self.config = config
+
+    def _project(self, externals: Sequence[ExternalEvent]) -> EventTrace:
+        return (
+            self.original_trace.filter_failure_detector_messages()
+            .filter_checkpoint_messages()
+            .subsequence_intersection(
+                list(externals),
+                filter_known_absents=self.config.filter_known_absents,
+            )
+        )
+
+    def test(self, externals, violation_fingerprint, stats=None, init=None):
+        if stats is not None:
+            stats.record_replay()
+        projected = self._project(externals)
+        ok = self.checker.verdicts(
+            [projected], [externals], violation_fingerprint.code
+        )[0]
+        if not ok:
+            return None
+        return self.checker.host_executed_trace(
+            projected, externals, violation_fingerprint
+        )
+
+    def test_batch(
+        self, candidates: Sequence[Sequence[ExternalEvent]], violation_fingerprint
+    ) -> List[bool]:
+        projected = [self._project(c) for c in candidates]
+        return self.checker.verdicts(
+            projected, candidates, violation_fingerprint.code
+        )

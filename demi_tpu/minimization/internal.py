@@ -1,0 +1,221 @@
+"""Internal-event (delivery) minimization: shrink the *schedule*, not the
+external inputs.
+
+Reference: minification/internal_minimization/ — RemovalStrategy (24 LoC),
+OneAtATimeRemoval.scala (251), ScheduleCheckers.scala (108). A strategy
+proposes candidate schedules, each omitting some deliveries; the STS
+ignore-absent oracle checks whether the violation still reproduces; the
+executed (absents-pruned) trace becomes the new baseline.
+
+``BatchedInternalMinimizer`` is the TPU-native upgrade the reference can't
+do: test *every* single-removal candidate of a round as one vmapped replay
+batch instead of one-at-a-time.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+from ..events import (
+    BeginUnignorableEvents,
+    EndUnignorableEvents,
+    MsgEvent,
+    TimerDelivery,
+    Unique,
+)
+from ..trace import EventTrace
+from .stats import MinimizationStats
+
+
+def removable_delivery_indices(trace: EventTrace) -> List[int]:
+    """Positions of deliveries eligible for removal: internal message and
+    timer deliveries outside unignorable blocks (external deliveries belong
+    to external minimization; reference: OneAtATimeRemoval.scala:17-129)."""
+    out: List[int] = []
+    unignorable = 0
+    for i, u in enumerate(trace.events):
+        event = u.event
+        if isinstance(event, BeginUnignorableEvents):
+            unignorable += 1
+        elif isinstance(event, EndUnignorableEvents):
+            unignorable = max(0, unignorable - 1)
+        elif unignorable == 0:
+            if isinstance(event, TimerDelivery):
+                out.append(i)
+            elif isinstance(event, MsgEvent) and not event.is_external:
+                out.append(i)
+    return out
+
+
+def remove_delivery(trace: EventTrace, index: int) -> EventTrace:
+    """Candidate schedule: the trace without the delivery at ``index``
+    (its MsgSend stays — sent but never delivered)."""
+    events = list(trace.events)
+    del events[index]
+    return EventTrace(events, trace.original_externals)
+
+
+class RemovalStrategy:
+    """Iterator-with-feedback over candidate schedules
+    (reference: RemovalStrategy.scala)."""
+
+    def next_candidate(self, last_failing: EventTrace) -> Optional[EventTrace]:
+        raise NotImplementedError
+
+    def on_result(self, reproduced: bool) -> None:
+        pass
+
+
+class OneAtATimeStrategy(RemovalStrategy):
+    """Try removing each removable delivery, restarting the scan on the new
+    baseline after every successful removal (reference:
+    OneAtATimeStrategy, OneAtATimeRemoval.scala:17-129)."""
+
+    def __init__(self, left_to_right: bool = False):
+        self.cursor = 0
+        self._last_len: Optional[int] = None
+        self.left_to_right = left_to_right
+
+    def next_candidate(self, last_failing: EventTrace) -> Optional[EventTrace]:
+        if self._last_len != len(last_failing.events):
+            # Baseline changed (successful removal pruned events): keep the
+            # cursor for left-to-right, restart otherwise.
+            self._last_len = len(last_failing.events)
+            if not self.left_to_right:
+                self.cursor = 0
+        candidates = removable_delivery_indices(last_failing)
+        if self.cursor >= len(candidates):
+            return None
+        idx = candidates[self.cursor]
+        return remove_delivery(last_failing, idx)
+
+    def on_result(self, reproduced: bool) -> None:
+        if not reproduced:
+            self.cursor += 1
+        # On success the baseline shrinks; next_candidate resets/keeps the
+        # cursor accordingly.
+
+
+class LeftToRightOneAtATime(OneAtATimeStrategy):
+    """Single pass, never revisiting earlier positions
+    (reference: OneAtATimeRemoval.scala:132-137)."""
+
+    def __init__(self):
+        super().__init__(left_to_right=True)
+
+
+class SrcDstFIFORemoval(RemovalStrategy):
+    """Only remove the *last* delivery of some (src, dst) channel — under
+    TCP-like FIFO semantics removing a middle message is meaningless
+    (reference: SrcDstFIFORemoval, OneAtATimeRemoval.scala:145-251)."""
+
+    def __init__(self):
+        self._tried: set = set()  # (src, dst) channels already attempted
+        self._last_len: Optional[int] = None
+
+    def next_candidate(self, last_failing: EventTrace) -> Optional[EventTrace]:
+        if self._last_len != len(last_failing.events):
+            self._last_len = len(last_failing.events)
+            self._tried = set()
+        last_of_channel = {}
+        for i in removable_delivery_indices(last_failing):
+            event = last_failing.events[i].event
+            key = (
+                ("timer", event.rcv)
+                if isinstance(event, TimerDelivery)
+                else (event.snd, event.rcv)
+            )
+            last_of_channel[key] = i
+        for key, idx in sorted(last_of_channel.items(), key=lambda kv: -kv[1]):
+            if key not in self._tried:
+                self._pending_key = key
+                return remove_delivery(last_failing, idx)
+        return None
+
+    def on_result(self, reproduced: bool) -> None:
+        if not reproduced:
+            self._tried.add(self._pending_key)
+        # On success the channel's new last message becomes a fresh
+        # candidate (and "freebies" recompute via the new baseline).
+
+
+class STSSchedMinimizer:
+    """The internal-minimization loop (reference: STSSchedMinimizer,
+    ScheduleCheckers.scala:34-107): repeatedly propose a candidate schedule,
+    check with an STS-style oracle, keep the last failing execution."""
+
+    def __init__(
+        self,
+        check: Callable[[EventTrace], Optional[EventTrace]],
+        strategy: RemovalStrategy,
+        stats: Optional[MinimizationStats] = None,
+    ):
+        # check(candidate_expected_trace) -> executed violating trace | None
+        self.check = check
+        self.strategy = strategy
+        self.stats = stats or MinimizationStats()
+
+    def minimize(self, initial_failing: EventTrace) -> EventTrace:
+        self.stats.update_strategy(
+            type(self.strategy).__name__, "STSSched"
+        )
+        self.stats.record_prune_start()
+        last_failing = initial_failing
+        while True:
+            candidate = self.strategy.next_candidate(last_failing)
+            if candidate is None:
+                break
+            result = self.check(candidate)
+            reproduced = result is not None
+            self.strategy.on_result(reproduced)
+            if reproduced:
+                last_failing = result
+            self.stats.record_internal_size(
+                len(removable_delivery_indices(last_failing))
+            )
+        self.stats.record_prune_end()
+        deliveries = len(last_failing.deliveries())
+        timers = sum(
+            1 for u in last_failing.events if isinstance(u.event, TimerDelivery)
+        )
+        self.stats.record_minimized_counts(deliveries, 0, timers)
+        return last_failing
+
+
+class BatchedInternalMinimizer:
+    """Device-accelerated internal minimization: each round, replay ALL
+    single-removal candidates as one vmapped batch and adopt the first
+    reproducing candidate (deterministic order). Rounds repeat until no
+    candidate reproduces. Falls out of SURVEY.md §7's batched-trials design;
+    no reference counterpart (it tests candidates sequentially)."""
+
+    def __init__(
+        self,
+        batch_check: Callable[[List[EventTrace]], List[Optional[EventTrace]]],
+        stats: Optional[MinimizationStats] = None,
+        max_rounds: int = 10_000,
+    ):
+        # batch_check(candidates) -> per-candidate executed trace | None
+        self.batch_check = batch_check
+        self.stats = stats or MinimizationStats()
+        self.max_rounds = max_rounds
+
+    def minimize(self, initial_failing: EventTrace) -> EventTrace:
+        self.stats.update_strategy("BatchedOneAtATime", "DeviceReplay")
+        self.stats.record_prune_start()
+        last_failing = initial_failing
+        for _ in range(self.max_rounds):
+            indices = removable_delivery_indices(last_failing)
+            if not indices:
+                break
+            candidates = [remove_delivery(last_failing, i) for i in indices]
+            results = self.batch_check(candidates)
+            adopted = next((r for r in results if r is not None), None)
+            self.stats.record_internal_size(len(indices))
+            if adopted is None:
+                break
+            last_failing = adopted
+        self.stats.record_prune_end()
+        deliveries = len(last_failing.deliveries())
+        self.stats.record_minimized_counts(deliveries, 0, 0)
+        return last_failing

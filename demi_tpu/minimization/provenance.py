@@ -1,0 +1,53 @@
+"""Provenance pruning: drop events provably concurrent with the violation.
+
+Reference: ProvenanceTracker (schedulers/Util.scala:267-376) — computes the
+happens-before relation (first-order pairs + transitive closure) and prunes
+deliveries not in the causal past of the violation's affected nodes.
+
+Implemented as a backward causal slice over the trace, which yields the
+same closure without materializing the relation: walking backwards, a
+delivery is kept iff its receiver is currently *relevant* (an affected node,
+or the sender of a later kept delivery); keeping it makes its sender
+relevant for all earlier events.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Set
+
+from ..events import MsgEvent, MsgSend, TimerDelivery, Unique
+from ..trace import EventTrace
+
+
+def prune_concurrent_events(
+    trace: EventTrace, affected_nodes: Sequence[str]
+) -> EventTrace:
+    relevant: Set[str] = set(affected_nodes)
+    keep_ids: Set[int] = set()
+    kept_deliveries = 0
+    for u in reversed(trace.events):
+        event = u.event
+        if isinstance(event, MsgEvent):
+            if event.rcv in relevant:
+                keep_ids.add(u.id)
+                relevant.add(event.snd)
+                kept_deliveries += 1
+        elif isinstance(event, TimerDelivery):
+            if event.rcv in relevant:
+                keep_ids.add(u.id)
+                kept_deliveries += 1
+
+    events: List[Unique] = []
+    for u in trace.events:
+        event = u.event
+        if isinstance(event, (MsgEvent, TimerDelivery)):
+            if u.id in keep_ids:
+                events.append(u)
+        elif isinstance(event, MsgSend):
+            # Keep sends whose delivery survived, plus undelivered externals
+            # (they are re-injected on replay regardless).
+            if u.id in keep_ids or event.is_external:
+                events.append(u)
+        else:
+            events.append(u)
+    return EventTrace(events, trace.original_externals)

@@ -1,0 +1,258 @@
+"""Wildcard ("fungible clocks") minimization.
+
+Reference: minification/wildcard_minimization/ — Clusterizer.scala (21),
+ClockClusterizer.scala (290), OneAtATimeClusterizer.scala (116),
+AmbiguityResolutionStrategies.scala (117), WildcardMinimizer.scala (242),
+and minification/WildcardTestOracle.scala (63).
+
+Idea: exact (snd, rcv, fingerprint) replay is brittle — after removing
+events, the *specific* message contents change (terms, ids) even though a
+structurally-equivalent message would do. Wildcarding replaces expected
+deliveries with class-tag matches over the pending pool, so minimization
+can remove whole logical-clock clusters (e.g. "everything in Raft term 3")
+and still replay the rest.
+
+Ambiguity resolution (which pending message a wildcard takes) maps the
+reference's strategies to a policy enum: "first" (= SrcDstFIFOOnly — FIFO
+head), "last" (= LastOnlyStrategy). The DPOR-backtracking strategies
+(BackTrackStrategy / FirstAndLastBacktrack) require the DPOR scheduler's
+backtrack queue and arrive with it.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence, Set, Tuple
+
+from ..events import MsgEvent, TimerDelivery, Unique, WildCardMatch
+from ..fingerprints import FingerprintFactory
+from ..trace import EventTrace
+from .stats import MinimizationStats
+from .test_oracle import TestOracle
+
+
+def class_tag_of(msg: Any) -> Any:
+    """Wildcard class tag: DSL messages (int tuples) key on the leading tag,
+    host objects on their type name."""
+    if isinstance(msg, tuple) and msg and isinstance(msg[0], int):
+        return msg[0]
+    return type(msg).__name__
+
+
+def wildcard_delivery(u: Unique, policy: str) -> Unique:
+    event = u.event
+    wc = WildCardMatch(class_tag=class_tag_of(event.msg), policy=policy)
+    if isinstance(event, TimerDelivery):
+        return Unique(MsgEvent(event.rcv, event.rcv, wc), u.id)
+    return Unique(MsgEvent(event.snd, event.rcv, wc), u.id)
+
+
+class Clusterizer:
+    """Iterator of wildcarded candidate schedules with feedback
+    (reference: Clusterizer.scala — violationReproducedLastRun +
+    ignoredAbsentIds "freebies")."""
+
+    def next_trace(
+        self, violation_reproduced_last_run: bool, ignored_absent_ids: Set[int]
+    ) -> Optional[EventTrace]:
+        raise NotImplementedError
+
+
+def _deliveries(trace: EventTrace) -> List[int]:
+    return [
+        i
+        for i, u in enumerate(trace.events)
+        if isinstance(u.event, (MsgEvent, TimerDelivery))
+        and not (isinstance(u.event, MsgEvent) and u.event.is_external)
+    ]
+
+
+def _build_candidate(
+    trace: EventTrace,
+    removed: Set[int],
+    policy: str,
+) -> EventTrace:
+    """Remove deliveries at ``removed`` positions; wildcard the remaining
+    internal deliveries."""
+    events: List[Unique] = []
+    for i, u in enumerate(trace.events):
+        if i in removed:
+            continue
+        if isinstance(u.event, TimerDelivery) or (
+            isinstance(u.event, MsgEvent) and not u.event.is_external
+        ):
+            events.append(wildcard_delivery(u, policy))
+        else:
+            events.append(u)
+    return EventTrace(events, trace.original_externals)
+
+
+class SingletonClusterizer(Clusterizer):
+    """One delivery removed at a time, everything else wildcarded
+    (reference: OneAtATimeClusterizer.scala)."""
+
+    def __init__(self, trace: EventTrace, policy: str = "first"):
+        self.trace = trace
+        self.policy = policy
+        self.removed: Set[int] = set()
+        self._order = _deliveries(trace)
+        self._cursor = 0
+        self._pending: Optional[int] = None
+        self._started = False
+
+    def next_trace(self, reproduced: bool, ignored: Set[int]) -> Optional[EventTrace]:
+        if self._started:
+            if reproduced and self._pending is not None:
+                self.removed.add(self._pending)
+        self._started = True
+        while self._cursor < len(self._order):
+            idx = self._order[self._cursor]
+            self._cursor += 1
+            if idx in self.removed:
+                continue
+            self._pending = idx
+            return _build_candidate(self.trace, self.removed | {idx}, self.policy)
+        self._pending = None
+        return None
+
+    def current_trace(self) -> EventTrace:
+        return _build_candidate(self.trace, self.removed, self.policy)
+
+
+class ClockClusterizer(Clusterizer):
+    """Cluster deliveries by the fingerprinter's logical clock (e.g. Raft
+    term) and remove a whole cluster per round
+    (reference: ClockClusterizer.scala:73-134). Timers that cause clock
+    increments get their own one-at-a-time sub-iteration
+    (ClockClusterizer.scala:230-290) — here they cluster by their own clock
+    value, which subsumes the common case.
+
+    Aggressiveness (reference :12-21): "clocks" tries cluster removal only;
+    "singletons_after" falls back to singleton removal on the surviving
+    schedule (driven by WildcardMinimizer)."""
+
+    def __init__(
+        self,
+        trace: EventTrace,
+        fingerprinter: FingerprintFactory,
+        policy: str = "first",
+    ):
+        self.trace = trace
+        self.fingerprinter = fingerprinter
+        self.policy = policy
+        self.removed: Set[int] = set()
+        clusters: Dict[Any, List[int]] = {}
+        for i in _deliveries(trace):
+            msg = trace.events[i].event.msg
+            clock = fingerprinter.get_logical_clock(msg)
+            key = ("clock", clock) if clock is not None else ("noclock", class_tag_of(msg))
+            clusters.setdefault(key, []).append(i)
+        # Try larger clusters first: biggest wins shrink fastest.
+        self._clusters = sorted(clusters.values(), key=len, reverse=True)
+        self._cursor = 0
+        self._pending: Optional[List[int]] = None
+        self._started = False
+
+    def next_trace(self, reproduced: bool, ignored: Set[int]) -> Optional[EventTrace]:
+        if self._started and reproduced and self._pending is not None:
+            self.removed.update(self._pending)
+        self._started = True
+        while self._cursor < len(self._clusters):
+            cluster = [
+                i for i in self._clusters[self._cursor] if i not in self.removed
+            ]
+            self._cursor += 1
+            if not cluster:
+                continue
+            self._pending = cluster
+            return _build_candidate(
+                self.trace, self.removed | set(cluster), self.policy
+            )
+        self._pending = None
+        return None
+
+    def current_trace(self) -> EventTrace:
+        return _build_candidate(self.trace, self.removed, self.policy)
+
+
+class WildcardMinimizer:
+    """Drive a Clusterizer against an STS-style checker
+    (reference: WildcardMinimizer.scala; the DPOR one-shot checking mode
+    arrives with the DPOR scheduler)."""
+
+    def __init__(
+        self,
+        check: Callable[[EventTrace], Optional[EventTrace]],
+        stats: Optional[MinimizationStats] = None,
+        aggressiveness: str = "singletons_after",
+        policy: str = "first",
+    ):
+        self.check = check
+        self.stats = stats or MinimizationStats()
+        self.aggressiveness = aggressiveness
+        self.policy = policy
+
+    def minimize(
+        self, trace: EventTrace, fingerprinter: FingerprintFactory
+    ) -> EventTrace:
+        self.stats.update_strategy("ClockClusterizer", "WildcardSTS")
+        self.stats.record_prune_start()
+        best = trace
+        clusterizer = ClockClusterizer(trace, fingerprinter, self.policy)
+        best = self._drive(clusterizer, best)
+        if self.aggressiveness == "singletons_after":
+            singles = SingletonClusterizer(best, self.policy)
+            best = self._drive(singles, best)
+        self.stats.record_prune_end()
+        self.stats.record_minimized_counts(len(best.deliveries()), 0, 0)
+        return best
+
+    def _drive(self, clusterizer: Clusterizer, best: EventTrace) -> EventTrace:
+        reproduced = False
+        while True:
+            candidate = clusterizer.next_trace(reproduced, set())
+            if candidate is None:
+                break
+            result = self.check(candidate)
+            reproduced = result is not None
+            if reproduced:
+                best = result
+            self.stats.record_internal_size(len(best.deliveries()))
+        return best
+
+
+class WildcardTestOracle(TestOracle):
+    """Adapts wildcard replay into a TestOracle so external-event DDMin can
+    use it (reference: WildcardTestOracle.scala:10-63): project the trace
+    onto the candidate externals, wildcard all internal deliveries, check."""
+
+    def __init__(
+        self,
+        sts_factory: Callable[[], Any],  # () -> STSScheduler-like
+        original_trace: EventTrace,
+        policy: str = "first",
+        filter_known_absents: bool = True,
+    ):
+        self.sts_factory = sts_factory
+        self.original_trace = original_trace
+        self.policy = policy
+        self.filter_known_absents = filter_known_absents
+        self.smallest: Optional[EventTrace] = None
+
+    def test(self, externals, violation_fingerprint, stats=None, init=None):
+        projected = (
+            self.original_trace.filter_failure_detector_messages()
+            .filter_checkpoint_messages()
+            .subsequence_intersection(
+                externals, filter_known_absents=self.filter_known_absents
+            )
+        )
+        candidate = _build_candidate(projected, set(), self.policy)
+        sts = self.sts_factory()
+        result = sts.test_with_trace(
+            candidate, externals, violation_fingerprint, stats
+        )
+        if result is not None and (
+            self.smallest is None or len(result) < len(self.smallest)
+        ):
+            self.smallest = result
+        return result

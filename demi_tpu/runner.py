@@ -1,0 +1,250 @@
+"""Top-level runner API: fuzz → minimize pipelines.
+
+Reference: verification/RunnerUtils.scala (1438 LoC) — fuzz:62-147,
+runTheGamut:171-500 (the canonical pipeline documented at
+RunnerUtils.scala:22-27: fuzz -> shrinkSendContents -> stsSchedDDMin ->
+minimizeInternals -> replayExperiment), plus helpers.
+
+Host logic orchestrates; replay trials run on the host STS oracle or, via
+``use_device=True``, on the batched device replay kernel (DDMin levels and
+internal-minimization rounds become vmapped batches — SURVEY.md §7.2 step 6).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+from .config import SchedulerConfig
+from .external_events import ExternalEvent, MessageConstructor, Send
+from .fuzzing import Fuzzer
+from .minimization.ddmin import DDMin, make_dag
+from .minimization.internal import (
+    OneAtATimeStrategy,
+    RemovalStrategy,
+    SrcDstFIFORemoval,
+    STSSchedMinimizer,
+)
+from .minimization.provenance import prune_concurrent_events
+from .minimization.stats import MinimizationStats
+from .minimization.wildcards import WildcardMinimizer
+from .schedulers.random import RandomScheduler
+from .schedulers.replay import ReplayException, ReplayScheduler, STSScheduler, sts_oracle
+from .trace import EventTrace
+
+
+@dataclass
+class FuzzResult:
+    program: List[ExternalEvent]
+    trace: EventTrace
+    violation: Any
+    executions: int
+
+
+@dataclass
+class GamutResult:
+    """One entry per pipeline stage: (stage name, externals count,
+    deliveries count, trace)."""
+
+    mcs_externals: List[ExternalEvent]
+    final_trace: EventTrace
+    stages: List[Tuple[str, int, int]] = field(default_factory=list)
+    stats: MinimizationStats = field(default_factory=MinimizationStats)
+
+
+def fuzz(
+    config: SchedulerConfig,
+    fuzzer: Fuzzer,
+    max_executions: int = 1000,
+    seed: int = 0,
+    max_messages: int = 10_000,
+    invariant_check_interval: int = 0,
+    timer_weight: float = 1.0,
+    validate_replay: bool = False,
+) -> Optional[FuzzResult]:
+    """Generate fuzz tests and run them until a violation is found
+    (reference: RunnerUtils.fuzz, RunnerUtils.scala:62-147). With
+    ``validate_replay``, nondeterministic violations (those a strict replay
+    cannot reproduce) are discarded (RunnerUtils.scala:101-132)."""
+    sched = RandomScheduler(
+        config,
+        seed=seed,
+        max_messages=max_messages,
+        invariant_check_interval=invariant_check_interval,
+        timer_weight=timer_weight,
+    )
+    for i in range(max_executions):
+        program = fuzzer.generate_fuzz_test(seed=seed + i)
+        result = sched.execute(program)
+        if result.violation is None:
+            continue
+        if validate_replay:
+            replayer = ReplayScheduler(config)
+            try:
+                replayed = replayer.replay(result.trace, program)
+            except ReplayException:
+                continue
+            if replayed.violation is None or not replayed.violation.matches(
+                result.violation
+            ):
+                continue
+        return FuzzResult(
+            program=program,
+            trace=result.trace,
+            violation=result.violation,
+            executions=i + 1,
+        )
+    return None
+
+
+def sts_sched_ddmin(
+    config: SchedulerConfig,
+    trace: EventTrace,
+    externals: Sequence[ExternalEvent],
+    violation: Any,
+    stats: Optional[MinimizationStats] = None,
+    oracle=None,
+):
+    """External-event DDMin over the STS oracle
+    (reference: RunnerUtils.stsSchedDDMin, RunnerUtils.scala:642-707)."""
+    oracle = oracle or sts_oracle(config, trace)
+    ddmin = DDMin(oracle, check_unmodified=True, stats=stats or MinimizationStats())
+    mcs = ddmin.minimize(make_dag(list(externals)), violation)
+    verified = ddmin.verify_mcs(mcs, violation)
+    return mcs, verified
+
+
+def minimize_internals(
+    config: SchedulerConfig,
+    failing_trace: EventTrace,
+    externals: Sequence[ExternalEvent],
+    violation: Any,
+    strategy: Optional[RemovalStrategy] = None,
+    stats: Optional[MinimizationStats] = None,
+) -> EventTrace:
+    """Reference: RunnerUtils.minimizeInternals (RunnerUtils.scala:980-1003)."""
+
+    def check(candidate: EventTrace) -> Optional[EventTrace]:
+        sts = STSScheduler(config, candidate)
+        return sts.test_with_trace(candidate, list(externals), violation)
+
+    minimizer = STSSchedMinimizer(
+        check, strategy or OneAtATimeStrategy(), stats=stats or MinimizationStats()
+    )
+    return minimizer.minimize(failing_trace)
+
+
+def shrink_send_contents(
+    config: SchedulerConfig,
+    trace: EventTrace,
+    externals: Sequence[ExternalEvent],
+    violation: Any,
+    stats: Optional[MinimizationStats] = None,
+) -> List[ExternalEvent]:
+    """Mask components of external Send payloads one at a time, keeping
+    masks under which the violation still reproduces
+    (reference: RunnerUtils.shrinkSendContents, RunnerUtils.scala:1007-1094)."""
+    stats = stats or MinimizationStats()
+    stats.update_strategy("ShrinkSendContents", "STSSched")
+    current = list(externals)
+    oracle = sts_oracle(config, trace)
+    for pos, event in enumerate(current):
+        if not isinstance(event, Send) or event.msg_ctor is None:
+            continue
+        components = event.msg_ctor.components
+        if not components:
+            continue
+        masked: set = set()
+        for ci in range(len(components)):
+            trial_mask = masked | {ci}
+            trial_send = dataclasses.replace(event)
+            object.__setattr__(
+                trial_send, "msg_ctor", event.msg_ctor.masked(trial_mask)
+            )
+            # Keep the original eid so trace surgery still matches.
+            object.__setattr__(trial_send, "eid", event.eid)
+            trial = list(current)
+            trial[pos] = trial_send
+            if oracle.test(trial, violation, stats=stats) is not None:
+                masked = trial_mask
+                current = trial
+    return current
+
+
+def run_the_gamut(
+    config: SchedulerConfig,
+    fuzz_result: FuzzResult,
+    wildcards: bool = True,
+    provenance: bool = True,
+    internal_strategy: Optional[RemovalStrategy] = None,
+) -> GamutResult:
+    """The full minimization pipeline (reference: RunnerUtils.runTheGamut,
+    RunnerUtils.scala:171-500): provenance pruning → external DDMin →
+    internal minimization → wildcard (clock-cluster) minimization → final
+    internal minimization."""
+    stats = MinimizationStats()
+    trace, externals, violation = (
+        fuzz_result.trace,
+        fuzz_result.program,
+        fuzz_result.violation,
+    )
+    result = GamutResult(mcs_externals=list(externals), final_trace=trace, stats=stats)
+
+    def record(stage: str, ext: Sequence[ExternalEvent], tr: EventTrace):
+        result.stages.append((stage, len(ext), len(tr.deliveries())))
+
+    record("original", externals, trace)
+
+    if provenance:
+        affected = getattr(violation, "affected_nodes", lambda: ())()
+        if affected:
+            trace = prune_concurrent_events(trace, affected)
+            record("provenance", externals, trace)
+
+    # External-event DDMin.
+    mcs_dag, verified = sts_sched_ddmin(
+        config, trace, externals, violation, stats=stats
+    )
+    externals = mcs_dag.get_all_events()
+    if verified is not None:
+        trace = verified
+    record("ddmin", externals, trace)
+
+    # Internal minimization.
+    trace = minimize_internals(
+        config, trace, externals, violation,
+        strategy=internal_strategy or OneAtATimeStrategy(), stats=stats,
+    )
+    record("int_min", externals, trace)
+
+    if wildcards:
+        def check(candidate: EventTrace) -> Optional[EventTrace]:
+            sts = STSScheduler(config, candidate)
+            return sts.test_with_trace(candidate, list(externals), violation)
+
+        wc = WildcardMinimizer(check, stats=stats)
+        trace = wc.minimize(trace, config.fingerprinter)
+        record("wildcard", externals, trace)
+
+        trace = minimize_internals(
+            config, trace, externals, violation,
+            strategy=SrcDstFIFORemoval(), stats=stats,
+        )
+        record("int_min2", externals, trace)
+
+    result.mcs_externals = list(externals)
+    result.final_trace = trace
+    return result
+
+
+def print_minimization_stats(result: GamutResult) -> str:
+    """Human-readable pipeline summary (reference:
+    RunnerUtils.printMinimizationStats, RunnerUtils.scala:1200-1266)."""
+    lines = ["stage            externals  deliveries"]
+    for stage, ext, deliv in result.stages:
+        lines.append(f"{stage:<16} {ext:>9}  {deliv:>10}")
+    lines.append(f"total oracle replays: {result.stats.total_replays}")
+    text = "\n".join(lines)
+    print(text)
+    return text

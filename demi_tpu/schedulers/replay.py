@@ -317,9 +317,6 @@ class STSScheduler(TraceFollowingScheduler, TestOracle):
         stats=None,
         init: Optional[str] = None,
     ) -> Optional[EventTrace]:
-        if stats is not None:
-            stats.record_replay()
-            stats.record_replay_start()
         filtered = (
             self.original_trace.filter_failure_detector_messages()
             .filter_checkpoint_messages()
@@ -327,8 +324,23 @@ class STSScheduler(TraceFollowingScheduler, TestOracle):
                 externals, filter_known_absents=self.config.filter_known_absents
             )
         )
+        return self.test_with_trace(filtered, externals, violation_fingerprint, stats)
+
+    def test_with_trace(
+        self,
+        expected: EventTrace,
+        externals: Sequence[ExternalEvent],
+        violation_fingerprint: Any,
+        stats=None,
+    ) -> Optional[EventTrace]:
+        """Replay a caller-supplied expected schedule (internal minimization
+        hands in the original trace minus candidate deliveries; reference:
+        RunnerUtils.testWithStsSched, RunnerUtils.scala:913-943)."""
+        if stats is not None:
+            stats.record_replay()
+            stats.record_replay_start()
         try:
-            result = self.replay(filtered, externals)
+            result = self.replay(expected, externals)
         except ReplayException:
             return None
         finally:

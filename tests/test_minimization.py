@@ -1,0 +1,178 @@
+"""Internal minimization, wildcards, provenance, the gamut pipeline, and
+device-batched oracles."""
+
+import numpy as np
+import pytest
+
+from demi_tpu.apps.broadcast import (
+    TAG_BCAST,
+    broadcast_send_generator,
+    make_broadcast_app,
+)
+from demi_tpu.apps.common import dsl_start_events, make_host_invariant
+from demi_tpu.config import SchedulerConfig
+from demi_tpu.device import DeviceConfig
+from demi_tpu.device.batch_oracle import (
+    DeviceReplayChecker,
+    DeviceSTSOracle,
+    make_batched_internal_check,
+)
+from demi_tpu.events import MsgEvent
+from demi_tpu.external_events import (
+    MessageConstructor,
+    Send,
+    WaitQuiescence,
+)
+from demi_tpu.fuzzing import Fuzzer, FuzzerWeights
+from demi_tpu.minimization.ddmin import DDMin, make_dag
+from demi_tpu.minimization.internal import (
+    BatchedInternalMinimizer,
+    OneAtATimeStrategy,
+    SrcDstFIFORemoval,
+    STSSchedMinimizer,
+    removable_delivery_indices,
+)
+from demi_tpu.minimization.provenance import prune_concurrent_events
+from demi_tpu.minimization.wildcards import WildcardMinimizer, WildcardTestOracle
+from demi_tpu.runner import (
+    fuzz,
+    minimize_internals,
+    print_minimization_stats,
+    run_the_gamut,
+)
+from demi_tpu.schedulers import RandomScheduler, STSScheduler
+
+
+def _setup(n=3, seed_range=range(20)):
+    """Fuzz the unreliable broadcast to a violation."""
+    app = make_broadcast_app(n, reliable=False)
+    config = SchedulerConfig(invariant_check=make_host_invariant(app))
+    fuzzer = Fuzzer(
+        num_events=12,
+        weights=FuzzerWeights(kill=0.05, send=0.6, wait_quiescence=0.15),
+        message_gen=broadcast_send_generator(app),
+        prefix=dsl_start_events(app),
+        max_kills=1,
+    )
+    result = fuzz(config, fuzzer, max_executions=30, seed=0)
+    assert result is not None
+    return app, config, result
+
+
+def test_fuzz_with_replay_validation():
+    app = make_broadcast_app(3, reliable=False)
+    config = SchedulerConfig(invariant_check=make_host_invariant(app))
+    fuzzer = Fuzzer(
+        num_events=10,
+        weights=FuzzerWeights(kill=0.05, send=0.6, wait_quiescence=0.15),
+        message_gen=broadcast_send_generator(app),
+        prefix=dsl_start_events(app),
+        max_kills=1,
+    )
+    result = fuzz(config, fuzzer, max_executions=30, validate_replay=True)
+    assert result is not None
+    assert result.violation is not None
+
+
+def test_internal_minimization_shrinks_deliveries():
+    app, config, fr = _setup()
+
+    trace = minimize_internals(
+        config, fr.trace, fr.program, fr.violation, strategy=OneAtATimeStrategy()
+    )
+    assert len(trace.deliveries()) <= len(fr.trace.deliveries())
+    # The minimized schedule still reproduces.
+    sts = STSScheduler(config, trace)
+    assert (
+        sts.test_with_trace(trace, fr.program, fr.violation) is not None
+    )
+
+
+def test_srcdst_fifo_removal_runs():
+    app, config, fr = _setup()
+    trace = minimize_internals(
+        config, fr.trace, fr.program, fr.violation, strategy=SrcDstFIFORemoval()
+    )
+    assert len(trace.deliveries()) <= len(fr.trace.deliveries())
+
+
+def test_wildcard_minimizer():
+    app, config, fr = _setup()
+
+    def check(candidate):
+        sts = STSScheduler(config, candidate)
+        return sts.test_with_trace(candidate, fr.program, fr.violation)
+
+    wc = WildcardMinimizer(check)
+    trace = wc.minimize(fr.trace, config.fingerprinter)
+    assert len(trace.deliveries()) <= len(fr.trace.deliveries())
+
+
+def test_wildcard_test_oracle_with_ddmin():
+    app, config, fr = _setup()
+    oracle = WildcardTestOracle(
+        lambda: STSScheduler(config, fr.trace), fr.trace
+    )
+    ddmin = DDMin(oracle, check_unmodified=True)
+    mcs = ddmin.minimize(make_dag(fr.program), fr.violation)
+    assert len(mcs.get_all_events()) <= len(fr.program)
+    assert ddmin.verify_mcs(mcs, fr.violation) is not None
+
+
+def test_provenance_pruning_preserves_violation():
+    app, config, fr = _setup()
+    pruned = prune_concurrent_events(fr.trace, fr.violation.affected_nodes())
+    assert len(pruned.events) <= len(fr.trace.events)
+    sts = STSScheduler(config, pruned)
+    assert sts.test_with_trace(pruned, fr.program, fr.violation) is not None
+
+
+def test_run_the_gamut_end_to_end():
+    app, config, fr = _setup()
+    result = run_the_gamut(config, fr)
+    # The pipeline must shrink both dimensions and stay reproducing.
+    assert len(result.mcs_externals) <= len(fr.program)
+    assert len(result.final_trace.deliveries()) <= len(fr.trace.deliveries())
+    sts = STSScheduler(config, result.final_trace)
+    assert (
+        sts.test_with_trace(result.final_trace, result.mcs_externals, fr.violation)
+        is not None
+    )
+    summary = print_minimization_stats(result)
+    assert "ddmin" in summary
+
+
+def test_device_batched_internal_minimizer_matches_host():
+    app, config, fr = _setup()
+    cfg = DeviceConfig.for_app(
+        app, pool_capacity=64, max_steps=128, max_external_ops=32
+    )
+    checker = DeviceReplayChecker(app, cfg, config)
+    batch_check = make_batched_internal_check(checker, fr.program, fr.violation)
+    batched = BatchedInternalMinimizer(batch_check)
+    device_trace = batched.minimize(fr.trace)
+
+    host_trace = minimize_internals(
+        config, fr.trace, fr.program, fr.violation, strategy=OneAtATimeStrategy()
+    )
+    # Same fixpoint size (both adopt the first reproducing single-removal
+    # per round, in the same deterministic order).
+    assert len(device_trace.deliveries()) == len(host_trace.deliveries())
+
+
+def test_device_sts_oracle_ddmin():
+    app, config, fr = _setup()
+    cfg = DeviceConfig.for_app(
+        app, pool_capacity=64, max_steps=128, max_external_ops=32
+    )
+    oracle = DeviceSTSOracle(app, cfg, config, fr.trace)
+    ddmin = DDMin(oracle, check_unmodified=True)
+    mcs = ddmin.minimize(make_dag(fr.program), fr.violation)
+    assert ddmin.verify_mcs(mcs, fr.violation) is not None
+    # Host oracle agrees on the MCS.
+    from demi_tpu.schedulers import sts_oracle as host_oracle
+
+    assert (
+        host_oracle(config, fr.trace).test(mcs.get_all_events(), fr.violation)
+        is not None
+    )
