@@ -19,16 +19,14 @@ from ..external_events import MessageConstructor, Send, Start
 from ..minimization.test_oracle import IntViolation
 from ..runtime.actor import dsl_actor_factory
 
-_INV_CACHE: dict = {}
-
-
 def _jitted_invariant(app: DSLApp):
-    fn = _INV_CACHE.get(id(app))
+    # Cached on the app instance (id(app)-keyed globals collide after GC).
+    fn = getattr(app, "_jitted_invariant", None)
     if fn is None:
         from ..utils.hostjit import host_jit
 
         fn = host_jit(app.invariant)
-        _INV_CACHE[id(app)] = fn
+        object.__setattr__(app, "_jitted_invariant", fn)
     return fn
 
 

@@ -1,0 +1,235 @@
+"""Command-line interface: fuzz / minimize / replay / interactive / sweep.
+
+Reference: the coarse CLI mode strings of RunnerUtils.getExecutionMode
+(RunnerUtils.scala:40-60: --fuzz/--minimize/--interactive) — grown into a
+real subcommand CLI over the built-in apps.
+
+    python -m demi_tpu fuzz --app raft --nodes 3 --bug multivote -o exp/
+    python -m demi_tpu minimize -e exp/ --app raft --nodes 3 --bug multivote
+    python -m demi_tpu replay -e exp/ --app raft --nodes 3 --bug multivote
+    python -m demi_tpu sweep --app raft --nodes 3 --bug multivote --batch 1024
+    python -m demi_tpu interactive --app broadcast --nodes 3
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Optional
+
+from .apps.broadcast import broadcast_send_generator, make_broadcast_app
+from .apps.common import dsl_start_events, make_host_invariant
+from .apps.raft import make_raft_app, raft_send_generator
+from .config import SchedulerConfig
+from .dsl import DSLApp
+from .external_events import WaitQuiescence
+from .fuzzing import Fuzzer, FuzzerWeights
+
+
+def build_app(args) -> DSLApp:
+    if args.app == "broadcast":
+        return make_broadcast_app(args.nodes, reliable=args.bug is None)
+    if args.app == "raft":
+        return make_raft_app(args.nodes, bug=args.bug)
+    raise SystemExit(f"unknown app {args.app!r} (choices: broadcast, raft)")
+
+
+def build_fuzzer(app: DSLApp, args) -> Fuzzer:
+    gen = (
+        broadcast_send_generator(app)
+        if args.app == "broadcast"
+        else raft_send_generator(app)
+    )
+    weights = FuzzerWeights(
+        kill=args.kill_weight,
+        send=0.6,
+        wait_quiescence=0.15,
+        partition=args.partition_weight,
+        unpartition=args.partition_weight,
+    )
+    return Fuzzer(
+        num_events=args.num_events,
+        weights=weights,
+        message_gen=gen,
+        prefix=dsl_start_events(app),
+        max_kills=1,
+    )
+
+
+def cmd_fuzz(args) -> int:
+    from .runner import fuzz
+    from .serialization import ExperimentSerializer
+
+    app = build_app(args)
+    config = SchedulerConfig(invariant_check=make_host_invariant(app))
+    result = fuzz(
+        config,
+        build_fuzzer(app, args),
+        max_executions=args.max_executions,
+        seed=args.seed,
+        max_messages=args.max_messages,
+        invariant_check_interval=1,
+        timer_weight=args.timer_weight,
+        validate_replay=True,
+    )
+    if result is None:
+        print("no violation found")
+        return 1
+    print(
+        f"violation {result.violation} after {result.executions} executions; "
+        f"{len(result.program)} externals, {len(result.trace.deliveries())} deliveries"
+    )
+    if args.output:
+        ExperimentSerializer.save(
+            args.output, result.program, result.trace, result.violation,
+            app_name=args.app,
+        )
+        print(f"experiment saved to {args.output}")
+    return 0
+
+
+def cmd_minimize(args) -> int:
+    from .runner import FuzzResult, print_minimization_stats, run_the_gamut
+    from .serialization import ExperimentDeserializer, ExperimentSerializer
+
+    app = build_app(args)
+    config = SchedulerConfig(invariant_check=make_host_invariant(app))
+    de = ExperimentDeserializer(args.experiment, app)
+    externals = de.get_externals()
+    trace = de.get_trace(externals)
+    violation = de.get_violation()
+    fr = FuzzResult(program=externals, trace=trace, violation=violation, executions=0)
+    result = run_the_gamut(config, fr, wildcards=not args.no_wildcards)
+    print_minimization_stats(result)
+    ExperimentSerializer.save(
+        args.experiment, externals, trace, violation, app_name=args.app,
+        mcs=result.mcs_externals, minimized_trace=result.final_trace,
+        stats=result.stats,
+    )
+    print(f"MCS + minimized trace saved to {args.experiment}")
+    return 0
+
+
+def cmd_replay(args) -> int:
+    from .schedulers.replay import ReplayScheduler
+    from .serialization import ExperimentDeserializer
+
+    app = build_app(args)
+    config = SchedulerConfig(invariant_check=make_host_invariant(app))
+    de = ExperimentDeserializer(args.experiment, app)
+    externals = de.get_externals()
+    trace = de.get_trace(externals)
+    result = ReplayScheduler(config).replay(trace, externals)
+    print(
+        f"replayed {result.deliveries} deliveries; violation: {result.violation}"
+    )
+    return 0 if result.violation is not None else 1
+
+
+def cmd_sweep(args) -> int:
+    import numpy as np
+    import jax
+
+    from .device import DeviceConfig, make_explore_kernel
+    from .device.core import ST_VIOLATION
+    from .device.encoding import lower_program, stack_programs
+
+    app = build_app(args)
+    cfg = DeviceConfig.for_app(
+        app,
+        pool_capacity=args.pool,
+        max_steps=args.max_messages,
+        max_external_ops=max(16, args.num_events + app.num_actors + 2),
+        invariant_interval=1,
+        timer_weight=args.timer_weight,
+    )
+    fuzzer = build_fuzzer(app, args)
+    programs = [
+        fuzzer.generate_fuzz_test(seed=args.seed + i) for i in range(args.batch)
+    ]
+    progs = stack_programs([lower_program(app, cfg, p) for p in programs])
+    keys = jax.random.split(jax.random.PRNGKey(args.seed), args.batch)
+    kernel = make_explore_kernel(app, cfg)
+    res = kernel(progs, keys)
+    violations = np.asarray(res.violation)
+    lanes = np.nonzero(np.asarray(res.status) == ST_VIOLATION)[0]
+    print(
+        json.dumps(
+            {
+                "lanes": args.batch,
+                "violations": int((violations != 0).sum()),
+                "codes": {
+                    str(int(c)): int((violations == c).sum())
+                    for c in np.unique(violations)
+                    if c != 0
+                },
+                "first_violating_lane": int(lanes[0]) if len(lanes) else None,
+            }
+        )
+    )
+    return 0
+
+
+def cmd_interactive(args) -> int:
+    from .schedulers.interactive import InteractiveScheduler
+
+    app = build_app(args)
+    config = SchedulerConfig(invariant_check=make_host_invariant(app))
+    sched = InteractiveScheduler(config)
+    program = dsl_start_events(app) + [WaitQuiescence()]
+    result = sched.run_session(program)
+    print(f"session over: {result.deliveries} deliveries, violation {result.violation}")
+    return 0
+
+
+def main(argv: Optional[list] = None) -> int:
+    parser = argparse.ArgumentParser(prog="demi_tpu")
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    def common(p):
+        p.add_argument("--app", default="broadcast")
+        p.add_argument("--nodes", type=int, default=3)
+        p.add_argument("--bug", default=None)
+        p.add_argument("--seed", type=int, default=0)
+        p.add_argument("--num-events", type=int, default=12, dest="num_events")
+        p.add_argument("--max-messages", type=int, default=400, dest="max_messages")
+        p.add_argument("--timer-weight", type=float, default=0.2, dest="timer_weight")
+        p.add_argument("--kill-weight", type=float, default=0.05, dest="kill_weight")
+        p.add_argument(
+            "--partition-weight", type=float, default=0.0, dest="partition_weight"
+        )
+
+    p = sub.add_parser("fuzz", help="random fuzzing until a violation")
+    common(p)
+    p.add_argument("--max-executions", type=int, default=200, dest="max_executions")
+    p.add_argument("-o", "--output", default=None)
+    p.set_defaults(fn=cmd_fuzz)
+
+    p = sub.add_parser("minimize", help="run the minimization gamut on an experiment")
+    common(p)
+    p.add_argument("-e", "--experiment", required=True)
+    p.add_argument("--no-wildcards", action="store_true")
+    p.set_defaults(fn=cmd_minimize)
+
+    p = sub.add_parser("replay", help="strict-replay an experiment")
+    common(p)
+    p.add_argument("-e", "--experiment", required=True)
+    p.set_defaults(fn=cmd_replay)
+
+    p = sub.add_parser("sweep", help="device-batched fuzz sweep")
+    common(p)
+    p.add_argument("--batch", type=int, default=256)
+    p.add_argument("--pool", type=int, default=256)
+    p.set_defaults(fn=cmd_sweep)
+
+    p = sub.add_parser("interactive", help="hand-drive a schedule")
+    common(p)
+    p.set_defaults(fn=cmd_interactive)
+
+    args = parser.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

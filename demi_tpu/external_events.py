@@ -9,15 +9,35 @@ trace positions stay distinguishable across subsequence trials.
 
 from __future__ import annotations
 
-import itertools
 from dataclasses import dataclass, field
 from typing import Any, Callable, List, Optional, Sequence
 
-_eid_counter = itertools.count(1)
+
+class _EidCounter:
+    def __init__(self):
+        self._next = 1
+
+    def next(self) -> int:
+        value = self._next
+        self._next += 1
+        return value
+
+    def ensure_floor(self, floor: int) -> None:
+        """Advance past ``floor`` — deserialization restores recorded eids
+        and must keep fresh events from aliasing them (eids are identity)."""
+        if self._next <= floor:
+            self._next = floor + 1
+
+
+_eid_counter = _EidCounter()
 
 
 def _next_eid() -> int:
-    return next(_eid_counter)
+    return _eid_counter.next()
+
+
+def ensure_eid_floor(floor: int) -> None:
+    _eid_counter.ensure_floor(floor)
 
 
 class MessageConstructor:

@@ -1,0 +1,78 @@
+"""IncrementalDDMin: DDMin over a DPOR oracle with a growing edit-distance
+budget.
+
+Reference: minification/IncrementalDeltaDebugging.scala (122 LoC) — run
+DDMin with DPOR capped at max edit distance 0, 2, 4, …, maxMaxDistance,
+relying on DPOR never re-exploring interleavings; ResumableDPOR keeps one
+live DPOR instance per external subsequence (:94-122).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from ..config import SchedulerConfig
+from ..external_events import ExternalEvent
+from ..schedulers.dpor import DPORScheduler
+from ..trace import EventTrace
+from .ddmin import DDMin, Minimizer, make_dag
+from .event_dag import EventDag
+from .stats import MinimizationStats
+from .test_oracle import TestOracle
+
+
+class ResumableDPOR(TestOracle):
+    """One DPOR instance per external subsequence, so repeated DDMin probes
+    of the same subsequence resume instead of restarting."""
+
+    def __init__(self, config: SchedulerConfig, dpor_kwargs: Optional[dict] = None):
+        self.config = config
+        self.dpor_kwargs = dict(dpor_kwargs or {})
+        self.instances: Dict[Tuple[int, ...], DPORScheduler] = {}
+        self.max_distance: Optional[int] = None
+
+    def _instance(self, externals: Sequence[ExternalEvent]) -> DPORScheduler:
+        key = tuple(e.eid for e in externals)
+        inst = self.instances.get(key)
+        if inst is None:
+            inst = DPORScheduler(
+                self.config, arvind_ordering=True, **self.dpor_kwargs
+            )
+            self.instances[key] = inst
+        inst.max_distance = self.max_distance
+        return inst
+
+    def test(self, externals, violation_fingerprint, stats=None, init=None):
+        return self._instance(externals).test(
+            externals, violation_fingerprint, stats=stats, init=init
+        )
+
+
+class IncrementalDDMin(Minimizer):
+    """Reference: IncrementalDeltaDebugging.minimize (:42-75)."""
+
+    def __init__(
+        self,
+        config: SchedulerConfig,
+        max_max_distance: int = 8,
+        stats: Optional[MinimizationStats] = None,
+        dpor_kwargs: Optional[dict] = None,
+    ):
+        self.oracle = ResumableDPOR(config, dpor_kwargs)
+        self.max_max_distance = max_max_distance
+        self.stats = stats or MinimizationStats()
+
+    def minimize(self, dag: EventDag, violation_fingerprint: Any, init=None) -> EventDag:
+        current = dag
+        distance = 0
+        while distance <= self.max_max_distance:
+            self.oracle.max_distance = distance
+            self.stats.update_strategy(
+                f"IncDDMin(dist={distance})", "ResumableDPOR"
+            )
+            ddmin = DDMin(self.oracle, check_unmodified=False, stats=self.stats)
+            candidate = ddmin.minimize(current, violation_fingerprint, init=init)
+            if len(candidate.get_all_events()) < len(current.get_all_events()):
+                current = candidate
+            distance = 2 if distance == 0 else distance * 2
+        return current

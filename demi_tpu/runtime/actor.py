@@ -112,16 +112,15 @@ class DSLActorAdapter(Actor):
                 ctx.send(self.app.actor_name(dst_id), msg)
 
 
-_HANDLER_CACHE: dict = {}
-
-
 def _jitted_handler(app: DSLApp):
-    fn = _HANDLER_CACHE.get(id(app))
+    # Cached on the app instance itself — a global dict keyed by id(app)
+    # collides when ids are reused after GC.
+    fn = getattr(app, "_jitted_handler", None)
     if fn is None:
         from ..utils.hostjit import host_jit
 
         fn = host_jit(app.handler)
-        _HANDLER_CACHE[id(app)] = fn
+        object.__setattr__(app, "_jitted_handler", fn)
     return fn
 
 

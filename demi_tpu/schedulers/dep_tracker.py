@@ -1,0 +1,119 @@
+"""DepTracker: stable event identities + the happens-before forest.
+
+Reference: verification/DepTracker.scala (173 LoC). Every delivery gets an
+id that is *stable across re-executions*: keyed by (snd, rcv, fingerprint,
+parent-delivery id, occurrence#), where the parent is the delivery during
+whose handler the message was sent (DepTracker.getMessage:82-109 dedups the
+same way). The parent edges form a forest, so happens-before between two
+deliveries reduces to an ancestor check — which is also what makes the
+racing-pair scan vectorizable (ancestor bitsets; SURVEY.md §7.2 step 7).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+import numpy as np
+
+ROOT = 0  # externals' parent
+
+
+@dataclass(frozen=True)
+class DporEvent:
+    """One schedulable event in the DPOR universe."""
+
+    id: int
+    snd: str
+    rcv: str
+    fingerprint: Any
+    parent: int
+    is_timer: bool = False
+
+
+class DepTracker:
+    def __init__(self, fingerprinter):
+        self.fingerprinter = fingerprinter
+        self._ids: Dict[Tuple, int] = {}
+        self.events: Dict[int, DporEvent] = {}
+        self._next_id = 1
+        # Per (key, parent) occurrence counters for the *current* execution;
+        # reset between executions so re-sends map to the same ids.
+        self._occurrence: Dict[Tuple, int] = {}
+        # Ancestor bitsets, grown lazily: _ancestors[id] has bit k set iff
+        # event k happens-before event id (k on id's parent chain).
+        self._ancestors: Dict[int, np.ndarray] = {ROOT: np.zeros(1, np.uint64)}
+
+    # -- per-execution lifecycle ------------------------------------------
+    def begin_execution(self) -> None:
+        self._occurrence.clear()
+
+    # -- id assignment -----------------------------------------------------
+    def event_for(
+        self, snd: str, rcv: str, msg: Any, parent: int, is_timer: bool = False
+    ) -> DporEvent:
+        fp = self.fingerprinter.fingerprint(msg)
+        base_key = (snd, rcv, fp, parent, is_timer)
+        occ = self._occurrence.get(base_key, 0)
+        self._occurrence[base_key] = occ + 1
+        key = base_key + (occ,)
+        eid = self._ids.get(key)
+        if eid is None:
+            eid = self._next_id
+            self._next_id += 1
+            self._ids[key] = eid
+            event = DporEvent(eid, snd, rcv, fp, parent, is_timer)
+            self.events[eid] = event
+            self._ancestors[eid] = self._ancestor_bits(parent, eid)
+        return self.events[eid]
+
+    def _ancestor_bits(self, parent: int, eid: int) -> np.ndarray:
+        words = eid // 64 + 1
+        bits = np.zeros(words, np.uint64)
+        pbits = self._ancestors.get(parent)
+        if pbits is not None:
+            bits[: len(pbits)] |= pbits
+        if parent != ROOT:
+            bits[parent // 64] |= np.uint64(1) << np.uint64(parent % 64)
+        return bits
+
+    # -- happens-before ----------------------------------------------------
+    def is_ancestor(self, a: int, b: int) -> bool:
+        """True iff a happens-before b (a on b's parent chain)."""
+        bits = self._ancestors.get(b)
+        if bits is None:
+            return False
+        word = a // 64
+        return word < len(bits) and bool(bits[word] >> np.uint64(a % 64) & np.uint64(1))
+
+    def concurrent(self, a: int, b: int) -> bool:
+        return not self.is_ancestor(a, b) and not self.is_ancestor(b, a)
+
+    # -- the racing-pair scan (vectorized) --------------------------------
+    def racing_pairs(self, trace: List[int]) -> List[Tuple[int, int]]:
+        """All (i, j) index pairs in ``trace`` (i < j) whose events race:
+        same receiver, concurrent (neither is the other's ancestor).
+
+        The O(n²) scan the reference does pairwise with graph-path queries
+        (DPORwHeuristics.scala:1122-1139) — here a handful of boolean
+        matrix ops over ancestor bitsets."""
+        n = len(trace)
+        if n < 2:
+            return []
+        ids = np.asarray(trace)
+        rcvs = np.asarray([hash(self.events[e].rcv) for e in trace])
+        max_words = max(len(self._ancestors[e]) for e in trace)
+        anc = np.zeros((n, max_words), np.uint64)
+        for k, e in enumerate(trace):
+            bits = self._ancestors[e]
+            anc[k, : len(bits)] = bits
+        # ancestor_matrix[i, j] = trace[i] happens-before trace[j]
+        word = ids // 64
+        bit = (ids % 64).astype(np.uint64)
+        hb = (anc[:, word] >> bit[None, :]) & np.uint64(1)  # [j, i] -> i in anc(j)
+        ancestor = hb.T.astype(bool)  # [i, j]
+        same_rcv = rcvs[:, None] == rcvs[None, :]
+        upper = np.triu(np.ones((n, n), bool), k=1)
+        racing = upper & same_rcv & ~ancestor & ~ancestor.T
+        out = np.argwhere(racing)
+        return [(int(i), int(j)) for i, j in out]

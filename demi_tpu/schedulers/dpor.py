@@ -1,0 +1,271 @@
+"""DPOR with heuristics: systematic schedule-space exploration.
+
+Reference: schedulers/DPOR.scala (710, the classic depth-first original)
+and schedulers/DPORwHeuristics.scala (1304 — the production version with
+priority-queue backtracking, bounds, budgets, divergence tolerance, and
+TestOracle duty), plus schedulers/BacktrackOrdering.scala (174).
+
+Re-derivation: one execution runs on the sequential host engine with a
+*prescribed prefix* of DporEvent ids; after each execution the racing-pair
+scan (vectorized over ancestor bitsets — see DepTracker.racing_pairs) emits
+backtrack points (prefix + flipped event), deduped by an explored-set and
+ordered by a pluggable heuristic. Because pending sets are recorded per
+step, backtrack points are only enqueued when the flipped event was
+actually deliverable at the branch index — strictly tighter than the
+reference's graph-path approximation (DPORwHeuristics.scala:1043-1077).
+
+Scope note: exploration reorders *deliveries*; external injections stay at
+their segment (quiescence) boundaries, as in the reference's
+quiescent-period restriction (DPORwHeuristics.scala:1098-1100).
+"""
+
+from __future__ import annotations
+
+import heapq
+import time as _time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Set, Tuple
+
+from ..config import SchedulerConfig
+from ..external_events import ExternalEvent
+from ..minimization.test_oracle import TestOracle
+from ..runtime.system import PendingEntry
+from ..trace import EventTrace
+from .base import BaseScheduler, ExecutionResult
+from .dep_tracker import ROOT, DepTracker, DporEvent
+from .random import _violation_matches
+
+
+class BacktrackOrdering:
+    """Priority for backtrack points; smaller = explored sooner
+    (reference: BacktrackOrdering.scala)."""
+
+    def priority(self, prefix: Tuple[int, ...], original_trace: Sequence[int]) -> float:
+        raise NotImplementedError
+
+
+class DefaultBacktrackOrdering(BacktrackOrdering):
+    """Deepest-first (classic DPOR; reference :58-69)."""
+
+    def priority(self, prefix, original_trace) -> float:
+        return -len(prefix)
+
+
+class StopImmediatelyOrdering(BacktrackOrdering):
+    """Makes the explorer stop after the initial interleaving
+    (reference :72-81)."""
+
+    def priority(self, prefix, original_trace) -> float:
+        return float("inf")
+
+
+def arvind_distance(prefix: Sequence[int], original: Sequence[int]) -> int:
+    """Modified edit distance to the original trace: count events not in
+    the original plus misordered pairs; deletions are free
+    (reference: ArvindDistanceOrdering.arvindDistance,
+    BacktrackOrdering.scala:116-144)."""
+    orig_pos = {e: i for i, e in enumerate(original)}
+    unexpected = sum(1 for e in prefix if e not in orig_pos)
+    known = [orig_pos[e] for e in prefix if e in orig_pos]
+    misordered = sum(
+        1
+        for i in range(len(known))
+        for j in range(i + 1, len(known))
+        if known[i] > known[j]
+    )
+    return unexpected + misordered
+
+
+class ArvindDistanceOrdering(BacktrackOrdering):
+    """Prefer backtracks closest to the original trace — the ordering
+    IncrementalDDMin relies on (reference :99-173)."""
+
+    def __init__(self, original_trace: Sequence[int]):
+        self.original = list(original_trace)
+
+    def priority(self, prefix, original_trace) -> float:
+        return arvind_distance(prefix, self.original)
+
+
+class _DporExecution(BaseScheduler):
+    """One controlled execution following a prescribed DporEvent-id prefix,
+    then a deterministic depth-first default order."""
+
+    def __init__(self, config: SchedulerConfig, tracker: DepTracker,
+                 prescription: Tuple[int, ...], max_messages: int):
+        super().__init__(config, max_messages)
+        self.tracker = tracker
+        self.prescription = list(prescription)
+        self._pending: List[Tuple[PendingEntry, DporEvent]] = []
+        self._current_parent = ROOT
+        self.delivered_ids: List[int] = []
+        self.pending_sets: List[Set[int]] = []
+        self.divergences = 0
+
+    # -- policy hooks ------------------------------------------------------
+    def reset_pending(self) -> None:
+        self._pending = []
+        self._current_parent = ROOT
+        self.delivered_ids = []
+        self.pending_sets = []
+
+    def add_pending(self, entry: PendingEntry) -> None:
+        event = self.tracker.event_for(
+            entry.snd, entry.rcv, entry.msg, self._current_parent,
+            is_timer=entry.is_timer,
+        )
+        self._pending.append((entry, event))
+
+    def pending_entries(self) -> List[PendingEntry]:
+        return [e for e, _ in self._pending]
+
+    def actor_terminated(self, name: str) -> None:
+        self._pending = [
+            (e, ev) for e, ev in self._pending if e.rcv != name and e.snd != name
+        ]
+
+    def choose_next(self) -> Optional[PendingEntry]:
+        deliverable = [
+            (e, ev) for e, ev in self._pending if self.system.deliverable(e)
+        ]
+        if not deliverable:
+            return None
+        self.pending_sets.append({ev.id for _, ev in deliverable})
+        chosen = None
+        while self.prescription:
+            want = self.prescription[0]
+            match = next((p for p in deliverable if p[1].id == want), None)
+            self.prescription.pop(0)
+            if match is not None:
+                chosen = match
+                break
+            self.divergences += 1  # prescribed event absent; skip it
+        if chosen is None:
+            # Default deterministic order: lowest event id (depth-first
+            # canonical; fully reproducible).
+            chosen = min(deliverable, key=lambda p: p[1].id)
+        entry, event = chosen
+        self._pending.remove(chosen)
+        self._current_parent = event.id
+        self.delivered_ids.append(event.id)
+        return entry
+
+
+class DPORScheduler(TestOracle):
+    """The exploration driver + TestOracle.
+
+    State (dep graph, backtrack queue, explored set) persists across
+    ``test()`` calls, giving the resumability IncrementalDDMin needs
+    (reference: DPORwHeuristics reset semantics :225-254 and ResumableDPOR,
+    IncrementalDeltaDebugging.scala:94-122)."""
+
+    def __init__(
+        self,
+        config: SchedulerConfig,
+        max_messages: int = 2_000,
+        max_interleavings: int = 1_000,
+        budget_seconds: float = float("inf"),
+        ordering: Optional[BacktrackOrdering] = None,
+        max_distance: Optional[int] = None,
+        stop_after_next_trace: bool = False,
+        arvind_ordering: bool = False,
+    ):
+        self.config = config
+        self.max_messages = max_messages
+        self.max_interleavings = max_interleavings
+        self.budget_seconds = budget_seconds
+        self.ordering = ordering or DefaultBacktrackOrdering()
+        # Switch to ArvindDistanceOrdering once the first execution fixes
+        # the original trace (it can't exist before then).
+        self._arvind_pending = arvind_ordering and ordering is None
+        self.max_distance = max_distance
+        self.stop_after_next_trace = stop_after_next_trace
+        self.tracker = DepTracker(config.fingerprinter)
+        self._backtracks: List[Tuple[float, int, Tuple[int, ...]]] = []
+        self._explored: Set[Tuple[int, ...]] = set()
+        self._push_counter = 0
+        self.interleavings_explored = 0
+        self.original_trace_ids: Optional[List[int]] = None
+        self.shortest_violating: Optional[EventTrace] = None
+
+    # -- exploration -------------------------------------------------------
+    def explore(
+        self,
+        externals: Sequence[ExternalEvent],
+        target_violation: Any = None,
+    ) -> Optional[ExecutionResult]:
+        """Systematically explore interleavings until a (matching) violation
+        or bounds are hit. Returns the violating execution, or None."""
+        deadline = _time.monotonic() + self.budget_seconds
+        prescription: Tuple[int, ...] = ()
+        while self.interleavings_explored < self.max_interleavings:
+            if _time.monotonic() > deadline:
+                break
+            execution = _DporExecution(
+                self.config, self.tracker, prescription, self.max_messages
+            )
+            self.tracker.begin_execution()
+            result = execution.execute(list(externals))
+            self.interleavings_explored += 1
+            if self.original_trace_ids is None:
+                self.original_trace_ids = list(execution.delivered_ids)
+                if self._arvind_pending:
+                    self.ordering = ArvindDistanceOrdering(self.original_trace_ids)
+                    self._arvind_pending = False
+            if result.violation is not None and _violation_matches(
+                target_violation, result.violation
+            ):
+                if self.shortest_violating is None or len(result.trace) < len(
+                    self.shortest_violating
+                ):
+                    self.shortest_violating = result.trace
+                return result
+            self._enqueue_backtracks(execution)
+            if self.stop_after_next_trace and self.interleavings_explored >= 2:
+                break
+            nxt = self._pop_backtrack()
+            if nxt is None:
+                break
+            prescription = nxt
+        return None
+
+    def _enqueue_backtracks(self, execution: _DporExecution) -> None:
+        trace = execution.delivered_ids
+        pending_sets = execution.pending_sets
+        for i, j in self.tracker.racing_pairs(trace):
+            flipped = trace[j]
+            if i >= len(pending_sets) or flipped not in pending_sets[i]:
+                continue  # not actually deliverable at the branch point
+            prefix = tuple(trace[:i]) + (flipped,)
+            if prefix in self._explored:
+                continue
+            self._explored.add(prefix)
+            if self.max_distance is not None and self.original_trace_ids:
+                if arvind_distance(prefix, self.original_trace_ids) > self.max_distance:
+                    continue
+            prio = self.ordering.priority(prefix, self.original_trace_ids or [])
+            self._push_counter += 1
+            heapq.heappush(self._backtracks, (prio, self._push_counter, prefix))
+
+    def _pop_backtrack(self) -> Optional[Tuple[int, ...]]:
+        if not self._backtracks:
+            return None
+        prio, _, prefix = heapq.heappop(self._backtracks)
+        if prio == float("inf"):
+            return None
+        return prefix
+
+    # -- TestOracle --------------------------------------------------------
+    def test(
+        self,
+        externals: Sequence[ExternalEvent],
+        violation_fingerprint: Any,
+        stats=None,
+        init: Optional[str] = None,
+    ) -> Optional[EventTrace]:
+        if stats is not None:
+            stats.record_replay()
+        result = self.explore(externals, target_violation=violation_fingerprint)
+        if result is None:
+            return None
+        result.trace.set_original_externals(list(externals))
+        return result.trace

@@ -1,0 +1,121 @@
+"""InteractiveScheduler: hand-drive executions from a console.
+
+Reference: schedulers/InteractiveScheduler.scala (472 LoC) — a jline REPL
+with deliver/inv/fail/start/ext commands producing an EventTrace + optional
+violation. Here the command source is pluggable (stdin or any iterator), so
+interactive sessions are scriptable and testable.
+
+Commands:
+  pending            list deliverable pending events
+  deliver <k>        deliver the k-th listed pending event
+  ext                inject external events up to the next wait
+  inv                run the invariant check now
+  run <n>            deliver n events FIFO
+  quit               end the session
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable, Iterator, List, Optional, Sequence
+
+from ..config import SchedulerConfig
+from ..external_events import ExternalEvent
+from ..runtime.system import PendingEntry
+from .base import BaseScheduler, ExecutionResult
+
+
+class InteractiveScheduler(BaseScheduler):
+    def __init__(
+        self,
+        config: SchedulerConfig,
+        commands: Optional[Iterable[str]] = None,
+        out: Callable[[str], None] = print,
+    ):
+        super().__init__(config)
+        self._commands: Optional[Iterator[str]] = (
+            iter(commands) if commands is not None else None
+        )
+        self.out = out
+
+    # -- policy hooks ------------------------------------------------------
+    def reset_pending(self) -> None:
+        self._pending: List[PendingEntry] = []
+
+    def add_pending(self, entry: PendingEntry) -> None:
+        self._pending.append(entry)
+
+    def pending_entries(self) -> List[PendingEntry]:
+        return list(self._pending)
+
+    def actor_terminated(self, name: str) -> None:
+        self._pending = [
+            e for e in self._pending if e.rcv != name and e.snd != name
+        ]
+
+    def choose_next(self) -> Optional[PendingEntry]:
+        return None  # deliveries are command-driven
+
+    # -- the session -------------------------------------------------------
+    def run_session(self, externals: Sequence[ExternalEvent]) -> ExecutionResult:
+        self.prepare(list(externals))
+        program = list(externals)
+        cursor = 0
+        cursor, _, _ = self._inject_until_wait(program, cursor)
+        violation = None
+        while True:
+            cmd = self._next_command()
+            if cmd is None or cmd == "quit":
+                break
+            parts = cmd.split()
+            if not parts:
+                continue
+            name = parts[0]
+            if name == "pending":
+                for i, entry in enumerate(self._deliverable()):
+                    self.out(f"[{i}] {entry.snd} -> {entry.rcv}: {entry.msg!r}")
+            elif name == "deliver" and len(parts) == 2:
+                deliverable = self._deliverable()
+                k = int(parts[1])
+                if 0 <= k < len(deliverable):
+                    entry = deliverable[k]
+                    self._pending.remove(entry)
+                    self._deliver(entry)
+                else:
+                    self.out(f"no pending event [{k}]")
+            elif name == "run" and len(parts) == 2:
+                for _ in range(int(parts[1])):
+                    deliverable = self._deliverable()
+                    if not deliverable:
+                        break
+                    entry = deliverable[0]
+                    self._pending.remove(entry)
+                    self._deliver(entry)
+            elif name == "ext":
+                cursor, _, _ = self._inject_until_wait(program, cursor)
+                self.out(f"injected through external #{cursor}")
+            elif name == "inv":
+                violation = self.check_invariant()
+                self.out(f"violation: {violation!r}")
+                if violation is not None:
+                    break
+            else:
+                self.out(f"unknown command: {cmd!r}")
+        if violation is None:
+            violation = self.check_invariant()
+        return ExecutionResult(
+            trace=self.trace,
+            violation=violation,
+            deliveries=self.deliveries,
+            quiescent=False,
+        )
+
+    def _deliverable(self) -> List[PendingEntry]:
+        return [e for e in self._pending if self.system.deliverable(e)]
+
+    def _next_command(self) -> Optional[str]:
+        if self._commands is not None:
+            return next(self._commands, None)
+        try:
+            return input("demi> ").strip()
+        except EOFError:
+            return None

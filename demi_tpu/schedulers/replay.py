@@ -19,6 +19,7 @@ Matching policy:
 
 from __future__ import annotations
 
+import copy
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from ..config import SchedulerConfig
@@ -63,8 +64,6 @@ class _ReplayPending:
         self.by_key: Dict[Tuple[str, str, Any], List[PendingEntry]] = {}
         self.timers: Dict[Tuple[str, Any], List[PendingEntry]] = {}
         self.by_external_uid: Dict[int, PendingEntry] = {}
-        # Reverse link for O(1) discard (entry identity -> recorded uid).
-        self._ext_uid_of: Dict[int, int] = {}
         self.all: List[PendingEntry] = []
 
     def add(self, entry: PendingEntry, external_uid: Optional[int] = None) -> None:
@@ -77,7 +76,9 @@ class _ReplayPending:
             self.by_key.setdefault(key, []).append(entry)
             if external_uid is not None:
                 self.by_external_uid[external_uid] = entry
-                self._ext_uid_of[id(entry)] = external_uid
+                # Reverse link stored on the entry itself (O(1) discard,
+                # survives the deepcopy snapshots peek takes).
+                entry.ext_uid = external_uid
 
     def _discard(self, entry: PendingEntry) -> None:
         self.all.remove(entry)
@@ -87,7 +88,7 @@ class _ReplayPending:
         else:
             key = (entry.snd, entry.rcv, self.fingerprinter.fingerprint(entry.msg))
             self.by_key[key].remove(entry)
-            ext_uid = self._ext_uid_of.pop(id(entry), None)
+            ext_uid = getattr(entry, "ext_uid", None)
             if ext_uid is not None:
                 self.by_external_uid.pop(ext_uid, None)
 
@@ -146,11 +147,20 @@ class TraceFollowingScheduler(BaseScheduler):
     #: "raise" (strict replay) or "ignore" (STS).
     absent_policy = "raise"
 
-    def __init__(self, config: SchedulerConfig, max_messages: int = 100_000):
+    def __init__(
+        self,
+        config: SchedulerConfig,
+        max_messages: int = 100_000,
+        allow_peek: bool = False,
+        max_peek_messages: int = 10,
+    ):
         super().__init__(config, max_messages)
         self.rpending: Optional[_ReplayPending] = None
         self.ignored_absent: List[Unique] = []
         self._unignorable_depth = 0
+        self.allow_peek = allow_peek
+        self.max_peek_messages = max_peek_messages
+        self.peeked_prefixes = 0
 
     # BaseScheduler policy hooks (we bypass its dispatch loop but reuse
     # prepare/_deliver/_absorb/_record_send plumbing).
@@ -264,12 +274,17 @@ class TraceFollowingScheduler(BaseScheduler):
         # other meta events: ignore
 
     def _replay_delivery(self, exp: Unique, event: MsgEvent) -> None:
-        if isinstance(event.msg, WildCardMatch):
-            entry = self.rpending.pop_wildcard(event.rcv, event.msg)
-        elif event.is_external:
-            entry = self.rpending.pop_external(exp.id)
-        else:
-            entry = self.rpending.pop_internal(event.snd, event.rcv, event.msg)
+        entry = self._match_delivery(exp, event)
+        if (
+            entry is None
+            and self.allow_peek
+            and self._unignorable_depth == 0
+            # External deliveries match by recorded-uid linkage, which probe
+            # deliveries can never create — peeking for them is guaranteed
+            # to fail and just costs two full-system snapshots.
+            and not (event.is_external and not isinstance(event.msg, WildCardMatch))
+        ):
+            entry = self._peek(exp, event)
         if entry is None:
             self._handle_absent(exp)
             return
@@ -277,6 +292,47 @@ class TraceFollowingScheduler(BaseScheduler):
             self._deliver(entry)
         # Undeliverable (partitioned/killed receiver): dropped, as recorded
         # kills/partitions dictate.
+
+    def _match_delivery(self, exp: Unique, event: MsgEvent) -> Optional[PendingEntry]:
+        if isinstance(event.msg, WildCardMatch):
+            return self.rpending.pop_wildcard(event.rcv, event.msg)
+        if event.is_external:
+            return self.rpending.pop_external(exp.id)
+        return self.rpending.pop_internal(event.snd, event.rcv, event.msg)
+
+    def _peek(self, exp: Unique, event: MsgEvent) -> Optional[PendingEntry]:
+        """Try to *enable* the absent expected event by delivering up to
+        max_peek_messages unexpected pending messages in FIFO order; keep
+        the enabling prefix on success, roll everything back on failure.
+
+        Reference: STSScheduler.peek (STSScheduler.scala:314-378) +
+        IntervalPeekScheduler (IntervalPeekScheduler.scala:130-173). The
+        reference checkpoints the Instrumenter and runs a separate
+        scheduler; a by-construction runtime just snapshots itself."""
+        system_snap = self.system.checkpoint()
+        pending_snap = copy.deepcopy(self.rpending)
+        trace_len = len(self.trace.events)
+        deliveries_before = self.deliveries
+        logs_len = len(self.logs)
+        for _ in range(self.max_peek_messages):
+            candidate = next(
+                (e for e in self.rpending.all if self.system.deliverable(e)), None
+            )
+            if candidate is None:
+                break
+            self.rpending._discard(candidate)
+            self._deliver(candidate)
+            found = self._match_delivery(exp, event)
+            if found is not None:
+                self.peeked_prefixes += 1
+                return found
+        # Roll back the failed probe.
+        self.system.restore(system_snap)
+        self.rpending = pending_snap
+        del self.trace.events[trace_len:]
+        del self.logs[logs_len:]
+        self.deliveries = deliveries_before
+        return None
 
     def _handle_absent(self, exp: Unique) -> None:
         if self.absent_policy == "raise" or self._unignorable_depth > 0:
@@ -306,8 +362,9 @@ class STSScheduler(TraceFollowingScheduler, TestOracle):
         config: SchedulerConfig,
         original_trace: EventTrace,
         max_messages: int = 100_000,
+        **kwargs,
     ):
-        super().__init__(config, max_messages)
+        super().__init__(config, max_messages, **kwargs)
         self.original_trace = original_trace
 
     def test(

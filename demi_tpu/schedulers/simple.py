@@ -1,0 +1,136 @@
+"""The simple scheduler family.
+
+Reference: schedulers/NullScheduler.scala (56), FairScheduler.scala (103),
+BasicScheduler.scala (221), PeekScheduler.scala (197). These are the
+building blocks and baselines: FIFO, round-robin-fair, and "Peek" (record
+one full execution of an external program under fair scheduling, acting as
+a TestOracle).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+from ..config import SchedulerConfig
+from ..external_events import ExternalEvent
+from ..minimization.test_oracle import TestOracle
+from ..runtime.system import PendingEntry
+from ..trace import EventTrace
+from .base import BaseScheduler
+from .random import _violation_matches
+
+
+class NullScheduler(BaseScheduler):
+    """Delivers nothing; external events still apply. The reference's
+    NullScheduler is the boot-time pass-through (everything classified a
+    system message, NullScheduler.scala:26-32) — in a by-construction
+    runtime the analog is simply a scheduler that never dispatches."""
+
+    def reset_pending(self) -> None:
+        self._pending: List[PendingEntry] = []
+
+    def add_pending(self, entry: PendingEntry) -> None:
+        self._pending.append(entry)
+
+    def pending_entries(self) -> List[PendingEntry]:
+        return list(self._pending)
+
+    def actor_terminated(self, name: str) -> None:
+        pass
+
+    def choose_next(self) -> Optional[PendingEntry]:
+        return None
+
+
+class BasicScheduler(BaseScheduler):
+    """Global FIFO: deliver in arrival order
+    (reference: BasicScheduler.scala — per-receiver FIFO prototype)."""
+
+    def reset_pending(self) -> None:
+        self._pending: List[PendingEntry] = []
+
+    def add_pending(self, entry: PendingEntry) -> None:
+        self._pending.append(entry)
+
+    def pending_entries(self) -> List[PendingEntry]:
+        return list(self._pending)
+
+    def actor_terminated(self, name: str) -> None:
+        self._pending = [
+            e for e in self._pending if e.rcv != name and e.snd != name
+        ]
+
+    def choose_next(self) -> Optional[PendingEntry]:
+        for entry in self._pending:
+            if self.system.deliverable(entry):
+                self._pending.remove(entry)
+                return entry
+        # Drop undeliverable heads lazily like the host random scheduler?
+        # Basic keeps them (they may become deliverable after UnPartition).
+        return None
+
+
+class FairScheduler(BaseScheduler):
+    """Round-robin over receivers: each actor in turn gets its oldest
+    deliverable message (reference: FairScheduler.scala:34-70 — whose
+    blocked-actor test at :41 is inverted; fixed here)."""
+
+    def reset_pending(self) -> None:
+        self._queues: Dict[str, List[PendingEntry]] = {}
+        self._order: List[str] = []
+        self._rr = 0
+
+    def add_pending(self, entry: PendingEntry) -> None:
+        if entry.rcv not in self._queues:
+            self._queues[entry.rcv] = []
+            self._order.append(entry.rcv)
+        self._queues[entry.rcv].append(entry)
+
+    def pending_entries(self) -> List[PendingEntry]:
+        return [e for q in self._queues.values() for e in q]
+
+    def actor_terminated(self, name: str) -> None:
+        self._queues.pop(name, None)
+        if name in self._order:
+            self._order.remove(name)
+
+    def choose_next(self) -> Optional[PendingEntry]:
+        if not self._order:
+            return None
+        n = len(self._order)
+        for k in range(n):
+            actor = self._order[(self._rr + k) % n]
+            queue = self._queues.get(actor, [])
+            for entry in queue:
+                if self.system.deliverable(entry):
+                    queue.remove(entry)
+                    self._rr = (self._rr + k + 1) % n
+                    return entry
+        return None
+
+
+class PeekScheduler(FairScheduler, TestOracle):
+    """Record a full fair-order execution of an external program, including
+    all internal events; as a TestOracle, answers whether the program
+    produces the violation under fair scheduling
+    (reference: PeekScheduler.scala:46-52,168-196)."""
+
+    def peek(self, externals: Sequence[ExternalEvent]):
+        return self.execute(list(externals))
+
+    def test(
+        self,
+        externals: Sequence[ExternalEvent],
+        violation_fingerprint: Any,
+        stats=None,
+        init: Optional[str] = None,
+    ) -> Optional[EventTrace]:
+        if stats is not None:
+            stats.record_replay()
+        result = self.execute(list(externals))
+        if result.violation is not None and _violation_matches(
+            violation_fingerprint, result.violation
+        ):
+            result.trace.set_original_externals(list(externals))
+            return result.trace
+        return None

@@ -1,0 +1,321 @@
+"""Experiment persistence: save/restore fuzz+minimization artifacts.
+
+Reference: verification/Serialization.scala (526 LoC). The reference uses
+Java serialization with heavy sanitization (closures → fingerprints,
+ActorRefs re-resolved by re-booting a system, Serialization.scala:124-155).
+Here everything is *structural JSON*: DSL messages are int tuples, external
+events serialize as records, and deserialization rebuilds constructors from
+the app definition — no code objects on disk, diffable experiment dirs.
+
+Layout of an experiment dir (reference files in parens):
+  metadata.json             (lifecycle.py capture)
+  externals.json            (original_externals.bin)
+  event_trace.json          (event_trace.bin)
+  violation.json            (violation.bin)
+  mcs.json                  (mcs.bin)                [optional]
+  minimized_trace.json      (minimizedInternalTrace.bin) [optional]
+  minimization_stats.json   (minimization_stats.json)   [optional]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import platform
+import subprocess
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+from .dsl import DSLApp
+from .events import (
+    BeginUnignorableEvents,
+    BeginWaitCondition,
+    BeginWaitQuiescence,
+    CodeBlockEvent,
+    EndUnignorableEvents,
+    Event,
+    HardKillEvent,
+    KillEvent,
+    MsgEvent,
+    MsgSend,
+    PartitionEvent,
+    Quiescence,
+    SpawnEvent,
+    TimerDelivery,
+    UnPartitionEvent,
+    Unique,
+)
+from .external_events import (
+    ExternalEvent,
+    HardKill,
+    Kill,
+    MessageConstructor,
+    Partition,
+    Send,
+    Start,
+    UnPartition,
+    WaitQuiescence,
+    ensure_eid_floor,
+)
+from .minimization.stats import MinimizationStats
+from .minimization.test_oracle import IntViolation
+from .runtime.actor import dsl_actor_factory
+from .trace import EventTrace
+
+_EVENT_TYPES = {
+    "msg_send": MsgSend,
+    "msg_event": MsgEvent,
+    "timer_delivery": TimerDelivery,
+    "spawn": SpawnEvent,
+    "kill": KillEvent,
+    "hardkill": HardKillEvent,
+    "partition": PartitionEvent,
+    "unpartition": UnPartitionEvent,
+    "quiescence": Quiescence,
+    "begin_wait_quiescence": BeginWaitQuiescence,
+    "begin_wait_condition": BeginWaitCondition,
+    "begin_unignorable": BeginUnignorableEvents,
+    "end_unignorable": EndUnignorableEvents,
+    "code_block": CodeBlockEvent,
+}
+
+
+def _msg_to_json(msg: Any):
+    if isinstance(msg, tuple):
+        return {"t": "tuple", "v": list(int(x) for x in msg)}
+    if isinstance(msg, (int, str, float, bool)) or msg is None:
+        return {"t": "lit", "v": msg}
+    return {"t": "repr", "v": repr(msg)}
+
+
+def _msg_from_json(obj):
+    if obj["t"] == "tuple":
+        return tuple(obj["v"])
+    return obj["v"]
+
+
+def _event_to_json(u: Unique) -> Dict[str, Any]:
+    e = u.event
+    rec: Dict[str, Any] = {"id": u.id}
+    if isinstance(e, MsgSend):
+        rec.update(type="msg_send", snd=e.snd, rcv=e.rcv, msg=_msg_to_json(e.msg))
+    elif isinstance(e, MsgEvent):
+        rec.update(type="msg_event", snd=e.snd, rcv=e.rcv, msg=_msg_to_json(e.msg))
+    elif isinstance(e, TimerDelivery):
+        rec.update(type="timer_delivery", rcv=e.rcv, msg=_msg_to_json(e.msg))
+    elif isinstance(e, SpawnEvent):
+        rec.update(type="spawn", name=e.name)
+    elif isinstance(e, KillEvent):
+        rec.update(type="kill", name=e.name)
+    elif isinstance(e, HardKillEvent):
+        rec.update(type="hardkill", name=e.name)
+    elif isinstance(e, PartitionEvent):
+        rec.update(type="partition", a=e.a, b=e.b)
+    elif isinstance(e, UnPartitionEvent):
+        rec.update(type="unpartition", a=e.a, b=e.b)
+    elif isinstance(e, CodeBlockEvent):
+        rec.update(type="code_block", label=e.label)
+    elif isinstance(e, Quiescence):
+        rec.update(type="quiescence")
+    elif isinstance(e, BeginWaitQuiescence):
+        rec.update(type="begin_wait_quiescence")
+    elif isinstance(e, BeginWaitCondition):
+        rec.update(type="begin_wait_condition")
+    elif isinstance(e, BeginUnignorableEvents):
+        rec.update(type="begin_unignorable")
+    elif isinstance(e, EndUnignorableEvents):
+        rec.update(type="end_unignorable")
+    else:
+        raise TypeError(f"unserializable event {e!r}")
+    return rec
+
+
+def _event_from_json(rec: Dict[str, Any], app: Optional[DSLApp]) -> Unique:
+    t = rec["type"]
+    if t == "msg_send":
+        e: Event = MsgSend(rec["snd"], rec["rcv"], _msg_from_json(rec["msg"]))
+    elif t == "msg_event":
+        e = MsgEvent(rec["snd"], rec["rcv"], _msg_from_json(rec["msg"]))
+    elif t == "timer_delivery":
+        e = TimerDelivery(rec["rcv"], _msg_from_json(rec["msg"]))
+    elif t == "spawn":
+        ctor = None
+        if app is not None:
+            ctor = dsl_actor_factory(app, app.actor_id(rec["name"]))
+        e = SpawnEvent("__external__", rec["name"], ctor=ctor)
+    elif t == "kill":
+        e = KillEvent(rec["name"])
+    elif t == "hardkill":
+        e = HardKillEvent(rec["name"])
+    elif t == "partition":
+        e = PartitionEvent(rec["a"], rec["b"])
+    elif t == "unpartition":
+        e = UnPartitionEvent(rec["a"], rec["b"])
+    elif t == "code_block":
+        e = CodeBlockEvent(rec.get("label", ""))
+    else:
+        e = _EVENT_TYPES[t]()
+    return Unique(e, rec["id"])
+
+
+def _external_to_json(e: ExternalEvent) -> Dict[str, Any]:
+    rec: Dict[str, Any] = {"eid": e.eid}
+    if isinstance(e, Start):
+        rec.update(type="start", name=e.name)
+    elif isinstance(e, Kill):
+        rec.update(type="kill", name=e.name)
+    elif isinstance(e, HardKill):
+        rec.update(type="hardkill", name=e.name)
+    elif isinstance(e, Send):
+        rec.update(type="send", name=e.name, msg=_msg_to_json(e.message()))
+    elif isinstance(e, WaitQuiescence):
+        rec.update(type="wait_quiescence", budget=e.budget)
+    elif isinstance(e, Partition):
+        rec.update(type="partition", a=e.a, b=e.b)
+    elif isinstance(e, UnPartition):
+        rec.update(type="unpartition", a=e.a, b=e.b)
+    else:
+        raise TypeError(
+            f"{type(e).__name__} is not serializable (WaitCondition/CodeBlock "
+            "close over host code; reference sanitization drops them too)"
+        )
+    return rec
+
+
+def _external_from_json(rec: Dict[str, Any], app: Optional[DSLApp]) -> ExternalEvent:
+    t = rec["type"]
+    if t == "start":
+        ctor = None
+        if app is not None:
+            ctor = dsl_actor_factory(app, app.actor_id(rec["name"]))
+        e: ExternalEvent = Start(rec["name"], ctor=ctor)
+    elif t == "kill":
+        e = Kill(rec["name"])
+    elif t == "hardkill":
+        e = HardKill(rec["name"])
+    elif t == "send":
+        msg = _msg_from_json(rec["msg"])
+        e = Send(rec["name"], MessageConstructor(lambda m=msg: m))
+    elif t == "wait_quiescence":
+        e = WaitQuiescence(budget=rec.get("budget"))
+    elif t == "partition":
+        e = Partition(rec["a"], rec["b"])
+    elif t == "unpartition":
+        e = UnPartition(rec["a"], rec["b"])
+    else:
+        raise TypeError(f"unknown external record {t!r}")
+    # Restore the recorded identity: minimization artifacts reference
+    # events by eid (reference: ids preserved via the saved IDGenerator
+    # state, Serialization.scala:181-182,318-321). Advance the global
+    # counter so fresh events never alias restored ones.
+    object.__setattr__(e, "eid", rec["eid"])
+    ensure_eid_floor(rec["eid"])
+    return e
+
+
+def _metadata() -> Dict[str, Any]:
+    """Reference: src/main/python/lifecycle.py — host/git capture."""
+    meta = {
+        "timestamp": time.strftime("%Y-%m-%dT%H:%M:%S"),
+        "host": platform.node(),
+        "platform": platform.platform(),
+    }
+    try:
+        meta["git_sha"] = (
+            subprocess.run(
+                ["git", "rev-parse", "HEAD"], capture_output=True, text=True,
+                timeout=5, cwd=os.path.dirname(os.path.abspath(__file__)),
+            ).stdout.strip()
+        )
+    except Exception:
+        pass
+    return meta
+
+
+class ExperimentSerializer:
+    @staticmethod
+    def save(
+        directory: str,
+        externals: Sequence[ExternalEvent],
+        trace: EventTrace,
+        violation: Any,
+        app_name: str = "",
+        mcs: Optional[Sequence[ExternalEvent]] = None,
+        minimized_trace: Optional[EventTrace] = None,
+        stats: Optional[MinimizationStats] = None,
+    ) -> str:
+        os.makedirs(directory, exist_ok=True)
+
+        def write(name: str, obj) -> None:
+            with open(os.path.join(directory, name), "w") as f:
+                json.dump(obj, f, indent=1)
+
+        write("metadata.json", {**_metadata(), "app": app_name})
+        write("externals.json", [_external_to_json(e) for e in externals])
+        write("event_trace.json", [_event_to_json(u) for u in trace.events])
+        if isinstance(violation, IntViolation):
+            write(
+                "violation.json",
+                {"code": violation.code, "nodes": list(violation.nodes)},
+            )
+        if mcs is not None:
+            write("mcs.json", [e.eid for e in mcs])
+        if minimized_trace is not None:
+            write(
+                "minimized_trace.json",
+                [_event_to_json(u) for u in minimized_trace.events],
+            )
+        if stats is not None:
+            with open(os.path.join(directory, "minimization_stats.json"), "w") as f:
+                f.write(stats.to_json())
+        return directory
+
+
+class ExperimentDeserializer:
+    def __init__(self, directory: str, app: Optional[DSLApp] = None):
+        self.directory = directory
+        self.app = app
+
+    def _read(self, name: str, required: bool = False):
+        path = os.path.join(self.directory, name)
+        if not os.path.exists(path):
+            if required:
+                raise FileNotFoundError(
+                    f"not an experiment dir: {self.directory!r} has no {name}"
+                )
+            return None
+        with open(path) as f:
+            return json.load(f)
+
+    def get_externals(self) -> List[ExternalEvent]:
+        return [
+            _external_from_json(r, self.app)
+            for r in self._read("externals.json", required=True)
+        ]
+
+    def get_trace(self, externals: Optional[Sequence[ExternalEvent]] = None) -> EventTrace:
+        events = [
+            _event_from_json(r, self.app)
+            for r in self._read("event_trace.json", required=True)
+        ]
+        return EventTrace(events, list(externals) if externals else None)
+
+    def get_violation(self) -> Optional[IntViolation]:
+        rec = self._read("violation.json")
+        if rec is None:
+            return None
+        return IntViolation(rec["code"], tuple(rec["nodes"]))
+
+    def get_mcs(self, externals: Sequence[ExternalEvent]) -> Optional[List[ExternalEvent]]:
+        eids = self._read("mcs.json")
+        if eids is None:
+            return None
+        by_eid = {e.eid: e for e in externals}
+        return [by_eid[i] for i in eids]
+
+    def get_stats(self) -> Optional[MinimizationStats]:
+        path = os.path.join(self.directory, "minimization_stats.json")
+        if not os.path.exists(path):
+            return None
+        with open(path) as f:
+            return MinimizationStats.from_json(f.read())

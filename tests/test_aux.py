@@ -1,0 +1,226 @@
+"""Aux subsystems: simple schedulers, STS peek, interactive console,
+serialization round-trip, ShiViz export, CLI."""
+
+import json
+import os
+
+import pytest
+
+from demi_tpu.apps.broadcast import TAG_BCAST, make_broadcast_app
+from demi_tpu.apps.common import dsl_start_events, make_host_invariant
+from demi_tpu.config import SchedulerConfig
+from demi_tpu.events import MsgEvent
+from demi_tpu.external_events import (
+    Kill,
+    MessageConstructor,
+    Send,
+    WaitQuiescence,
+)
+from demi_tpu.schedulers import RandomScheduler, STSScheduler
+from demi_tpu.schedulers.interactive import InteractiveScheduler
+from demi_tpu.schedulers.simple import (
+    BasicScheduler,
+    FairScheduler,
+    NullScheduler,
+    PeekScheduler,
+)
+from demi_tpu.serialization import ExperimentDeserializer, ExperimentSerializer
+from demi_tpu.utils.shiviz import trace_to_shiviz
+
+
+def _app_and_config(reliable=False, n=3):
+    app = make_broadcast_app(n, reliable=reliable)
+    return app, SchedulerConfig(invariant_check=make_host_invariant(app))
+
+
+def _program(app, *extra):
+    return dsl_start_events(app) + list(extra) + [WaitQuiescence()]
+
+
+def _send(app, actor, bid):
+    return Send(app.actor_name(actor), MessageConstructor(lambda: (TAG_BCAST, bid)))
+
+
+def test_null_scheduler_delivers_nothing():
+    app, config = _app_and_config(reliable=True)
+    result = NullScheduler(config).execute(_program(app, _send(app, 0, 0)))
+    assert result.deliveries == 0
+
+
+def test_basic_scheduler_fifo_order():
+    app, config = _app_and_config(reliable=True)
+    result = BasicScheduler(config).execute(
+        _program(app, _send(app, 0, 0), _send(app, 1, 1))
+    )
+    deliveries = [e for e in result.trace.get_events() if isinstance(e, MsgEvent)]
+    # First two deliveries are the externals, in send order.
+    assert deliveries[0].msg == (TAG_BCAST, 0)
+    assert deliveries[1].msg == (TAG_BCAST, 1)
+
+
+def test_fair_scheduler_round_robins():
+    app, config = _app_and_config(reliable=True, n=4)
+    result = FairScheduler(config).execute(
+        _program(app, _send(app, 0, 0))
+    )
+    assert result.deliveries >= 4
+    assert result.violation is None
+
+
+def test_peek_scheduler_as_oracle():
+    app, config = _app_and_config(reliable=False)
+    program = _program(app, _send(app, 0, 0))
+    trace = PeekScheduler(config).test(program, None)
+    assert trace is not None  # fair order reproduces the disagreement
+
+
+def test_sts_peek_enables_absent_event():
+    """Remove a relay delivery X (n0->nk) from the expected schedule. The
+    relays nk sends are still expected, but on replay nk never received —
+    they're absent until the pending X is delivered. Peek probes pending
+    messages FIFO, delivers X, and the expected event becomes matchable;
+    without peek those events are simply skipped."""
+    app, config = _app_and_config(reliable=True)
+    program = _program(app, _send(app, 0, 0))
+    base = RandomScheduler(config, seed=1).execute(program)
+    events = list(base.trace.events)
+    relay_idx = next(
+        i
+        for i, u in enumerate(events)
+        if isinstance(u.event, MsgEvent) and not u.event.is_external
+    )
+    from demi_tpu.trace import EventTrace
+
+    pruned = EventTrace(
+        events[:relay_idx] + events[relay_idx + 1 :], base.trace.original_externals
+    )
+    sts_nopeek = STSScheduler(config, pruned)
+    sts_nopeek.test_with_trace(pruned, program, base.violation)
+    sts_peek = STSScheduler(config, pruned, allow_peek=True)
+    sts_peek.test_with_trace(pruned, program, base.violation)
+    assert sts_peek.peeked_prefixes >= 1, "peek never enabled anything"
+    assert len(sts_peek.ignored_absent) < len(sts_nopeek.ignored_absent)
+
+
+def test_sts_peek_failed_probe_rolls_back():
+    """An expected delivery that can never be enabled (bogus message): the
+    probe must fail and leave the execution identical to a no-peek run."""
+    app, config = _app_and_config(reliable=True)
+    program = _program(app, _send(app, 0, 0), _send(app, 1, 5))
+    base = RandomScheduler(config, seed=2).execute(program)
+    from demi_tpu.events import MsgEvent as ME, Unique
+    from demi_tpu.trace import EventTrace
+
+    events = list(base.trace.events)
+    # Insert a bogus expected delivery mid-trace (message never sent).
+    mid = len(events) // 2
+    bogus = Unique(ME(app.actor_name(0), app.actor_name(1), (TAG_BCAST, 29)), 99999)
+    doctored = EventTrace(
+        events[:mid] + [bogus] + events[mid:], base.trace.original_externals
+    )
+    runs = {}
+    for peek in (False, True):
+        sts = STSScheduler(config, doctored, allow_peek=peek)
+        sts.test_with_trace(doctored, program, base.violation)
+        runs[peek] = [
+            (e.snd, e.rcv, e.msg)
+            for e in sts.trace.get_events()
+            if isinstance(e, ME)
+        ]
+        assert any(u.id == 99999 for u in sts.ignored_absent)
+    assert runs[False] == runs[True], "failed peek left divergent state"
+
+
+def test_interactive_scripted_session():
+    app, config = _app_and_config(reliable=False)
+    out = []
+    sched = InteractiveScheduler(
+        config,
+        commands=["pending", "deliver 0", "inv", "quit"],
+        out=out.append,
+    )
+    program = _program(app, _send(app, 0, 0))
+    result = sched.run_session(program)
+    assert result.deliveries == 1
+    assert result.violation is not None  # one actor delivered, others empty
+    assert any("->" in line for line in out)
+
+
+def test_serialization_round_trip(tmp_path):
+    app, config = _app_and_config(reliable=False)
+    program = _program(app, _send(app, 0, 0), _send(app, 1, 1))
+    result = RandomScheduler(config, seed=2).execute(program)
+    assert result.violation is not None
+
+    exp_dir = str(tmp_path / "exp")
+    ExperimentSerializer.save(
+        exp_dir, program, result.trace, result.violation, app_name="broadcast"
+    )
+    de = ExperimentDeserializer(exp_dir, app)
+    externals = de.get_externals()
+    trace = de.get_trace(externals)
+    violation = de.get_violation()
+    assert [e.eid for e in externals] == [e.eid for e in program]
+    assert violation.matches(result.violation)
+    assert len(trace.events) == len(result.trace.events)
+    # The loaded artifacts still reproduce through the STS oracle.
+    sts = STSScheduler(config, trace)
+    assert sts.test_with_trace(trace, externals, violation) is not None
+
+
+def test_shiviz_export():
+    app, config = _app_and_config(reliable=True)
+    result = RandomScheduler(config, seed=3).execute(
+        _program(app, _send(app, 0, 0))
+    )
+    text = trace_to_shiviz(result.trace)
+    assert "deliver" in text
+    # Every other line is a host + vector clock header.
+    header = text.splitlines()[0]
+    host, clock = header.split(" ", 1)
+    json.loads(clock)
+
+
+def test_cli_fuzz_minimize_replay(tmp_path):
+    from demi_tpu.cli import main
+
+    exp = str(tmp_path / "exp")
+    assert (
+        main(
+            [
+                "fuzz", "--app", "broadcast", "--nodes", "3", "--bug", "x",
+                "--seed", "1", "--max-executions", "40", "-o", exp,
+            ]
+        )
+        == 0
+    )
+    assert os.path.exists(os.path.join(exp, "event_trace.json"))
+    assert (
+        main(["minimize", "--app", "broadcast", "--nodes", "3", "--bug", "x",
+              "-e", exp])
+        == 0
+    )
+    assert os.path.exists(os.path.join(exp, "mcs.json"))
+    assert (
+        main(["replay", "--app", "broadcast", "--nodes", "3", "--bug", "x",
+              "-e", exp])
+        == 0
+    )
+
+
+def test_cli_sweep(tmp_path, capsys):
+    from demi_tpu.cli import main
+
+    assert (
+        main(
+            [
+                "sweep", "--app", "broadcast", "--nodes", "3", "--bug", "x",
+                "--batch", "16", "--max-messages", "64",
+            ]
+        )
+        == 0
+    )
+    out = capsys.readouterr().out.strip().splitlines()[-1]
+    data = json.loads(out)
+    assert data["lanes"] == 16
+    assert data["violations"] >= 1

@@ -1,0 +1,132 @@
+"""DPOR: dependency tracking, racing-pair scan, systematic exploration,
+and IncrementalDDMin."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from demi_tpu.apps.broadcast import make_broadcast_app
+from demi_tpu.apps.common import dsl_start_events, make_host_invariant
+from demi_tpu.config import SchedulerConfig
+from demi_tpu.dsl import DSLApp
+from demi_tpu.external_events import MessageConstructor, Send, WaitQuiescence
+from demi_tpu.fingerprints import FingerprintFactory
+from demi_tpu.minimization.incremental_ddmin import IncrementalDDMin, ResumableDPOR
+from demi_tpu.minimization.ddmin import make_dag
+from demi_tpu.schedulers.dep_tracker import ROOT, DepTracker
+from demi_tpu.schedulers.dpor import (
+    ArvindDistanceOrdering,
+    DPORScheduler,
+    arvind_distance,
+)
+from demi_tpu.schedulers.random import RandomScheduler
+
+
+def make_order_bug_app() -> DSLApp:
+    """Violation iff message B (tag 2) is delivered before message A
+    (tag 1) — strictly order-dependent, so random/default schedules that
+    deliver in send order never trip it; only reordering finds it."""
+
+    def init_state(actor_id):
+        return np.zeros(2, np.int32)  # [got_b_first, got_any]
+
+    def handler(actor_id, state, snd, msg):
+        tag = msg[0]
+        first = state[1] == 0
+        got_b_first = jnp.where((tag == 2) & first, 1, state[0])
+        state = state.at[0].set(got_b_first)
+        state = state.at[1].set(1)
+        return state, jnp.zeros((1, 4), jnp.int32)
+
+    def invariant(states, alive):
+        return jnp.where(jnp.any((states[:, 0] == 1) & alive), jnp.int32(1), 0)
+
+    return DSLApp(
+        name="o", num_actors=2, state_width=2, msg_width=2, max_outbox=1,
+        init_state=init_state, handler=handler, invariant=invariant,
+    )
+
+
+def test_dep_tracker_ids_stable_across_executions():
+    ff = FingerprintFactory()
+    tracker = DepTracker(ff)
+    tracker.begin_execution()
+    a1 = tracker.event_for("x", "y", (1, 0), ROOT)
+    b1 = tracker.event_for("x", "y", (2, 0), ROOT)
+    tracker.begin_execution()
+    a2 = tracker.event_for("x", "y", (1, 0), ROOT)
+    b2 = tracker.event_for("x", "y", (2, 0), ROOT)
+    assert a1.id == a2.id and b1.id == b2.id
+
+
+def test_dep_tracker_ancestry_and_races():
+    ff = FingerprintFactory()
+    tracker = DepTracker(ff)
+    tracker.begin_execution()
+    a = tracker.event_for("x", "r", (1,), ROOT)
+    b = tracker.event_for("r", "r", (2,), a.id)  # sent while delivering a
+    c = tracker.event_for("y", "r", (3,), ROOT)
+    assert tracker.is_ancestor(a.id, b.id)
+    assert not tracker.is_ancestor(b.id, a.id)
+    assert tracker.concurrent(a.id, c.id)
+    pairs = tracker.racing_pairs([a.id, b.id, c.id])
+    # (a,c) and (b,c) race (same receiver, concurrent); (a,b) don't.
+    assert (0, 2) in pairs and (1, 2) in pairs and (0, 1) not in pairs
+
+
+def test_arvind_distance():
+    assert arvind_distance([1, 2, 3], [1, 2, 3]) == 0
+    assert arvind_distance([3, 1], [1, 2, 3]) == 1  # one misordered pair
+    assert arvind_distance([9], [1, 2, 3]) == 1  # one unexpected
+
+
+def test_dpor_finds_order_dependent_bug():
+    app = make_order_bug_app()
+    config = SchedulerConfig(invariant_check=make_host_invariant(app))
+    program = dsl_start_events(app) + [
+        Send(app.actor_name(0), MessageConstructor(lambda: (1, 0))),  # A
+        Send(app.actor_name(0), MessageConstructor(lambda: (2, 0))),  # B
+        WaitQuiescence(),
+    ]
+    # The default deterministic interleaving delivers A then B: no bug.
+    dpor = DPORScheduler(config, max_interleavings=10)
+    result = dpor.explore(program)
+    assert result is not None, "DPOR failed to reorder the racing pair"
+    assert result.violation is not None
+    assert dpor.interleavings_explored >= 2  # needed a backtrack
+
+
+def test_dpor_exhausts_without_bug():
+    app = make_broadcast_app(2, reliable=True)
+    config = SchedulerConfig(invariant_check=make_host_invariant(app))
+    program = dsl_start_events(app) + [
+        Send(app.actor_name(0), MessageConstructor(lambda: (1, 0))),
+        Send(app.actor_name(1), MessageConstructor(lambda: (1, 1))),
+        WaitQuiescence(),
+    ]
+    dpor = DPORScheduler(config, max_interleavings=50)
+    result = dpor.explore(program)
+    assert result is None
+    assert dpor.interleavings_explored >= 2  # races were explored
+
+
+def test_dpor_as_oracle_and_incremental_ddmin():
+    app = make_order_bug_app()
+    config = SchedulerConfig(invariant_check=make_host_invariant(app))
+    send_a = Send(app.actor_name(0), MessageConstructor(lambda: (1, 0)))
+    send_b = Send(app.actor_name(0), MessageConstructor(lambda: (2, 0)))
+    noise = Send(app.actor_name(1), MessageConstructor(lambda: (1, 1)))
+    program = dsl_start_events(app) + [send_a, send_b, noise, WaitQuiescence()]
+
+    dpor = DPORScheduler(config, max_interleavings=20)
+    found = dpor.explore(program)
+    assert found is not None
+
+    inc = IncrementalDDMin(config, max_max_distance=4,
+                           dpor_kwargs={"max_interleavings": 20})
+    mcs = inc.minimize(make_dag(program), found.violation)
+    kept = mcs.get_all_events()
+    # B alone suffices (B delivered first trivially when A is pruned).
+    assert send_b in kept
+    assert noise not in kept
+    assert len(kept) <= 3  # start(s) + B (A may go too)
