@@ -1,0 +1,15 @@
+from .codec import (
+    native_available,
+    pack_records,
+    unpack_records,
+    read_record_log,
+    write_record_log,
+)
+
+__all__ = [
+    "native_available",
+    "pack_records",
+    "unpack_records",
+    "read_record_log",
+    "write_record_log",
+]

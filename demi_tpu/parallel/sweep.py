@@ -1,0 +1,173 @@
+"""Sweep driver: schedule-space sweeps at slice and multi-slice scale.
+
+Scale model (SURVEY.md §2.8/§5.8): within a slice, the lane batch shards
+over ICI via the mesh kernels (mesh.py); across slices, the *seed/program
+space* partitions — each slice takes a disjoint chunk and only violation
+summaries travel over DCN (they're O(lanes), not O(state)). In a
+multi-process jax.distributed deployment each process calls
+``run_chunk`` on its slice's mesh with its ``slice_index``; in-process, the
+driver iterates chunks (the single-host path the driver/bench use).
+
+Also provides time-to-first-violation measurement — the BASELINE.md
+headline metric against the JVM reference.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+
+from ..dsl import DSLApp
+from ..device.core import ST_VIOLATION, DeviceConfig
+from ..device.encoding import lower_program, stack_programs
+from ..device.explore import make_explore_kernel
+from ..external_events import ExternalEvent
+from .mesh import LANES, make_mesh, shard_explore_kernel
+
+
+@dataclass
+class SweepChunkResult:
+    slice_index: int
+    lanes: int
+    violations: int
+    codes: dict
+    first_violating_lane: Optional[int]
+    first_violation_code: Optional[int]
+    seconds: float
+
+
+@dataclass
+class SweepResult:
+    chunks: List[SweepChunkResult] = field(default_factory=list)
+
+    @property
+    def lanes(self) -> int:
+        return sum(c.lanes for c in self.chunks)
+
+    @property
+    def violations(self) -> int:
+        return sum(c.violations for c in self.chunks)
+
+    @property
+    def schedules_per_sec(self) -> float:
+        secs = sum(c.seconds for c in self.chunks)
+        return self.lanes / secs if secs > 0 else 0.0
+
+
+class SweepDriver:
+    def __init__(
+        self,
+        app: DSLApp,
+        cfg: DeviceConfig,
+        program_gen: Callable[[int], Sequence[ExternalEvent]],
+        mesh=None,
+        use_mesh: bool = False,
+    ):
+        self.app = app
+        self.cfg = cfg
+        self.program_gen = program_gen
+        if use_mesh:
+            self.mesh = mesh or make_mesh()
+            self.kernel = shard_explore_kernel(app, cfg, self.mesh)
+            self._align = self.mesh.shape[LANES]
+        else:
+            self.mesh = None
+            self.kernel = make_explore_kernel(app, cfg)
+            self._align = 1
+    def _programs(self, seeds: Sequence[int]):
+        # Lowered per call: seeds are disjoint across chunks, so a
+        # driver-lifetime cache would only ever grow (sweeps can cover 1M+
+        # seeds). Pad-duplicates within the chunk hit the local cache.
+        cache: dict = {}
+        progs = []
+        for s in seeds:
+            prog = cache.get(s)
+            if prog is None:
+                prog = lower_program(self.app, self.cfg, self.program_gen(s))
+                cache[s] = prog
+            progs.append(prog)
+        return stack_programs(progs)
+
+    def run_chunk(
+        self, seeds: Sequence[int], slice_index: int = 0, base_key: int = 0
+    ) -> SweepChunkResult:
+        """One slice-sized chunk: lanes = len(seeds). When mesh-sharded the
+        batch is padded up to a multiple of the mesh axis by repeating
+        seeds; padded lanes are excluded from every reported count."""
+        real = list(seeds)
+        assert real, "empty chunk"
+        n_real = len(real)
+        padded = list(real)
+        while len(padded) % self._align:
+            padded.extend(real[: self._align - (len(padded) % self._align)])
+        progs = self._programs(padded)
+        keys = jax.vmap(
+            lambda s: jax.random.fold_in(jax.random.PRNGKey(base_key), s)
+        )(np.asarray(padded, np.uint32))
+        t0 = time.perf_counter()
+        res = self.kernel(progs, keys)
+        jax.block_until_ready(res)
+        seconds = time.perf_counter() - t0
+        violations = np.asarray(res.violation)[:n_real]
+        statuses = np.asarray(res.status)[:n_real]
+        lanes = np.nonzero(statuses == ST_VIOLATION)[0]
+        codes = {
+            int(c): int((violations == c).sum())
+            for c in np.unique(violations)
+            if c != 0
+        }
+        return SweepChunkResult(
+            slice_index=slice_index,
+            lanes=n_real,
+            violations=int((violations != 0).sum()),
+            codes=codes,
+            first_violating_lane=int(lanes[0]) if len(lanes) else None,
+            first_violation_code=(
+                int(violations[lanes[0]]) if len(lanes) else None
+            ),
+            seconds=seconds,
+        )
+
+    def sweep(
+        self,
+        total_lanes: int,
+        chunk_size: int,
+        num_slices: int = 1,
+        stop_on_violation: bool = False,
+    ) -> SweepResult:
+        """Partition ``total_lanes`` seeds into chunks round-robined over
+        ``num_slices`` logical slices (in one process they run
+        sequentially; in a jax.distributed deployment each process runs its
+        own slice_index's chunks)."""
+        result = SweepResult()
+        seed = 0
+        chunk_idx = 0
+        while seed < total_lanes:
+            n = min(chunk_size, total_lanes - seed)
+            chunk = self.run_chunk(
+                range(seed, seed + n), slice_index=chunk_idx % num_slices
+            )
+            result.chunks.append(chunk)
+            seed += n
+            chunk_idx += 1
+            if stop_on_violation and chunk.violations:
+                break
+        return result
+
+    def time_to_first_violation(
+        self, chunk_size: int, max_lanes: int = 1_000_000
+    ) -> Tuple[Optional[float], SweepResult]:
+        """Wall-clock until the first violating lane (the BASELINE.md
+        headline metric), sweeping chunk by chunk."""
+        t0 = time.perf_counter()
+        result = self.sweep(
+            max_lanes, chunk_size, stop_on_violation=True
+        )
+        if result.violations:
+            return time.perf_counter() - t0, result
+        return None, result

@@ -243,6 +243,7 @@ class ExperimentSerializer:
         mcs: Optional[Sequence[ExternalEvent]] = None,
         minimized_trace: Optional[EventTrace] = None,
         stats: Optional[MinimizationStats] = None,
+        device_trace=None,  # int32 [rows, rec_width] device records
     ) -> str:
         os.makedirs(directory, exist_ok=True)
 
@@ -268,6 +269,12 @@ class ExperimentSerializer:
         if stats is not None:
             with open(os.path.join(directory, "minimization_stats.json"), "w") as f:
                 f.write(stats.to_json())
+        if device_trace is not None:
+            from .native import write_record_log
+
+            write_record_log(
+                os.path.join(directory, "device_trace.demirec"), device_trace
+            )
         return directory
 
 
@@ -319,3 +326,11 @@ class ExperimentDeserializer:
             return None
         with open(path) as f:
             return MinimizationStats.from_json(f.read())
+
+    def get_device_trace(self):
+        path = os.path.join(self.directory, "device_trace.demirec")
+        if not os.path.exists(path):
+            return None
+        from .native import read_record_log
+
+        return read_record_log(path)

@@ -1,0 +1,83 @@
+// Native record codec: column-delta + zigzag-varint compression for the
+// int32 record streams the framework produces in bulk (device trace
+// records, replay schedules, sweep archives — the record encoding of
+// demi_tpu/device/core.py).
+//
+// The reference's only native layer is build-time bytecode weaving
+// (SURVEY.md §2.7); in this framework interposition is by construction, so
+// the native need moves to the data path: experiment dirs store millions of
+// records (64-actor 1M-schedule sweeps), and Python-side packing is the
+// bottleneck. Format (shared with the pure-Python fallback in
+// demi_tpu/native/codec.py):
+//   per value: zigzag(value - previous value in the same column) as varint,
+//   rows stored row-major.
+//
+// Build: g++ -O2 -shared -fPIC record_codec.cpp -o libdemi_records.so
+
+#include <cstdint>
+#include <cstddef>
+
+extern "C" {
+
+static inline uint32_t zigzag(int32_t v) {
+    return (static_cast<uint32_t>(v) << 1) ^ static_cast<uint32_t>(v >> 31);
+}
+
+static inline int32_t unzigzag(uint32_t z) {
+    return static_cast<int32_t>((z >> 1) ^ (~(z & 1) + 1));
+}
+
+// Returns bytes written, or -1 if out_cap would be exceeded.
+int64_t demi_pack(const int32_t* data, int64_t n_rows, int64_t row_width,
+                  uint8_t* out, int64_t out_cap) {
+    int64_t pos = 0;
+    for (int64_t r = 0; r < n_rows; ++r) {
+        for (int64_t c = 0; c < row_width; ++c) {
+            int32_t prev = r > 0 ? data[(r - 1) * row_width + c] : 0;
+            // Explicit 32-bit wraparound (signed overflow is UB; the
+            // Python fallback wraps the same way).
+            int32_t delta = static_cast<int32_t>(
+                static_cast<uint32_t>(data[r * row_width + c]) -
+                static_cast<uint32_t>(prev));
+            uint32_t z = zigzag(delta);
+            while (true) {
+                if (pos >= out_cap) return -1;
+                if (z < 0x80) {
+                    out[pos++] = static_cast<uint8_t>(z);
+                    break;
+                }
+                out[pos++] = static_cast<uint8_t>((z & 0x7f) | 0x80);
+                z >>= 7;
+            }
+        }
+    }
+    return pos;
+}
+
+// Returns rows decoded, or -1 on malformed/truncated input.
+int64_t demi_unpack(const uint8_t* buf, int64_t len, int32_t* out,
+                    int64_t n_rows, int64_t row_width) {
+    int64_t pos = 0;
+    for (int64_t r = 0; r < n_rows; ++r) {
+        for (int64_t c = 0; c < row_width; ++c) {
+            uint32_t z = 0;
+            int shift = 0;
+            while (true) {
+                if (pos >= len || shift > 28) return -1;
+                uint8_t b = buf[pos++];
+                z |= static_cast<uint32_t>(b & 0x7f) << shift;
+                if (!(b & 0x80)) break;
+                shift += 7;
+            }
+            int32_t prev = r > 0 ? out[(r - 1) * row_width + c] : 0;
+            // uint32 add: wraparound is intended (INT32_MAX -> INT32_MIN
+            // transitions), signed overflow would be UB.
+            out[r * row_width + c] = static_cast<int32_t>(
+                static_cast<uint32_t>(unzigzag(z)) +
+                static_cast<uint32_t>(prev));
+        }
+    }
+    return n_rows;
+}
+
+}  // extern "C"
