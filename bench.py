@@ -47,7 +47,13 @@ def main():
         cmd(0, 10), cmd(1, 11), cmd(2, 12), WaitQuiescence(budget=60),
         cmd(3, 20), cmd(4, 21), WaitQuiescence(budget=60),
     ]
-    batch = 2048
+    # One compiled shape; lane count sized to the platform (TPU throughput
+    # scales with lanes, CPU saturates early). Override: DEMI_BENCH_BATCH.
+    import os
+
+    platform = jax.devices()[0].platform
+    default_batch = 8192 if platform not in ("cpu",) else 1024
+    batch = int(os.environ.get("DEMI_BENCH_BATCH", default_batch))
     kernel = make_explore_kernel(app, cfg)
     progs = stack_programs([lower_program(app, cfg, program)] * batch)
     keys = jax.random.split(jax.random.PRNGKey(0), batch)

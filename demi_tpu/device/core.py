@@ -49,6 +49,9 @@ OP_HARDKILL = 7
 REC_NONE = 0
 REC_DELIVERY = 1
 REC_TIMER = 2
+# Wildcard delivery (replay input only): a=dst, b=policy (0=first/FIFO,
+# 1=last), msg[0]=class tag. Lowered from WildCardMatch expected events.
+REC_WILDCARD = 4
 REC_EXT_BASE = 10  # REC_EXT_BASE + op
 
 # Lane status.

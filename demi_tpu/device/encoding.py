@@ -38,6 +38,7 @@ from ..external_events import (
     UnPartition,
     WaitQuiescence,
 )
+from ..events import WildCardMatch
 from ..trace import EventTrace
 from .core import (
     OP_END,
@@ -52,6 +53,7 @@ from .core import (
     REC_EXT_BASE,
     REC_NONE,
     REC_TIMER,
+    REC_WILDCARD,
     DeviceConfig,
 )
 from .explore import ExtProgram
@@ -156,6 +158,24 @@ def lower_expected_trace(
                 )
             # internal sends re-occur as delivery side effects
         elif isinstance(ev, MsgEvent):
+            if isinstance(ev.msg, WildCardMatch):
+                wc = ev.msg
+                if not isinstance(wc.class_tag, int):
+                    raise TypeError(
+                        "device wildcard replay needs int class tags "
+                        f"(got {wc.class_tag!r})"
+                    )
+                if wc.selector is not None or wc.policy not in ("first", "last"):
+                    raise TypeError(
+                        f"wildcard policy {wc.policy!r}/selector is not "
+                        "lowerable to the device tier"
+                    )
+                policy = 1 if wc.policy == "last" else 0
+                recs.append(
+                    [REC_WILDCARD, app.actor_id(ev.rcv), policy, wc.class_tag]
+                    + [0] * (w - 1)
+                )
+                continue
             src = _actor_or_external(app, ev.snd)
             payload = uid_payload.get(u.id, None)
             if payload is None:

@@ -21,6 +21,7 @@ from .core import (
     REC_DELIVERY,
     REC_EXT_BASE,
     REC_TIMER,
+    REC_WILDCARD,
     ST_DONE,
     ST_VIOLATION,
     DeviceConfig,
@@ -41,6 +42,10 @@ class ReplayResult(NamedTuple):
     ignored_absent: jnp.ndarray  # int32: expected deliveries with no match
 
 
+def _is_delivery_kind(kind):
+    return (kind == REC_DELIVERY) | (kind == REC_TIMER) | (kind == REC_WILDCARD)
+
+
 def make_replay_run_lane(app: DSLApp, cfg: DeviceConfig):
     """Unjitted single-lane replay ``run_lane(records, key) -> ReplayResult``
     (composable with vmap/jit/shardings by callers)."""
@@ -59,24 +64,33 @@ def make_replay_run_lane(app: DSLApp, cfg: DeviceConfig):
 
         def apply_delivery(state):
             is_timer_rec = kind == REC_TIMER
+            is_wild = kind == REC_WILDCARD
             mask = deliverable_mask(state, cfg)
-            match = (
-                mask
-                & (state.pool_dst == b)
+            exact = (
+                (state.pool_dst == b)
                 & jnp.all(state.pool_msg == msg[None, :], axis=1)
                 & (state.pool_timer == is_timer_rec)
+                # Timers self-address; messages match on sender too.
+                & (is_timer_rec | (state.pool_src == a))
             )
-            # Timers self-address; messages match on sender too.
-            match = match & (is_timer_rec | (state.pool_src == a))
+            # Wildcard (reference: WildCardMatch selectors,
+            # STSScheduler.scala:696-708): receiver + class tag only.
+            wild = (state.pool_dst == a) & (state.pool_msg[:, 0] == msg[0])
+            match = mask & jnp.where(is_wild, wild, exact)
             any_match = jnp.any(match)
-            # FIFO: earliest arrival among matches.
-            seqs = jnp.where(match, state.pool_seq, big)
-            idx = jnp.argmin(seqs).astype(jnp.int32)
+            # policy: FIFO (earliest arrival) or, for wildcard "last",
+            # latest arrival.
+            want_last = is_wild & (b == 1)
+            seqs_first = jnp.where(match, state.pool_seq, big)
+            seqs_last = jnp.where(match, state.pool_seq, -big)
+            idx = jnp.where(
+                want_last, jnp.argmax(seqs_last), jnp.argmin(seqs_first)
+            ).astype(jnp.int32)
             idx = jnp.where(any_match, idx, jnp.int32(cfg.pool_capacity))
             return deliver_index(state, cfg, app, idx)
 
         is_ext = kind >= REC_EXT_BASE
-        is_delivery = (kind == REC_DELIVERY) | (kind == REC_TIMER)
+        is_delivery = _is_delivery_kind(kind)
         state = jax.lax.cond(
             is_ext,
             apply_ext,
@@ -94,7 +108,7 @@ def make_replay_run_lane(app: DSLApp, cfg: DeviceConfig):
             state = jax.lax.cond(
                 state.status >= ST_DONE, lambda s: s, lambda s: replay_record(s, rec), state
             )
-            was_delivery = (rec[0] == REC_DELIVERY) | (rec[0] == REC_TIMER)
+            was_delivery = _is_delivery_kind(rec[0])
             skipped = was_delivery & (state.deliveries == before) & (state.status < ST_DONE)
             return (state, ignored + skipped.astype(jnp.int32)), None
 

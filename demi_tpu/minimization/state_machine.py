@@ -1,0 +1,45 @@
+"""Synoptic-model-guided removal (stub) + historical trace retention.
+
+Reference: internal_minimization/StateMachineRemoval.scala (43 LoC) — an
+acknowledged stub in the reference too (returns None, :26-30), kept for
+pipeline parity; HistoricalEventTraces (:34-43) retains every executed
+MetaEventTrace when SchedulerConfig.store_event_traces is on, as input for
+state-machine inference.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ..trace import EventTrace, MetaEventTrace
+from .internal import RemovalStrategy
+
+
+class HistoricalEventTraces:
+    #: retention cap: prepare() runs per execution, so an unbounded list
+    #: would pin every trace of a long minimization session.
+    max_traces = 1000
+    traces: List[MetaEventTrace] = []
+
+    @classmethod
+    def record(cls, meta: MetaEventTrace) -> None:
+        cls.traces.append(meta)
+        if len(cls.traces) > cls.max_traces:
+            del cls.traces[: len(cls.traces) - cls.max_traces]
+
+    @classmethod
+    def clear(cls) -> None:
+        cls.traces = []
+
+    @classmethod
+    def violating(cls) -> List[MetaEventTrace]:
+        return [m for m in cls.traces if m.caused_violation]
+
+
+class StateMachineRemoval(RemovalStrategy):
+    """Planned: infer a state machine from HistoricalEventTraces (Synoptic)
+    and propose removals of deliveries off the violating path. Like the
+    reference, currently proposes nothing."""
+
+    def next_candidate(self, last_failing: EventTrace) -> Optional[EventTrace]:
+        return None

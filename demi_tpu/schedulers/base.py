@@ -55,7 +55,7 @@ from ..external_events import (
 from ..runtime.checkpoints import CheckpointCollector
 from ..runtime.failure_detector import FDMessageOrchestrator, QueryReachableGroup
 from ..runtime.system import ControlledActorSystem, PendingEntry
-from ..trace import EventTrace
+from ..trace import EventTrace, MetaEventTrace
 
 
 class ScheduleHalt(Exception):
@@ -137,6 +137,14 @@ class BaseScheduler:
             self.fd = FDMessageOrchestrator(self._fd_enqueue)
         else:
             self.fd = None
+        # Per-event log capture for Synoptic-style inference (reference:
+        # MetaEventTrace, EventTrace.scala:542-568; retention via
+        # HistoricalEventTraces when store_event_traces is on).
+        self.meta_trace = MetaEventTrace(self.trace)
+        if self.config.store_event_traces:
+            from ..minimization.state_machine import HistoricalEventTraces
+
+            HistoricalEventTraces.record(self.meta_trace)
 
     def execute(self, externals: Sequence[ExternalEvent]) -> ExecutionResult:
         """Run the full external-event program to completion (or a cap),
@@ -145,6 +153,8 @@ class BaseScheduler:
         violation = self._run_program(list(externals))
         if violation is None:
             violation = self.check_invariant()
+        if violation is not None:
+            self.meta_trace.set_caused_violation()
         return ExecutionResult(
             trace=self.trace,
             violation=violation,
@@ -320,3 +330,4 @@ class BaseScheduler:
 
     def _on_log(self, name: str, line: str) -> None:
         self.logs.append((name, line))
+        self.meta_trace.append_log_output(f"{name}: {line}")

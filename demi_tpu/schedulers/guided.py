@@ -86,6 +86,8 @@ class GuidedScheduler(BaseScheduler):
         self.trace.set_original_externals(externals)
         self._current_externals = externals
         violation = self.check_invariant()
+        if violation is not None:
+            self.meta_trace.set_caused_violation()
         return ExecutionResult(
             trace=self.trace,
             violation=violation,

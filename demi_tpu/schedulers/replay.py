@@ -116,9 +116,17 @@ class _ReplayPending:
             return entry
         return None
 
-    def pop_wildcard(self, rcv: str, wc: WildCardMatch) -> Optional[PendingEntry]:
+    def pop_wildcard(
+        self, rcv: str, wc: WildCardMatch, deliverable=None
+    ) -> Optional[PendingEntry]:
         candidates = [
-            e for e in self.all if e.rcv == rcv and wc.matches(e.msg, self.fingerprinter)
+            e
+            for e in self.all
+            if e.rcv == rcv
+            and wc.matches(e.msg, self.fingerprinter)
+            # Only deliverable entries are candidates (device-tier parity:
+            # the wildcard mask is ANDed with deliverable_mask).
+            and (deliverable is None or deliverable(e))
         ]
         if not candidates:
             return None
@@ -200,6 +208,8 @@ class TraceFollowingScheduler(BaseScheduler):
             if self.deliveries >= self.max_messages:
                 break
         violation = self.check_invariant()
+        if violation is not None:
+            self.meta_trace.set_caused_violation()
         return ExecutionResult(
             trace=self.trace,
             violation=violation,
@@ -295,7 +305,9 @@ class TraceFollowingScheduler(BaseScheduler):
 
     def _match_delivery(self, exp: Unique, event: MsgEvent) -> Optional[PendingEntry]:
         if isinstance(event.msg, WildCardMatch):
-            return self.rpending.pop_wildcard(event.rcv, event.msg)
+            return self.rpending.pop_wildcard(
+                event.rcv, event.msg, deliverable=self.system.deliverable
+            )
         if event.is_external:
             return self.rpending.pop_external(exp.id)
         return self.rpending.pop_internal(event.snd, event.rcv, event.msg)

@@ -1,0 +1,69 @@
+"""Minimization-progress graphs from minimization_stats.json.
+
+Reference: src/main/python/minimization_stats/{generate_graph.py,
+combine_graphs.py} — gnuplot charts of iteration → #events. Here: CSV for
+any plotting tool plus an inline ASCII chart (no plotting deps in the
+image).
+
+    python -m demi_tpu.tools.stats_graph experiment_dir/
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from typing import List, Tuple
+
+from ..minimization.stats import MinimizationStats
+
+
+def progression(stats: MinimizationStats) -> List[Tuple[str, int, int]]:
+    """(stage, global replay #, externals-at-that-replay) rows."""
+    rows: List[Tuple[str, int, int]] = []
+    offset = 0
+    for stage in stats.stages:
+        for replay, size in sorted(stage.iteration_size.items()):
+            rows.append((stage.strategy, offset + replay, size))
+        offset += stage.total_replays
+    return rows
+
+
+def to_csv(stats: MinimizationStats) -> str:
+    lines = ["stage,replay,externals"]
+    for stage, replay, size in progression(stats):
+        lines.append(f"{stage},{replay},{size}")
+    return "\n".join(lines) + "\n"
+
+
+def ascii_chart(stats: MinimizationStats, width: int = 60) -> str:
+    rows = progression(stats)
+    if not rows:
+        return "(no iteration data)\n"
+    peak = max(size for _, _, size in rows) or 1
+    out = []
+    for stage, replay, size in rows:
+        bar = "#" * max(1, int(width * size / peak))
+        out.append(f"{replay:>5} {size:>5} {bar}  {stage}")
+    return "\n".join(out) + "\n"
+
+
+def main(argv=None) -> int:
+    args = argv if argv is not None else sys.argv[1:]
+    if not args:
+        print("usage: stats_graph <experiment-dir-or-stats.json>")
+        return 2
+    path = args[0]
+    if os.path.isdir(path):
+        path = os.path.join(path, "minimization_stats.json")
+    with open(path) as f:
+        stats = MinimizationStats.from_json(f.read())
+    csv_path = os.path.splitext(path)[0] + ".csv"
+    with open(csv_path, "w") as f:
+        f.write(to_csv(stats))
+    print(ascii_chart(stats), end="")
+    print(f"csv written to {csv_path}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -394,12 +394,18 @@ class MetaEventTrace:
         self.caused_violation = True
 
     def append_log_output(self, msg: str) -> None:
-        last = self.trace.last_non_meta_event
-        key = last.id if last is not None else -1
+        # Key by trace *position* (uids are shared by MsgSend/MsgEvent
+        # pairs, which would duplicate output).
+        key = -1
+        for i in range(len(self.trace.events) - 1, -1, -1):
+            if not is_meta_event(self.trace.events[i].event):
+                key = i
+                break
         self.event_to_log_output.setdefault(key, []).append(msg)
 
     def get_ordered_log_output(self) -> List[str]:
         out: List[str] = []
-        for u in self.trace.events:
-            out.extend(self.event_to_log_output.get(u.id, []))
+        out.extend(self.event_to_log_output.get(-1, []))
+        for i in range(len(self.trace.events)):
+            out.extend(self.event_to_log_output.get(i, []))
         return out

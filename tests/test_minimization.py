@@ -160,6 +160,68 @@ def test_device_batched_internal_minimizer_matches_host():
     assert len(device_trace.deliveries()) == len(host_trace.deliveries())
 
 
+def test_device_wildcard_replay_matches_host():
+    """Wildcarded candidate schedules (ClockClusterizer-style) produce the
+    same verdicts on the device replay kernel as on the host STS replayer."""
+    from demi_tpu.apps.common import dsl_start_events as starts
+    from demi_tpu.apps.raft import make_raft_app
+    from demi_tpu.external_events import WaitQuiescence
+    from demi_tpu.minimization.wildcards import SingletonClusterizer
+
+    # Raft/multivote: violating traces are full of internal deliveries
+    # (votes, append-entries) — the wildcard target.
+    app = make_raft_app(3, bug="multivote")
+    config = SchedulerConfig(invariant_check=make_host_invariant(app))
+    program = starts(app) + [WaitQuiescence()]
+    fr = None
+    for seed in range(30):
+        sched = RandomScheduler(config, seed=seed, max_messages=120,
+                                invariant_check_interval=1)
+        result = sched.execute(program)
+        if result.violation is not None:
+            fr = result
+            break
+    assert fr is not None
+    cfg = DeviceConfig.for_app(
+        app, pool_capacity=192, max_steps=200, max_external_ops=16,
+        invariant_interval=1,
+    )
+    checker = DeviceReplayChecker(app, cfg, config)
+
+    # Candidates: all deliveries wildcarded, each single delivery removed
+    # in turn (plus the nothing-removed baseline).
+    clusterizer = SingletonClusterizer(fr.trace)
+    candidates = [clusterizer.current_trace()]
+    while True:
+        cand = clusterizer.next_trace(False, set())
+        if cand is None:
+            break
+        candidates.append(cand)
+    assert len(candidates) >= 3
+    candidates = candidates[:12]  # keep the batch small
+
+    # Exact (non-wildcard) baseline reproduces on both tiers; wildcarded
+    # candidates may legitimately lose reproduction (ambiguity resolution
+    # picks a different pending message — which is why the clusterizer is
+    # feedback-driven). The invariant here is tier *agreement*.
+    exact = checker.verdicts([fr.trace], [program], fr.violation.code)
+    sts0 = STSScheduler(config, fr.trace)
+    host_exact = sts0.test_with_trace(fr.trace, program, fr.violation) is not None
+    assert exact == [host_exact]
+    assert host_exact, "exact replay lost the violation"
+
+    device_verdicts = checker.verdicts(
+        candidates, [program] * len(candidates), fr.violation.code
+    )
+    host_verdicts = []
+    for cand in candidates:
+        sts = STSScheduler(config, cand)
+        host_verdicts.append(
+            sts.test_with_trace(cand, program, fr.violation) is not None
+        )
+    assert device_verdicts == host_verdicts
+
+
 def test_device_sts_oracle_ddmin():
     app, config, fr = _setup()
     cfg = DeviceConfig.for_app(

@@ -1,0 +1,74 @@
+"""MetaEventTrace capture, HistoricalEventTraces, stats graphing tool."""
+
+import os
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from demi_tpu.apps.common import dsl_start_events, make_host_invariant
+from demi_tpu.apps.broadcast import TAG_BCAST, make_broadcast_app
+from demi_tpu.config import SchedulerConfig
+from demi_tpu.external_events import MessageConstructor, Send, WaitQuiescence
+from demi_tpu.minimization.state_machine import (
+    HistoricalEventTraces,
+    StateMachineRemoval,
+)
+from demi_tpu.minimization.stats import MinimizationStats
+from demi_tpu.runtime.actor import Actor
+from demi_tpu.schedulers import RandomScheduler
+from demi_tpu.tools.stats_graph import ascii_chart, main as stats_main, to_csv
+
+
+class ChattyActor(Actor):
+    def receive(self, ctx, snd, msg):
+        ctx.log(f"got {msg} from {snd}")
+
+    def checkpoint_state(self):
+        return np.zeros(1, np.int32)
+
+
+def test_meta_trace_captures_logs_per_event():
+    from demi_tpu.external_events import Start
+
+    config = SchedulerConfig(store_event_traces=True)
+    HistoricalEventTraces.clear()
+    sched = RandomScheduler(config, seed=0)
+    program = [
+        Start("a", ctor=ChattyActor),
+        Send("a", MessageConstructor(lambda: "hello")),
+        WaitQuiescence(),
+    ]
+    result = sched.execute(program)
+    meta = sched.meta_trace
+    out = meta.get_ordered_log_output()
+    assert out == ["a: got hello from __external__"]
+    assert HistoricalEventTraces.traces[-1] is meta
+    assert not meta.caused_violation
+
+
+def test_state_machine_removal_is_explicit_stub():
+    assert StateMachineRemoval().next_candidate(None) is None
+
+
+def test_stats_graph_tool(tmp_path, capsys):
+    stats = MinimizationStats()
+    stats.update_strategy("DDMin", "STS")
+    for i, size in enumerate([10, 7, 5, 3]):
+        stats.record_replay()
+        stats.record_iteration_size(size)
+    stats.update_strategy("IntMin", "STS")
+    stats.record_replay()
+    stats.record_iteration_size(3)
+
+    csv = to_csv(stats)
+    assert "DDMin,1,10" in csv
+    chart = ascii_chart(stats)
+    assert "#" in chart and "IntMin" in chart
+
+    path = tmp_path / "minimization_stats.json"
+    path.write_text(stats.to_json())
+    assert stats_main([str(tmp_path / "minimization_stats.json")]) == 0
+    out = capsys.readouterr().out
+    assert "csv written" in out
+    assert os.path.exists(str(tmp_path / "minimization_stats.csv"))
