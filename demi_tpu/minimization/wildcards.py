@@ -118,6 +118,21 @@ class SingletonClusterizer(Clusterizer):
         return _build_candidate(self.trace, self.removed, self.policy)
 
 
+def _clock_clusters(
+    trace: EventTrace, fingerprinter: FingerprintFactory
+) -> List[List[int]]:
+    """Delivery positions grouped by logical clock (fallback: class tag),
+    largest cluster first — shared by the sequential and batched
+    clusterizers so their clustering can't drift."""
+    clusters: Dict[Any, List[int]] = {}
+    for i in _deliveries(trace):
+        msg = trace.events[i].event.msg
+        clock = fingerprinter.get_logical_clock(msg)
+        key = ("clock", clock) if clock is not None else ("noclock", class_tag_of(msg))
+        clusters.setdefault(key, []).append(i)
+    return sorted(clusters.values(), key=len, reverse=True)
+
+
 class ClockClusterizer(Clusterizer):
     """Cluster deliveries by the fingerprinter's logical clock (e.g. Raft
     term) and remove a whole cluster per round
@@ -140,14 +155,8 @@ class ClockClusterizer(Clusterizer):
         self.fingerprinter = fingerprinter
         self.policy = policy
         self.removed: Set[int] = set()
-        clusters: Dict[Any, List[int]] = {}
-        for i in _deliveries(trace):
-            msg = trace.events[i].event.msg
-            clock = fingerprinter.get_logical_clock(msg)
-            key = ("clock", clock) if clock is not None else ("noclock", class_tag_of(msg))
-            clusters.setdefault(key, []).append(i)
-        # Try larger clusters first: biggest wins shrink fastest.
-        self._clusters = sorted(clusters.values(), key=len, reverse=True)
+        # Larger clusters first: biggest wins shrink fastest.
+        self._clusters = _clock_clusters(trace, fingerprinter)
         self._cursor = 0
         self._pending: Optional[List[int]] = None
         self._started = False
@@ -217,6 +226,69 @@ class WildcardMinimizer:
             if reproduced:
                 best = result
             self.stats.record_internal_size(len(best.deliveries()))
+        return best
+
+
+class BatchedWildcardMinimizer:
+    """Device-accelerated wildcard minimization: each round tests ALL
+    remaining candidate cluster-removals as one vmapped replay batch
+    (REC_WILDCARD records) and adopts the first reproducing one.
+
+    Unlike the sequential ClockClusterizer (whose cursor visits each
+    cluster once), rounds repeat to a fixed point — a cluster that failed
+    alone is retried after later removals — so this variant can remove a
+    superset of what the sequential pass removes. The reference tests
+    clusters one at a time; no counterpart there."""
+
+    def __init__(
+        self,
+        batch_verdicts: Callable[[List[EventTrace]], List[bool]],
+        host_check: Callable[[EventTrace], Optional[EventTrace]],
+        stats: Optional[MinimizationStats] = None,
+        policy: str = "first",
+    ):
+        # batch_verdicts(candidates) -> [reproduced?]; host_check produces
+        # the executed trace for the adopted schedule.
+        self.batch_verdicts = batch_verdicts
+        self.host_check = host_check
+        self.stats = stats or MinimizationStats()
+        self.policy = policy
+
+    def minimize(
+        self, trace: EventTrace, fingerprinter: FingerprintFactory
+    ) -> EventTrace:
+        self.stats.update_strategy("BatchedClockClusterizer", "DeviceReplay")
+        self.stats.record_prune_start()
+        removed: Set[int] = set()
+        cluster_list = _clock_clusters(trace, fingerprinter)
+        while True:
+            remaining = [
+                [i for i in c if i not in removed] for c in cluster_list
+            ]
+            remaining = [c for c in remaining if c]
+            if not remaining:
+                break
+            candidates = [
+                _build_candidate(trace, removed | set(c), self.policy)
+                for c in remaining
+            ]
+            for cand in candidates:
+                self.stats.record_replay()
+            verdicts = self.batch_verdicts(candidates)
+            adopted = next(
+                (c for c, ok in zip(remaining, verdicts) if ok), None
+            )
+            if adopted is None:
+                break
+            removed.update(adopted)
+            self.stats.record_internal_size(
+                len(_deliveries(trace)) - len(removed)
+            )
+        final_candidate = _build_candidate(trace, removed, self.policy)
+        executed = self.host_check(final_candidate)
+        self.stats.record_prune_end()
+        best = executed if executed is not None else trace
+        self.stats.record_minimized_counts(len(best.deliveries()), 0, 0)
         return best
 
 
