@@ -261,6 +261,7 @@ class BatchedWildcardMinimizer:
         self.stats.record_prune_start()
         removed: Set[int] = set()
         cluster_list = _clock_clusters(trace, fingerprinter)
+        best = trace  # last host-confirmed violating execution
         while True:
             remaining = [
                 [i for i in c if i not in removed] for c in cluster_list
@@ -275,19 +276,26 @@ class BatchedWildcardMinimizer:
             for cand in candidates:
                 self.stats.record_replay()
             verdicts = self.batch_verdicts(candidates)
-            adopted = next(
-                (c for c, ok in zip(remaining, verdicts) if ok), None
-            )
+            # Host-confirm before adopting (device verdicts are compressed
+            # codes; the sibling make_batched_internal_check guards the
+            # same way), so progress is never discarded by a final-step
+            # host/device disagreement.
+            adopted = None
+            for cluster, cand, ok in zip(remaining, candidates, verdicts):
+                if not ok:
+                    continue
+                executed = self.host_check(cand)
+                if executed is not None:
+                    adopted = cluster
+                    best = executed
+                    break
             if adopted is None:
                 break
             removed.update(adopted)
             self.stats.record_internal_size(
                 len(_deliveries(trace)) - len(removed)
             )
-        final_candidate = _build_candidate(trace, removed, self.policy)
-        executed = self.host_check(final_candidate)
         self.stats.record_prune_end()
-        best = executed if executed is not None else trace
         self.stats.record_minimized_counts(len(best.deliveries()), 0, 0)
         return best
 

@@ -25,7 +25,7 @@ import itertools
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Set, Tuple
 
-from ..events import EXTERNAL, IdGenerator
+from ..events import EXTERNAL, FAILURE_DETECTOR, IdGenerator
 from .actor import Actor, Context
 
 
@@ -119,6 +119,10 @@ class ControlledActorSystem:
 
         Mirrors the drop-predicate schedulers consult in the reference
         (RandomScheduler.scala:292, STSScheduler.scala:608)."""
+        if entry.rcv == FAILURE_DETECTOR:
+            # The perfect FD is scheduler-side and always reachable from
+            # live actors (reference: FailureDetector.scala placeholder).
+            return entry.snd not in self.network.isolated
         if entry.rcv not in self.actors or entry.rcv in self.crashed:
             return False
         if entry.is_timer or entry.is_external:
@@ -159,6 +163,11 @@ class ControlledActorSystem:
         Instrumenter.actorCrashed:184-199); effects captured before the
         crash are kept."""
         assert self.deliverable(entry), f"undeliverable entry {entry!r}"
+        if entry.rcv == FAILURE_DETECTOR:
+            # The FD endpoint is scheduler-side bookkeeping, not an actor;
+            # delivering to it at this layer has no actor-side effect
+            # (schedulers answer queries via FDMessageOrchestrator).
+            return []
         actor = self.actors[entry.rcv]
         self._merge_vector_clock(entry)
         try:

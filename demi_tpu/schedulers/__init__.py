@@ -7,6 +7,10 @@ from .replay import (
     TraceFollowingScheduler,
     sts_oracle,
 )
+from .simple import BasicScheduler, FairScheduler, NullScheduler, PeekScheduler
+from .dpor import DPORScheduler
+from .guided import GuidedScheduler
+from .interactive import InteractiveScheduler
 
 __all__ = [
     "BaseScheduler",
@@ -20,4 +24,11 @@ __all__ = [
     "STSScheduler",
     "TraceFollowingScheduler",
     "sts_oracle",
+    "BasicScheduler",
+    "FairScheduler",
+    "NullScheduler",
+    "PeekScheduler",
+    "DPORScheduler",
+    "GuidedScheduler",
+    "InteractiveScheduler",
 ]

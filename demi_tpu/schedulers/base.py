@@ -279,10 +279,11 @@ class BaseScheduler:
             unique = Unique(MsgEvent(entry.snd, entry.rcv, entry.msg), entry.uid)
         self.trace.append(unique)
         self.deliveries += 1
-        if entry.rcv == "__fd__" and self.fd is not None:
+        if entry.rcv == "__fd__":
             # Queries addressed to the failure detector are answered by the
-            # scheduler itself (reference: FailureDetector.scala:44-149).
-            if isinstance(entry.msg, QueryReachableGroup):
+            # scheduler itself (reference: FailureDetector.scala:44-149);
+            # with the FD disabled they fall into the void like deadLetters.
+            if self.fd is not None and isinstance(entry.msg, QueryReachableGroup):
                 self.fd.handle_query(entry.snd)
             self.on_delivery(unique, entry)
             return
