@@ -20,3 +20,13 @@ from .trace import EventTrace
 from .events import Unique
 
 __all__ = ["SchedulerConfig", "EventTrace", "Unique", "__version__"]
+
+
+def __getattr__(name):
+    # Lazy top-level conveniences (keep `import demi_tpu` light — the
+    # runner/apps pull in jax).
+    if name in ("fuzz", "run_the_gamut", "print_minimization_stats"):
+        from . import runner
+
+        return getattr(runner, name)
+    raise AttributeError(f"module 'demi_tpu' has no attribute {name!r}")

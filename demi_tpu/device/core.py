@@ -401,8 +401,11 @@ def apply_external_op(
         is_hardkill, lambda s: purge_actor(s, a_c), lambda s: s, state
     )
 
-    # Start emits the actor's initial rows (fresh-start only, matching host
-    # spawn-on_start; recovery of an isolated actor re-emits nothing).
+    # One combined pool insertion for both effects of this op — the Start's
+    # initial rows (fresh-start only) and the Send's external message.
+    # (Under vmap both cond branches of the step execute, so every
+    # insert_rows pass — cumsum + searchsorted + 7 scatters — is paid per
+    # step; merging halves that cost for the inject path.)
     k0 = initial_rows.shape[1]
     if k0 > 0:
         rows = initial_rows[a_c]
@@ -414,23 +417,26 @@ def apply_external_op(
             r_timer = jnp.any(r_msg[:, 0:1] == tags[None, :], axis=1) & (r_dst == a_c)
         else:
             r_timer = jnp.zeros(k0, bool)
+        all_valid = jnp.concatenate([r_valid, is_send[None]])
+        all_src = jnp.concatenate([jnp.full((k0,), a_c), jnp.asarray([n], jnp.int32)])
+        all_dst = jnp.concatenate([r_dst, a_c[None]])
+        all_timer = jnp.concatenate([r_timer, jnp.asarray([False])])
+        all_msg = jnp.concatenate([r_msg, msg[None, :]])
         state = insert_rows(
-            state, cfg, r_valid, jnp.full((k0,), a_c), r_dst, r_timer,
-            jnp.zeros(k0, bool), r_msg,
+            state, cfg, all_valid, all_src, all_dst, all_timer,
+            jnp.zeros(k0 + 1, bool), all_msg,
         )
-
-    # Send: inject external message to actor a.
-    send_valid = jnp.asarray([True])
-    state = insert_rows(
-        state,
-        cfg,
-        send_valid & is_send,
-        jnp.asarray([n], jnp.int32),  # EXTERNAL sender id
-        a_c[None],
-        jnp.asarray([False]),
-        jnp.asarray([False]),
-        msg[None, :],
-    )
+    else:
+        state = insert_rows(
+            state,
+            cfg,
+            is_send[None],
+            jnp.asarray([n], jnp.int32),  # EXTERNAL sender id
+            a_c[None],
+            jnp.asarray([False]),
+            jnp.asarray([False]),
+            msg[None, :],
+        )
 
     if cfg.record_trace:
         rec = jnp.concatenate([jnp.stack([REC_EXT_BASE + op, a, b]), msg])

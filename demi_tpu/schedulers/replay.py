@@ -353,6 +353,18 @@ class TraceFollowingScheduler(BaseScheduler):
                 f"pending={[(e.snd, e.rcv) for e in self.rpending.all]!r}"
             )
         self.ignored_absent.append(exp)
+        # Divergence-abort modes (reference: STSScheduler
+        # unexpectedTransitions/abortingDueToDivergence, :167-183): strict
+        # aborts on the first absence; lax tolerates a handful.
+        if self.config.abort_upon_divergence:
+            raise ReplayException(f"divergence (absent {exp!r}), strict abort")
+        if (
+            self.config.abort_upon_divergence_lax
+            and len(self.ignored_absent) > max(4, self.deliveries // 4)
+        ):
+            raise ReplayException(
+                f"divergence ({len(self.ignored_absent)} absents), lax abort"
+            )
 
 
 class ReplayScheduler(TraceFollowingScheduler):
