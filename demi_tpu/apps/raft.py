@@ -19,9 +19,15 @@ Safety invariants (jitted, checked per-delivery via invariant_interval=1):
   code 2 — committed-prefix agreement: two alive nodes disagree on an
            entry both consider committed.
 
-Seeded bugs for fuzzing (reference-style known-bug case studies):
+Seeded bugs for fuzzing (reference-style known-bug case studies, standing
+in for the akka-raft raft-NN branches):
   bug="multivote"   — voted_for ignored: a node votes for every candidate
-                      of the current term (classic two-leaders bug).
+                      of the current term (voter-side two-leaders bug).
+  bug="stale_vote"  — candidate counts VoteReply messages from its *older*
+                      candidacies (term check missing on the tally):
+                      delayed replies from term T-1 elect it in term T
+                      without a real majority (candidate-side two-leaders
+                      bug; needs message delay/reordering to trigger).
   bug="stale_commit"— leader counts itself twice when advancing commit,
                       committing entries without a true majority.
 """
@@ -225,9 +231,13 @@ def make_raft_app(
     def on_vote_reply(actor_id, state, snd, msg):
         term, granted = msg[1], msg[2]
         state = maybe_step_down(state, term)
-        count = (
-            (state[ROLE] == CANDIDATE) & (term == state[TERM]) & (granted != 0)
-        )
+        if bug == "stale_vote":
+            # BUG: tally ignores which candidacy the reply belongs to.
+            count = (state[ROLE] == CANDIDATE) & (granted != 0)
+        else:
+            count = (
+                (state[ROLE] == CANDIDATE) & (term == state[TERM]) & (granted != 0)
+            )
         votes = jnp.where(
             count, state[VOTES] | (jnp.int32(1) << snd), state[VOTES]
         )

@@ -75,13 +75,17 @@ class DeviceConfig:
     max_steps: int = 512
     invariant_interval: int = 0  # 0 = only at completion
     record_trace: bool = False
+    # Track causal parents in trace records (device DPOR): each delivery
+    # record carries the trace index of the delivery/injection that created
+    # its message. Requires record_trace.
+    record_parents: bool = False
     # Probability weight of picking a pending timer vs a message (host
     # counterpart: FullyRandom.timer_weight). 1.0 = uniform over all.
     timer_weight: float = 1.0
 
     @property
     def rec_width(self) -> int:
-        return 3 + self.msg_width
+        return 3 + self.msg_width + (1 if self.record_parents else 0)
 
     @staticmethod
     def for_app(app: DSLApp, **overrides) -> "DeviceConfig":
@@ -111,6 +115,7 @@ class ScheduleState(NamedTuple):
     pool_parked: jnp.ndarray  # [P] bool (timer loop-avoidance)
     pool_msg: jnp.ndarray  # [P, W] int32
     pool_seq: jnp.ndarray  # [P] int32 arrival order (FIFO matching)
+    pool_crec: jnp.ndarray  # [P] int32 trace index of the creating event (-1 none)
     # Timer-parking memory (host: justScheduledTimers keyed (rcv, fp);
     # device: one remembered timer per actor).
     timer_mem: jnp.ndarray  # [N, W] int32
@@ -150,6 +155,7 @@ def init_state(app: DSLApp, cfg: DeviceConfig, key) -> ScheduleState:
         pool_parked=jnp.zeros(p, bool),
         pool_msg=jnp.zeros((p, w), jnp.int32),
         pool_seq=jnp.zeros(p, jnp.int32),
+        pool_crec=jnp.full(p, -1, jnp.int32),
         timer_mem=jnp.zeros((n, w), jnp.int32),
         timer_mem_valid=jnp.zeros(n, bool),
         ext_cursor=jnp.int32(0),
@@ -208,6 +214,7 @@ def insert_rows(
     row_timer: jnp.ndarray,  # [K] bool
     row_parked: jnp.ndarray,  # [K] bool
     row_msg: jnp.ndarray,  # [K, W] int32
+    crec=None,  # scalar int32: trace index of the creating event
 ) -> ScheduleState:
     """Scatter up to K new entries into free pool slots. Overflow (more valid
     rows than free slots) flips the lane status to ST_OVERFLOW."""
@@ -223,6 +230,7 @@ def insert_rows(
     slots = jnp.where(ok, slots, cfg.pool_capacity)  # out-of-range => dropped
 
     seqs = state.seq_counter + want  # arrival order follows row order
+    k = row_valid.shape[0]
     new_state = state._replace(
         pool_valid=state.pool_valid.at[slots].set(True, mode="drop"),
         pool_src=state.pool_src.at[slots].set(row_src, mode="drop"),
@@ -234,6 +242,14 @@ def insert_rows(
         seq_counter=state.seq_counter + want[-1],
         status=jnp.where(overflow, jnp.int32(ST_OVERFLOW), state.status),
     )
+    if crec is not None:
+        # Creator links are only maintained when tracing (DPOR mode) —
+        # untraced sweeps skip the extra scatter entirely.
+        new_state = new_state._replace(
+            pool_crec=state.pool_crec.at[slots].set(
+                jnp.broadcast_to(crec, (k,)), mode="drop"
+            )
+        )
     return new_state
 
 
@@ -262,6 +278,8 @@ def deliver_index(
     dst = state.pool_dst[safe_idx]
     msg = state.pool_msg[safe_idx]
     is_timer = state.pool_timer[safe_idx]
+    parent_rec = state.pool_crec[safe_idx]
+    rec_idx = state.trace_len  # this delivery's record position
 
     handler_state = state.actor_state[dst]
     new_row, outbox = app.handler(dst, handler_state, src, msg)
@@ -321,12 +339,16 @@ def deliver_index(
         timer_mem=timer_mem, timer_mem_valid=timer_mem_valid, pool_parked=pool_parked
     )
 
-    state = insert_rows(state, cfg, ob_valid, ob_src, ob_dst, ob_timer, ob_parked, ob_msg)
+    state = insert_rows(
+        state, cfg, ob_valid, ob_src, ob_dst, ob_timer, ob_parked, ob_msg,
+        crec=rec_idx if cfg.record_parents else None,
+    )
     if cfg.record_trace:
         kind = jnp.where(is_timer, REC_TIMER, REC_DELIVERY)
-        rec = jnp.concatenate(
-            [jnp.stack([kind, src, dst]), msg]
-        )
+        parts = [jnp.stack([kind, src, dst]), msg]
+        if cfg.record_parents:
+            parts.append(parent_rec[None])
+        rec = jnp.concatenate(parts)
         state = _append_record(state, cfg, rec, valid_idx)
     return state
 
@@ -361,6 +383,7 @@ def apply_external_op(
     n = cfg.num_actors
     a_c = jnp.clip(a, 0, n - 1)
     b_c = jnp.clip(b, 0, n - 1)
+    rec_idx = state.trace_len  # this op's record position (creator link)
 
     is_start = op == OP_START
     is_kill = op == OP_KILL
@@ -425,6 +448,7 @@ def apply_external_op(
         state = insert_rows(
             state, cfg, all_valid, all_src, all_dst, all_timer,
             jnp.zeros(k0 + 1, bool), all_msg,
+            crec=rec_idx if cfg.record_parents else None,
         )
     else:
         state = insert_rows(
@@ -436,10 +460,14 @@ def apply_external_op(
             jnp.asarray([False]),
             jnp.asarray([False]),
             msg[None, :],
+            crec=rec_idx if cfg.record_parents else None,
         )
 
     if cfg.record_trace:
-        rec = jnp.concatenate([jnp.stack([REC_EXT_BASE + op, a, b]), msg])
+        parts = [jnp.stack([REC_EXT_BASE + op, a, b]), msg]
+        if cfg.record_parents:
+            parts.append(jnp.asarray([-1], jnp.int32))
+        rec = jnp.concatenate(parts)
         enabled = (op != OP_END) & (op != OP_WAIT)
         state = _append_record(state, cfg, rec, enabled)
     return state

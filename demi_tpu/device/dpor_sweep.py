@@ -1,0 +1,274 @@
+"""Batched device DPOR: explore many backtrack points per kernel launch.
+
+The reference explores one interleaving at a time (DPORwHeuristics runs a
+full JVM execution per backtrack point). Here a backtrack point is a
+*prescription* — a prefix of delivery records plus the flipped event — and
+a whole frontier of prescriptions runs as one vmapped batch: each lane
+follows its prescription (skipping absent records, divergence-tolerant)
+and continues with random exploration; lanes record parent-tracked traces
+(DeviceConfig.record_parents), from which the host derives the
+happens-before forest and the next round's racing pairs with no
+re-execution. SURVEY §7.2 step 7: the racing-pair scan is data-parallel
+bit math; only the frontier priority queue stays host-side.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..config import SchedulerConfig
+from ..dsl import DSLApp
+from ..external_events import ExternalEvent
+from .core import (
+    REC_DELIVERY,
+    REC_TIMER,
+    ST_DISPATCH,
+    ST_DONE,
+    ST_VIOLATION,
+    DeviceConfig,
+    ScheduleState,
+    check_invariant,
+    deliver_index,
+    deliverable_mask,
+    init_state,
+)
+from .encoding import lower_program
+from .explore import ExtProgram, LaneResult, _finalize, make_step_fn
+
+
+def make_dpor_kernel(app: DSLApp, cfg: DeviceConfig):
+    """jitted ``kernel(progs[B], prescriptions[B, R, recw], keys[B]) ->
+    LaneResult[B]``. cfg must have record_trace and record_parents on.
+
+    Dispatch follows the prescription while records match (absent records
+    are skipped — divergence tolerance), then falls back to the explore
+    step's random choice."""
+    assert cfg.record_trace and cfg.record_parents
+    base_step = make_step_fn(app, cfg)
+    big = jnp.int32(2**30)
+    r_max = cfg.max_steps
+    recw = cfg.rec_width
+
+    def match_record(state: ScheduleState, rec):
+        is_timer_rec = rec[0] == REC_TIMER
+        mask = deliverable_mask(state, cfg)
+        exact = (
+            (state.pool_dst == rec[2])
+            & jnp.all(state.pool_msg == rec[3 : 3 + cfg.msg_width][None, :], axis=1)
+            & (state.pool_timer == is_timer_rec)
+            & (is_timer_rec | (state.pool_src == rec[1]))
+        )
+        match = mask & exact
+        seqs = jnp.where(match, state.pool_seq, big)
+        idx = jnp.argmin(seqs).astype(jnp.int32)
+        return jnp.where(jnp.any(match), idx, jnp.int32(cfg.pool_capacity))
+
+    def step(carry, presc, prog):
+        state, cursor = carry
+
+        def prescribed_dispatch(state, cursor):
+            # Skip past absent prescribed records to the first matchable one.
+            def cond(c3):
+                c, idx, _ = c3
+                rec_kind = presc[jnp.minimum(c, r_max - 1), 0]
+                in_range = (c < r_max) & (
+                    (rec_kind == REC_DELIVERY) | (rec_kind == REC_TIMER)
+                )
+                return in_range & (idx >= cfg.pool_capacity)
+
+            def body(c3):
+                c, _, skips = c3
+                idx = match_record(state, presc[jnp.minimum(c, r_max - 1)])
+                found = idx < cfg.pool_capacity
+                return (
+                    jnp.where(found, c, c + 1),
+                    idx,
+                    skips + jnp.where(found, 0, 1),
+                )
+
+            c, idx, _ = jax.lax.while_loop(
+                cond, body, (cursor, jnp.int32(cfg.pool_capacity), jnp.int32(0))
+            )
+            found = idx < cfg.pool_capacity
+            new_state = deliver_index(state, cfg, app, idx)
+            # Per-delivery invariant checks apply during prefix replay too
+            # (transient violations — e.g. two-leaders healed by a later
+            # step-down — are exactly what DPOR prescribes its way into).
+            if cfg.invariant_interval:
+                code = jnp.where(
+                    found, check_invariant(new_state, app), jnp.int32(0)
+                )
+                new_state = new_state._replace(
+                    status=jnp.where(
+                        code != 0, jnp.int32(ST_VIOLATION), new_state.status
+                    ),
+                    violation=jnp.where(
+                        code != 0, code.astype(jnp.int32), new_state.violation
+                    ),
+                )
+            return new_state, jnp.where(found, c + 1, c), found
+
+        in_dispatch = state.status == ST_DISPATCH
+        rec_kind = presc[jnp.minimum(cursor, r_max - 1), 0]
+        presc_active = in_dispatch & (cursor < r_max) & (
+            (rec_kind == REC_DELIVERY) | (rec_kind == REC_TIMER)
+        )
+
+        def with_prescription(args):
+            state, cursor = args
+            new_state, new_cursor, found = prescribed_dispatch(state, cursor)
+            # If nothing in the prescription matched, fall back to the
+            # normal (random) step from the ORIGINAL state.
+            fell_back = ~found
+            rnd = base_step(state, prog)
+            out = jax.tree_util.tree_map(
+                lambda a, b: jnp.where(fell_back, a, b), rnd, new_state
+            )
+            return out, new_cursor
+
+        def without(args):
+            state, cursor = args
+            return base_step(state, prog), cursor
+
+        state, cursor = jax.lax.cond(
+            presc_active, with_prescription, without, (state, cursor)
+        )
+        return (state, cursor), None
+
+    def run_lane(prog: ExtProgram, presc, key) -> LaneResult:
+        state = init_state(app, cfg, key)
+
+        def body(carry, _):
+            return step(carry, presc, prog)
+
+        (state, _cursor), _ = jax.lax.scan(
+            body, (state, jnp.int32(0)), None, length=cfg.max_steps
+        )
+        state = jax.lax.cond(
+            state.status < ST_DONE, lambda s: _finalize(s, app, cfg), lambda s: s, state
+        )
+        return LaneResult(
+            status=state.status,
+            violation=state.violation,
+            deliveries=state.deliveries,
+            trace=state.trace,
+            trace_len=state.trace_len,
+        )
+
+    return jax.jit(jax.vmap(run_lane))
+
+
+# ---------------------------------------------------------------------------
+# Host-side racing analysis over parent-tracked records
+# ---------------------------------------------------------------------------
+
+def racing_prescriptions(
+    records: np.ndarray, trace_len: int, rec_width: int
+) -> List[Tuple[Tuple[int, ...], ...]]:
+    """From one lane's parent-tracked trace, derive backtrack prescriptions:
+    for each racing pair (i, j) — same receiver, concurrent (no
+    happens-before path), j's message already created before i — the
+    prescription is the delivery records before i plus j's record."""
+    recs = records[:trace_len]
+    parent_col = rec_width - 1
+    is_delivery = np.isin(recs[:, 0], (REC_DELIVERY, REC_TIMER))
+    positions = np.nonzero(is_delivery)[0]
+    # Ancestor bitmask per record position (python ints as bitsets).
+    anc: Dict[int, int] = {}
+    for pos in range(trace_len):
+        p = int(recs[pos, parent_col]) if is_delivery[pos] else -1
+        if p < 0 or p >= pos:
+            anc[pos] = 0
+        else:
+            anc[pos] = anc.get(p, 0) | (1 << p)
+    out: List[Tuple[Tuple[int, ...], ...]] = []
+    for ii, i in enumerate(positions):
+        for j in positions[ii + 1 :]:
+            if recs[i, 2] != recs[j, 2]:  # same receiver only
+                continue
+            if (anc[int(j)] >> int(i)) & 1:
+                continue  # i happens-before j
+            cj = int(recs[j, parent_col])  # j's creation record
+            if cj >= int(i):
+                continue  # j's message didn't exist yet at i
+            prefix = [tuple(int(x) for x in recs[p]) for p in positions if p < i]
+            prefix.append(tuple(int(x) for x in recs[j]))
+            out.append(tuple(prefix))
+    return out
+
+
+class DeviceDPOR:
+    """Frontier-batched DPOR driver: rounds of B prescriptions per kernel
+    launch, deepest-first priority, explored-set dedup."""
+
+    def __init__(
+        self,
+        app: DSLApp,
+        cfg: DeviceConfig,
+        program: Sequence[ExternalEvent],
+        batch_size: int = 64,
+    ):
+        assert cfg.record_trace and cfg.record_parents
+        self.app = app
+        self.cfg = cfg
+        self.kernel = make_dpor_kernel(app, cfg)
+        self.prog = lower_program(app, cfg, list(program))
+        self.batch_size = batch_size
+        self.explored: Set[Tuple] = set()
+        self.interleavings = 0
+
+    def _pack(self, prescriptions: List[Tuple]) -> np.ndarray:
+        r, w = self.cfg.max_steps, self.cfg.rec_width
+        out = np.zeros((len(prescriptions), r, w), np.int32)
+        for k, presc in enumerate(prescriptions):
+            for t, rec in enumerate(presc[:r]):
+                out[k, t] = rec
+        return out
+
+    def explore(
+        self, target_code: Optional[int] = None, max_rounds: int = 20
+    ) -> Optional[Tuple[np.ndarray, int]]:
+        """Returns (records, trace_len) of a violating lane, or None."""
+        frontier: List[Tuple] = [tuple()]
+        self.explored.add(tuple())
+        for _ in range(max_rounds):
+            if not frontier:
+                return None
+            frontier.sort(key=len, reverse=True)  # deepest-first
+            batch, frontier = frontier[: self.batch_size], frontier[self.batch_size :]
+            # Pad to a fixed batch size so the kernel compiles once; pad
+            # lanes run prescription-free (fresh random exploration) and
+            # their results feed the frontier like any other lane.
+            batch = batch + [tuple()] * (self.batch_size - len(batch))
+            prescs = self._pack(batch)
+            progs = ExtProgram(
+                op=np.broadcast_to(self.prog.op, (len(batch),) + self.prog.op.shape),
+                a=np.broadcast_to(self.prog.a, (len(batch),) + self.prog.a.shape),
+                b=np.broadcast_to(self.prog.b, (len(batch),) + self.prog.b.shape),
+                msg=np.broadcast_to(self.prog.msg, (len(batch),) + self.prog.msg.shape),
+            )
+            keys = jax.vmap(
+                lambda s: jax.random.fold_in(jax.random.PRNGKey(0), s)
+            )(np.arange(self.interleavings, self.interleavings + len(batch), dtype=np.uint32))
+            res = self.kernel(progs, prescs, keys)
+            self.interleavings += len(batch)
+            violations = np.asarray(res.violation)
+            traces = np.asarray(res.trace)
+            lens = np.asarray(res.trace_len)
+            for lane in range(len(batch)):
+                code = int(violations[lane])
+                if code != 0 and (target_code is None or code == target_code):
+                    return traces[lane], int(lens[lane])
+            for lane in range(len(batch)):
+                for presc in racing_prescriptions(
+                    traces[lane], int(lens[lane]), self.cfg.rec_width
+                ):
+                    if presc not in self.explored:
+                        self.explored.add(presc)
+                        frontier.append(presc)
+        return None

@@ -187,9 +187,11 @@ def lower_expected_trace(
         # Quiescence / wait markers have no device meaning in replay.
     if len(recs) > max_records:
         raise ValueError(f"expected trace has {len(recs)} records > {max_records}")
-    out = np.zeros((max_records, 3 + w), np.int32)
+    # Rows are kind/a/b/msg; right-pad to the cfg's record width (a
+    # record_parents cfg has a trailing parent column, zero here).
+    out = np.zeros((max_records, cfg.rec_width), np.int32)
     for i, r in enumerate(recs):
-        out[i] = r
+        out[i, : len(r)] = r
     return out
 
 
@@ -201,12 +203,13 @@ def device_trace_to_guide(
     app: DSLApp, records: np.ndarray, trace_len: int
 ) -> List[Tuple]:
     """Decode a device-recorded trace into a host guide: a list of
-    ("ext", op, a, b, msg) / ("deliver", src, dst, msg, is_timer) steps."""
+    ("ext", op, a, b, msg) / ("deliver", src, dst, msg, is_timer) steps.
+    Accepts parent-tracked records (extra trailing column) transparently."""
     guide: List[Tuple] = []
     for i in range(int(trace_len)):
         rec = records[i]
         kind = int(rec[0])
-        msg = tuple(int(x) for x in rec[3:])
+        msg = tuple(int(x) for x in rec[3 : 3 + app.msg_width])
         if kind == REC_NONE:
             continue
         if kind in (REC_DELIVERY, REC_TIMER):

@@ -54,7 +54,9 @@ def make_replay_run_lane(app: DSLApp, cfg: DeviceConfig):
 
     def replay_record(state: ScheduleState, rec) -> ScheduleState:
         kind = rec[0]
-        a, b, msg = rec[1], rec[2], rec[3:]
+        # Explicit msg slice: parent-tracked records carry a trailing
+        # column that must not leak into message matching.
+        a, b, msg = rec[1], rec[2], rec[3 : 3 + cfg.msg_width]
 
         def apply_ext(state):
             return apply_external_op(

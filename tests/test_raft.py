@@ -148,6 +148,25 @@ def test_device_host_parity_on_raft_violation():
     assert host_result.violation.code == int(res.violation[lane])
 
 
+def test_stale_vote_bug_found_by_device_sweep():
+    """Candidate-side tally bug: delayed VoteReply messages from an older
+    candidacy elect a leader without a real majority — pure message-delay
+    reordering, found by the sweep; correct raft stays clean (covered by
+    test_correct_raft_safe_under_fuzz)."""
+    app = make_raft_app(3, bug="stale_vote")
+    cfg = DeviceConfig.for_app(
+        app, pool_capacity=256, max_steps=250, max_external_ops=8,
+        invariant_interval=1,
+    )
+    kernel = make_explore_kernel(app, cfg)
+    program = dsl_start_events(app) + [WaitQuiescence()]
+    batch = 128
+    progs = stack_programs([lower_program(app, cfg, program)] * batch)
+    keys = jax.random.split(jax.random.PRNGKey(5), batch)
+    res = kernel(progs, keys)
+    assert np.any(np.asarray(res.violation) == 1)
+
+
 def test_stale_commit_bug_found_by_device_sweep():
     """Deep-bug discovery: the stale_commit bug (leader double-counts itself
     when advancing commit) produces divergent *committed* prefixes only via
