@@ -171,6 +171,40 @@ def cmd_sweep(args) -> int:
     return 0
 
 
+def cmd_dpor(args) -> int:
+    """Systematic batched DPOR search (BASELINE config 2 shape)."""
+    from .device import DeviceConfig
+    from .device.dpor_sweep import DeviceDPOROracle
+
+    app = build_app(args)
+    config = SchedulerConfig(invariant_check=make_host_invariant(app))
+    cfg = DeviceConfig.for_app(
+        app,
+        pool_capacity=args.pool,
+        max_steps=args.max_messages,
+        max_external_ops=max(16, args.num_events + app.num_actors + 2),
+        invariant_interval=1,
+        timer_weight=args.timer_weight,
+        record_trace=True,
+        record_parents=True,
+    )
+    oracle = DeviceDPOROracle(
+        app, cfg, config, batch_size=args.batch, max_rounds=args.rounds
+    )
+    program = dsl_start_events(app) + [WaitQuiescence()]
+    trace = oracle.test(program, None)
+    print(
+        json.dumps(
+            {
+                "interleavings": oracle.last_interleavings,
+                "violation_found": trace is not None,
+                "deliveries": len(trace.deliveries()) if trace is not None else None,
+            }
+        )
+    )
+    return 0 if trace is not None else 1
+
+
 def cmd_interactive(args) -> int:
     from .schedulers.interactive import InteractiveScheduler
 
@@ -222,6 +256,13 @@ def main(argv: Optional[list] = None) -> int:
     p.add_argument("--batch", type=int, default=256)
     p.add_argument("--pool", type=int, default=256)
     p.set_defaults(fn=cmd_sweep)
+
+    p = sub.add_parser("dpor", help="systematic batched DPOR search")
+    common(p)
+    p.add_argument("--batch", type=int, default=64)
+    p.add_argument("--pool", type=int, default=256)
+    p.add_argument("--rounds", type=int, default=10)
+    p.set_defaults(fn=cmd_dpor)
 
     p = sub.add_parser("interactive", help="hand-drive a schedule")
     common(p)

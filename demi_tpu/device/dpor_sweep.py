@@ -202,6 +202,68 @@ def racing_prescriptions(
     return out
 
 
+class DeviceDPOROracle:
+    """TestOracle over DeviceDPOR: systematic batched search for a target
+    violation on a given external program; positives lift to full host
+    EventTraces via GuidedScheduler (BASELINE config 2 shape: bounded
+    DPOR search on raft-class apps)."""
+
+    def __init__(
+        self,
+        app: DSLApp,
+        cfg: DeviceConfig,
+        config: SchedulerConfig,
+        batch_size: int = 64,
+        max_rounds: int = 20,
+    ):
+        self.app = app
+        self.cfg = cfg
+        self.config = config
+        self.batch_size = batch_size
+        self.max_rounds = max_rounds
+        self.last_interleavings = 0
+
+    def test(self, externals, violation_fingerprint, stats=None, init=None):
+        from ..schedulers.guided import GuidedScheduler, GuideDivergence
+        from .encoding import device_trace_to_guide
+
+        if stats is not None:
+            stats.record_replay()
+        if violation_fingerprint is not None and not hasattr(
+            violation_fingerprint, "code"
+        ):
+            # Device verdicts are int codes (same contract as
+            # DeviceSTSOracle); don't silently widen unknown fingerprints
+            # to accept-anything.
+            raise TypeError(
+                "DeviceDPOROracle needs an IntViolation-style fingerprint "
+                f"(got {type(violation_fingerprint).__name__})"
+            )
+        dpor = DeviceDPOR(self.app, self.cfg, externals, self.batch_size)
+        target = getattr(violation_fingerprint, "code", None)
+        found = dpor.explore(target_code=target, max_rounds=self.max_rounds)
+        self.last_interleavings = dpor.interleavings
+        if found is None:
+            return None
+        records, trace_len = found
+        guide = device_trace_to_guide(self.app, records, trace_len)
+        gs = GuidedScheduler(self.config, self.app)
+        # No per-delivery check needed here: a violating device lane halts
+        # at the violation, so the lifted trace's final state carries it.
+        try:
+            result = gs.execute_guide(guide)
+        except GuideDivergence:
+            return None  # device/host mismatch = non-reproduction
+        if result.violation is None:
+            return None
+        if violation_fingerprint is not None and not violation_fingerprint.matches(
+            result.violation
+        ):
+            return None
+        result.trace.set_original_externals(list(externals))
+        return result.trace
+
+
 class DeviceDPOR:
     """Frontier-batched DPOR driver: rounds of B prescriptions per kernel
     launch, deepest-first priority, explored-set dedup."""

@@ -80,6 +80,27 @@ def test_device_dpor_exhausts_without_bug():
     assert dpor.interleavings >= 2
 
 
+def test_device_dpor_oracle_lifts_to_host():
+    """DeviceDPOROracle finds the reversal ordering and returns a full host
+    EventTrace whose violation matches."""
+    from demi_tpu.apps.common import make_host_invariant
+    from demi_tpu.config import SchedulerConfig
+    from demi_tpu.device.dpor_sweep import DeviceDPOROracle
+    from demi_tpu.minimization.test_oracle import IntViolation
+
+    app, cfg, program = _setup(3)
+    config = SchedulerConfig(invariant_check=make_host_invariant(app))
+    oracle = DeviceDPOROracle(app, cfg, config, batch_size=16, max_rounds=20)
+    trace = oracle.test(program, IntViolation(1))
+    assert trace is not None
+    assert oracle.last_interleavings >= 1
+    # The lifted trace replays deterministically on the host.
+    from demi_tpu.schedulers import STSScheduler
+
+    sts = STSScheduler(config, trace)
+    assert sts.test_with_trace(trace, program, IntViolation(1)) is not None
+
+
 def test_racing_prescriptions_shape():
     """Unit: two concurrent same-receiver deliveries race; the prescription
     is the pre-branch prefix plus the flipped record."""
