@@ -11,12 +11,23 @@ numbers and its JVM cannot run in this image; BASELINE.md records this).
 """
 
 import json
+import os
+import sys
 import time
 
 import numpy as np
 
 
 def main():
+    from demi_tpu._axon_guard import reexec_on_wedge
+
+    # A wedged axon tunnel would hang forever; fall back to CPU and emit a
+    # (low) number instead.
+    reexec_on_wedge(
+        list(sys.argv),
+        "bench: axon tunnel unresponsive; falling back to CPU",
+        mesh_devices=0,
+    )
     import jax
 
     from demi_tpu.apps.common import dsl_start_events
@@ -49,8 +60,6 @@ def main():
     ]
     # One compiled shape; lane count sized to the platform (TPU throughput
     # scales with lanes, CPU saturates early). Override: DEMI_BENCH_BATCH.
-    import os
-
     platform = jax.devices()[0].platform
     default_batch = 8192 if platform not in ("cpu",) else 1024
     batch = int(os.environ.get("DEMI_BENCH_BATCH", default_batch))

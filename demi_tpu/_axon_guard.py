@@ -1,0 +1,91 @@
+"""Axon-tunnel wedge guard (stdlib-only; importable from conftest before
+any jax import).
+
+The axon TPU tunnel is single-tenant: a stale holder makes every JAX
+backend init hang forever, and selecting CPU after the axon plugin
+registered (which happens at interpreter boot via sitecustomize) hangs
+too. The only fixes are boot-time env changes — so callers either re-exec
+themselves with a clean env or fail fast with the recipe.
+
+The probe runs in its own session with output to DEVNULL so orphaned
+tunnel-helper children can't keep pipes (and therefore the probe) alive
+past the timeout, and its verdict is cached per process.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+from typing import List, Optional
+
+_SENTINEL = "_DEMI_TPU_CPU_REEXEC"
+_PROBE_TIMEOUT = 120
+_verdict: Optional[bool] = None
+
+RECOVERY_RECIPE = (
+    "PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu "
+    "XLA_FLAGS=--xla_force_host_platform_device_count=8"
+)
+
+
+def axon_wedged() -> bool:
+    """True iff the axon plugin is present and JAX backend init hangs.
+    Cached per process; ~seconds on a healthy tunnel, _PROBE_TIMEOUT on a
+    wedged one."""
+    global _verdict
+    if _verdict is not None:
+        return _verdict
+    if os.environ.get(_SENTINEL) or not os.environ.get("PALLAS_AXON_POOL_IPS"):
+        _verdict = False
+        return False
+    proc = subprocess.Popen(
+        [sys.executable, "-c", "import jax; jax.devices()"],
+        stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL,
+        start_new_session=True,
+    )
+    try:
+        proc.wait(timeout=_PROBE_TIMEOUT)
+        _verdict = False  # init completed (or failed fast): not wedged
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            proc.kill()
+        _verdict = True
+    return _verdict
+
+
+def cpu_env(mesh_devices: int = 8) -> dict:
+    env = dict(os.environ)
+    env[_SENTINEL] = "1"
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = env.get("XLA_FLAGS", "")
+    if mesh_devices and "xla_force_host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (
+            f"{flags} --xla_force_host_platform_device_count={mesh_devices}"
+        ).strip()
+    return env
+
+
+def reexec_on_wedge(argv: List[str], message: str, mesh_devices: int = 8) -> None:
+    """Probe; on a wedged tunnel, re-exec ``argv`` with the CPU env (never
+    returns in that case)."""
+    if not axon_wedged():
+        return
+    os.write(2, (message + "\n").encode())
+    os.execve(sys.executable, [sys.executable] + argv, cpu_env(mesh_devices))
+
+
+def raise_on_wedge() -> None:
+    """Probe; on a wedged tunnel raise (library entry points can't re-exec
+    their caller)."""
+    if axon_wedged():
+        raise RuntimeError(
+            "axon TPU tunnel is unresponsive (stale single-tenant holder); "
+            f"re-run with {RECOVERY_RECIPE} for the CPU mesh "
+            "(see .claude/skills/verify/SKILL.md)"
+        )

@@ -1,8 +1,24 @@
 """Test configuration: force an 8-device virtual CPU mesh so multi-chip
 sharding is exercised without TPU hardware (the driver separately dry-runs
-the multi-chip path; see __graft_entry__.dryrun_multichip)."""
+the multi-chip path; see __graft_entry__.dryrun_multichip).
+
+Axon-tunnel wedge guard: the axon TPU tunnel is single-tenant and a stale
+holder makes *every* JAX backend init hang forever (see
+.claude/skills/verify/SKILL.md). Selecting CPU after the axon plugin
+registered also hangs, and registration happens at interpreter boot — so
+when a subprocess probe detects the wedge, re-exec the whole pytest run
+with axon disabled from boot."""
 
 import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from demi_tpu._axon_guard import reexec_on_wedge  # noqa: E402
+
+reexec_on_wedge(
+    ["-m", "pytest"] + sys.argv[1:],
+    "demi_tpu conftest: axon tunnel unresponsive; re-running tests on the CPU mesh",
+)
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
