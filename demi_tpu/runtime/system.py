@@ -99,6 +99,10 @@ class ControlledActorSystem:
         self.log_listener: Optional[Callable[[str, str], None]] = None
         # Send-capture buffer, active only inside deliver()/spawn().
         self._capturing: Optional[List[PendingEntry]] = None
+        # Last completed (or aborted) capture buffer — the crash path reads
+        # this, since _with_capture's finally clears _capturing before the
+        # exception propagates.
+        self._last_capture: List[PendingEntry] = []
         self._cancelled_timers: List[Tuple[str, Any]] = []
 
     # -- introspection -----------------------------------------------------
@@ -175,10 +179,11 @@ class ControlledActorSystem:
                 entry.rcv, lambda ctx: actor.receive(ctx, entry.snd, entry.msg)
             )
         except Exception:
+            # Effects performed before the crash are kept: in the reference
+            # (Akka), tells made before the throw already sit in mailboxes
+            # when Instrumenter.actorCrashed runs.
             self.crashed.add(entry.rcv)
-            captured = self._capturing or []
-            self._capturing = None
-            return captured
+            return self._last_capture
 
     def run_code_block(self, block: Callable[[], None]) -> List[PendingEntry]:
         """Execute an external CodeBlock with send capture attributed to
@@ -195,6 +200,9 @@ class ControlledActorSystem:
         return PendingEntry(self.id_gen.next(), snd, rcv, msg, vc={})
 
     def _with_capture(self, name: str, fn: Callable[[Context], None]) -> List[PendingEntry]:
+        # Clear before anything can raise, so deliver()'s crash path can
+        # never return a previous delivery's capture.
+        self._last_capture = []
         assert self._capturing is None, "re-entrant delivery"
         self._capturing = []
         ctx = Context(self, name)
@@ -203,6 +211,7 @@ class ControlledActorSystem:
         finally:
             captured = self._capturing
             self._capturing = None
+            self._last_capture = captured
         return captured
 
     def _capture_send(self, snd: str, rcv: str, msg: Any) -> None:
