@@ -100,7 +100,26 @@ def cmd_minimize(args) -> int:
     trace = de.get_trace(externals)
     violation = de.get_violation()
     fr = FuzzResult(program=externals, trace=trace, violation=violation, executions=0)
-    result = run_the_gamut(config, fr, wildcards=not args.no_wildcards)
+    if args.strategy == "incddmin":
+        from .runner import edit_distance_dpor_ddmin
+
+        mcs = edit_distance_dpor_ddmin(
+            config, trace, externals, violation,
+            dpor_kwargs={"max_interleavings": args.max_interleavings},
+        )
+        kept = mcs.get_all_events()
+        print(f"IncDDMin MCS: {len(externals)} -> {len(kept)} externals")
+        ExperimentSerializer.save(
+            args.experiment, externals, trace, violation, app_name=args.app,
+            mcs=kept,
+        )
+        return 0
+    # Device-batched trials are the default for DSL apps (the BASELINE
+    # north-star pipeline); --host falls back to the sequential STS oracle.
+    result = run_the_gamut(
+        config, fr, wildcards=not args.no_wildcards,
+        app=None if args.host else app,
+    )
     print_minimization_stats(result)
     ExperimentSerializer.save(
         args.experiment, externals, trace, violation, app_name=args.app,
@@ -244,6 +263,18 @@ def main(argv: Optional[list] = None) -> int:
     common(p)
     p.add_argument("-e", "--experiment", required=True)
     p.add_argument("--no-wildcards", action="store_true")
+    p.add_argument(
+        "--host", action="store_true",
+        help="sequential host STS oracle instead of device-batched trials",
+    )
+    p.add_argument(
+        "--strategy", choices=["gamut", "incddmin"], default="gamut",
+        help="gamut (default) or IncrementalDDMin over a resumable DPOR oracle",
+    )
+    p.add_argument(
+        "--max-interleavings", type=int, default=64, dest="max_interleavings",
+        help="DPOR interleaving budget per incddmin probe",
+    )
     p.set_defaults(fn=cmd_minimize)
 
     p = sub.add_parser("replay", help="strict-replay an experiment")

@@ -30,6 +30,31 @@ from .encoding import lower_expected_trace
 from .replay import make_replay_kernel
 
 
+def default_device_config(
+    app: DSLApp,
+    trace: EventTrace,
+    externals: Sequence[ExternalEvent],
+    **overrides,
+) -> DeviceConfig:
+    """Size the static device shapes from the recorded execution: enough
+    steps to replay the whole trace, enough pool for its peak concurrency
+    (padded 2x for wildcard/backtrack variants), rounded up to multiples of
+    8 so repeated gamut runs reuse compiled kernels."""
+
+    def _round8(n: int) -> int:
+        return max(8, (n + 7) // 8 * 8)
+
+    n_events = len(trace.events)
+    defaults = dict(
+        pool_capacity=_round8(max(64, 2 * n_events)),
+        max_steps=_round8(max(64, 2 * n_events)),
+        max_external_ops=_round8(len(externals) + 8),
+        invariant_interval=1,
+    )
+    defaults.update(overrides)
+    return DeviceConfig.for_app(app, **defaults)
+
+
 class DeviceReplayChecker:
     """Batched candidate checking for DSL apps: lower candidate expected
     traces, replay them all at once, compare violation codes."""
@@ -115,8 +140,11 @@ class DeviceSTSOracle(TestOracle):
         cfg: DeviceConfig,
         config: SchedulerConfig,
         original_trace: EventTrace,
+        checker: Optional[DeviceReplayChecker] = None,
     ):
-        self.checker = DeviceReplayChecker(app, cfg, config)
+        # Pass a shared checker to reuse one compiled replay kernel across
+        # pipeline stages.
+        self.checker = checker or DeviceReplayChecker(app, cfg, config)
         self.original_trace = original_trace
         self.config = config
 

@@ -25,11 +25,16 @@ class ResumableDPOR(TestOracle):
     """One DPOR instance per external subsequence, so repeated DDMin probes
     of the same subsequence resume instead of restarting."""
 
-    def __init__(self, config: SchedulerConfig, dpor_kwargs: Optional[dict] = None):
+    def __init__(self, config: SchedulerConfig, dpor_kwargs: Optional[dict] = None,
+                 initial_trace: Optional[EventTrace] = None):
         self.config = config
         self.dpor_kwargs = dict(dpor_kwargs or {})
         self.instances: Dict[Tuple[int, ...], DPORScheduler] = {}
         self.max_distance: Optional[int] = None
+        # Recorded violating trace: each fresh instance steers its first
+        # execution by it (divergence-tolerant), so probes of reproducing
+        # subsequences succeed in ~1 execution (DPORwHeuristics.scala:723-762).
+        self.initial_trace = initial_trace
 
     def _instance(self, externals: Sequence[ExternalEvent]) -> DPORScheduler:
         key = tuple(e.eid for e in externals)
@@ -38,6 +43,7 @@ class ResumableDPOR(TestOracle):
             inst = DPORScheduler(
                 self.config, arvind_ordering=True, **self.dpor_kwargs
             )
+            inst.set_initial_trace(self.initial_trace)
             self.instances[key] = inst
         inst.max_distance = self.max_distance
         return inst
@@ -57,8 +63,9 @@ class IncrementalDDMin(Minimizer):
         max_max_distance: int = 8,
         stats: Optional[MinimizationStats] = None,
         dpor_kwargs: Optional[dict] = None,
+        initial_trace: Optional[EventTrace] = None,
     ):
-        self.oracle = ResumableDPOR(config, dpor_kwargs)
+        self.oracle = ResumableDPOR(config, dpor_kwargs, initial_trace=initial_trace)
         self.max_max_distance = max_max_distance
         self.stats = stats or MinimizationStats()
 

@@ -46,6 +46,144 @@ def wildcard_delivery(u: Unique, policy: str) -> Unique:
     return Unique(MsgEvent(event.snd, event.rcv, wc), u.id)
 
 
+class AmbiguityResolver:
+    """Wildcard ambiguity resolution with backtrack registration
+    (reference: AmbiguityResolutionStrategies.scala:44-107).
+
+    A pick-script maps ambiguity-point ordinals (the k-th wildcard match
+    this run that had >1 candidate) to candidate indices. Unscripted points
+    fall back to the wildcard's FIFO policy while *recording* the
+    alternative picks — the driver's script queue is the re-derivation of
+    the reference's DPOR backtrack-point registration:
+
+      - strategy "backtrack" (= BackTrackStrategy:44-75): alternatives are
+        the distinct-fingerprint candidates, scanned from the tail
+        (the reference's reversed-tail heuristic);
+      - strategy "first_and_last" (= FirstAndLastBacktrack:78-107): only
+        the first and last candidates are considered.
+    """
+
+    def __init__(self, script: Optional[Dict[int, int]] = None,
+                 strategy: str = "backtrack"):
+        self.script: Dict[int, int] = dict(script or {})
+        self.strategy = strategy
+        self.point = 0
+        # (point ordinal, [alternative candidate indices]) for unscripted
+        # ambiguity points seen this run.
+        self.alternatives: List[Tuple[int, List[int]]] = []
+
+    def pick(self, msgs: List[Any], fingerprinter, default_policy: str) -> int:
+        if len(msgs) == 1:
+            return 0
+        point = self.point
+        self.point += 1
+        if point in self.script:
+            return min(self.script[point], len(msgs) - 1)
+        idx = len(msgs) - 1 if default_policy == "last" else 0
+        if self.strategy == "first_and_last":
+            alt = [j for j in (0, len(msgs) - 1) if j != idx]
+        else:
+            seen = {fingerprinter.fingerprint(msgs[idx])}
+            alt = []
+            for j in reversed(range(len(msgs))):
+                if j == idx:
+                    continue
+                fp = fingerprinter.fingerprint(msgs[j])
+                if fp in seen:
+                    continue
+                seen.add(fp)
+                alt.append(j)
+        if alt:
+            self.alternatives.append((point, alt))
+        return idx
+
+
+def check_with_ambiguity_backtracks(
+    sts_factory: Callable[[EventTrace], Any],
+    candidate: EventTrace,
+    externals: Sequence[Any],
+    violation: Any,
+    strategy: str = "backtrack",
+    max_attempts: int = 16,
+) -> Optional[EventTrace]:
+    """STS-check a wildcarded candidate, retrying alternative wildcard picks
+    when the default FIFO resolution fails to reproduce the violation.
+
+    The breadth-first script queue plays the role of the reference's DPOR
+    backtrack queue (WildcardMinimizer.testWithDpor +
+    AmbiguityResolutionStrategies.setBacktrack); each attempt is one full
+    STS replay with one pick overridden."""
+    from collections import deque
+
+    tried: Set[Tuple] = set()
+    queue: deque = deque([{}])
+    attempts = 0
+    while queue and attempts < max_attempts:
+        script = queue.popleft()
+        key = tuple(sorted(script.items()))
+        if key in tried:
+            continue
+        tried.add(key)
+        attempts += 1
+        resolver = AmbiguityResolver(script, strategy)
+        sts = sts_factory(candidate)
+        sts.ambiguity_resolver = resolver
+        result = sts.test_with_trace(candidate, list(externals), violation)
+        if result is not None:
+            return result
+        for point, alts in resolver.alternatives:
+            for a in alts:
+                nxt = dict(script)
+                nxt[point] = a
+                queue.append(nxt)
+    return None
+
+
+def make_sts_backtrack_check(
+    config,
+    externals: Sequence[Any],
+    violation: Any,
+    strategy: str = "backtrack",
+    max_attempts: int = 16,
+) -> Callable[[EventTrace], Optional[EventTrace]]:
+    """check(candidate) that retries alternative wildcard picks via
+    AmbiguityResolver when FIFO resolution loses the violation."""
+    from ..schedulers.replay import STSScheduler
+
+    def check(candidate: EventTrace) -> Optional[EventTrace]:
+        return check_with_ambiguity_backtracks(
+            lambda cand: STSScheduler(config, cand),
+            candidate, externals, violation,
+            strategy=strategy, max_attempts=max_attempts,
+        )
+
+    return check
+
+
+def make_dpor_check(
+    config,
+    externals: Sequence[Any],
+    violation: Any,
+    budget_seconds: float = 30.0,
+    max_interleavings: int = 8,
+) -> Callable[[EventTrace], Optional[EventTrace]]:
+    """check(candidate) backed by a fresh one-shot DPOR schedule checker
+    (reference: WildcardMinimizer.testWithDpor, WildcardMinimizer.scala:
+    67-114): steer by the wildcarded candidate, recover lost violations by
+    flipping racing deliveries within the budget."""
+    from ..schedulers.dpor import DPORScheduler
+
+    def check(candidate: EventTrace) -> Optional[EventTrace]:
+        sched = DPORScheduler(
+            config,
+            max_interleavings=max_interleavings,
+            budget_seconds=budget_seconds,
+        )
+        return sched.check_schedule(candidate, list(externals), violation)
+
+    return check
+
+
 class Clusterizer:
     """Iterator of wildcarded candidate schedules with feedback
     (reference: Clusterizer.scala — violationReproducedLastRun +
@@ -246,13 +384,20 @@ class BatchedWildcardMinimizer:
         host_check: Callable[[EventTrace], Optional[EventTrace]],
         stats: Optional[MinimizationStats] = None,
         policy: str = "first",
+        first_and_last: bool = False,
     ):
         # batch_verdicts(candidates) -> [reproduced?]; host_check produces
-        # the executed trace for the adopted schedule.
+        # the executed trace for the adopted schedule. With first_and_last,
+        # every cluster-removal is tried under BOTH ambiguity policies in
+        # the same batch — the device-tier FirstAndLastBacktrack
+        # (AmbiguityResolutionStrategies.scala:78-107): alternative picks
+        # become extra lanes in one kernel launch instead of sequential
+        # DPOR backtracks.
         self.batch_verdicts = batch_verdicts
         self.host_check = host_check
         self.stats = stats or MinimizationStats()
         self.policy = policy
+        self.first_and_last = first_and_last
 
     def minimize(
         self, trace: EventTrace, fingerprinter: FingerprintFactory
@@ -261,6 +406,11 @@ class BatchedWildcardMinimizer:
         self.stats.record_prune_start()
         removed: Set[int] = set()
         cluster_list = _clock_clusters(trace, fingerprinter)
+        policies = (
+            (self.policy, "last" if self.policy == "first" else "first")
+            if self.first_and_last
+            else (self.policy,)
+        )
         best = trace  # last host-confirmed violating execution
         while True:
             remaining = [
@@ -269,10 +419,12 @@ class BatchedWildcardMinimizer:
             remaining = [c for c in remaining if c]
             if not remaining:
                 break
-            candidates = [
-                _build_candidate(trace, removed | set(c), self.policy)
+            trials = [
+                (c, pol, _build_candidate(trace, removed | set(c), pol))
                 for c in remaining
+                for pol in policies
             ]
+            candidates = [cand for _, _, cand in trials]
             for cand in candidates:
                 self.stats.record_replay()
             verdicts = self.batch_verdicts(candidates)
@@ -281,7 +433,7 @@ class BatchedWildcardMinimizer:
             # same way), so progress is never discarded by a final-step
             # host/device disagreement.
             adopted = None
-            for cluster, cand, ok in zip(remaining, candidates, verdicts):
+            for (cluster, _pol, cand), ok in zip(trials, verdicts):
                 if not ok:
                     continue
                 executed = self.host_check(cand)

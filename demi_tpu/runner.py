@@ -172,17 +172,104 @@ def shrink_send_contents(
     return current
 
 
+def extract_fresh_dep_graph(
+    config: SchedulerConfig,
+    trace: EventTrace,
+    externals: Sequence[ExternalEvent],
+):
+    """Harvest a DepTracker (happens-before forest + stable DporEvent ids)
+    from one trace-steered execution, for seeding DPOR-as-oracle via
+    ``SchedulerConfig.original_dep_graph`` (reference:
+    RunnerUtils.extractFreshDepGraph, RunnerUtils.scala:946-977).
+    Returns (tracker, delivered_ids)."""
+    from .schedulers.dep_tracker import DepTracker
+    from .schedulers.dpor import _DporExecution, trace_to_steering_keys
+
+    tracker = DepTracker(config.fingerprinter)
+    tracker.begin_execution()
+    execution = _DporExecution(
+        config, tracker, (), max_messages=100_000,
+        initial_keys=trace_to_steering_keys(trace, config.fingerprinter),
+    )
+    execution.execute(list(externals))
+    return tracker, list(execution.delivered_ids)
+
+
+def edit_distance_dpor_ddmin(
+    config: SchedulerConfig,
+    trace: EventTrace,
+    externals: Sequence[ExternalEvent],
+    violation: Any,
+    max_max_distance: int = 8,
+    stats: Optional[MinimizationStats] = None,
+    dpor_kwargs: Optional[dict] = None,
+):
+    """External-event DDMin over a resumable DPOR oracle with a growing
+    edit-distance budget, steered by the recorded violating trace and
+    seeded with its dep graph (reference: RunnerUtils.editDistanceDporDDMin,
+    RunnerUtils.scala:812-879)."""
+    from .minimization.incremental_ddmin import IncrementalDDMin
+
+    tracker, _ = extract_fresh_dep_graph(config, trace, externals)
+    seeded = dataclasses.replace(config, original_dep_graph=tracker)
+    inc = IncrementalDDMin(
+        seeded,
+        max_max_distance=max_max_distance,
+        stats=stats or MinimizationStats(),
+        dpor_kwargs=dpor_kwargs,
+        initial_trace=trace,
+    )
+    mcs = inc.minimize(make_dag(list(externals)), violation)
+    return mcs
+
+
+def bounded_dpor(
+    config: SchedulerConfig,
+    externals: Sequence[ExternalEvent],
+    violation: Any = None,
+    max_interleavings: int = 1_000,
+    max_messages: int = 2_000,
+    budget_seconds: float = float("inf"),
+    initial_trace: Optional[EventTrace] = None,
+):
+    """Bounded systematic exploration (reference: RunnerUtils.boundedDPOR,
+    RunnerUtils.scala:881-911). Returns the DPORScheduler (for
+    interleavings_explored / shortest_violating) and the violating
+    ExecutionResult or None."""
+    from .schedulers.dpor import DPORScheduler
+
+    sched = DPORScheduler(
+        config,
+        max_messages=max_messages,
+        max_interleavings=max_interleavings,
+        budget_seconds=budget_seconds,
+    )
+    if initial_trace is not None:
+        sched.set_initial_trace(initial_trace)
+    result = sched.explore(externals, target_violation=violation)
+    return sched, result
+
+
 def run_the_gamut(
     config: SchedulerConfig,
     fuzz_result: FuzzResult,
     wildcards: bool = True,
     provenance: bool = True,
     internal_strategy: Optional[RemovalStrategy] = None,
+    app=None,
+    device_cfg=None,
 ) -> GamutResult:
     """The full minimization pipeline (reference: RunnerUtils.runTheGamut,
     RunnerUtils.scala:171-500): provenance pruning → external DDMin →
     internal minimization → wildcard (clock-cluster) minimization → final
-    internal minimization."""
+    internal minimization.
+
+    With ``app`` (a DSLApp), every stage's candidate trials run as
+    device-batched replay kernels — BatchedDDMin levels, batched
+    one-at-a-time internal rounds, batched wildcard clusters — and the host
+    STS oracle executes only the adopted candidates for bookkeeping traces
+    (the BASELINE north-star shape). Without ``app``, everything runs on
+    the host STS oracle (arbitrary Python actors)."""
     stats = MinimizationStats()
     trace, externals, violation = (
         fuzz_result.trace,
@@ -202,20 +289,51 @@ def run_the_gamut(
             trace = prune_concurrent_events(trace, affected)
             record("provenance", externals, trace)
 
+    checker = None
+    if app is not None:
+        from .device.batch_oracle import (
+            DeviceReplayChecker,
+            DeviceSTSOracle,
+            default_device_config,
+            make_batched_internal_check,
+        )
+        from .minimization.ddmin import BatchedDDMin
+        from .minimization.internal import BatchedInternalMinimizer
+        from .minimization.wildcards import BatchedWildcardMinimizer
+
+        device_cfg = device_cfg or default_device_config(app, trace, externals)
+        checker = DeviceReplayChecker(app, device_cfg, config)
+
     # External-event DDMin.
-    mcs_dag, verified = sts_sched_ddmin(
-        config, trace, externals, violation, stats=stats
-    )
+    if checker is not None:
+        oracle = DeviceSTSOracle(app, device_cfg, config, trace, checker=checker)
+        ddmin = BatchedDDMin(oracle, stats=stats)
+        mcs_dag = ddmin.minimize(make_dag(list(externals)), violation)
+        verified = ddmin.verified_trace
+    else:
+        mcs_dag, verified = sts_sched_ddmin(
+            config, trace, externals, violation, stats=stats
+        )
     externals = mcs_dag.get_all_events()
     if verified is not None:
         trace = verified
     record("ddmin", externals, trace)
 
+    def _device_int_min(tr: EventTrace) -> EventTrace:
+        minimizer = BatchedInternalMinimizer(
+            make_batched_internal_check(checker, list(externals), violation),
+            stats=stats,
+        )
+        return minimizer.minimize(tr)
+
     # Internal minimization.
-    trace = minimize_internals(
-        config, trace, externals, violation,
-        strategy=internal_strategy or OneAtATimeStrategy(), stats=stats,
-    )
+    if checker is not None:
+        trace = _device_int_min(trace)
+    else:
+        trace = minimize_internals(
+            config, trace, externals, violation,
+            strategy=internal_strategy or OneAtATimeStrategy(), stats=stats,
+        )
     record("int_min", externals, trace)
 
     if wildcards:
@@ -223,14 +341,31 @@ def run_the_gamut(
             sts = STSScheduler(config, candidate)
             return sts.test_with_trace(candidate, list(externals), violation)
 
-        wc = WildcardMinimizer(check, stats=stats)
+        if checker is not None:
+            def batch_verdicts(candidates):
+                return checker.verdicts(
+                    candidates, [list(externals)] * len(candidates), violation.code
+                )
+
+            # first_and_last: every cluster-removal tried under both
+            # ambiguity policies in the same batch (the device-tier
+            # FirstAndLastBacktrack — alternative picks are extra lanes,
+            # not sequential backtracks).
+            wc = BatchedWildcardMinimizer(
+                batch_verdicts, check, stats=stats, first_and_last=True
+            )
+        else:
+            wc = WildcardMinimizer(check, stats=stats)
         trace = wc.minimize(trace, config.fingerprinter)
         record("wildcard", externals, trace)
 
-        trace = minimize_internals(
-            config, trace, externals, violation,
-            strategy=SrcDstFIFORemoval(), stats=stats,
-        )
+        if checker is not None:
+            trace = _device_int_min(trace)
+        else:
+            trace = minimize_internals(
+                config, trace, externals, violation,
+                strategy=SrcDstFIFORemoval(), stats=stats,
+            )
         record("int_min2", externals, trace)
 
     result.mcs_externals = list(externals)
@@ -244,6 +379,11 @@ def print_minimization_stats(result: GamutResult) -> str:
     lines = ["stage            externals  deliveries"]
     for stage, ext, deliv in result.stages:
         lines.append(f"{stage:<16} {ext:>9}  {deliv:>10}")
+    for st in result.stats.stages:
+        lines.append(
+            f"  {st.strategy}/{st.oracle}: {st.total_replays} trials, "
+            f"prune {st.prune_duration_seconds:.2f}s"
+        )
     lines.append(f"total oracle replays: {result.stats.total_replays}")
     text = "\n".join(lines)
     print(text)

@@ -104,6 +104,10 @@ class BaseScheduler:
         """All currently pending entries (for divergence diagnostics)."""
         raise NotImplementedError
 
+    def remove_pending(self, entry: PendingEntry) -> None:
+        """Remove one specific pending entry (timer-cancel support)."""
+        raise NotImplementedError
+
     def actor_terminated(self, name: str) -> None:
         """Scrub pending state for a HardKilled actor (reference:
         Scheduler.actorTerminated; RandomScheduler.scala:536-547)."""
@@ -314,10 +318,15 @@ class BaseScheduler:
         self._record_send(entry)
 
     def notify_timer_cancel(self, name: str, msg: Any) -> None:
-        """Default: drop the first matching pending timer."""
-        # Subclasses with custom structures override; default uses
-        # pending_entries + a remove hook if provided.
-        pass
+        """Drop the first matching pending timer, so a cancelled timer can
+        never be delivered (reference: WrappedCancellable →
+        Scheduler.notify_timer_cancel, Instrumenter.scala:1145-1173).
+        Without this, replay/STS/DPOR could deliver timers the recorded
+        system cancelled — interleavings it could not exhibit."""
+        for entry in self.pending_entries():
+            if entry.is_timer and entry.rcv == name and entry.msg == msg:
+                self.remove_pending(entry)
+                return
 
     # -- invariant checking ----------------------------------------------
     def check_invariant(self) -> Optional[Any]:

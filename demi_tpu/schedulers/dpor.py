@@ -86,15 +86,47 @@ class ArvindDistanceOrdering(BacktrackOrdering):
         return arvind_distance(prefix, self.original)
 
 
+def trace_to_steering_keys(trace: EventTrace, fingerprinter) -> List[Tuple]:
+    """Convert a recorded EventTrace's deliveries into divergence-tolerant
+    steering keys (snd, rcv, fingerprint, is_timer) for the first DPOR
+    execution (reference: DPORwHeuristicsUtil.convertToDPORTrace,
+    DPORwHeuristics.scala:1245-1304, feeding the nextTrace following at
+    :542-555)."""
+    from ..events import MsgEvent, TimerDelivery, WildCardMatch
+
+    keys: List[Tuple] = []
+    for u in trace.events:
+        ev = u.event
+        if isinstance(ev, MsgEvent):
+            if isinstance(ev.msg, WildCardMatch):
+                # Wildcarded expected delivery: match by receiver + class
+                # tag (reference: getMatchingMessage WildCardMatch support,
+                # DPORwHeuristics.scala:477-514).
+                keys.append(("*", ev.rcv, ev.msg))
+            else:
+                keys.append((ev.snd, ev.rcv, fingerprinter.fingerprint(ev.msg), False))
+        elif isinstance(ev, TimerDelivery):
+            keys.append((ev.rcv, ev.rcv, fingerprinter.fingerprint(ev.msg), True))
+    return keys
+
+
 class _DporExecution(BaseScheduler):
     """One controlled execution following a prescribed DporEvent-id prefix,
-    then a deterministic depth-first default order."""
+    then a deterministic depth-first default order.
+
+    With ``initial_keys`` (first execution of a DPOR-as-oracle run), the
+    schedule instead follows the recorded violating trace by
+    (snd, rcv, fingerprint, is_timer) with divergence tolerance — absent
+    recorded events are skipped (reference: getNextMatchingMessage /
+    prioritizePendingUponDivergence, DPORwHeuristics.scala:542-555)."""
 
     def __init__(self, config: SchedulerConfig, tracker: DepTracker,
-                 prescription: Tuple[int, ...], max_messages: int):
+                 prescription: Tuple[int, ...], max_messages: int,
+                 initial_keys: Optional[List[Tuple]] = None):
         super().__init__(config, max_messages)
         self.tracker = tracker
         self.prescription = list(prescription)
+        self.initial_keys = list(initial_keys or [])
         self._pending: List[Tuple[PendingEntry, DporEvent]] = []
         self._current_parent = ROOT
         self.delivered_ids: List[int] = []
@@ -118,6 +150,9 @@ class _DporExecution(BaseScheduler):
     def pending_entries(self) -> List[PendingEntry]:
         return [e for e, _ in self._pending]
 
+    def remove_pending(self, entry: PendingEntry) -> None:
+        self._pending = [(e, ev) for e, ev in self._pending if e is not entry]
+
     def actor_terminated(self, name: str) -> None:
         self._pending = [
             (e, ev) for e, ev in self._pending if e.rcv != name and e.snd != name
@@ -131,7 +166,39 @@ class _DporExecution(BaseScheduler):
             return None
         self.pending_sets.append({ev.id for _, ev in deliverable})
         chosen = None
-        while self.prescription:
+        while self.initial_keys:
+            key = self.initial_keys[0]
+            if key[0] == "*":
+                _, rcv, wc = key
+                matches = [
+                    p
+                    for p in deliverable
+                    if p[0].rcv == rcv
+                    and wc.matches(p[0].msg, self.config.fingerprinter)
+                ]
+                if wc.policy == "last" and matches:
+                    match = matches[-1]
+                else:
+                    match = matches[0] if matches else None
+            else:
+                snd, rcv, fp, is_timer = key
+                match = next(
+                    (
+                        p
+                        for p in deliverable
+                        if p[1].snd == snd
+                        and p[1].rcv == rcv
+                        and p[1].fingerprint == fp
+                        and p[1].is_timer == is_timer
+                    ),
+                    None,
+                )
+            self.initial_keys.pop(0)
+            if match is not None:
+                chosen = match
+                break
+            self.divergences += 1  # recorded event absent; skip it
+        while chosen is None and self.prescription:
             want = self.prescription[0]
             match = next((p for p in deliverable if p[1].id == want), None)
             self.prescription.pop(0)
@@ -179,13 +246,31 @@ class DPORScheduler(TestOracle):
         self._arvind_pending = arvind_ordering and ordering is None
         self.max_distance = max_distance
         self.stop_after_next_trace = stop_after_next_trace
-        self.tracker = DepTracker(config.fingerprinter)
+        # Seed the dep graph from a prior (fuzz/STS) run when provided
+        # (reference: originalDepGraph, SchedulerConfig.scala:9-37, harvested
+        # by RunnerUtils.extractFreshDepGraph:946-977).
+        if isinstance(config.original_dep_graph, DepTracker):
+            self.tracker = config.original_dep_graph
+        else:
+            self.tracker = DepTracker(config.fingerprinter)
+        # Recorded violating trace to steer the first execution toward
+        # (reference: test() -> run(events, initialTrace, initialGraph),
+        # DPORwHeuristics.scala:723-762).
+        self.initial_trace: Optional[EventTrace] = None
+        self._steer_next = False
         self._backtracks: List[Tuple[float, int, Tuple[int, ...]]] = []
         self._explored: Set[Tuple[int, ...]] = set()
         self._push_counter = 0
         self.interleavings_explored = 0
         self.original_trace_ids: Optional[List[int]] = None
         self.shortest_violating: Optional[EventTrace] = None
+
+    def set_initial_trace(self, trace: Optional[EventTrace]) -> None:
+        """Steer the first execution by this recorded violating trace, so
+        DPOR-as-oracle reproduces a known violation in ~1 execution instead
+        of searching blind from the canonical order."""
+        self.initial_trace = trace
+        self._steer_next = trace is not None
 
     # -- exploration -------------------------------------------------------
     def explore(
@@ -197,12 +282,22 @@ class DPORScheduler(TestOracle):
         or bounds are hit. Returns the violating execution, or None."""
         deadline = _time.monotonic() + self.budget_seconds
         prescription: Tuple[int, ...] = ()
+        steering: Optional[List[Tuple]] = None
+        if self.initial_trace is not None and (
+            self._steer_next or self.interleavings_explored == 0
+        ):
+            steering = trace_to_steering_keys(
+                self.initial_trace, self.config.fingerprinter
+            )
+            self._steer_next = False
         while self.interleavings_explored < self.max_interleavings:
             if _time.monotonic() > deadline:
                 break
             execution = _DporExecution(
-                self.config, self.tracker, prescription, self.max_messages
+                self.config, self.tracker, prescription, self.max_messages,
+                initial_keys=steering,
             )
+            steering = None  # only the first execution is trace-steered
             self.tracker.begin_execution()
             result = execution.execute(list(externals))
             self.interleavings_explored += 1
@@ -253,6 +348,28 @@ class DPORScheduler(TestOracle):
         if prio == float("inf"):
             return None
         return prefix
+
+    # -- one-shot schedule checking ---------------------------------------
+    def check_schedule(
+        self,
+        candidate_trace: EventTrace,
+        externals: Sequence[ExternalEvent],
+        violation: Any,
+    ) -> Optional[EventTrace]:
+        """One-shot checker for a (possibly wildcarded) candidate schedule
+        (reference: WildcardMinimizer.testWithDpor,
+        WildcardMinimizer.scala:67-114 — stopAfterNextTrace + per-cluster
+        budget). The first execution steers by the candidate (wildcards
+        match by receiver + class tag); if its FIFO ambiguity picks lose
+        the violation, the backtrack queue flips racing deliveries within
+        the interleaving/time budget — the DPOR-side analog of
+        BackTrackStrategy."""
+        self.set_initial_trace(candidate_trace)
+        result = self.explore(externals, target_violation=violation)
+        if result is None:
+            return None
+        result.trace.set_original_externals(list(externals))
+        return result.trace
 
     # -- TestOracle --------------------------------------------------------
     def test(

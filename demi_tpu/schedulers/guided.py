@@ -52,6 +52,9 @@ class GuidedScheduler(BaseScheduler):
     def pending_entries(self) -> List[PendingEntry]:
         return list(self._pending)
 
+    def remove_pending(self, entry: PendingEntry) -> None:
+        self._pending.remove(entry)
+
     def actor_terminated(self, name: str) -> None:
         self._pending = [
             e for e in self._pending if e.rcv != name and e.snd != name

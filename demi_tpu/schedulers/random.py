@@ -209,6 +209,12 @@ class RandomScheduler(BaseScheduler):
     def pending_entries(self) -> List[PendingEntry]:
         return self.pending.entries() + list(self._parked_timers)
 
+    def remove_pending(self, entry: PendingEntry) -> None:
+        if entry in self._parked_timers:
+            self._parked_timers.remove(entry)
+        else:
+            self.pending.remove_entry(entry)
+
     def actor_terminated(self, name: str) -> None:
         self.pending.remove_for_actor(name)
         self._parked_timers = [e for e in self._parked_timers if e.rcv != name]

@@ -117,7 +117,7 @@ class _ReplayPending:
         return None
 
     def pop_wildcard(
-        self, rcv: str, wc: WildCardMatch, deliverable=None
+        self, rcv: str, wc: WildCardMatch, deliverable=None, resolver=None
     ) -> Optional[PendingEntry]:
         candidates = [
             e
@@ -134,6 +134,11 @@ class _ReplayPending:
             idx = wc.selector([e.msg for e in candidates])
             if idx is None:
                 return None
+            entry = candidates[idx]
+        elif resolver is not None:
+            idx = resolver.pick(
+                [e.msg for e in candidates], self.fingerprinter, wc.policy
+            )
             entry = candidates[idx]
         elif wc.policy == "last":
             entry = candidates[-1]
@@ -169,6 +174,9 @@ class TraceFollowingScheduler(BaseScheduler):
         self.allow_peek = allow_peek
         self.max_peek_messages = max_peek_messages
         self.peeked_prefixes = 0
+        # Optional wildcard ambiguity resolver (pick-script + backtrack
+        # registration; see minimization/wildcards.py AmbiguityResolver).
+        self.ambiguity_resolver = None
 
     # BaseScheduler policy hooks (we bypass its dispatch loop but reuse
     # prepare/_deliver/_absorb/_record_send plumbing).
@@ -184,6 +192,9 @@ class TraceFollowingScheduler(BaseScheduler):
 
     def pending_entries(self) -> List[PendingEntry]:
         return list(self.rpending.all)
+
+    def remove_pending(self, entry: PendingEntry) -> None:
+        self.rpending._discard(entry)
 
     def actor_terminated(self, name: str) -> None:
         self.rpending.remove_for_actor(name)
@@ -306,7 +317,8 @@ class TraceFollowingScheduler(BaseScheduler):
     def _match_delivery(self, exp: Unique, event: MsgEvent) -> Optional[PendingEntry]:
         if isinstance(event.msg, WildCardMatch):
             return self.rpending.pop_wildcard(
-                event.rcv, event.msg, deliverable=self.system.deliverable
+                event.rcv, event.msg, deliverable=self.system.deliverable,
+                resolver=self.ambiguity_resolver,
             )
         if event.is_external:
             return self.rpending.pop_external(exp.id)

@@ -35,6 +35,9 @@ class NullScheduler(BaseScheduler):
     def pending_entries(self) -> List[PendingEntry]:
         return list(self._pending)
 
+    def remove_pending(self, entry: PendingEntry) -> None:
+        self._pending.remove(entry)
+
     def actor_terminated(self, name: str) -> None:
         pass
 
@@ -54,6 +57,9 @@ class BasicScheduler(BaseScheduler):
 
     def pending_entries(self) -> List[PendingEntry]:
         return list(self._pending)
+
+    def remove_pending(self, entry: PendingEntry) -> None:
+        self._pending.remove(entry)
 
     def actor_terminated(self, name: str) -> None:
         self._pending = [
@@ -88,6 +94,9 @@ class FairScheduler(BaseScheduler):
 
     def pending_entries(self) -> List[PendingEntry]:
         return [e for q in self._queues.values() for e in q]
+
+    def remove_pending(self, entry: PendingEntry) -> None:
+        self._queues[entry.rcv].remove(entry)
 
     def actor_terminated(self, name: str) -> None:
         self._queues.pop(name, None)

@@ -201,6 +201,14 @@ def test_cli_fuzz_minimize_replay(tmp_path):
         == 0
     )
     assert os.path.exists(os.path.join(exp, "mcs.json"))
+    # The default minimize path farms trials to the device-batched oracles;
+    # the saved stats must show the batched stages and their trial counts.
+    with open(os.path.join(exp, "minimization_stats.json")) as f:
+        stages = json.load(f)
+    strategies = {s["strategy"] for s in stages}
+    assert "BatchedDDMin" in strategies
+    assert "BatchedOneAtATime" in strategies
+    assert sum(s["total_replays"] for s in stages) > 0
     assert (
         main(["replay", "--app", "broadcast", "--nodes", "3", "--bug", "x",
               "-e", exp])
