@@ -106,6 +106,7 @@ def cmd_minimize(args) -> int:
         mcs = edit_distance_dpor_ddmin(
             config, trace, externals, violation,
             dpor_kwargs={"max_interleavings": args.max_interleavings},
+            checkpoint_dir=args.experiment, resume=args.resume,
         )
         kept = mcs.get_all_events()
         print(f"IncDDMin MCS: {len(externals)} -> {len(kept)} externals")
@@ -119,6 +120,7 @@ def cmd_minimize(args) -> int:
     result = run_the_gamut(
         config, fr, wildcards=not args.no_wildcards,
         app=None if args.host else app,
+        checkpoint_dir=args.experiment, resume=args.resume,
     )
     print_minimization_stats(result)
     ExperimentSerializer.save(
@@ -274,6 +276,11 @@ def main(argv: Optional[list] = None) -> int:
     p.add_argument(
         "--max-interleavings", type=int, default=64, dest="max_interleavings",
         help="DPOR interleaving budget per incddmin probe",
+    )
+    p.add_argument(
+        "--resume", action="store_true",
+        help="restart after the last completed pipeline stage "
+             "(stage checkpoints live in the experiment dir)",
     )
     p.set_defaults(fn=cmd_minimize)
 

@@ -23,7 +23,7 @@ import numpy as np
 import jax
 
 from ..dsl import DSLApp
-from ..device.core import ST_VIOLATION, DeviceConfig
+from ..device.core import ST_OVERFLOW, ST_VIOLATION, DeviceConfig
 from ..device.encoding import lower_program, stack_programs
 from ..device.explore import make_explore_kernel
 from ..external_events import ExternalEvent
@@ -39,6 +39,9 @@ class SweepChunkResult:
     first_violating_lane: Optional[int]
     first_violation_code: Optional[int]
     seconds: float
+    # Lanes aborted with ST_OVERFLOW (pool too small): these completed no
+    # verdict, so any nonzero count means the sweep's numbers undercount.
+    overflow_lanes: int = 0
 
 
 @dataclass
@@ -131,6 +134,7 @@ class SweepDriver:
                 int(violations[lanes[0]]) if len(lanes) else None
             ),
             seconds=seconds,
+            overflow_lanes=int((statuses == ST_OVERFLOW).sum()),
         )
 
     def sweep(

@@ -203,14 +203,31 @@ def edit_distance_dpor_ddmin(
     max_max_distance: int = 8,
     stats: Optional[MinimizationStats] = None,
     dpor_kwargs: Optional[dict] = None,
+    checkpoint_dir: Optional[str] = None,
+    resume: bool = False,
 ):
     """External-event DDMin over a resumable DPOR oracle with a growing
     edit-distance budget, steered by the recorded violating trace and
     seeded with its dep graph (reference: RunnerUtils.editDistanceDporDDMin,
-    RunnerUtils.scala:812-879)."""
+    RunnerUtils.scala:812-879). With ``checkpoint_dir``, the dep graph is
+    persisted; ``resume=True`` reloads it across restarts
+    (Serialization.scala:176-187)."""
     from .minimization.incremental_ddmin import IncrementalDDMin
 
-    tracker, _ = extract_fresh_dep_graph(config, trace, externals)
+    tracker = None
+    if checkpoint_dir is not None and resume:
+        # Only an explicit resume reloads a persisted dep graph — a stale
+        # one from an earlier experiment in the same dir would silently
+        # degrade steering (ids/fingerprints minted for a different trace).
+        from .serialization import load_dep_graph
+
+        tracker = load_dep_graph(checkpoint_dir, config.fingerprinter)
+    if tracker is None:
+        tracker, _ = extract_fresh_dep_graph(config, trace, externals)
+        if checkpoint_dir is not None:
+            from .serialization import save_dep_graph
+
+            save_dep_graph(checkpoint_dir, tracker)
     seeded = dataclasses.replace(config, original_dep_graph=tracker)
     inc = IncrementalDDMin(
         seeded,
@@ -258,6 +275,8 @@ def run_the_gamut(
     internal_strategy: Optional[RemovalStrategy] = None,
     app=None,
     device_cfg=None,
+    checkpoint_dir: Optional[str] = None,
+    resume: bool = False,
 ) -> GamutResult:
     """The full minimization pipeline (reference: RunnerUtils.runTheGamut,
     RunnerUtils.scala:171-500): provenance pruning → external DDMin →
@@ -269,7 +288,15 @@ def run_the_gamut(
     one-at-a-time internal rounds, batched wildcard clusters — and the host
     STS oracle executes only the adopted candidates for bookkeeping traces
     (the BASELINE north-star shape). Without ``app``, everything runs on
-    the host STS oracle (arbitrary Python actors)."""
+    the host STS oracle (arbitrary Python actors).
+
+    With ``checkpoint_dir``, every completed stage's (externals, trace) is
+    persisted; ``resume=True`` skips stages whose checkpoints exist and
+    restarts after the last completed one (reference: per-stage experiment
+    serialization + deserializeExperiment, Serialization.scala /
+    RunnerUtils.scala:502-552)."""
+    from .serialization import load_stage, save_stage
+
     stats = MinimizationStats()
     trace, externals, violation = (
         fuzz_result.trace,
@@ -280,6 +307,40 @@ def run_the_gamut(
 
     def record(stage: str, ext: Sequence[ExternalEvent], tr: EventTrace):
         result.stages.append((stage, len(ext), len(tr.deliveries())))
+
+    def checkpoint(stage: str, ext: Sequence[ExternalEvent], tr: EventTrace):
+        if checkpoint_dir is not None:
+            save_stage(checkpoint_dir, stage, ext, tr)
+
+    def restore(stage: str):
+        """(externals, trace) if this stage completed in a prior run."""
+        if not (resume and checkpoint_dir is not None):
+            return None
+        restored = load_stage(checkpoint_dir, stage, app)
+        if restored is None:
+            return None
+        # Checkpoints can't persist actor factories; for DSL apps load_stage
+        # rebuilds them from the app, but in host mode (app=None) the
+        # restored Start/Spawn events carry ctor=None and every later
+        # replay would fail with "no factory". Re-bind from the original
+        # program's Start events by actor name.
+        r_ext, r_trace = restored
+        from .events import SpawnEvent
+        from .external_events import Start
+
+        by_name = {
+            e.name: e.ctor
+            for e in fuzz_result.program
+            if isinstance(e, Start) and e.ctor is not None
+        }
+        for e in r_ext:
+            if isinstance(e, Start) and e.ctor is None:
+                object.__setattr__(e, "ctor", by_name.get(e.name))
+        for u in r_trace.events:
+            ev = u.event
+            if isinstance(ev, SpawnEvent) and ev.ctor is None:
+                object.__setattr__(ev, "ctor", by_name.get(ev.name))
+        return r_ext, r_trace
 
     record("original", externals, trace)
 
@@ -305,18 +366,23 @@ def run_the_gamut(
         checker = DeviceReplayChecker(app, device_cfg, config)
 
     # External-event DDMin.
-    if checker is not None:
-        oracle = DeviceSTSOracle(app, device_cfg, config, trace, checker=checker)
-        ddmin = BatchedDDMin(oracle, stats=stats)
-        mcs_dag = ddmin.minimize(make_dag(list(externals)), violation)
-        verified = ddmin.verified_trace
+    restored = restore("ddmin")
+    if restored is not None:
+        externals, trace = restored
     else:
-        mcs_dag, verified = sts_sched_ddmin(
-            config, trace, externals, violation, stats=stats
-        )
-    externals = mcs_dag.get_all_events()
-    if verified is not None:
-        trace = verified
+        if checker is not None:
+            oracle = DeviceSTSOracle(app, device_cfg, config, trace, checker=checker)
+            ddmin = BatchedDDMin(oracle, stats=stats)
+            mcs_dag = ddmin.minimize(make_dag(list(externals)), violation)
+            verified = ddmin.verified_trace
+        else:
+            mcs_dag, verified = sts_sched_ddmin(
+                config, trace, externals, violation, stats=stats
+            )
+        externals = mcs_dag.get_all_events()
+        if verified is not None:
+            trace = verified
+        checkpoint("ddmin", externals, trace)
     record("ddmin", externals, trace)
 
     def _device_int_min(tr: EventTrace) -> EventTrace:
@@ -327,13 +393,18 @@ def run_the_gamut(
         return minimizer.minimize(tr)
 
     # Internal minimization.
-    if checker is not None:
-        trace = _device_int_min(trace)
+    restored = restore("int_min")
+    if restored is not None:
+        externals, trace = restored
     else:
-        trace = minimize_internals(
-            config, trace, externals, violation,
-            strategy=internal_strategy or OneAtATimeStrategy(), stats=stats,
-        )
+        if checker is not None:
+            trace = _device_int_min(trace)
+        else:
+            trace = minimize_internals(
+                config, trace, externals, violation,
+                strategy=internal_strategy or OneAtATimeStrategy(), stats=stats,
+            )
+        checkpoint("int_min", externals, trace)
     record("int_min", externals, trace)
 
     if wildcards:
@@ -341,31 +412,41 @@ def run_the_gamut(
             sts = STSScheduler(config, candidate)
             return sts.test_with_trace(candidate, list(externals), violation)
 
-        if checker is not None:
-            def batch_verdicts(candidates):
-                return checker.verdicts(
-                    candidates, [list(externals)] * len(candidates), violation.code
-                )
-
-            # first_and_last: every cluster-removal tried under both
-            # ambiguity policies in the same batch (the device-tier
-            # FirstAndLastBacktrack — alternative picks are extra lanes,
-            # not sequential backtracks).
-            wc = BatchedWildcardMinimizer(
-                batch_verdicts, check, stats=stats, first_and_last=True
-            )
+        restored = restore("wildcard")
+        if restored is not None:
+            externals, trace = restored
         else:
-            wc = WildcardMinimizer(check, stats=stats)
-        trace = wc.minimize(trace, config.fingerprinter)
+            if checker is not None:
+                def batch_verdicts(candidates):
+                    return checker.verdicts(
+                        candidates, [list(externals)] * len(candidates), violation.code
+                    )
+
+                # first_and_last: every cluster-removal tried under both
+                # ambiguity policies in the same batch (the device-tier
+                # FirstAndLastBacktrack — alternative picks are extra lanes,
+                # not sequential backtracks).
+                wc = BatchedWildcardMinimizer(
+                    batch_verdicts, check, stats=stats, first_and_last=True
+                )
+            else:
+                wc = WildcardMinimizer(check, stats=stats)
+            trace = wc.minimize(trace, config.fingerprinter)
+            checkpoint("wildcard", externals, trace)
         record("wildcard", externals, trace)
 
-        if checker is not None:
-            trace = _device_int_min(trace)
+        restored = restore("int_min2")
+        if restored is not None:
+            externals, trace = restored
         else:
-            trace = minimize_internals(
-                config, trace, externals, violation,
-                strategy=SrcDstFIFORemoval(), stats=stats,
-            )
+            if checker is not None:
+                trace = _device_int_min(trace)
+            else:
+                trace = minimize_internals(
+                    config, trace, externals, violation,
+                    strategy=SrcDstFIFORemoval(), stats=stats,
+                )
+            checkpoint("int_min2", externals, trace)
         record("int_min2", externals, trace)
 
     result.mcs_externals = list(externals)

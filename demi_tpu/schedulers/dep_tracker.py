@@ -77,6 +77,45 @@ class DepTracker:
             bits[parent // 64] |= np.uint64(1) << np.uint64(parent % 64)
         return bits
 
+    # -- persistence -------------------------------------------------------
+    def to_records(self) -> List[Dict]:
+        """Flat records for JSON persistence (reference: depGraph nodes +
+        edges, Serialization.scala:176-187). Ancestor bitsets are derived
+        state and are rebuilt on load."""
+        out = []
+        inv = {eid: key for key, eid in self._ids.items()}
+        for eid in sorted(self.events):
+            ev = self.events[eid]
+            key = inv[eid]
+            out.append(
+                {
+                    "id": eid,
+                    "snd": ev.snd,
+                    "rcv": ev.rcv,
+                    "fp": ev.fingerprint,
+                    "parent": ev.parent,
+                    "is_timer": ev.is_timer,
+                    "occ": key[5],
+                }
+            )
+        return out
+
+    @classmethod
+    def from_records(cls, records: List[Dict], fingerprinter) -> "DepTracker":
+        tracker = cls(fingerprinter)
+        for rec in sorted(records, key=lambda r: r["id"]):
+            eid = rec["id"]
+            fp = rec["fp"]
+            key = (rec["snd"], rec["rcv"], fp, rec["parent"], rec["is_timer"],
+                   rec["occ"])
+            event = DporEvent(eid, rec["snd"], rec["rcv"], fp, rec["parent"],
+                              rec["is_timer"])
+            tracker._ids[key] = eid
+            tracker.events[eid] = event
+            tracker._ancestors[eid] = tracker._ancestor_bits(rec["parent"], eid)
+            tracker._next_id = max(tracker._next_id, eid + 1)
+        return tracker
+
     # -- happens-before ----------------------------------------------------
     def is_ancestor(self, a: int, b: int) -> bool:
         """True iff a happens-before b (a on b's parent chain)."""

@@ -28,6 +28,7 @@ from typing import Any, Dict, List, Optional, Sequence
 
 from .dsl import DSLApp
 from .events import (
+    WildCardMatch,
     BeginUnignorableEvents,
     BeginWaitCondition,
     BeginWaitQuiescence,
@@ -81,6 +82,11 @@ _EVENT_TYPES = {
 
 
 def _msg_to_json(msg: Any):
+    if isinstance(msg, WildCardMatch):
+        # Wildcarded expected deliveries occur in minimization-stage
+        # checkpoints (policy enum only; closure selectors don't persist,
+        # matching the reference's sanitization).
+        return {"t": "wc", "tag": msg.class_tag, "policy": msg.policy}
     if isinstance(msg, tuple):
         return {"t": "tuple", "v": list(int(x) for x in msg)}
     if isinstance(msg, (int, str, float, bool)) or msg is None:
@@ -89,8 +95,24 @@ def _msg_to_json(msg: Any):
 
 
 def _msg_from_json(obj):
+    if obj["t"] == "wc":
+        return WildCardMatch(class_tag=obj["tag"], policy=obj["policy"])
     if obj["t"] == "tuple":
         return tuple(obj["v"])
+    return obj["v"]
+
+
+def _fp_to_json(fp: Any):
+    """Fingerprints are nested tuples/scalars; JSON lists don't round-trip
+    to tuples, so encode structure explicitly."""
+    if isinstance(fp, tuple):
+        return {"t": "tuple", "v": [_fp_to_json(x) for x in fp]}
+    return {"t": "lit", "v": fp}
+
+
+def _fp_from_json(obj) -> Any:
+    if obj["t"] == "tuple":
+        return tuple(_fp_from_json(x) for x in obj["v"])
     return obj["v"]
 
 
@@ -276,6 +298,67 @@ class ExperimentSerializer:
                 os.path.join(directory, "device_trace.demirec"), device_trace
             )
         return directory
+
+
+def save_dep_graph(directory: str, tracker) -> str:
+    """Persist a DepTracker's happens-before forest (reference: depGraph
+    nodes/edges, Serialization.scala:176-187, 391-421) so restartable
+    minimization can re-seed DPOR without re-running the recording."""
+    os.makedirs(directory, exist_ok=True)
+    records = []
+    for rec in tracker.to_records():
+        rec = dict(rec)
+        rec["fp"] = _fp_to_json(rec["fp"])
+        records.append(rec)
+    path = os.path.join(directory, "dep_graph.json")
+    with open(path, "w") as f:
+        json.dump(records, f, indent=1)
+    return path
+
+
+def load_dep_graph(directory: str, fingerprinter):
+    """Rebuild the DepTracker saved by save_dep_graph, or None if absent."""
+    from .schedulers.dep_tracker import DepTracker
+
+    path = os.path.join(directory, "dep_graph.json")
+    if not os.path.exists(path):
+        return None
+    with open(path) as f:
+        records = json.load(f)
+    for rec in records:
+        rec["fp"] = _fp_from_json(rec["fp"])
+    return DepTracker.from_records(records, fingerprinter)
+
+
+def save_stage(
+    directory: str,
+    stage: str,
+    externals: Sequence[ExternalEvent],
+    trace: EventTrace,
+) -> None:
+    """Checkpoint one minimization-pipeline stage's outputs (reference:
+    every gamut stage's trace is serialized for restart,
+    RunnerUtils.scala:171-500 + deserializeExperiment:502-525)."""
+    os.makedirs(directory, exist_ok=True)
+    obj = {
+        "stage": stage,
+        "externals": [_external_to_json(e) for e in externals],
+        "trace": [_event_to_json(u) for u in trace.events],
+    }
+    with open(os.path.join(directory, f"stage_{stage}.json"), "w") as f:
+        json.dump(obj, f, indent=1)
+
+
+def load_stage(directory: str, stage: str, app: Optional[DSLApp] = None):
+    """(externals, trace) for a checkpointed stage, or None if absent."""
+    path = os.path.join(directory, f"stage_{stage}.json")
+    if not os.path.exists(path):
+        return None
+    with open(path) as f:
+        obj = json.load(f)
+    externals = [_external_from_json(r, app) for r in obj["externals"]]
+    events = [_event_from_json(r, app) for r in obj["trace"]]
+    return externals, EventTrace(events, externals)
 
 
 class ExperimentDeserializer:

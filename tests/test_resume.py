@@ -1,0 +1,160 @@
+"""Restartable minimization: stage checkpoints + dep-graph persistence
+(reference: Serialization.scala:176-187, RunnerUtils.deserializeExperiment
+:502-552)."""
+
+import json
+import os
+
+import pytest
+
+from demi_tpu.apps.broadcast import broadcast_send_generator, make_broadcast_app
+from demi_tpu.apps.common import dsl_start_events, make_host_invariant
+from demi_tpu.config import SchedulerConfig
+from demi_tpu.fuzzing import Fuzzer, FuzzerWeights
+from demi_tpu.runner import fuzz, run_the_gamut
+from demi_tpu.serialization import (
+    load_dep_graph,
+    load_stage,
+    save_dep_graph,
+    save_stage,
+)
+
+
+@pytest.fixture(scope="module")
+def broadcast_violation():
+    app = make_broadcast_app(3, reliable=False)
+    config = SchedulerConfig(invariant_check=make_host_invariant(app))
+    fuzzer = Fuzzer(
+        num_events=12,
+        weights=FuzzerWeights(kill=0.05, send=0.6, wait_quiescence=0.15),
+        message_gen=broadcast_send_generator(app),
+        prefix=dsl_start_events(app),
+        max_kills=1,
+    )
+    fr = fuzz(config, fuzzer, max_executions=30)
+    assert fr is not None
+    return app, config, fr
+
+
+def test_stage_checkpoint_roundtrip(tmp_path, broadcast_violation):
+    app, config, fr = broadcast_violation
+    save_stage(str(tmp_path), "ddmin", fr.program, fr.trace)
+    restored = load_stage(str(tmp_path), "ddmin", app)
+    assert restored is not None
+    externals, trace = restored
+    assert [e.eid for e in externals] == [e.eid for e in fr.program]
+    assert len(trace.events) == len(fr.trace.events)
+    assert [type(u.event).__name__ for u in trace.events] == [
+        type(u.event).__name__ for u in fr.trace.events
+    ]
+
+
+def test_dep_graph_roundtrip(tmp_path, broadcast_violation):
+    app, config, fr = broadcast_violation
+    from demi_tpu.runner import extract_fresh_dep_graph
+
+    tracker, delivered = extract_fresh_dep_graph(config, fr.trace, fr.program)
+    save_dep_graph(str(tmp_path), tracker)
+    loaded = load_dep_graph(str(tmp_path), config.fingerprinter)
+    assert loaded is not None
+    assert set(loaded.events) == set(tracker.events)
+    for eid, ev in tracker.events.items():
+        lev = loaded.events[eid]
+        assert (lev.snd, lev.rcv, lev.fingerprint, lev.parent, lev.is_timer) == (
+            ev.snd, ev.rcv, ev.fingerprint, ev.parent, ev.is_timer
+        )
+    # Ancestor structure rebuilt identically: same racing pairs.
+    assert loaded.racing_pairs(delivered) == tracker.racing_pairs(delivered)
+    # Stable id assignment: a steered re-execution on the LOADED tracker
+    # reuses the recorded ids instead of minting fresh ones.
+    next_before = loaded._next_id
+    from demi_tpu.schedulers.dpor import _DporExecution, trace_to_steering_keys
+
+    loaded.begin_execution()
+    execution = _DporExecution(
+        config, loaded, (), 10_000,
+        initial_keys=trace_to_steering_keys(fr.trace, config.fingerprinter),
+    )
+    execution.execute(list(fr.program))
+    assert execution.delivered_ids == delivered
+    assert loaded._next_id == next_before
+
+
+def test_gamut_kill_and_resume(tmp_path, broadcast_violation):
+    """Simulate a crash after the ddmin stage: a resumed run must not
+    re-execute completed stages and must produce an equivalent result."""
+    app, config, fr = broadcast_violation
+    full_dir = str(tmp_path / "full")
+    full = run_the_gamut(config, fr, checkpoint_dir=full_dir)
+
+    # "Crash" after ddmin: copy only the ddmin checkpoint to a new dir.
+    crash_dir = str(tmp_path / "crashed")
+    os.makedirs(crash_dir)
+    with open(os.path.join(full_dir, "stage_ddmin.json")) as f:
+        ddmin_ckpt = json.load(f)
+    with open(os.path.join(crash_dir, "stage_ddmin.json"), "w") as f:
+        json.dump(ddmin_ckpt, f)
+
+    resumed = run_the_gamut(config, fr, checkpoint_dir=crash_dir, resume=True)
+    # The resumed run skipped ddmin: no DDMin stage appears in its stats.
+    strategies = [s.strategy for s in resumed.stats.stages]
+    assert not any("DDMin" in s for s in strategies), strategies
+    # And it picked up exactly where the full run was after ddmin.
+    full_stages = dict((s, (e, d)) for s, e, d in full.stages)
+    res_stages = dict((s, (e, d)) for s, e, d in resumed.stages)
+    assert res_stages["ddmin"] == full_stages["ddmin"]
+    assert [e.eid for e in resumed.mcs_externals] == [
+        e.eid for e in full.mcs_externals
+    ]
+    # Later stages now have their own checkpoints for a future resume.
+    assert os.path.exists(os.path.join(crash_dir, "stage_int_min.json"))
+
+
+def test_cli_minimize_resume(tmp_path):
+    """End-to-end CLI kill-and-resume: fuzz, minimize (writes stage
+    checkpoints into the experiment dir), then minimize --resume skips the
+    completed pipeline."""
+    from demi_tpu.cli import main
+
+    exp = str(tmp_path / "exp")
+    assert main([
+        "fuzz", "--app", "broadcast", "--nodes", "3", "--bug", "x",
+        "--seed", "3", "--max-executions", "40", "-o", exp,
+    ]) == 0
+    assert main([
+        "minimize", "--app", "broadcast", "--nodes", "3", "--bug", "x",
+        "-e", exp, "--host",
+    ]) == 0
+    assert os.path.exists(os.path.join(exp, "stage_ddmin.json"))
+    assert main([
+        "minimize", "--app", "broadcast", "--nodes", "3", "--bug", "x",
+        "-e", exp, "--host", "--resume",
+    ]) == 0
+    with open(os.path.join(exp, "minimization_stats.json")) as f:
+        stages = json.load(f)
+    # The resumed run's stats contain no replay work at all: every stage
+    # was restored from its checkpoint.
+    assert sum(s["total_replays"] for s in stages) == 0, stages
+
+
+def test_host_mode_resume_rebinds_ctors(tmp_path, broadcast_violation):
+    """A stage checkpoint restored WITHOUT the app (host mode) can't carry
+    actor factories on disk; run_the_gamut must re-bind them from the
+    original program or every post-resume stage silently no-ops."""
+    from demi_tpu.external_events import Start
+    from demi_tpu.schedulers.replay import STSScheduler
+
+    app, config, fr = broadcast_violation
+    d = str(tmp_path)
+    save_stage(d, "ddmin", fr.program, fr.trace)
+    # Raw host-mode load really does lose the ctors...
+    externals, _ = load_stage(d, "ddmin", None)
+    assert any(e.ctor is None for e in externals if isinstance(e, Start))
+    # ...but the resumed pipeline re-binds them: its output trace is still
+    # replayable and reproduces the violation.
+    resumed = run_the_gamut(config, fr, checkpoint_dir=d, resume=True,
+                            wildcards=False)
+    sts = STSScheduler(config, resumed.final_trace)
+    assert sts.test_with_trace(
+        resumed.final_trace, resumed.mcs_externals, fr.violation
+    ) is not None
