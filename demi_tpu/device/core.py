@@ -263,14 +263,33 @@ def purge_actor(state: ScheduleState, actor: jnp.ndarray) -> ScheduleState:
 # Delivery
 # ---------------------------------------------------------------------------
 
-def deliver_index(
-    state: ScheduleState, cfg: DeviceConfig, app: DSLApp, idx: jnp.ndarray
-) -> ScheduleState:
-    """Deliver pool entry ``idx``: run the app handler for the receiver,
-    absorb its outbox (with timer parking), consume the entry.
+class RowProposal(NamedTuple):
+    """Pool-insert rows proposed by one effects pass (the insert itself is
+    deferred so the fused step pays ONE insert for both step kinds)."""
 
-    ``idx`` must point at a deliverable entry; delivering with an invalid
-    index (== pool_capacity) is a no-op enabled by the caller's masking."""
+    valid: jnp.ndarray  # [K] bool
+    src: jnp.ndarray  # [K] int32
+    dst: jnp.ndarray  # [K] int32
+    timer: jnp.ndarray  # [K] bool
+    parked: jnp.ndarray  # [K] bool
+    msg: jnp.ndarray  # [K, W] int32
+
+    @staticmethod
+    def concat(a: "RowProposal", b: "RowProposal") -> "RowProposal":
+        return RowProposal(
+            *(jnp.concatenate([x, y]) for x, y in zip(a, b))
+        )
+
+
+def delivery_effects(
+    state: ScheduleState, cfg: DeviceConfig, app: DSLApp, idx: jnp.ndarray
+) -> Tuple[ScheduleState, RowProposal, jnp.ndarray]:
+    """Deliver pool entry ``idx`` minus the pool insert: run the app handler
+    for the receiver, consume the entry, update timer parking; return the
+    outbox as a RowProposal plus the trace record for this delivery.
+
+    ``idx`` must point at a deliverable entry; an invalid index
+    (== pool_capacity) makes the whole pass a no-op."""
     n = cfg.num_actors
     valid_idx = idx < cfg.pool_capacity
     safe_idx = jnp.minimum(idx, cfg.pool_capacity - 1)
@@ -279,7 +298,6 @@ def deliver_index(
     msg = state.pool_msg[safe_idx]
     is_timer = state.pool_timer[safe_idx]
     parent_rec = state.pool_crec[safe_idx]
-    rec_idx = state.trace_len  # this delivery's record position
 
     handler_state = state.actor_state[dst]
     new_row, outbox = app.handler(dst, handler_state, src, msg)
@@ -339,16 +357,31 @@ def deliver_index(
         timer_mem=timer_mem, timer_mem_valid=timer_mem_valid, pool_parked=pool_parked
     )
 
-    state = insert_rows(
-        state, cfg, ob_valid, ob_src, ob_dst, ob_timer, ob_parked, ob_msg,
-        crec=rec_idx if cfg.record_parents else None,
-    )
+    rows = RowProposal(ob_valid, ob_src, ob_dst, ob_timer, ob_parked, ob_msg)
     if cfg.record_trace:
         kind = jnp.where(is_timer, REC_TIMER, REC_DELIVERY)
         parts = [jnp.stack([kind, src, dst]), msg]
         if cfg.record_parents:
             parts.append(parent_rec[None])
         rec = jnp.concatenate(parts)
+    else:
+        rec = jnp.zeros((0,), jnp.int32)
+    return state, rows, rec
+
+
+def deliver_index(
+    state: ScheduleState, cfg: DeviceConfig, app: DSLApp, idx: jnp.ndarray
+) -> ScheduleState:
+    """Deliver pool entry ``idx``: delivery_effects + the pool insert +
+    trace append (the standalone form used by the replay/DPOR kernels)."""
+    valid_idx = idx < cfg.pool_capacity
+    rec_idx = state.trace_len  # this delivery's record position
+    state, rows, rec = delivery_effects(state, cfg, app, idx)
+    state = insert_rows(
+        state, cfg, rows.valid, rows.src, rows.dst, rows.timer, rows.parked,
+        rows.msg, crec=rec_idx if cfg.record_parents else None,
+    )
+    if cfg.record_trace:
         state = _append_record(state, cfg, rec, valid_idx)
     return state
 
@@ -367,7 +400,7 @@ def _append_record(state: ScheduleState, cfg: DeviceConfig, rec, enabled) -> Sch
 # External-op injection
 # ---------------------------------------------------------------------------
 
-def apply_external_op(
+def external_effects(
     state: ScheduleState,
     cfg: DeviceConfig,
     app: DSLApp,
@@ -377,13 +410,14 @@ def apply_external_op(
     a: jnp.ndarray,
     b: jnp.ndarray,
     msg: jnp.ndarray,  # [W]
-) -> ScheduleState:
-    """Apply one external op (Start/Kill/Send/Partition/...) to the lane.
-    Mirrors BaseScheduler._inject_one."""
+) -> Tuple[ScheduleState, RowProposal, jnp.ndarray, jnp.ndarray]:
+    """Apply one external op (Start/Kill/Send/Partition/...) minus the pool
+    insert; mirrors BaseScheduler._inject_one. Returns the proposed rows
+    (Start's initial messages + Send's external message), the trace record,
+    and its enabled flag. Pass OP_END to make the whole pass a no-op."""
     n = cfg.num_actors
     a_c = jnp.clip(a, 0, n - 1)
     b_c = jnp.clip(b, 0, n - 1)
-    rec_idx = state.trace_len  # this op's record position (creator link)
 
     is_start = op == OP_START
     is_kill = op == OP_KILL
@@ -416,19 +450,17 @@ def apply_external_op(
     cut = state.cut.at[a_c, b_c].set(cut_val)
     cut = cut.at[b_c, a_c].set(cut_val)
 
+    # HardKill scrub, branchless (the fused step can't afford a lax.cond
+    # whose both sides run under vmap anyway).
+    touch = ((state.pool_src == a_c) | (state.pool_dst == a_c)) & is_hardkill
     state = state._replace(
         started=started, isolated=isolated, stopped=stopped,
         actor_state=actor_state, cut=cut,
-    )
-    state = jax.lax.cond(
-        is_hardkill, lambda s: purge_actor(s, a_c), lambda s: s, state
+        pool_valid=state.pool_valid & ~touch,
     )
 
-    # One combined pool insertion for both effects of this op — the Start's
-    # initial rows (fresh-start only) and the Send's external message.
-    # (Under vmap both cond branches of the step execute, so every
-    # insert_rows pass — cumsum + searchsorted + 7 scatters — is paid per
-    # step; merging halves that cost for the inject path.)
+    # Proposed rows: the Start's initial messages (fresh-start only) and the
+    # Send's external message, as one [K0+1]-row proposal.
     k0 = initial_rows.shape[1]
     if k0 > 0:
         rows = initial_rows[a_c]
@@ -440,27 +472,22 @@ def apply_external_op(
             r_timer = jnp.any(r_msg[:, 0:1] == tags[None, :], axis=1) & (r_dst == a_c)
         else:
             r_timer = jnp.zeros(k0, bool)
-        all_valid = jnp.concatenate([r_valid, is_send[None]])
-        all_src = jnp.concatenate([jnp.full((k0,), a_c), jnp.asarray([n], jnp.int32)])
-        all_dst = jnp.concatenate([r_dst, a_c[None]])
-        all_timer = jnp.concatenate([r_timer, jnp.asarray([False])])
-        all_msg = jnp.concatenate([r_msg, msg[None, :]])
-        state = insert_rows(
-            state, cfg, all_valid, all_src, all_dst, all_timer,
-            jnp.zeros(k0 + 1, bool), all_msg,
-            crec=rec_idx if cfg.record_parents else None,
+        proposal = RowProposal(
+            valid=jnp.concatenate([r_valid, is_send[None]]),
+            src=jnp.concatenate([jnp.full((k0,), a_c), jnp.asarray([n], jnp.int32)]),
+            dst=jnp.concatenate([r_dst, a_c[None]]),
+            timer=jnp.concatenate([r_timer, jnp.asarray([False])]),
+            parked=jnp.zeros(k0 + 1, bool),
+            msg=jnp.concatenate([r_msg, msg[None, :]]),
         )
     else:
-        state = insert_rows(
-            state,
-            cfg,
-            is_send[None],
-            jnp.asarray([n], jnp.int32),  # EXTERNAL sender id
-            a_c[None],
-            jnp.asarray([False]),
-            jnp.asarray([False]),
-            msg[None, :],
-            crec=rec_idx if cfg.record_parents else None,
+        proposal = RowProposal(
+            valid=is_send[None],
+            src=jnp.asarray([n], jnp.int32),  # EXTERNAL sender id
+            dst=a_c[None],
+            timer=jnp.asarray([False]),
+            parked=jnp.asarray([False]),
+            msg=msg[None, :],
         )
 
     if cfg.record_trace:
@@ -468,7 +495,34 @@ def apply_external_op(
         if cfg.record_parents:
             parts.append(jnp.asarray([-1], jnp.int32))
         rec = jnp.concatenate(parts)
-        enabled = (op != OP_END) & (op != OP_WAIT)
+    else:
+        rec = jnp.zeros((0,), jnp.int32)
+    enabled = (op != OP_END) & (op != OP_WAIT)
+    return state, proposal, rec, enabled
+
+
+def apply_external_op(
+    state: ScheduleState,
+    cfg: DeviceConfig,
+    app: DSLApp,
+    initial_rows: jnp.ndarray,
+    init_states: jnp.ndarray,
+    op: jnp.ndarray,
+    a: jnp.ndarray,
+    b: jnp.ndarray,
+    msg: jnp.ndarray,
+) -> ScheduleState:
+    """external_effects + the pool insert + trace append (the standalone
+    form used by the replay/DPOR kernels)."""
+    rec_idx = state.trace_len  # this op's record position (creator link)
+    state, rows, rec, enabled = external_effects(
+        state, cfg, app, initial_rows, init_states, op, a, b, msg
+    )
+    state = insert_rows(
+        state, cfg, rows.valid, rows.src, rows.dst, rows.timer, rows.parked,
+        rows.msg, crec=rec_idx if cfg.record_parents else None,
+    )
+    if cfg.record_trace:
         state = _append_record(state, cfg, rec, enabled)
     return state
 

@@ -28,12 +28,15 @@ from .core import (
     ST_INJECT,
     ST_VIOLATION,
     DeviceConfig,
+    RowProposal,
     ScheduleState,
-    apply_external_op,
+    _append_record,
     check_invariant,
-    deliver_index,
+    delivery_effects,
     deliverable_mask,
+    external_effects,
     init_state,
+    insert_rows,
 )
 
 
@@ -70,49 +73,181 @@ def _precomputed(app: DSLApp, cfg: DeviceConfig):
     return jnp.asarray(init_states), jnp.asarray(initial_rows)
 
 
-def _inject_step(state: ScheduleState, prog: ExtProgram, app, cfg, init_states, initial_rows):
-    e = prog.op.shape[0]
-    cur = jnp.clip(state.ext_cursor, 0, e - 1)
-    op = prog.op[cur]
-    exhausted = state.ext_cursor >= e
-    op = jnp.where(exhausted, OP_END, op)
-    state = apply_external_op(
-        state, cfg, app, initial_rows, init_states, op, prog.a[cur], prog.b[cur], prog.msg[cur]
-    )
-    new_cursor = state.ext_cursor + jnp.where(exhausted, 0, 1).astype(jnp.int32)
-    to_dispatch = (op == OP_WAIT) | (op == OP_END) | (new_cursor >= e)
-    status = jnp.where(
-        state.status == ST_INJECT,
-        jnp.where(to_dispatch, ST_DISPATCH, ST_INJECT),
-        state.status,  # preserve overflow aborts from apply_external_op
-    )
-    # Bounded quiescence: a WAIT op carries its budget in field `a`
-    # (0 = strict); a final drain — entered via OP_END *or* by running off
-    # the end of a full-length program — is unlimited (stale budgets must
-    # not cap it).
-    seg_budget = jnp.where(
-        op == OP_WAIT,
-        prog.a[cur],
-        jnp.where((op == OP_END) | (new_cursor >= e), 0, state.seg_budget),
-    ).astype(jnp.int32)
-    # Host-parity run-end semantics (reference: execution ends with the
-    # segment of the LAST external event): the segment we're entering is
-    # final if this op is OP_END / past-the-end, or a WAIT with nothing but
-    # OP_END after it.
-    next_cur = jnp.clip(new_cursor, 0, e - 1)
-    next_op = jnp.where(new_cursor >= e, OP_END, prog.op[next_cur])
-    final_seg = to_dispatch & (
-        (op == OP_END)
-        | (new_cursor >= e)
-        | ((op == OP_WAIT) & (next_op == OP_END))
-    )
-    return state._replace(
-        ext_cursor=new_cursor,
-        status=status,
-        seg_budget=seg_budget,
-        seg_start=jnp.where(to_dispatch, state.deliveries, state.seg_start).astype(jnp.int32),
-        final_seg=jnp.where(to_dispatch, final_seg, state.final_seg),
-    )
+def make_step_fn(app: DSLApp, cfg: DeviceConfig):
+    """The fused, branchless step: injection and dispatch effects are both
+    computed with masks (inert op / invalid index for the inactive side) and
+    their pool inserts merge into ONE insert_rows pass per step.
+
+    Under vmap a ``lax.cond``'s branches both execute anyway, so the old
+    two-branch form paid the O(pool) insert machinery (free-slot cumsum +
+    searchsorted + 7 scatters) twice per step; profiling shows these O(pool)
+    passes dominate step cost. Fusing removes a full insert pass and both
+    cond selects."""
+    init_states, initial_rows = _precomputed(app, cfg)
+
+    def step(state: ScheduleState, prog: ExtProgram) -> ScheduleState:
+        # Frozen lanes (done/violation/overflow) need no outer guard: every
+        # effect below is masked by `injecting`/`dispatching`, so their
+        # state is bit-preserved without the selects a vmapped lax.cond
+        # would pay.
+        active = state.status < ST_DONE
+        injecting = active & (state.status == ST_INJECT)
+        dispatching = active & (state.status == ST_DISPATCH)
+        rec_idx = state.trace_len  # creator link for this step's insert
+
+        # ----- injection side (inert unless `injecting`: op -> OP_END) ----
+        e = prog.op.shape[0]
+        cur = jnp.clip(state.ext_cursor, 0, e - 1)
+        exhausted = state.ext_cursor >= e
+        op = jnp.where(injecting & ~exhausted, prog.op[cur], OP_END)
+        state, inj_rows, inj_rec, inj_enabled = external_effects(
+            state, cfg, app, initial_rows, init_states,
+            op, prog.a[cur], prog.b[cur], prog.msg[cur],
+        )
+        new_cursor = state.ext_cursor + (injecting & ~exhausted).astype(jnp.int32)
+        raw_op = jnp.where(exhausted, OP_END, prog.op[cur])
+        to_dispatch = injecting & (
+            (raw_op == OP_WAIT) | (raw_op == OP_END) | (new_cursor >= e)
+        )
+        # Bounded quiescence: a WAIT op carries its budget in field `a`
+        # (0 = strict); a final drain — entered via OP_END *or* by running
+        # off the end of a full-length program — is unlimited (stale budgets
+        # must not cap it).
+        seg_budget = jnp.where(
+            injecting,
+            jnp.where(
+                raw_op == OP_WAIT,
+                prog.a[cur],
+                jnp.where(
+                    (raw_op == OP_END) | (new_cursor >= e), 0, state.seg_budget
+                ),
+            ),
+            state.seg_budget,
+        ).astype(jnp.int32)
+        # Host-parity run-end semantics (reference: execution ends with the
+        # segment of the LAST external event): the segment we're entering is
+        # final if this op is OP_END / past-the-end, or a WAIT with nothing
+        # but OP_END after it.
+        next_cur = jnp.clip(new_cursor, 0, e - 1)
+        next_op = jnp.where(new_cursor >= e, OP_END, prog.op[next_cur])
+        final_seg = to_dispatch & (
+            (raw_op == OP_END)
+            | (new_cursor >= e)
+            | ((raw_op == OP_WAIT) & (next_op == OP_END))
+        )
+        state = state._replace(
+            ext_cursor=new_cursor,
+            seg_budget=seg_budget,
+            seg_start=jnp.where(
+                to_dispatch, state.deliveries, state.seg_start
+            ).astype(jnp.int32),
+            final_seg=jnp.where(to_dispatch, final_seg, state.final_seg),
+        )
+
+        # ----- dispatch side (inert unless `dispatching`: idx -> P) -------
+        mask = deliverable_mask(state, cfg) & dispatching
+        count = jnp.sum(mask.astype(jnp.int32))
+        any_deliverable = count > 0
+
+        key, sub = jax.random.split(state.rng)
+        if cfg.timer_weight != 1.0:
+            # Two-stage choice: class (timer vs message) by weighted counts,
+            # then uniform within class (host counterpart: FullyRandom with
+            # timer_weight).
+            tmask = mask & state.pool_timer
+            mmask = mask & ~state.pool_timer
+            tcount = jnp.sum(tmask.astype(jnp.int32))
+            mcount = jnp.sum(mmask.astype(jnp.int32))
+            sub, sub2 = jax.random.split(sub)
+            wt = cfg.timer_weight * tcount
+            p_timer = jnp.where(
+                (tcount > 0) & (mcount > 0),
+                wt / jnp.maximum(wt + mcount, 1e-9),
+                jnp.where(tcount > 0, 1.0, 0.0),
+            )
+            pick_timer = jax.random.uniform(sub2) < p_timer
+            mask = jnp.where(pick_timer, tmask, mmask)
+            count = jnp.where(pick_timer, tcount, mcount)
+        u = jax.random.uniform(sub)
+        k = jnp.minimum((u * count).astype(jnp.int32), jnp.maximum(count - 1, 0))
+        cum = jnp.cumsum(mask.astype(jnp.int32))
+        idx = jnp.searchsorted(cum, k + 1, side="left").astype(jnp.int32)
+        idx = jnp.where(
+            any_deliverable & dispatching, idx, jnp.int32(cfg.pool_capacity)
+        )
+        # rng advances only on dispatch steps (keeps the schedule stream
+        # identical to the unfused kernel).
+        state = state._replace(
+            rng=jnp.where(dispatching, key, state.rng)
+        )
+        state, del_rows, del_rec = delivery_effects(state, cfg, app, idx)
+
+        # ----- the ONE pool insert for both sides -------------------------
+        rows = RowProposal.concat(inj_rows, del_rows)
+        state = insert_rows(
+            state, cfg, rows.valid, rows.src, rows.dst, rows.timer,
+            rows.parked, rows.msg,
+            crec=rec_idx if cfg.record_parents else None,
+        )
+        if cfg.record_trace:
+            # At most one record per lane per step: the delivery's when one
+            # happened, else the injection's.
+            delivered = idx < cfg.pool_capacity
+            rec = jnp.where(delivered, del_rec, inj_rec)
+            state = _append_record(
+                state, cfg, rec, delivered | (injecting & inj_enabled)
+            )
+
+        # One invariant evaluation per step serves both the interval check
+        # and quiescence finalization (both see the post-delivery state).
+        inv_code = check_invariant(state, app)
+
+        # ----- interval invariant check (dispatch side) -------------------
+        if cfg.invariant_interval:
+            due = (state.deliveries % cfg.invariant_interval) == 0
+            code = jnp.where(due & any_deliverable, inv_code, jnp.int32(0))
+            state = state._replace(
+                status=jnp.where(
+                    code != 0, jnp.int32(ST_VIOLATION), state.status
+                ),
+                violation=jnp.where(
+                    code != 0, code.astype(jnp.int32), state.violation
+                ),
+            )
+
+        # ----- status resolution ------------------------------------------
+        # Inject side: move to dispatch at segment boundaries (unless the
+        # insert flipped the lane to overflow).
+        status = jnp.where(
+            injecting & (state.status == ST_INJECT) & to_dispatch,
+            jnp.int32(ST_DISPATCH),
+            state.status,
+        )
+        # Dispatch side: quiescence = nothing deliverable or budget spent.
+        budget_spent = (state.seg_budget > 0) & (
+            state.deliveries - state.seg_start >= state.seg_budget
+        )
+        quiescent = (
+            dispatching
+            & (~any_deliverable | budget_spent)
+            & (status == ST_DISPATCH)
+        )
+        fin_code = inv_code
+        status = jnp.where(
+            quiescent,
+            jnp.where(
+                state.final_seg,
+                jnp.where(fin_code != 0, jnp.int32(ST_VIOLATION), jnp.int32(ST_DONE)),
+                jnp.int32(ST_INJECT),
+            ),
+            status,
+        )
+        violation = jnp.where(
+            quiescent & state.final_seg, fin_code.astype(jnp.int32), state.violation
+        )
+        return state._replace(status=status, violation=violation)
+
+    return step
 
 
 def _finalize(state: ScheduleState, app, cfg) -> ScheduleState:
@@ -121,85 +256,6 @@ def _finalize(state: ScheduleState, app, cfg) -> ScheduleState:
         status=jnp.where(code != 0, ST_VIOLATION, ST_DONE).astype(jnp.int32),
         violation=code.astype(jnp.int32),
     )
-
-
-def _dispatch_step(state: ScheduleState, prog: ExtProgram, app, cfg):
-    mask = deliverable_mask(state, cfg)
-    count = jnp.sum(mask.astype(jnp.int32))
-    any_deliverable = count > 0
-
-    key, sub = jax.random.split(state.rng)
-    if cfg.timer_weight != 1.0:
-        # Two-stage choice: class (timer vs message) by weighted counts,
-        # then uniform within class (host counterpart: FullyRandom with
-        # timer_weight).
-        tmask = mask & state.pool_timer
-        mmask = mask & ~state.pool_timer
-        tcount = jnp.sum(tmask.astype(jnp.int32))
-        mcount = jnp.sum(mmask.astype(jnp.int32))
-        sub, sub2 = jax.random.split(sub)
-        wt = cfg.timer_weight * tcount
-        p_timer = jnp.where(
-            (tcount > 0) & (mcount > 0),
-            wt / jnp.maximum(wt + mcount, 1e-9),
-            jnp.where(tcount > 0, 1.0, 0.0),
-        )
-        pick_timer = jax.random.uniform(sub2) < p_timer
-        mask = jnp.where(pick_timer, tmask, mmask)
-        count = jnp.where(pick_timer, tcount, mcount)
-    u = jax.random.uniform(sub)
-    k = jnp.minimum((u * count).astype(jnp.int32), jnp.maximum(count - 1, 0))
-    cum = jnp.cumsum(mask.astype(jnp.int32))
-    idx = jnp.searchsorted(cum, k + 1, side="left").astype(jnp.int32)
-    idx = jnp.where(any_deliverable, idx, jnp.int32(cfg.pool_capacity))
-    state = state._replace(rng=key)
-    state = deliver_index(state, cfg, app, idx)
-
-    if cfg.invariant_interval:
-        due = (state.deliveries % cfg.invariant_interval) == 0
-        code = jnp.where(
-            due & any_deliverable, check_invariant(state, app), jnp.int32(0)
-        )
-        state = state._replace(
-            status=jnp.where(code != 0, jnp.int32(ST_VIOLATION), state.status),
-            violation=jnp.where(code != 0, code.astype(jnp.int32), state.violation),
-        )
-
-    # Quiescence handling: nothing deliverable, or the segment's
-    # bounded-wait budget expired. The run ends with its final segment
-    # (host/reference parity — no extra drain past a trailing wait).
-    budget_spent = (state.seg_budget > 0) & (
-        state.deliveries - state.seg_start >= state.seg_budget
-    )
-    quiescent = (~any_deliverable | budget_spent) & (state.status == ST_DISPATCH)
-    state = jax.lax.cond(
-        quiescent & state.final_seg,
-        lambda s: _finalize(s, app, cfg),
-        lambda s: s._replace(
-            status=jnp.where(
-                quiescent, jnp.int32(ST_INJECT), s.status
-            )
-        ),
-        state,
-    )
-    return state
-
-
-def make_step_fn(app: DSLApp, cfg: DeviceConfig):
-    init_states, initial_rows = _precomputed(app, cfg)
-
-    def step(state: ScheduleState, prog: ExtProgram) -> ScheduleState:
-        def active(state):
-            return jax.lax.cond(
-                state.status == ST_INJECT,
-                lambda s: _inject_step(s, prog, app, cfg, init_states, initial_rows),
-                lambda s: _dispatch_step(s, prog, app, cfg),
-                state,
-            )
-
-        return jax.lax.cond(state.status >= ST_DONE, lambda s: s, active, state)
-
-    return step
 
 
 def make_run_lane(app: DSLApp, cfg: DeviceConfig):

@@ -18,6 +18,7 @@ import jax.numpy as jnp
 
 from ..dsl import DSLApp
 from .core import (
+    OP_END,
     REC_DELIVERY,
     REC_EXT_BASE,
     REC_TIMER,
@@ -25,12 +26,15 @@ from .core import (
     ST_DONE,
     ST_VIOLATION,
     DeviceConfig,
+    RowProposal,
     ScheduleState,
-    apply_external_op,
+    _append_record,
     check_invariant,
-    deliver_index,
     deliverable_mask,
+    delivery_effects,
+    external_effects,
     init_state,
+    insert_rows,
 )
 from .explore import _precomputed
 
@@ -52,53 +56,67 @@ def make_replay_run_lane(app: DSLApp, cfg: DeviceConfig):
     init_states, initial_rows = _precomputed(app, cfg)
     big = jnp.int32(2**30)
 
-    def replay_record(state: ScheduleState, rec) -> ScheduleState:
+    def replay_record(state: ScheduleState, rec, active) -> ScheduleState:
+        """Fused, branchless record application: the external and delivery
+        sides both run with masks (inert op / invalid index for whichever
+        doesn't apply) and share ONE pool-insert pass — same shape as the
+        fused explore step (both lax.cond branches would execute under vmap
+        anyway, and the O(pool) insert machinery dominates)."""
         kind = rec[0]
         # Explicit msg slice: parent-tracked records carry a trailing
         # column that must not leak into message matching.
         a, b, msg = rec[1], rec[2], rec[3 : 3 + cfg.msg_width]
+        is_ext = active & (kind >= REC_EXT_BASE)
+        is_delivery = active & _is_delivery_kind(kind)
+        rec_idx = state.trace_len
 
-        def apply_ext(state):
-            return apply_external_op(
-                state, cfg, app, initial_rows, init_states,
-                kind - REC_EXT_BASE, a, b, msg,
-            )
-
-        def apply_delivery(state):
-            is_timer_rec = kind == REC_TIMER
-            is_wild = kind == REC_WILDCARD
-            mask = deliverable_mask(state, cfg)
-            exact = (
-                (state.pool_dst == b)
-                & jnp.all(state.pool_msg == msg[None, :], axis=1)
-                & (state.pool_timer == is_timer_rec)
-                # Timers self-address; messages match on sender too.
-                & (is_timer_rec | (state.pool_src == a))
-            )
-            # Wildcard (reference: WildCardMatch selectors,
-            # STSScheduler.scala:696-708): receiver + class tag only.
-            wild = (state.pool_dst == a) & (state.pool_msg[:, 0] == msg[0])
-            match = mask & jnp.where(is_wild, wild, exact)
-            any_match = jnp.any(match)
-            # policy: FIFO (earliest arrival) or, for wildcard "last",
-            # latest arrival.
-            want_last = is_wild & (b == 1)
-            seqs_first = jnp.where(match, state.pool_seq, big)
-            seqs_last = jnp.where(match, state.pool_seq, -big)
-            idx = jnp.where(
-                want_last, jnp.argmax(seqs_last), jnp.argmin(seqs_first)
-            ).astype(jnp.int32)
-            idx = jnp.where(any_match, idx, jnp.int32(cfg.pool_capacity))
-            return deliver_index(state, cfg, app, idx)
-
-        is_ext = kind >= REC_EXT_BASE
-        is_delivery = _is_delivery_kind(kind)
-        state = jax.lax.cond(
-            is_ext,
-            apply_ext,
-            lambda s: jax.lax.cond(is_delivery, apply_delivery, lambda x: x, s),
-            state,
+        # External side (inert op unless is_ext).
+        op = jnp.where(is_ext, kind - REC_EXT_BASE, OP_END)
+        state, ext_rows, ext_rec, ext_enabled = external_effects(
+            state, cfg, app, initial_rows, init_states, op, a, b, msg
         )
+
+        # Delivery side (invalid index unless is_delivery and matched).
+        is_timer_rec = kind == REC_TIMER
+        is_wild = kind == REC_WILDCARD
+        mask = deliverable_mask(state, cfg)
+        exact = (
+            (state.pool_dst == b)
+            & jnp.all(state.pool_msg == msg[None, :], axis=1)
+            & (state.pool_timer == is_timer_rec)
+            # Timers self-address; messages match on sender too.
+            & (is_timer_rec | (state.pool_src == a))
+        )
+        # Wildcard (reference: WildCardMatch selectors,
+        # STSScheduler.scala:696-708): receiver + class tag only.
+        wild = (state.pool_dst == a) & (state.pool_msg[:, 0] == msg[0])
+        match = mask & jnp.where(is_wild, wild, exact)
+        any_match = jnp.any(match)
+        # policy: FIFO (earliest arrival) or, for wildcard "last",
+        # latest arrival.
+        want_last = is_wild & (b == 1)
+        seqs_first = jnp.where(match, state.pool_seq, big)
+        seqs_last = jnp.where(match, state.pool_seq, -big)
+        idx = jnp.where(
+            want_last, jnp.argmax(seqs_last), jnp.argmin(seqs_first)
+        ).astype(jnp.int32)
+        idx = jnp.where(
+            any_match & is_delivery, idx, jnp.int32(cfg.pool_capacity)
+        )
+        state, del_rows, del_rec = delivery_effects(state, cfg, app, idx)
+
+        rows = RowProposal.concat(ext_rows, del_rows)
+        state = insert_rows(
+            state, cfg, rows.valid, rows.src, rows.dst, rows.timer,
+            rows.parked, rows.msg,
+            crec=rec_idx if cfg.record_parents else None,
+        )
+        if cfg.record_trace:
+            delivered = idx < cfg.pool_capacity
+            out_rec = jnp.where(delivered, del_rec, ext_rec)
+            state = _append_record(
+                state, cfg, out_rec, delivered | (is_ext & ext_enabled)
+            )
         return state
 
     def run_lane(records, key) -> ReplayResult:
@@ -107,9 +125,7 @@ def make_replay_run_lane(app: DSLApp, cfg: DeviceConfig):
         def body(carry, rec):
             state, ignored = carry
             before = state.deliveries
-            state = jax.lax.cond(
-                state.status >= ST_DONE, lambda s: s, lambda s: replay_record(s, rec), state
-            )
+            state = replay_record(state, rec, state.status < ST_DONE)
             was_delivery = _is_delivery_kind(rec[0])
             skipped = was_delivery & (state.deliveries == before) & (state.status < ST_DONE)
             return (state, ignored + skipped.astype(jnp.int32)), None
