@@ -103,10 +103,24 @@ def cmd_minimize(args) -> int:
     if args.strategy == "incddmin":
         from .runner import edit_distance_dpor_ddmin
 
+        # Device probes explore batch_size lanes per round: map the user's
+        # interleaving budget onto rounds so --max-interleavings works on
+        # both paths.
+        device_batch = 32
         mcs = edit_distance_dpor_ddmin(
             config, trace, externals, violation,
-            dpor_kwargs={"max_interleavings": args.max_interleavings},
+            dpor_kwargs=(
+                {
+                    "batch_size": device_batch,
+                    "max_rounds": max(
+                        1, args.max_interleavings // device_batch
+                    ),
+                }
+                if not args.host
+                else {"max_interleavings": args.max_interleavings}
+            ),
             checkpoint_dir=args.experiment, resume=args.resume,
+            app=None if args.host else app,
         )
         kept = mcs.get_all_events()
         print(f"IncDDMin MCS: {len(externals)} -> {len(kept)} externals")

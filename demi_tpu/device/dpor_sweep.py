@@ -24,6 +24,7 @@ import jax.numpy as jnp
 from ..config import SchedulerConfig
 from ..dsl import DSLApp
 from ..external_events import ExternalEvent
+from ..schedulers.dpor import arvind_distance
 from .core import (
     REC_DELIVERY,
     REC_TIMER,
@@ -206,7 +207,15 @@ class DeviceDPOROracle:
     """TestOracle over DeviceDPOR: systematic batched search for a target
     violation on a given external program; positives lift to full host
     EventTraces via GuidedScheduler (BASELINE config 2 shape: bounded
-    DPOR search on raft-class apps)."""
+    DPOR search on raft-class apps).
+
+    Resumable: one DeviceDPOR (frontier + explored set) is kept per
+    external subsequence, so repeated DDMin probes of the same subsequence
+    continue the search instead of restarting (the device analog of
+    ResumableDPOR, IncrementalDeltaDebugging.scala:94-122). With
+    ``initial_trace`` set, each fresh instance is seeded with the recorded
+    schedule's prescription; ``max_distance`` (set by IncrementalDDMin)
+    caps backtracks by edit distance to it."""
 
     def __init__(
         self,
@@ -215,6 +224,7 @@ class DeviceDPOROracle:
         config: SchedulerConfig,
         batch_size: int = 64,
         max_rounds: int = 20,
+        initial_trace=None,
     ):
         self.app = app
         self.cfg = cfg
@@ -222,6 +232,27 @@ class DeviceDPOROracle:
         self.batch_size = batch_size
         self.max_rounds = max_rounds
         self.last_interleavings = 0
+        self.initial_trace = initial_trace
+        self.max_distance: Optional[int] = None
+        self._instances: Dict[Tuple, DeviceDPOR] = {}
+
+    def set_initial_trace(self, trace) -> None:
+        self.initial_trace = trace
+
+    def _instance(self, externals) -> DeviceDPOR:
+        key = tuple(e.eid for e in externals)
+        inst = self._instances.get(key)
+        if inst is None:
+            inst = DeviceDPOR(self.app, self.cfg, externals, self.batch_size)
+            if self.initial_trace is not None:
+                inst.seed(
+                    steering_prescription(
+                        self.app, self.cfg, self.initial_trace, externals
+                    )
+                )
+            self._instances[key] = inst
+        inst.max_distance = self.max_distance
+        return inst
 
     def test(self, externals, violation_fingerprint, stats=None, init=None):
         from ..schedulers.guided import GuidedScheduler, GuideDivergence
@@ -239,7 +270,7 @@ class DeviceDPOROracle:
                 "DeviceDPOROracle needs an IntViolation-style fingerprint "
                 f"(got {type(violation_fingerprint).__name__})"
             )
-        dpor = DeviceDPOR(self.app, self.cfg, externals, self.batch_size)
+        dpor = self._instance(externals)
         target = getattr(violation_fingerprint, "code", None)
         found = dpor.explore(target_code=target, max_rounds=self.max_rounds)
         self.last_interleavings = dpor.interleavings
@@ -264,9 +295,43 @@ class DeviceDPOROracle:
         return result.trace
 
 
+def steering_prescription(
+    app: DSLApp,
+    cfg: DeviceConfig,
+    trace,
+    externals: Sequence[ExternalEvent],
+) -> Tuple[Tuple[int, ...], ...]:
+    """Lower a recorded violating EventTrace to a DPOR prescription (its
+    delivery/timer records in order) so the first device execution replays
+    the recorded schedule — the device analog of the host scheduler's
+    initial-trace steering (DPORwHeuristics.scala:542-555). Prescription
+    following is divergence-tolerant, so a projected subsequence's missing
+    records are skipped."""
+    from .encoding import lower_expected_trace
+
+    projected = (
+        trace.filter_failure_detector_messages()
+        .filter_checkpoint_messages()
+        .subsequence_intersection(list(externals))
+    )
+    recs = lower_expected_trace(app, cfg, projected, externals, cfg.max_steps)
+    return tuple(
+        tuple(int(x) for x in r)
+        for r in recs
+        if r[0] in (REC_DELIVERY, REC_TIMER)
+    )
+
+
 class DeviceDPOR:
     """Frontier-batched DPOR driver: rounds of B prescriptions per kernel
-    launch, deepest-first priority, explored-set dedup."""
+    launch, deepest-first priority, explored-set dedup.
+
+    The frontier persists across ``explore`` calls (resumability — the
+    device analog of DPORwHeuristics keeping depGraph/backTrack intact
+    across test() calls, :225-254); ``seed`` plants an initial-trace
+    prescription; ``max_distance`` caps accepted backtracks by modified
+    edit distance to the seeded schedule (ArvindDistanceOrdering's metric
+    over record identities)."""
 
     def __init__(
         self,
@@ -282,7 +347,19 @@ class DeviceDPOR:
         self.prog = lower_program(app, cfg, list(program))
         self.batch_size = batch_size
         self.explored: Set[Tuple] = set()
+        self.frontier: List[Tuple] = [tuple()]
+        self.explored.add(tuple())
+        self.original: Optional[Tuple] = None
+        self.max_distance: Optional[int] = None
         self.interleavings = 0
+
+    def seed(self, prescription: Tuple[Tuple[int, ...], ...]) -> None:
+        """Plant an initial prescription at the head of the frontier (and
+        fix it as the edit-distance origin)."""
+        self.original = prescription
+        if prescription not in self.explored:
+            self.explored.add(prescription)
+            self.frontier.insert(0, prescription)
 
     def _pack(self, prescriptions: List[Tuple]) -> np.ndarray:
         r, w = self.cfg.max_steps, self.cfg.rec_width
@@ -295,13 +372,23 @@ class DeviceDPOR:
     def explore(
         self, target_code: Optional[int] = None, max_rounds: int = 20
     ) -> Optional[Tuple[np.ndarray, int]]:
-        """Returns (records, trace_len) of a violating lane, or None."""
-        frontier: List[Tuple] = [tuple()]
-        self.explored.add(tuple())
+        """Returns (records, trace_len) of a violating lane, or None.
+        Continues from the persisted frontier; call again for more rounds."""
+        frontier = self.frontier
         for _ in range(max_rounds):
             if not frontier:
+                self.frontier = frontier
                 return None
-            frontier.sort(key=len, reverse=True)  # deepest-first
+            # Deepest-first; a seeded initial prescription (index 0) stays
+            # first in round one regardless of length.
+            head, rest = (
+                ([frontier[0]], frontier[1:])
+                if self.original is not None and frontier
+                and frontier[0] == self.original
+                else ([], frontier)
+            )
+            rest.sort(key=len, reverse=True)
+            frontier = head + rest
             batch, frontier = frontier[: self.batch_size], frontier[self.batch_size :]
             # Pad to a fixed batch size so the kernel compiles once; pad
             # lanes run prescription-free (fresh random exploration) and
@@ -322,15 +409,29 @@ class DeviceDPOR:
             violations = np.asarray(res.violation)
             traces = np.asarray(res.trace)
             lens = np.asarray(res.trace_len)
+            hit = None
             for lane in range(len(batch)):
                 code = int(violations[lane])
                 if code != 0 and (target_code is None or code == target_code):
-                    return traces[lane], int(lens[lane])
+                    hit = (traces[lane], int(lens[lane]))
+                    break
             for lane in range(len(batch)):
                 for presc in racing_prescriptions(
                     traces[lane], int(lens[lane]), self.cfg.rec_width
                 ):
-                    if presc not in self.explored:
-                        self.explored.add(presc)
-                        frontier.append(presc)
+                    if presc in self.explored:
+                        continue
+                    if (
+                        self.max_distance is not None
+                        and self.original is not None
+                        and arvind_distance(presc, self.original)
+                        > self.max_distance
+                    ):
+                        continue
+                    self.explored.add(presc)
+                    frontier.append(presc)
+            if hit is not None:
+                self.frontier = frontier
+                return hit
+        self.frontier = frontier
         return None

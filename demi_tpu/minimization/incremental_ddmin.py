@@ -64,8 +64,15 @@ class IncrementalDDMin(Minimizer):
         stats: Optional[MinimizationStats] = None,
         dpor_kwargs: Optional[dict] = None,
         initial_trace: Optional[EventTrace] = None,
+        oracle: Optional[TestOracle] = None,
     ):
-        self.oracle = ResumableDPOR(config, dpor_kwargs, initial_trace=initial_trace)
+        # ``oracle`` override: any resumable DPOR-style oracle exposing a
+        # ``max_distance`` attribute — notably the device-batched
+        # DeviceDPOROracle (demi_tpu/device/dpor_sweep.py), which explores
+        # whole backtrack frontiers per kernel launch.
+        self.oracle = oracle or ResumableDPOR(
+            config, dpor_kwargs, initial_trace=initial_trace
+        )
         self.max_max_distance = max_max_distance
         self.stats = stats or MinimizationStats()
 

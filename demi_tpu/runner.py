@@ -205,14 +205,66 @@ def edit_distance_dpor_ddmin(
     dpor_kwargs: Optional[dict] = None,
     checkpoint_dir: Optional[str] = None,
     resume: bool = False,
+    app=None,
+    device_cfg=None,
 ):
     """External-event DDMin over a resumable DPOR oracle with a growing
     edit-distance budget, steered by the recorded violating trace and
     seeded with its dep graph (reference: RunnerUtils.editDistanceDporDDMin,
     RunnerUtils.scala:812-879). With ``checkpoint_dir``, the dep graph is
     persisted; ``resume=True`` reloads it across restarts
-    (Serialization.scala:176-187)."""
+    (Serialization.scala:176-187).
+
+    With ``app`` (a DSLApp), probes run on the device-batched DPOR oracle
+    instead — whole backtrack frontiers per vmapped kernel launch, steered
+    by the recorded trace. On both paths the finished MCS is checkpointed
+    (stage "incddmin"); ``resume=True`` returns it without re-searching."""
     from .minimization.incremental_ddmin import IncrementalDDMin
+
+    if checkpoint_dir is not None and resume:
+        from .serialization import load_stage
+
+        restored = load_stage(checkpoint_dir, "incddmin", app)
+        if restored is not None:
+            restored_externals, _ = restored
+            return make_dag(restored_externals)
+
+    def _checkpoint_result(mcs_dag):
+        if checkpoint_dir is not None:
+            from .serialization import save_stage
+
+            save_stage(
+                checkpoint_dir, "incddmin", mcs_dag.get_all_events(), trace
+            )
+        return mcs_dag
+
+    if app is not None:
+        import dataclasses as _dc
+
+        from .device.batch_oracle import default_device_config
+        from .device.dpor_sweep import DeviceDPOROracle
+
+        device_cfg = device_cfg or default_device_config(
+            app, trace, externals, record_trace=True, record_parents=True,
+        )
+        if not (device_cfg.record_trace and device_cfg.record_parents):
+            device_cfg = _dc.replace(
+                device_cfg, record_trace=True, record_parents=True
+            )
+        oracle = DeviceDPOROracle(
+            app, device_cfg, config, initial_trace=trace,
+            **{k: v for k, v in (dpor_kwargs or {}).items()
+               if k in ("batch_size", "max_rounds")},
+        )
+        inc = IncrementalDDMin(
+            config,
+            max_max_distance=max_max_distance,
+            stats=stats or MinimizationStats(),
+            oracle=oracle,
+        )
+        return _checkpoint_result(
+            inc.minimize(make_dag(list(externals)), violation)
+        )
 
     tracker = None
     if checkpoint_dir is not None and resume:
@@ -237,7 +289,7 @@ def edit_distance_dpor_ddmin(
         initial_trace=trace,
     )
     mcs = inc.minimize(make_dag(list(externals)), violation)
-    return mcs
+    return _checkpoint_result(mcs)
 
 
 def bounded_dpor(
@@ -452,6 +504,46 @@ def run_the_gamut(
     result.mcs_externals = list(externals)
     result.final_trace = trace
     return result
+
+
+def reorder_deliveries(
+    config: SchedulerConfig,
+    trace: EventTrace,
+    externals: Sequence[ExternalEvent],
+    new_order: Sequence[int],
+    violation: Any = None,
+) -> Optional[EventTrace]:
+    """Manually permute a trace's internal deliveries and re-execute
+    (reference: RunnerUtils.reorderDeliveries, RunnerUtils.scala:1389-1437
+    — the "schedule twiddling" tool for by-hand exploration).
+
+    ``new_order`` lists the current delivery positions (as returned by
+    ``removable_delivery_indices``) in the desired delivery order; all
+    other events keep their positions. Returns the STS-executed trace if
+    the candidate replays (and, when ``violation`` is given, reproduces
+    it), else None."""
+    from .minimization.internal import removable_delivery_indices
+    from .minimization.test_oracle import StatelessTestOracle
+
+    slots = removable_delivery_indices(trace)
+    assert sorted(new_order) == sorted(slots), (
+        "new_order must be a permutation of the trace's delivery positions"
+    )
+    events = list(trace.events)
+    for slot, src_pos in zip(slots, new_order):
+        events[slot] = trace.events[src_pos]
+    candidate = EventTrace(events, list(externals))
+    sts = STSScheduler(config, candidate)
+    try:
+        result = sts.replay(candidate, list(externals))
+    except ReplayException:
+        return None
+    if violation is not None and (
+        result.violation is None or not violation.matches(result.violation)
+    ):
+        return None
+    result.trace.set_original_externals(list(externals))
+    return result.trace
 
 
 def print_minimization_stats(result: GamutResult) -> str:

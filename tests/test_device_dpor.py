@@ -117,3 +117,87 @@ def test_racing_prescriptions_shape():
     (presc,) = prescs
     # Flip: deliver record 3's message first (no prior deliveries).
     assert presc == (tuple(int(x) for x in recs[3]),)
+
+
+def test_device_dpor_steering_reproduces_in_first_batch():
+    """Seeding the frontier with the recorded violating schedule makes the
+    steered lane reproduce the violation in round 1 (device analog of
+    DPORwHeuristics initial-trace steering)."""
+    from demi_tpu.apps.common import make_host_invariant
+    from demi_tpu.config import SchedulerConfig
+    from demi_tpu.device.dpor_sweep import DeviceDPOROracle, steering_prescription
+    from demi_tpu.minimization.test_oracle import IntViolation
+
+    app, cfg, program = _setup(4)
+    config = SchedulerConfig(invariant_check=make_host_invariant(app))
+
+    # Record the violation the slow way.
+    finder = DeviceDPOR(app, cfg, program, batch_size=32)
+    found = finder.explore(target_code=1, max_rounds=30)
+    assert found is not None
+    # Lift to host to get an EventTrace to steer by.
+    oracle = DeviceDPOROracle(app, cfg, config, batch_size=32, max_rounds=30)
+    trace = oracle.test(program, IntViolation(1))
+    assert trace is not None
+
+    # Fresh, steered oracle: one round of one batch suffices, and the
+    # steered prescription replays the full recorded schedule.
+    steered = DeviceDPOROracle(
+        app, cfg, config, batch_size=8, max_rounds=1, initial_trace=trace
+    )
+    presc = steering_prescription(app, cfg, trace, program)
+    assert len(presc) == 4  # all four deliveries prescribed
+    assert steered.test(program, IntViolation(1)) is not None
+    assert steered.last_interleavings <= 8  # a single batch
+
+
+def test_device_dpor_oracle_is_resumable():
+    """Repeated probes of the same subsequence continue the persisted
+    frontier instead of restarting (interleaving count accumulates, and
+    the explored-set is shared)."""
+    from demi_tpu.apps.common import make_host_invariant
+    from demi_tpu.config import SchedulerConfig
+    from demi_tpu.device.dpor_sweep import DeviceDPOROracle
+    from demi_tpu.minimization.test_oracle import IntViolation
+
+    app, cfg, program = _setup(3)
+    config = SchedulerConfig(invariant_check=make_host_invariant(app))
+    oracle = DeviceDPOROracle(app, cfg, config, batch_size=4, max_rounds=1)
+    # Hunt for a code that never occurs: each probe runs one more round.
+    assert oracle.test(program, IntViolation(2)) is None
+    first = oracle.last_interleavings
+    assert oracle.test(program, IntViolation(2)) is None
+    assert oracle.last_interleavings > first  # resumed, not restarted
+    inst = oracle._instance(program)
+    assert len(oracle._instances) == 1
+    assert inst.interleavings == oracle.last_interleavings
+
+
+def test_incremental_ddmin_with_device_oracle():
+    """IncrementalDDMin over the device-batched DPOR oracle minimizes the
+    reversal case (noise external pruned)."""
+    from demi_tpu.apps.common import make_host_invariant
+    from demi_tpu.config import SchedulerConfig
+    from demi_tpu.device.dpor_sweep import DeviceDPOROracle
+    from demi_tpu.minimization.ddmin import make_dag
+    from demi_tpu.minimization.incremental_ddmin import IncrementalDDMin
+    from demi_tpu.minimization.test_oracle import IntViolation
+
+    app, cfg, program = _setup(3)
+    # Noise: an extra send to the OTHER actor that the violation never
+    # needs.
+    noise = Send(app.actor_name(1), MessageConstructor(lambda: (1, 9)))
+    program = program[:-1] + [noise, WaitQuiescence()]
+    config = SchedulerConfig(invariant_check=make_host_invariant(app))
+
+    oracle = DeviceDPOROracle(app, cfg, config, batch_size=16, max_rounds=10)
+    finder = DeviceDPOROracle(app, cfg, config, batch_size=16, max_rounds=30)
+    trace = finder.test(program, IntViolation(1))
+    assert trace is not None
+    oracle.set_initial_trace(trace)
+
+    inc = IncrementalDDMin(config, max_max_distance=4, oracle=oracle)
+    mcs = inc.minimize(make_dag(program), IntViolation(1))
+    kept = mcs.get_all_events()
+    assert noise not in kept
+    assert len(kept) < len(program)

@@ -158,3 +158,26 @@ def test_host_mode_resume_rebinds_ctors(tmp_path, broadcast_violation):
     assert sts.test_with_trace(
         resumed.final_trace, resumed.mcs_externals, fr.violation
     ) is not None
+
+
+def test_incddmin_checkpoint_and_resume(tmp_path, broadcast_violation):
+    """edit_distance_dpor_ddmin checkpoints its MCS; resume returns it
+    without re-searching (works for host and device oracles alike)."""
+    from demi_tpu.runner import edit_distance_dpor_ddmin
+
+    app, config, fr = broadcast_violation
+    d = str(tmp_path)
+    mcs = edit_distance_dpor_ddmin(
+        config, fr.trace, fr.program, fr.violation,
+        max_max_distance=2, dpor_kwargs={"max_interleavings": 10},
+        checkpoint_dir=d,
+    )
+    assert os.path.exists(os.path.join(d, "stage_incddmin.json"))
+    resumed = edit_distance_dpor_ddmin(
+        config, fr.trace, fr.program, fr.violation,
+        max_max_distance=2, dpor_kwargs={"max_interleavings": 10},
+        checkpoint_dir=d, resume=True,
+    )
+    assert [e.eid for e in resumed.get_all_events()] == [
+        e.eid for e in mcs.get_all_events()
+    ]
