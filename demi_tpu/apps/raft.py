@@ -30,6 +30,16 @@ in for the akka-raft raft-NN branches):
                       bug; needs message delay/reordering to trigger).
   bug="stale_commit"— leader counts itself twice when advancing commit,
                       committing entries without a true majority.
+  bug="gap_append"  — follower drops the Log Matching precheck (prev_idx/
+                      prev_term ignored): a reordered AppendEntries writes
+                      a later entry over a hole, the leader's match_index
+                      advances past the hole, and commit covers an entry
+                      the follower never got (committed-prefix violation;
+                      raft-56-class, needs message reordering).
+  bug="commit_beyond"— follower adopts leader_commit without clamping to
+                      its own log length: a heartbeat reordered ahead of
+                      its AppendEntries commits an entry the follower
+                      doesn't have yet (committed-prefix violation).
 """
 
 from __future__ import annotations
@@ -268,9 +278,12 @@ def make_raft_app(
         state = state.at[LEADER_HINT].set(
             jnp.where(current, snd, state[LEADER_HINT])
         )
-        prev_ok = (prev_idx < state[LOG_LEN]) & (
-            log_term_at(state, prev_idx) == prev_term
-        )
+        if bug == "gap_append":
+            prev_ok = jnp.bool_(True)  # BUG: Log Matching precheck dropped
+        else:
+            prev_ok = (prev_idx < state[LOG_LEN]) & (
+                log_term_at(state, prev_idx) == prev_term
+            )
         ok = current & prev_ok
         has_entry = ent_term != 0
         write_idx = prev_idx + 1
@@ -297,12 +310,20 @@ def make_raft_app(
                 state[LOG_LEN],
             )
         )
-        new_commit = jnp.where(
-            ok,
-            jnp.maximum(state[COMMIT],
-                        jnp.minimum(leader_commit, state[LOG_LEN] - 1)),
-            state[COMMIT],
-        )
+        if bug == "commit_beyond":
+            # BUG: commit adopted from any current-term leader message,
+            # before the Log Matching check and unclamped — commits entries
+            # this follower hasn't received.
+            new_commit = jnp.where(
+                current, jnp.maximum(state[COMMIT], leader_commit), state[COMMIT]
+            )
+        else:
+            new_commit = jnp.where(
+                ok,
+                jnp.maximum(state[COMMIT],
+                            jnp.minimum(leader_commit, state[LOG_LEN] - 1)),
+                state[COMMIT],
+            )
         state = state.at[COMMIT].set(new_commit)
         match = jnp.where(ok, jnp.where(has_entry & can_write, write_idx, prev_idx), -1)
         out = one_row(empty_outbox(), 0, snd, jnp.int32(T_APPEND_REPLY),

@@ -1,18 +1,38 @@
-"""Synoptic-model-guided removal (stub) + historical trace retention.
+"""Synoptic-style model inference + model-guided removal.
 
 Reference: internal_minimization/StateMachineRemoval.scala (43 LoC) — an
-acknowledged stub in the reference too (returns None, :26-30), kept for
-pipeline parity; HistoricalEventTraces (:34-43) retains every executed
-MetaEventTrace when SchedulerConfig.store_event_traces is on, as input for
-state-machine inference.
+acknowledged stub in the reference (returns None, :26-30) whose intent was
+to mine a Synoptic model from the per-event log output retained in
+MetaEventTraces and use it to guide delivery removal. This implementation
+goes past the stub:
+
+- ``HistoricalEventTraces`` retains every executed MetaEventTrace when
+  ``SchedulerConfig.store_event_traces`` is on (reference :34-43).
+- ``SynopticModel.mine`` extracts Synoptic's three temporal-invariant
+  families over event labels — AlwaysFollowedBy, NeverFollowedBy,
+  AlwaysPrecedes (Beschastnikh et al., the model Synoptic refines against).
+- ``StateMachineRemoval`` ranks removable deliveries by how weakly their
+  label *discriminates* violating from non-violating executions (labels
+  whose frequency is the same in both populations are background noise)
+  and proposes removals least-discriminating-first — a model-guided
+  one-at-a-time ordering that reaches the MCS with fewer failed probes
+  than positional order when history is available, and degrades to plain
+  one-at-a-time when it isn't.
 """
 
 from __future__ import annotations
 
-from typing import List, Optional
+from collections import Counter
+from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
 
+from ..events import MsgEvent, TimerDelivery
 from ..trace import EventTrace, MetaEventTrace
-from .internal import RemovalStrategy
+from .internal import (
+    RemovalStrategy,
+    remove_delivery,
+    removable_delivery_indices,
+)
+from .wildcards import class_tag_of
 
 
 class HistoricalEventTraces:
@@ -35,11 +55,167 @@ class HistoricalEventTraces:
     def violating(cls) -> List[MetaEventTrace]:
         return [m for m in cls.traces if m.caused_violation]
 
+    @classmethod
+    def non_violating(cls) -> List[MetaEventTrace]:
+        return [m for m in cls.traces if not m.caused_violation]
+
+
+def delivery_label(event: Any) -> Tuple:
+    """Event label for model mining: (receiver, message class tag) — the
+    granularity Synoptic works at when log lines carry the handler name."""
+    if isinstance(event, TimerDelivery):
+        return (event.rcv, "timer", class_tag_of(event.msg))
+    return (event.rcv, class_tag_of(event.msg))
+
+
+def trace_labels(trace: EventTrace) -> List[Tuple]:
+    """Delivery-label sequence of one execution."""
+    out: List[Tuple] = []
+    for u in trace.events:
+        ev = u.event
+        if isinstance(ev, TimerDelivery) or (
+            isinstance(ev, MsgEvent) and not ev.is_external
+        ):
+            out.append(delivery_label(ev))
+    return out
+
+
+class SynopticModel:
+    """Temporal invariants mined over label sequences.
+
+    ``always_followed_by``: every a is eventually followed by a b, in every
+    trace. ``never_followed_by``: no a is ever followed by a b.
+    ``always_precedes``: every b has an earlier a, in every trace."""
+
+    def __init__(
+        self,
+        labels: Set[Tuple],
+        always_followed_by: Set[Tuple[Tuple, Tuple]],
+        never_followed_by: Set[Tuple[Tuple, Tuple]],
+        always_precedes: Set[Tuple[Tuple, Tuple]],
+    ):
+        self.labels = labels
+        self.always_followed_by = always_followed_by
+        self.never_followed_by = never_followed_by
+        self.always_precedes = always_precedes
+
+    @classmethod
+    def mine(cls, sequences: Sequence[Sequence[Tuple]]) -> "SynopticModel":
+        labels: Set[Tuple] = set()
+        for seq in sequences:
+            labels.update(seq)
+        afby: Set[Tuple[Tuple, Tuple]] = set()
+        nfby: Set[Tuple[Tuple, Tuple]] = set()
+        ap: Set[Tuple[Tuple, Tuple]] = set()
+        for a in labels:
+            for b in labels:
+                holds_afby = True
+                holds_nfby = True
+                holds_ap = True
+                for seq in sequences:
+                    # One scan per (pair, seq). The b-checks use the state
+                    # BEFORE index i is absorbed, so self-pairs (a == b)
+                    # mean "a strictly-earlier occurrence" — an immediately
+                    # repeated label correctly kills NFby(a,a) and AP(a,a)
+                    # needs a genuinely earlier a.
+                    seen_a = False
+                    last_a = -1
+                    for i, x in enumerate(seq):
+                        if x == b:
+                            if not seen_a:
+                                holds_ap = False
+                            else:
+                                holds_nfby = False
+                        if x == a:
+                            seen_a = True
+                            last_a = i
+                    # AFby: a b after the LAST a covers every earlier a too.
+                    if last_a >= 0 and not any(
+                        seq[j] == b for j in range(last_a + 1, len(seq))
+                    ):
+                        holds_afby = False
+                if holds_afby and any(a in seq for seq in sequences):
+                    afby.add((a, b))
+                if holds_nfby:
+                    nfby.add((a, b))
+                if holds_ap and any(b in seq for seq in sequences):
+                    ap.add((a, b))
+        return cls(labels, afby, nfby, ap)
+
+
+def discriminating_scores(
+    violating: Sequence[Sequence[Tuple]],
+    non_violating: Sequence[Sequence[Tuple]],
+) -> Dict[Tuple, float]:
+    """Per-label |mean frequency in violating − mean frequency in
+    non-violating|: ~0 means the label is background noise; large means it
+    tracks the violation."""
+
+    def mean_freq(seqs: Sequence[Sequence[Tuple]]) -> Counter:
+        total: Counter = Counter()
+        for seq in seqs:
+            total.update(seq)
+        n = max(len(seqs), 1)
+        return Counter({k: v / n for k, v in total.items()})
+
+    fv = mean_freq(violating)
+    fn = mean_freq(non_violating)
+    return {
+        label: abs(fv.get(label, 0.0) - fn.get(label, 0.0))
+        for label in set(fv) | set(fn)
+    }
+
 
 class StateMachineRemoval(RemovalStrategy):
-    """Planned: infer a state machine from HistoricalEventTraces (Synoptic)
-    and propose removals of deliveries off the violating path. Like the
-    reference, currently proposes nothing."""
+    """Model-guided one-at-a-time removal: deliveries whose labels least
+    discriminate violating from non-violating history go first. Without
+    history (store_event_traces off, or no non-violating runs recorded),
+    the ordering is positional — plain one-at-a-time."""
+
+    def __init__(self):
+        self._scores: Optional[Dict[Tuple, float]] = None
+        self._tried: Set[int] = set()
+        self._last_len: Optional[int] = None
+        self._pending: Optional[int] = None
+        self.model: Optional[SynopticModel] = None
+
+    def _ensure_model(self) -> None:
+        if self._scores is not None:
+            return
+        violating = [
+            trace_labels(m.trace) for m in HistoricalEventTraces.violating()
+        ]
+        passing = [
+            trace_labels(m.trace) for m in HistoricalEventTraces.non_violating()
+        ]
+        if violating and passing:
+            self._scores = discriminating_scores(violating, passing)
+            self.model = SynopticModel.mine(violating)
+        else:
+            self._scores = {}
 
     def next_candidate(self, last_failing: EventTrace) -> Optional[EventTrace]:
-        return None
+        self._ensure_model()
+        if self._last_len != len(last_failing.events):
+            self._last_len = len(last_failing.events)
+            self._tried = set()
+        indices = removable_delivery_indices(last_failing)
+        scored = sorted(
+            (i for i in indices if i not in self._tried),
+            key=lambda i: (
+                self._scores.get(
+                    delivery_label(last_failing.events[i].event), 0.0
+                ),
+                i,
+            ),
+        )
+        if not scored:
+            self._pending = None
+            return None
+        self._pending = scored[0]
+        return remove_delivery(last_failing, scored[0])
+
+    def on_result(self, reproduced: bool) -> None:
+        if not reproduced and self._pending is not None:
+            self._tried.add(self._pending)
+        # On success the baseline shrinks; next_candidate resets _tried.

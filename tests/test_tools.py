@@ -47,8 +47,14 @@ def test_meta_trace_captures_logs_per_event():
     assert not meta.caused_violation
 
 
-def test_state_machine_removal_is_explicit_stub():
-    assert StateMachineRemoval().next_candidate(None) is None
+def test_state_machine_removal_empty_trace():
+    """No removable deliveries -> no candidate (implemented strategy; the
+    full model-guided behavior is covered in tests/test_synoptic.py)."""
+    from demi_tpu.minimization.state_machine import HistoricalEventTraces
+    from demi_tpu.trace import EventTrace
+
+    HistoricalEventTraces.clear()
+    assert StateMachineRemoval().next_candidate(EventTrace()) is None
 
 
 def test_stats_graph_tool(tmp_path, capsys):
