@@ -1,0 +1,22 @@
+"""Bridge tier: test *external-process* applications under the controlled
+scheduler.
+
+The reference's defining capability is testing real, unmodified Akka apps
+by weaving interposition into their bytecode (WeaveActor.aj). A TPU-native
+framework can't weave arbitrary programs, so the bridge preserves the
+capability the way SURVEY §7.1 prescribes: a host-sequential mode drives an
+external process over a line-delimited JSON protocol — every actor's
+deliveries become protocol commands, every send/timer the app performs
+comes back as captured effects, and the scheduler stays in total control
+of ordering. Blocking ``ask`` semantics are preserved at this layer (the
+app reports it blocked; the scheduler delivers only the matching reply) —
+the part of the reference (Instrumenter.scala:679-877) the in-framework
+DSL deliberately omits.
+
+See demi_tpu/bridge/session.py for the protocol and
+demi_tpu/bridge/demo_app.py for a reference external application.
+"""
+
+from .session import BridgeActor, BridgeCrash, BridgeSession, bridge_invariant
+
+__all__ = ["BridgeActor", "BridgeCrash", "BridgeSession", "bridge_invariant"]

@@ -1,0 +1,125 @@
+"""Reference external application for the bridge tier.
+
+A three-actor ping service driven entirely over the bridge protocol —
+run it directly (``python -m demi_tpu.bridge.demo_app [--bug] [pipe|socket]``)
+or let BridgeSession spawn it.
+
+Actors:
+  client  — on ("go",): performs a BLOCKING ask to the server (sends
+            ("ping", n) and blocks until a ("pong", n) from the server);
+            on the pong it unblocks and notifies the monitor ("done", n).
+  server  — replies ("pong", n) to every ("ping", n). With --bug it
+            replies only to the FIRST ping ever — any later ask blocks the
+            client forever (quiescent deadlock, the classic ask pathology).
+  monitor — counts done notifications.
+
+State resets on "start" (each controlled execution restarts every actor),
+which is the determinism contract bridge apps must honor.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import sys
+
+
+class App:
+    def __init__(self, bug: bool):
+        self.bug = bug
+        self.state: dict = {}
+
+    def reset(self, actor: str) -> None:
+        if actor == "client":
+            self.state[actor] = {"asked": 0, "done": 0}
+        elif actor == "server":
+            self.state[actor] = {"pings": 0}
+        else:
+            self.state[actor] = {"done": 0}
+
+    def handle(self, actor: str, src: str, msg) -> dict:
+        effects: dict = {"op": "effects", "sends": [], "timers": [],
+                         "logs": [], "blocked": None}
+        st = self.state[actor]
+        tag = msg[0] if isinstance(msg, list) else msg
+        if actor == "client":
+            if tag == "go":
+                n = st["asked"]
+                st["asked"] += 1
+                effects["sends"].append({"dst": "server", "msg": ["ping", n]})
+                # Blocking ask: nothing else is deliverable to the client
+                # until the server's pong arrives.
+                effects["blocked"] = {"src": "server", "tag": "pong"}
+                effects["logs"].append(f"client asks ping {n}")
+            elif tag == "pong":
+                st["done"] += 1
+                effects["sends"].append({"dst": "monitor", "msg": ["done", msg[1]]})
+                effects["logs"].append(f"client got pong {msg[1]}")
+        elif actor == "server":
+            if tag == "ping":
+                st["pings"] += 1
+                drop = self.bug and st["pings"] > 1
+                if not drop:
+                    effects["sends"].append({"dst": src, "msg": ["pong", msg[1]]})
+                effects["logs"].append(
+                    f"server ping {msg[1]}" + (" DROPPED" if drop else "")
+                )
+        elif actor == "monitor":
+            if tag == "done":
+                st["done"] += 1
+        return effects
+
+
+def serve(recv, send, bug: bool) -> None:
+    app = App(bug)
+    send({"op": "register", "actors": ["client", "server", "monitor"]})
+    while True:
+        cmd = recv()
+        if cmd is None or cmd.get("op") == "shutdown":
+            return
+        op = cmd["op"]
+        if op == "start":
+            app.reset(cmd["actor"])
+            send({"op": "effects"})
+        elif op == "deliver":
+            send(app.handle(cmd["actor"], cmd["src"], cmd["msg"]))
+        elif op == "checkpoint":
+            send({"op": "state", "state": app.state[cmd["actor"]]})
+        elif op == "stop":
+            app.state.pop(cmd["actor"], None)  # no reply
+        else:
+            raise SystemExit(f"unknown op {cmd!r}")
+
+
+def main() -> None:
+    bug = "--bug" in sys.argv
+    mode = "socket" if "socket" in sys.argv else "pipe"
+    if mode == "socket":
+        host, port = os.environ["DEMI_BRIDGE_ADDR"].split(":")
+        conn = socket.create_connection((host, int(port)))
+        f = conn.makefile("rw", encoding="utf-8")
+
+        def recv():
+            line = f.readline()
+            return json.loads(line) if line else None
+
+        def send(obj):
+            f.write(json.dumps(obj) + "\n")
+            f.flush()
+
+        serve(recv, send, bug)
+    else:
+        def recv():
+            line = sys.stdin.readline()
+            return json.loads(line) if line else None
+
+        def send(obj):
+            sys.stdout.write(json.dumps(obj) + "\n")
+            sys.stdout.flush()
+
+        serve(recv, send, bug)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,290 @@
+"""Bridge session: one external process hosting actors under test.
+
+Protocol (line-delimited JSON; framework -> app on stdin, app -> framework
+on stdout, or over a localhost TCP socket):
+
+  app -> framework, once at boot:
+    {"op": "register", "actors": ["name", ...]}
+
+  framework -> app commands (each answered by exactly one "effects"):
+    {"op": "start",   "actor": a}                  actor (re)starts, resets
+    {"op": "deliver", "actor": a, "src": s, "msg": m}
+    {"op": "checkpoint", "actor": a}               -> {"op":"state", ...}
+    {"op": "stop",    "actor": a}                  HardKill (no reply)
+    {"op": "shutdown"}                             process exits (no reply)
+
+  app -> framework effects reply:
+    {"op": "effects",
+     "sends":  [{"dst": d, "msg": m}, ...],        captured sends
+     "timers": [m, ...],                           armed timers (self msgs)
+     "cancel": [m, ...],                           cancelled timers
+     "logs":   ["line", ...],
+     "blocked": null | {"src": s, "tag": t},       blocking ask: only a
+                                                   message from s (whose
+                                                   msg[0]==t if t given)
+                                                   is deliverable now
+     "crashed": false|true}                        handler raised
+
+Messages are JSON values; tuples arrive as lists and are normalized back
+to tuples on capture so fingerprinting and trace surgery work unchanged.
+
+The scheduler side is an ordinary ``Actor`` (BridgeActor), so every
+scheduler, oracle, and minimizer in the framework drives external apps
+with no special cases — fuzz -> minimize -> replay works end to end.
+Replay determinism is the app's contract: same delivery sequence, same
+effects (the same contract the reference imposes on Akka apps).
+
+Limitations (documented, matching PARITY.md): no STS peek/system-snapshot
+over bridge actors (external state can't be deep-copied — the reference
+needs app-supplied checkpoint/restore callbacks for the same reason), and
+one process per BridgeSession.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import subprocess
+import sys
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+from ..runtime.actor import Actor
+
+
+class BridgeCrash(Exception):
+    """The external handler reported a crash for this delivery."""
+
+
+def _normalize(msg: Any) -> Any:
+    """JSON round-trips tuples as lists; normalize to hashable tuples."""
+    if isinstance(msg, list):
+        return tuple(_normalize(m) for m in msg)
+    return msg
+
+
+class _PipeTransport:
+    def __init__(self, proc: subprocess.Popen):
+        self.proc = proc
+
+    def send(self, obj: dict) -> None:
+        self.proc.stdin.write(json.dumps(obj) + "\n")
+        self.proc.stdin.flush()
+
+    def recv(self) -> dict:
+        line = self.proc.stdout.readline()
+        if not line:
+            raise BridgeCrash(
+                f"external process exited (rc={self.proc.poll()})"
+            )
+        return json.loads(line)
+
+    def close(self) -> None:
+        try:
+            self.proc.stdin.close()
+        except Exception:
+            pass
+        self.proc.terminate()
+        try:
+            self.proc.wait(timeout=5)
+        except subprocess.TimeoutExpired:
+            self.proc.kill()
+
+
+class _SocketTransport:
+    """TCP localhost variant: the framework listens, the app connects
+    (address handed to the app via the DEMI_BRIDGE_ADDR env var)."""
+
+    def __init__(self, proc: subprocess.Popen, conn: socket.socket):
+        self.proc = proc
+        self.file = conn.makefile("rw", encoding="utf-8")
+
+    def send(self, obj: dict) -> None:
+        self.file.write(json.dumps(obj) + "\n")
+        self.file.flush()
+
+    def recv(self) -> dict:
+        line = self.file.readline()
+        if not line:
+            raise BridgeCrash(
+                f"external process hung up (rc={self.proc.poll()})"
+            )
+        return json.loads(line)
+
+    def close(self) -> None:
+        try:
+            self.file.close()
+        except Exception:
+            pass
+        self.proc.terminate()
+        try:
+            self.proc.wait(timeout=5)
+        except subprocess.TimeoutExpired:
+            self.proc.kill()
+
+
+class BridgeSession:
+    """Owns the external process; hands out actor factories whose actors
+    translate scheduler deliveries into protocol commands."""
+
+    def __init__(
+        self,
+        argv: Sequence[str],
+        transport: str = "pipe",
+        env: Optional[Dict[str, str]] = None,
+    ):
+        full_env = dict(os.environ)
+        if env:
+            full_env.update(env)
+        if transport == "pipe":
+            # stderr=None inherits the parent's real fd (sys.stderr may be
+            # a pytest-captured pseudo-file without fileno()).
+            proc = subprocess.Popen(
+                list(argv), stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+                stderr=None, text=True, env=full_env,
+            )
+            self.transport = _PipeTransport(proc)
+        elif transport == "socket":
+            server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            server.bind(("127.0.0.1", 0))
+            server.listen(1)
+            host, port = server.getsockname()
+            full_env["DEMI_BRIDGE_ADDR"] = f"{host}:{port}"
+            proc = subprocess.Popen(list(argv), env=full_env)
+            server.settimeout(30)
+            conn, _ = server.accept()
+            server.close()
+            self.transport = _SocketTransport(proc, conn)
+        else:
+            raise ValueError(f"unknown transport {transport!r}")
+        hello = self.transport.recv()
+        if hello.get("op") != "register":
+            raise BridgeCrash(f"expected register, got {hello!r}")
+        self.actor_names: List[str] = list(hello["actors"])
+
+    # -- protocol ----------------------------------------------------------
+    def command(self, obj: dict) -> dict:
+        self.transport.send(obj)
+        reply = self.transport.recv()
+        if reply.get("op") not in ("effects", "state"):
+            raise BridgeCrash(f"unexpected reply {reply!r}")
+        return reply
+
+    def notify(self, obj: dict) -> None:
+        self.transport.send(obj)
+
+    def close(self) -> None:
+        try:
+            self.notify({"op": "shutdown"})
+        except Exception:
+            pass
+        self.transport.close()
+
+    def __enter__(self) -> "BridgeSession":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # -- scheduler-facing --------------------------------------------------
+    def actor_factory(self, name: str) -> Callable[[], "BridgeActor"]:
+        assert name in self.actor_names, f"{name!r} not registered"
+        return lambda: BridgeActor(self, name)
+
+
+class BridgeActor(Actor):
+    """Scheduler-side proxy for one external actor: deliveries go over the
+    wire; returned effects replay into the capture Context, so the bridge
+    composes with every scheduler/minimizer unchanged."""
+
+    def __init__(self, session: BridgeSession, name: str):
+        self.session = session
+        self.name = name
+        self._blocked = False
+
+    def on_start(self, ctx) -> None:
+        effects = self.session.command({"op": "start", "actor": self.name})
+        self._apply(ctx, effects)
+
+    def receive(self, ctx, snd: str, msg: Any) -> None:
+        effects = self.session.command(
+            {"op": "deliver", "actor": self.name, "src": snd, "msg": msg}
+        )
+        self._apply(ctx, effects)
+
+    def on_stop(self) -> None:
+        # HardKill: no effects expected back.
+        self.session.notify({"op": "stop", "actor": self.name})
+
+    def checkpoint_state(self) -> Any:
+        reply = self.session.command(
+            {"op": "checkpoint", "actor": self.name}
+        )
+        state = dict(reply.get("state") or {})
+        # Surface blockedness for deadlock-style invariants.
+        state["_blocked"] = self._blocked
+        return state
+
+    # -- effects -----------------------------------------------------------
+    def _apply(self, ctx, effects: dict) -> None:
+        for send in effects.get("sends", ()):
+            ctx.send(send["dst"], _normalize(send["msg"]))
+        for msg in effects.get("timers", ()):
+            ctx.set_timer(_normalize(msg))
+        for msg in effects.get("cancel", ()):
+            ctx.cancel_timer(_normalize(msg))
+        for line in effects.get("logs", ()):
+            ctx.log(line)
+        blocked = effects.get("blocked")
+        system = ctx._system
+        if blocked:
+            src = blocked.get("src")
+            tag = blocked.get("tag")
+
+            def reply_pred(entry, src=src, tag=tag):
+                if src is not None and entry.snd != src:
+                    return False
+                if tag is not None:
+                    m = entry.msg
+                    head = m[0] if isinstance(m, tuple) and m else m
+                    return head == tag
+                return True
+
+            self._blocked = True
+            system.block_actor(self.name, reply_pred)
+        elif self._blocked:
+            self._blocked = False
+            system.unblock_actor(self.name)
+        if effects.get("crashed"):
+            raise BridgeCrash(f"{self.name} crashed in external handler")
+
+
+def bridge_invariant(
+    deadlock_violation_code: int = 1,
+    predicate: Optional[Callable[[Dict[str, Any]], Optional[int]]] = None,
+):
+    """Invariant over bridge checkpoints. By default flags quiescent
+    deadlock — some alive actor still blocked on an ask at quiescence —
+    the canonical ask-semantics pathology. ``predicate`` (states dict ->
+    code or None) layers app-specific checks on top."""
+    from ..minimization.test_oracle import IntViolation
+
+    def invariant(externals, checkpoint) -> Optional[IntViolation]:
+        states = {
+            name: reply.data
+            for name, reply in checkpoint.items()
+            if reply is not None and reply.data is not None
+        }
+        blocked = [
+            n for n, s in states.items()
+            if isinstance(s, dict) and s.get("_blocked")
+        ]
+        if blocked:
+            return IntViolation(deadlock_violation_code, tuple(sorted(blocked)))
+        if predicate is not None:
+            code = predicate(states)
+            if code:
+                return IntViolation(int(code), tuple(sorted(states)))
+        return None
+
+    return invariant

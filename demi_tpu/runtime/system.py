@@ -94,6 +94,12 @@ class ControlledActorSystem:
         self.actors: Dict[str, Actor] = {}
         self.crashed: Set[str] = set()
         self.stopped: Set[str] = set()  # HardKilled names (may be re-Started)
+        # Blocked-ask semantics (bridge tier only; in-framework DSL apps are
+        # CPS-style and never block — SURVEY §7.3). name -> reply predicate:
+        # while present, only entries satisfying the predicate are
+        # deliverable to that actor (reference: Instrumenter blocked-actor
+        # tracking, Instrumenter.scala:679-727).
+        self.blocked_asks: Dict[str, Callable[[PendingEntry], bool]] = {}
         self.network = Network()
         self.vector_clocks: Dict[str, Dict[str, int]] = {}
         self.log_listener: Optional[Callable[[str, str], None]] = None
@@ -118,8 +124,11 @@ class ControlledActorSystem:
     def is_crashed(self, name: str) -> bool:
         return name in self.crashed
 
-    def deliverable(self, entry: PendingEntry) -> bool:
+    def deliverable(self, entry: PendingEntry, ignore_blocked: bool = False) -> bool:
         """Would delivering this entry have any effect right now?
+        ``ignore_blocked`` answers "deliverable once the receiver's ask
+        unblocks?" — schedulers use it to keep (not drop) messages to
+        blocked actors.
 
         Mirrors the drop-predicate schedulers consult in the reference
         (RandomScheduler.scala:292, STSScheduler.scala:608)."""
@@ -129,6 +138,10 @@ class ControlledActorSystem:
             return entry.snd not in self.network.isolated
         if entry.rcv not in self.actors or entry.rcv in self.crashed:
             return False
+        if not ignore_blocked:
+            blocked = self.blocked_asks.get(entry.rcv)
+            if blocked is not None and not blocked(entry):
+                return False
         if entry.is_timer or entry.is_external:
             return entry.rcv not in self.network.isolated
         return not self.network.crosses_partition(entry.snd, entry.rcv)
@@ -155,9 +168,24 @@ class ControlledActorSystem:
         """Actually stop the actor (reference:
         EventOrchestrator.trigger_hard_kill:243-312). The scheduler must
         scrub its own pending state via Scheduler.actor_terminated."""
-        self.actors.pop(name, None)
+        actor = self.actors.pop(name, None)
+        if actor is not None:
+            stop = getattr(actor, "on_stop", None)
+            if stop is not None:
+                stop()
         self.stopped.add(name)
         self.crashed.discard(name)
+        self.blocked_asks.pop(name, None)
+
+    # -- blocked-ask bookkeeping (bridge tier) ----------------------------
+    def block_actor(self, name: str, reply_pred: Callable[[PendingEntry], bool]) -> None:
+        self.blocked_asks[name] = reply_pred
+
+    def unblock_actor(self, name: str) -> None:
+        self.blocked_asks.pop(name, None)
+
+    def blocked_actors(self) -> List[str]:
+        return sorted(self.blocked_asks.keys())
 
     # -- the one delivery --------------------------------------------------
     def deliver(self, entry: PendingEntry) -> List[PendingEntry]:

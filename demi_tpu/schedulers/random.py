@@ -198,13 +198,25 @@ class RandomScheduler(BaseScheduler):
         self.pending.add(entry)
 
     def choose_next(self) -> Optional[PendingEntry]:
-        while True:
-            entry = self.pending.pop()
-            if entry is None:
-                return None
-            if self.system.deliverable(entry):
-                return entry
-            # else: dropped, like a lossy network (see module docstring)
+        # Messages to ask-blocked actors are NOT lossy-network droppable:
+        # they stay pending until the actor unblocks (reference:
+        # Instrumenter blocked-actor tracking keeps mailboxes intact,
+        # Instrumenter.scala:679-727).
+        stashed: List[PendingEntry] = []
+        try:
+            while True:
+                entry = self.pending.pop()
+                if entry is None:
+                    return None
+                if self.system.deliverable(entry):
+                    return entry
+                if self.system.deliverable(entry, ignore_blocked=True):
+                    stashed.append(entry)
+                    continue
+                # else: dropped, like a lossy network (see module docstring)
+        finally:
+            for e in stashed:
+                self.pending.add(e)
 
     def pending_entries(self) -> List[PendingEntry]:
         return self.pending.entries() + list(self._parked_timers)

@@ -1,0 +1,106 @@
+"""Bridge tier: external-process apps under the controlled scheduler,
+including blocking-ask semantics and the full fuzz -> minimize arc."""
+
+import sys
+
+import pytest
+
+from demi_tpu.bridge import BridgeSession, bridge_invariant
+from demi_tpu.config import SchedulerConfig
+from demi_tpu.external_events import MessageConstructor, Send, Start, WaitQuiescence
+from demi_tpu.runner import sts_sched_ddmin
+from demi_tpu.schedulers import BasicScheduler, RandomScheduler
+from demi_tpu.schedulers.replay import ReplayScheduler
+
+ARGV = [sys.executable, "-m", "demi_tpu.bridge.demo_app"]
+BUG_ARGV = ARGV + ["--bug"]
+
+
+def _program(session, gos: int):
+    starts = [
+        Start(name, ctor=session.actor_factory(name))
+        for name in ("client", "server", "monitor")
+    ]
+    sends = [
+        Send("client", MessageConstructor(lambda: ("go",)))
+        for _ in range(gos)
+    ]
+    return starts + sends + [WaitQuiescence()]
+
+
+def test_bridge_correct_app_completes():
+    with BridgeSession(ARGV) as session:
+        config = SchedulerConfig(invariant_check=bridge_invariant())
+        result = RandomScheduler(config, seed=0).execute(_program(session, 2))
+        assert result.violation is None
+        # Both asks completed: monitor saw 2 dones.
+        sched_state = result.trace  # sanity: deliveries happened
+        assert result.deliveries >= 6  # 2x (go, ping, pong) at least
+
+
+def test_bridge_blocking_ask_defers_other_messages():
+    """While the client is blocked on its ask, a second 'go' must not be
+    deliverable — FIFO order would otherwise deliver it first."""
+    with BridgeSession(ARGV) as session:
+        config = SchedulerConfig(invariant_check=bridge_invariant())
+        sched = BasicScheduler(config)
+        result = sched.execute(_program(session, 2))
+        assert result.violation is None
+        # The trace shows go -> ping -> pong before the second go's ping.
+        from demi_tpu.events import MsgEvent
+
+        deliveries = [
+            (e.rcv, e.msg)
+            for e in result.trace.get_events()
+            if isinstance(e, MsgEvent)
+        ]
+        pongs = [i for i, (r, m) in enumerate(deliveries)
+                 if r == "client" and m[0] == "pong"]
+        second_go = [i for i, (r, m) in enumerate(deliveries)
+                     if r == "client" and m == ("go",)][1]
+        assert pongs and pongs[0] < second_go
+
+
+def test_bridge_deadlock_detected_and_minimized():
+    """The seeded server bug deadlocks the second ask; the deadlock
+    invariant flags it at quiescence, and external DDMin shrinks the
+    program (the monitor plays no role in it)."""
+    with BridgeSession(BUG_ARGV) as session:
+        config = SchedulerConfig(invariant_check=bridge_invariant())
+        program = _program(session, 2)
+        result = RandomScheduler(config, seed=1).execute(program)
+        assert result.violation is not None
+        assert "client" in result.violation.nodes
+
+        mcs, verified = sts_sched_ddmin(
+            config, result.trace, program, result.violation
+        )
+        kept = mcs.get_all_events()
+        assert verified is not None
+        # Monitor is pruned; at least one go + the client survive. (STS
+        # ignore-absent may shrink to a single go: the projected pong gets
+        # skipped as absent and the client stays blocked — the same
+        # heuristic over-reduction the reference's STSSched exhibits.)
+        names = [getattr(e, "name", None) for e in kept]
+        assert "monitor" not in names
+        assert len([n for n in names if n == "client"]) >= 1
+        assert sum(1 for e in kept if isinstance(e, Send)) >= 1
+
+
+def test_bridge_replay_determinism():
+    with BridgeSession(BUG_ARGV) as session:
+        config = SchedulerConfig(invariant_check=bridge_invariant())
+        program = _program(session, 2)
+        result = RandomScheduler(config, seed=1).execute(program)
+        assert result.violation is not None
+        replayed = ReplayScheduler(config).replay(result.trace, program)
+        assert replayed.violation is not None
+        assert replayed.violation.matches(result.violation)
+
+
+def test_bridge_socket_transport():
+    with BridgeSession(ARGV + ["socket"], transport="socket") as session:
+        config = SchedulerConfig(invariant_check=bridge_invariant())
+        result = RandomScheduler(config, seed=0).execute(_program(session, 1))
+        assert result.violation is None
+        assert result.deliveries >= 3

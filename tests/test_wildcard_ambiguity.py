@@ -160,3 +160,29 @@ def test_batched_first_and_last_trial_expansion(ambiguity_case):
         batch_verdicts, host_check, first_and_last=False
     ).minimize(rec.trace, config.fingerprinter)
     assert dual == 2 * sizes[0]
+
+
+def test_reorder_deliveries(ambiguity_case):
+    """Manual schedule twiddling (RunnerUtils.reorderDeliveries analog):
+    flipping the two relay deliveries turns the violation on/off."""
+    from demi_tpu.minimization.internal import removable_delivery_indices
+    from demi_tpu.runner import reorder_deliveries
+
+    app, config, program, rec = ambiguity_case
+    slots = removable_delivery_indices(rec.trace)
+    assert len(slots) == 2  # the two relays to r
+
+    # Identity order reproduces the recorded violation.
+    same = reorder_deliveries(config, rec.trace, program, slots, rec.violation)
+    assert same is not None
+
+    # Swapped order delivers relay-from-1 first: violation gone, but the
+    # schedule still replays cleanly.
+    swapped = reorder_deliveries(
+        config, rec.trace, program, [slots[1], slots[0]]
+    )
+    assert swapped is not None
+    swapped_viol = reorder_deliveries(
+        config, rec.trace, program, [slots[1], slots[0]], rec.violation
+    )
+    assert swapped_viol is None
