@@ -17,6 +17,6 @@ See demi_tpu/bridge/session.py for the protocol and
 demi_tpu/bridge/demo_app.py for a reference external application.
 """
 
-from .session import BridgeActor, BridgeCrash, BridgeSession, bridge_invariant
+from .session import BridgeActor, BridgeCrash, BridgeDown, BridgeSession, bridge_invariant
 
-__all__ = ["BridgeActor", "BridgeCrash", "BridgeSession", "bridge_invariant"]
+__all__ = ["BridgeActor", "BridgeCrash", "BridgeDown", "BridgeSession", "bridge_invariant"]

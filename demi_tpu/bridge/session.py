@@ -50,10 +50,19 @@ import sys
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
 from ..runtime.actor import Actor
+from ..runtime.system import HarnessError
 
 
 class BridgeCrash(Exception):
-    """The external handler reported a crash for this delivery."""
+    """The external handler reported a crash for this delivery (an
+    APPLICATION crash: the runtime marks the actor crashed and the
+    execution continues, like any raising handler)."""
+
+
+class BridgeDown(HarnessError):
+    """The external process died or the transport broke — an
+    INFRASTRUCTURE failure that aborts the execution (never converted
+    into actor-crash semantics)."""
 
 
 def _normalize(msg: Any) -> Any:
@@ -74,7 +83,7 @@ class _PipeTransport:
     def recv(self) -> dict:
         line = self.proc.stdout.readline()
         if not line:
-            raise BridgeCrash(
+            raise BridgeDown(
                 f"external process exited (rc={self.proc.poll()})"
             )
         return json.loads(line)
@@ -106,7 +115,7 @@ class _SocketTransport:
     def recv(self) -> dict:
         line = self.file.readline()
         if not line:
-            raise BridgeCrash(
+            raise BridgeDown(
                 f"external process hung up (rc={self.proc.poll()})"
             )
         return json.loads(line)
@@ -152,22 +161,32 @@ class BridgeSession:
             full_env["DEMI_BRIDGE_ADDR"] = f"{host}:{port}"
             proc = subprocess.Popen(list(argv), env=full_env)
             server.settimeout(30)
-            conn, _ = server.accept()
+            try:
+                conn, _ = server.accept()
+            except BaseException:
+                server.close()
+                proc.kill()
+                raise
             server.close()
             self.transport = _SocketTransport(proc, conn)
         else:
             raise ValueError(f"unknown transport {transport!r}")
-        hello = self.transport.recv()
-        if hello.get("op") != "register":
-            raise BridgeCrash(f"expected register, got {hello!r}")
-        self.actor_names: List[str] = list(hello["actors"])
+        try:
+            hello = self.transport.recv()
+            if hello.get("op") != "register":
+                raise BridgeDown(f"expected register, got {hello!r}")
+            self.actor_names: List[str] = list(hello["actors"])
+        except BaseException:
+            # Don't leak the child on a failed handshake.
+            self.transport.close()
+            raise
 
     # -- protocol ----------------------------------------------------------
     def command(self, obj: dict) -> dict:
         self.transport.send(obj)
         reply = self.transport.recv()
         if reply.get("op") not in ("effects", "state"):
-            raise BridgeCrash(f"unexpected reply {reply!r}")
+            raise BridgeDown(f"unexpected reply {reply!r}")
         return reply
 
     def notify(self, obj: dict) -> None:

@@ -163,6 +163,29 @@ def cmd_replay(args) -> int:
 
 
 def cmd_sweep(args) -> int:
+    if args.processes > 1:
+        from .parallel.distributed import launch_distributed_sweep
+
+        summary = launch_distributed_sweep(
+            num_processes=args.processes,
+            total_lanes=args.batch,
+            chunk_size=max(1, args.batch // (4 * args.processes)),
+            workload={
+                "app": args.app,
+                "nodes": args.nodes,
+                "bug": args.bug,
+                "seed": args.seed,
+                "num_events": args.num_events,
+                "max_messages": args.max_messages,
+                "timer_weight": args.timer_weight,
+                "kill_weight": args.kill_weight,
+                "partition_weight": args.partition_weight,
+                "pool": args.pool,
+            },
+        )
+        print(json.dumps(summary))
+        return 0
+
     import numpy as np
     import jax
 
@@ -307,6 +330,12 @@ def main(argv: Optional[list] = None) -> int:
     common(p)
     p.add_argument("--batch", type=int, default=256)
     p.add_argument("--pool", type=int, default=256)
+    p.add_argument(
+        "--processes", type=int, default=1,
+        help=">1: multi-process jax.distributed sweep (seed-space "
+             "partition per process, summaries aggregated over the "
+             "distributed runtime)",
+    )
     p.set_defaults(fn=cmd_sweep)
 
     p = sub.add_parser("dpor", help="systematic batched DPOR search")

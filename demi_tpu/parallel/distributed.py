@@ -1,0 +1,208 @@
+"""Multi-process sweep: the DCN half of SURVEY §5.8 as a real
+``jax.distributed`` deployment (not the in-process slice simulation).
+
+Topology: each process initializes the shared jax.distributed runtime
+(coordination service over TCP — the DCN stand-in on one host, the actual
+DCN on a multi-slice pod), sweeps its own partition of the seed space on
+its LOCAL devices, and the per-slice violation summaries — O(counters),
+never schedule state — are aggregated with a cross-process allgather over
+the distributed runtime's collectives (Gloo on CPU, ICI/DCN on TPU).
+
+Two entry points:
+  - ``run_slice(...)``: what ONE process runs (importable; also the
+    ``python -m demi_tpu.parallel.distributed`` worker main).
+  - ``launch_distributed_sweep(...)``: single-host convenience launcher
+    that spawns N worker processes with virtual CPU devices and returns
+    rank 0's aggregated summary — the smoke path proving the deployment
+    shape without a pod.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import subprocess
+import sys
+from typing import Optional
+
+
+DEFAULT_WORKLOAD = {
+    "app": "broadcast",
+    "nodes": 4,
+    "bug": "x",
+    "seed": 0,
+    "num_events": 10,
+    "max_messages": 96,
+    "timer_weight": 0.2,
+    "kill_weight": 0.05,
+    "partition_weight": 0.0,
+    "pool": 64,
+}
+
+
+def _build_workload(workload: dict):
+    """Build the app/config/fuzzer from a CLI-args-shaped dict, reusing the
+    CLI's own builders so every flag means the same thing with or without
+    --processes."""
+    import argparse
+
+    from ..cli import build_app, build_fuzzer
+    from ..device.core import DeviceConfig
+
+    merged = {**DEFAULT_WORKLOAD, **workload}
+    args = argparse.Namespace(**merged)
+    app = build_app(args)
+    cfg = DeviceConfig.for_app(
+        app,
+        pool_capacity=args.pool,
+        max_steps=args.max_messages,
+        max_external_ops=max(16, args.num_events + app.num_actors + 2),
+        invariant_interval=1,
+        timer_weight=args.timer_weight,
+    )
+    fuzzer = build_fuzzer(app, args)
+    return app, cfg, fuzzer
+
+
+def run_slice(
+    coordinator: str,
+    num_processes: int,
+    process_id: int,
+    total_lanes: int,
+    chunk_size: int,
+    workload: Optional[dict] = None,
+) -> dict:
+    """One slice's work: initialize the distributed runtime, sweep this
+    process's seed partition, allgather the summaries. ``workload`` is a
+    CLI-args-shaped dict (see DEFAULT_WORKLOAD)."""
+    import jax
+
+    jax.distributed.initialize(
+        coordinator, num_processes=num_processes, process_id=process_id
+    )
+    import jax.numpy as jnp
+    from jax.experimental import multihost_utils
+
+    from .sweep import SweepDriver
+
+    app, cfg, fuzzer = _build_workload(workload or {})
+    driver = SweepDriver(
+        app, cfg, lambda s: fuzzer.generate_fuzz_test(seed=s)
+    )
+    # Seed partition: rank r takes seeds r, r+P, r+2P, ... (disjoint).
+    seeds = list(range(process_id, total_lanes, num_processes))
+    chunks = []
+    for i in range(0, len(seeds), chunk_size):
+        chunks.append(
+            driver.run_chunk(seeds[i : i + chunk_size], slice_index=process_id)
+        )
+    lanes = sum(c.lanes for c in chunks)
+    violations = sum(c.violations for c in chunks)
+    overflow = sum(c.overflow_lanes for c in chunks)
+    seconds = sum(c.seconds for c in chunks)
+    # Only summaries cross the wire (O(counters) per slice).
+    local = jnp.asarray([lanes, violations, overflow], jnp.int32)
+    gathered = multihost_utils.process_allgather(local)
+    per_slice = [[int(x) for x in row] for row in gathered]
+    totals = [int(x) for x in gathered.sum(axis=0)]
+    return {
+        "process_id": process_id,
+        "num_processes": num_processes,
+        "global_devices": jax.device_count(),
+        "local_devices": jax.local_device_count(),
+        "per_slice": per_slice,
+        "total_lanes": totals[0],
+        "total_violations": totals[1],
+        "total_overflow": totals[2],
+        "local_seconds": round(seconds, 3),
+    }
+
+
+_SUMMARY_MARK = "DEMI_SUMMARY:"
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def launch_distributed_sweep(
+    num_processes: int = 2,
+    total_lanes: int = 64,
+    chunk_size: int = 16,
+    workload: Optional[dict] = None,
+    devices_per_process: int = 2,
+    timeout: float = 600.0,
+) -> dict:
+    """Single-host smoke launcher: N worker processes, virtual CPU devices,
+    shared distributed runtime. Returns rank 0's aggregated summary."""
+    port = _free_port()
+    coordinator = f"127.0.0.1:{port}"
+    env = dict(os.environ)
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (
+        f"--xla_force_host_platform_device_count={devices_per_process}"
+    )
+    env.pop("JAX_NUM_PROCESSES", None)
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    procs = [
+        subprocess.Popen(
+            [
+                sys.executable, "-m", "demi_tpu.parallel.distributed",
+                coordinator, str(num_processes), str(rank),
+                str(total_lanes), str(chunk_size),
+                json.dumps(workload or {}),
+            ],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env,
+        )
+        for rank in range(num_processes)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=timeout)
+            outs.append((p.returncode, out, err))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for rc, out, err in outs:
+        if rc != 0:
+            raise RuntimeError(
+                f"worker failed rc={rc}: stdout={out[-300:]!r} "
+                f"stderr={err[-800:]!r}"
+            )
+    # Every rank prints its summary; rank 0's carries the aggregate. The
+    # sentinel + raw_decode survives collective backends (Gloo) writing
+    # status text onto the same stdout, even mid-line.
+    out0 = outs[0][1]
+    pos = out0.rfind(_SUMMARY_MARK)
+    if pos < 0:
+        raise RuntimeError(
+            f"no summary in rank-0 stdout: {out0[-500:]!r}"
+        )
+    summary, _ = json.JSONDecoder().raw_decode(
+        out0[pos + len(_SUMMARY_MARK):]
+    )
+    return summary
+
+
+def main(argv) -> int:
+    coordinator, n, rank, lanes, chunk, workload_json = argv[:6]
+    summary = run_slice(
+        coordinator, int(n), int(rank), int(lanes), int(chunk),
+        json.loads(workload_json),
+    )
+    print("\n" + _SUMMARY_MARK + json.dumps(summary), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -29,6 +29,13 @@ from ..events import EXTERNAL, FAILURE_DETECTOR, IdGenerator
 from .actor import Actor, Context
 
 
+class HarnessError(Exception):
+    """Infrastructure failure (dead bridge process, broken transport) —
+    NOT an application crash. deliver() re-raises these instead of
+    converting them into actor-crashed semantics, so a dead test harness
+    can never masquerade as a clean passing run."""
+
+
 @dataclass
 class PendingEntry:
     """One captured, undelivered event (message send or armed timer).
@@ -206,6 +213,8 @@ class ControlledActorSystem:
             return self._with_capture(
                 entry.rcv, lambda ctx: actor.receive(ctx, entry.snd, entry.msg)
             )
+        except HarnessError:
+            raise
         except Exception:
             # Effects performed before the crash are kept: in the reference
             # (Akka), tells made before the throw already sit in mailboxes

@@ -53,6 +53,11 @@ class RandomizationStrategy:
     def remove_entry(self, entry: PendingEntry) -> None:
         raise NotImplementedError
 
+    def requeue(self, entry: PendingEntry) -> None:
+        """Put back an entry popped but not delivered (blocked receiver),
+        preserving the structure's ordering guarantees. Default: add()."""
+        self.add(entry)
+
     def clear(self) -> None:
         raise NotImplementedError
 
@@ -151,6 +156,14 @@ class SrcDstFIFO(RandomizationStrategy):
         else:
             self._queues[entry.key()].remove(entry)
 
+    def requeue(self, entry: PendingEntry) -> None:
+        """A popped channel head goes back to the FRONT of its channel —
+        appending would silently reorder the TCP-modeled FIFO."""
+        if entry.is_timer:
+            self._timers.append(entry)
+        else:
+            self._queues.setdefault(entry.key(), []).insert(0, entry)
+
     def clear(self) -> None:
         self._queues.clear()
         self._timers.clear()
@@ -215,8 +228,10 @@ class RandomScheduler(BaseScheduler):
                     continue
                 # else: dropped, like a lossy network (see module docstring)
         finally:
-            for e in stashed:
-                self.pending.add(e)
+            # Reverse order: repeated front-inserts then restore the
+            # original relative order of same-channel entries.
+            for e in reversed(stashed):
+                self.pending.requeue(e)
 
     def pending_entries(self) -> List[PendingEntry]:
         return self.pending.entries() + list(self._parked_timers)

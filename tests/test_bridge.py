@@ -104,3 +104,42 @@ def test_bridge_socket_transport():
         result = RandomScheduler(config, seed=0).execute(_program(session, 1))
         assert result.violation is None
         assert result.deliveries >= 3
+
+
+def test_bridge_process_death_aborts_not_silent():
+    """A dying external process is an infrastructure failure: the run must
+    raise BridgeDown, never report a clean no-violation result."""
+    from demi_tpu.bridge import BridgeDown
+
+    # An app that registers then exits immediately.
+    argv = [sys.executable, "-c", (
+        "import json,sys;"
+        "print(json.dumps({'op':'register','actors':['client','server','monitor']}),flush=True)"
+    )]
+    session = BridgeSession(argv)
+    config = SchedulerConfig(invariant_check=bridge_invariant())
+    with pytest.raises(BridgeDown):
+        RandomScheduler(config, seed=0).execute(_program(session, 1))
+    session.close()
+
+
+def test_bridge_srcdst_fifo_order_survives_blocking():
+    """Regression: a popped-but-blocked channel head must go back to the
+    FRONT of its (src,dst) FIFO queue — tail re-append would reorder the
+    TCP-modeled channel whenever an actor blocks."""
+    with BridgeSession(ARGV) as session:
+        config = SchedulerConfig(invariant_check=bridge_invariant())
+        for seed in range(6):
+            sched = RandomScheduler(config, seed=seed, strategy="srcdst_fifo")
+            result = sched.execute(_program(session, 3))
+            assert result.violation is None
+            from demi_tpu.events import MsgEvent
+
+            dones = [
+                e.msg[1]
+                for e in result.trace.get_events()
+                if isinstance(e, MsgEvent) and e.rcv == "monitor"
+            ]
+            # The client's asks are numbered in channel order; FIFO across
+            # the blocked stretches keeps dones ascending.
+            assert dones == sorted(dones) and len(dones) == 3, (seed, dones)
