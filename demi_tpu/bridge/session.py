@@ -77,8 +77,13 @@ class _PipeTransport:
         self.proc = proc
 
     def send(self, obj: dict) -> None:
-        self.proc.stdin.write(json.dumps(obj) + "\n")
-        self.proc.stdin.flush()
+        try:
+            self.proc.stdin.write(json.dumps(obj) + "\n")
+            self.proc.stdin.flush()
+        except OSError as e:  # dead child: infrastructure, not app crash
+            raise BridgeDown(
+                f"external process unwritable (rc={self.proc.poll()}): {e}"
+            ) from e
 
     def recv(self) -> dict:
         line = self.proc.stdout.readline()
@@ -109,8 +114,13 @@ class _SocketTransport:
         self.file = conn.makefile("rw", encoding="utf-8")
 
     def send(self, obj: dict) -> None:
-        self.file.write(json.dumps(obj) + "\n")
-        self.file.flush()
+        try:
+            self.file.write(json.dumps(obj) + "\n")
+            self.file.flush()
+        except OSError as e:
+            raise BridgeDown(
+                f"external process unwritable (rc={self.proc.poll()}): {e}"
+            ) from e
 
     def recv(self) -> dict:
         line = self.file.readline()

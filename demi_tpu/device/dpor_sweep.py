@@ -174,32 +174,31 @@ def racing_prescriptions(
     """From one lane's parent-tracked trace, derive backtrack prescriptions:
     for each racing pair (i, j) — same receiver, concurrent (no
     happens-before path), j's message already created before i — the
-    prescription is the delivery records before i plus j's record."""
-    recs = records[:trace_len]
-    parent_col = rec_width - 1
+    prescription is the delivery records before i plus j's record.
+
+    The O(n^2) pair scan runs in the native analyzer when available
+    (native/trace_analysis.cpp; pure-Python fallback is
+    semantics-identical)."""
+    from ..native import racing_pair_scan
+
+    # Slice to rec_width: the scan derives the parent column from the last
+    # column, so trailing padding must never reach it.
+    recs = records[:trace_len, :rec_width]
+    pairs = racing_pair_scan(recs)
+    if len(pairs) == 0:
+        return []
     is_delivery = np.isin(recs[:, 0], (REC_DELIVERY, REC_TIMER))
     positions = np.nonzero(is_delivery)[0]
-    # Ancestor bitmask per record position (python ints as bitsets).
-    anc: Dict[int, int] = {}
-    for pos in range(trace_len):
-        p = int(recs[pos, parent_col]) if is_delivery[pos] else -1
-        if p < 0 or p >= pos:
-            anc[pos] = 0
-        else:
-            anc[pos] = anc.get(p, 0) | (1 << p)
+    # Record tuples materialized once; prefix for branch index i is the
+    # delivery tuples strictly before i.
+    tuples = {int(p): tuple(int(x) for x in recs[p]) for p in positions}
+    ordered = [int(p) for p in positions]
     out: List[Tuple[Tuple[int, ...], ...]] = []
-    for ii, i in enumerate(positions):
-        for j in positions[ii + 1 :]:
-            if recs[i, 2] != recs[j, 2]:  # same receiver only
-                continue
-            if (anc[int(j)] >> int(i)) & 1:
-                continue  # i happens-before j
-            cj = int(recs[j, parent_col])  # j's creation record
-            if cj >= int(i):
-                continue  # j's message didn't exist yet at i
-            prefix = [tuple(int(x) for x in recs[p]) for p in positions if p < i]
-            prefix.append(tuple(int(x) for x in recs[j]))
-            out.append(tuple(prefix))
+    for i, j in pairs:
+        k = np.searchsorted(positions, i)
+        prefix = [tuples[p] for p in ordered[:k]]
+        prefix.append(tuples[int(j)])
+        out.append(tuple(prefix))
     return out
 
 

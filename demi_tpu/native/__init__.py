@@ -1,3 +1,4 @@
+from .analysis import analysis_native_available, racing_pair_scan
 from .codec import (
     native_available,
     pack_records,
@@ -7,9 +8,11 @@ from .codec import (
 )
 
 __all__ = [
+    "analysis_native_available",
     "native_available",
     "pack_records",
     "unpack_records",
     "read_record_log",
     "write_record_log",
+    "racing_pair_scan",
 ]

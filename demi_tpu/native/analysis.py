@@ -1,0 +1,117 @@
+"""Racing-pair scan: ctypes binding to the C++ analyzer
+(native/trace_analysis.cpp) with a semantics-identical pure-Python
+fallback.
+
+This is the host-side hot loop of batched device DPOR: every round scans
+every lane's parent-tracked trace for co-enabled same-receiver pairs
+(reference: DPORwHeuristics.scala:1122-1139). At batch 32 x ~100-record
+traces the O(n^2) Python scan dominates frontier turnaround; the native
+path runs it over raw int32 buffers with per-record ancestor bitsets.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Optional
+
+import numpy as np
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_SRC = os.path.join(_REPO_ROOT, "native", "trace_analysis.cpp")
+_BUILD_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "_build")
+_SO = os.path.join(_BUILD_DIR, "libdemi_analysis.so")
+
+_lib: Optional[ctypes.CDLL] = None
+_lib_tried = False
+
+
+def _delivery_kinds():
+    # Single source of truth for record kinds (the C++ is_delivery must
+    # mirror these; see native/trace_analysis.cpp header comment).
+    from ..device.core import REC_DELIVERY, REC_TIMER
+
+    return (REC_DELIVERY, REC_TIMER)
+
+
+def _load_native() -> Optional[ctypes.CDLL]:
+    global _lib, _lib_tried
+    if _lib_tried:
+        return _lib
+    _lib_tried = True
+    try:
+        if not os.path.exists(_SO) or (
+            os.path.exists(_SRC)
+            and os.path.getmtime(_SRC) > os.path.getmtime(_SO)
+        ):
+            if not os.path.exists(_SRC):
+                return None
+            os.makedirs(_BUILD_DIR, exist_ok=True)
+            # Build to a per-pid temp path, then atomically replace:
+            # concurrent builders (parallel pytest) must never interleave
+            # writes into the loaded .so.
+            tmp = f"{_SO}.{os.getpid()}.tmp"
+            subprocess.run(
+                ["g++", "-O2", "-shared", "-fPIC", _SRC, "-o", tmp],
+                check=True, capture_output=True, timeout=120,
+            )
+            os.replace(tmp, _SO)
+        lib = ctypes.CDLL(_SO)
+        lib.demi_racing_pairs.restype = ctypes.c_int64
+        lib.demi_racing_pairs.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_void_p, ctypes.c_int64,
+        ]
+        _lib = lib
+    except Exception:
+        _lib = None
+    return _lib
+
+
+def analysis_native_available() -> bool:
+    return _load_native() is not None
+
+
+def _py_racing_pairs(recs: np.ndarray) -> np.ndarray:
+    n, w = recs.shape
+    parent_col = w - 1
+    is_delivery = np.isin(recs[:, 0], _delivery_kinds())
+    positions = np.nonzero(is_delivery)[0]
+    anc = {}
+    for pos in range(n):
+        p = int(recs[pos, parent_col]) if is_delivery[pos] else -1
+        if p < 0 or p >= pos:
+            anc[pos] = 0
+        else:
+            anc[pos] = anc.get(p, 0) | (1 << p)
+    out = []
+    for ii, i in enumerate(positions):
+        for j in positions[ii + 1:]:
+            if recs[i, 2] != recs[j, 2]:
+                continue
+            if (anc[int(j)] >> int(i)) & 1:
+                continue
+            if int(recs[j, parent_col]) >= int(i):
+                continue
+            out.append((int(i), int(j)))
+    return np.asarray(out, np.int32).reshape(-1, 2)
+
+
+def racing_pair_scan(recs: np.ndarray) -> np.ndarray:
+    """All racing (i, j) record-position pairs of one lane's trace
+    ([k, 2] int32). Native when available, Python otherwise."""
+    recs = np.ascontiguousarray(recs, np.int32)
+    n, w = recs.shape
+    lib = _load_native()
+    if lib is None or n == 0:
+        return _py_racing_pairs(recs)
+    cap = max(64, n * 4)
+    while True:
+        out = np.empty((cap, 2), np.int32)
+        count = lib.demi_racing_pairs(
+            recs.ctypes.data, n, w, out.ctypes.data, cap
+        )
+        if count <= cap:
+            return out[:count].copy()
+        cap = int(count)

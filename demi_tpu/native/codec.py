@@ -46,10 +46,14 @@ def _load_native() -> Optional[ctypes.CDLL]:
             if not os.path.exists(_SRC):
                 return None
             os.makedirs(_BUILD_DIR, exist_ok=True)
+            # Per-pid temp + atomic replace: concurrent builders must not
+            # interleave writes into the loaded .so.
+            tmp = f"{_SO}.{os.getpid()}.tmp"
             subprocess.run(
-                ["g++", "-O2", "-shared", "-fPIC", _SRC, "-o", _SO],
+                ["g++", "-O2", "-shared", "-fPIC", _SRC, "-o", tmp],
                 check=True, capture_output=True, timeout=120,
             )
+            os.replace(tmp, _SO)
         lib = ctypes.CDLL(_SO)
         lib.demi_pack.restype = ctypes.c_int64
         lib.demi_pack.argtypes = [

@@ -164,15 +164,39 @@ def launch_distributed_sweep(
         )
         for rank in range(num_processes)
     ]
-    outs = []
-    try:
-        for p in procs:
+    # Drain all workers CONCURRENTLY: sequential communicate() deadlocks if
+    # a later-drained worker fills its pipe buffer before the collective.
+    import threading
+
+    outs: list = [None] * num_processes
+    errs: list = [None] * num_processes
+
+    def _drain(i, p):
+        try:
             out, err = p.communicate(timeout=timeout)
-            outs.append((p.returncode, out, err))
+        except subprocess.TimeoutExpired:
+            p.kill()
+            out, err = p.communicate()
+        outs[i] = out
+        errs[i] = err
+
+    threads = [
+        threading.Thread(target=_drain, args=(i, p), daemon=True)
+        for i, p in enumerate(procs)
+    ]
+    try:
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout + 30)
     finally:
         for p in procs:
             if p.poll() is None:
                 p.kill()
+    outs = [
+        (p.returncode, outs[i] or "", errs[i] or "")
+        for i, p in enumerate(procs)
+    ]
     for rc, out, err in outs:
         if rc != 0:
             raise RuntimeError(
