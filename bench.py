@@ -117,6 +117,7 @@ def bench_time_to_first_violation(jax):
     app = make_broadcast_app(4, reliable=False)
     cfg = DeviceConfig.for_app(
         app, pool_capacity=64, max_steps=96, max_external_ops=24,
+        early_exit=True,  # fuzzed lanes quiesce far below the step cap
     )
     fuzzer = Fuzzer(
         num_events=10,
@@ -155,6 +156,7 @@ def bench_config5(jax, total_lanes=None):
         max_steps=4608,
         max_external_ops=80,
         invariant_interval=0,  # agreement holds only at quiescence
+        early_exit=True,  # the flood quiesces below the step cap
     )
     starts = dsl_start_events(app)
 

@@ -50,6 +50,10 @@ def default_device_config(
         max_steps=_round8(max(64, 2 * n_events)),
         max_external_ops=_round8(len(externals) + 8),
         invariant_interval=1,
+        # Minimization candidates shrink far below the shared static
+        # record shape; early exit makes replay wall-clock track the
+        # longest live candidate instead of the shape.
+        early_exit=True,
     )
     defaults.update(overrides)
     return DeviceConfig.for_app(app, **defaults)

@@ -82,6 +82,13 @@ class DeviceConfig:
     # Probability weight of picking a pending timer vs a message (host
     # counterpart: FullyRandom.timer_weight). 1.0 = uniform over all.
     timer_weight: float = 1.0
+    # Early exit: drive the step loop with lax.while_loop instead of a
+    # fixed-length scan, so wall-clock tracks the slowest LIVE lane in the
+    # batch rather than max_steps. ~10x on workloads whose lanes finish
+    # well under the cap (short minimization candidates, early-quiescing
+    # sweeps); ~9% loop overhead when every lane runs the full budget —
+    # hence opt-in.
+    early_exit: bool = False
 
     @property
     def rec_width(self) -> int:

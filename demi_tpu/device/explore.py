@@ -267,10 +267,25 @@ def make_run_lane(app: DSLApp, cfg: DeviceConfig):
     def run_lane(prog: ExtProgram, key) -> LaneResult:
         state = init_state(app, cfg, key)
 
-        def body(state, _):
-            return step(state, prog), None
+        if cfg.early_exit:
+            # Under vmap the cond is OR-reduced across the batch: the loop
+            # runs only as long as some lane is still live.
+            def cond(carry):
+                s, i = carry
+                return (s.status < ST_DONE) & (i < cfg.max_steps)
 
-        state, _ = jax.lax.scan(body, state, None, length=cfg.max_steps)
+            def wl_body(carry):
+                s, i = carry
+                return step(s, prog), i + 1
+
+            state, _ = jax.lax.while_loop(
+                cond, wl_body, (state, jnp.int32(0))
+            )
+        else:
+            def body(state, _):
+                return step(state, prog), None
+
+            state, _ = jax.lax.scan(body, state, None, length=cfg.max_steps)
         # Lanes that ran out of steps mid-flight: evaluate the invariant on
         # whatever was reached (parity: host caps via max_messages then
         # checks).

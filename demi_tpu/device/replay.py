@@ -19,6 +19,7 @@ import jax.numpy as jnp
 from ..dsl import DSLApp
 from .core import (
     OP_END,
+    REC_NONE,
     REC_DELIVERY,
     REC_EXT_BASE,
     REC_TIMER,
@@ -122,15 +123,43 @@ def make_replay_run_lane(app: DSLApp, cfg: DeviceConfig):
     def run_lane(records, key) -> ReplayResult:
         state = init_state(app, cfg, key)
 
-        def body(carry, rec):
-            state, ignored = carry
+        def apply_one(state, ignored, rec):
             before = state.deliveries
             state = replay_record(state, rec, state.status < ST_DONE)
             was_delivery = _is_delivery_kind(rec[0])
             skipped = was_delivery & (state.deliveries == before) & (state.status < ST_DONE)
-            return (state, ignored + skipped.astype(jnp.int32)), None
+            return state, ignored + skipped.astype(jnp.int32)
 
-        (state, ignored), _ = jax.lax.scan(body, (state, jnp.int32(0)), records)
+        if cfg.early_exit:
+            # Stop at trailing padding (REC_NONE) or a finished lane; under
+            # vmap the cond is OR-reduced, so the batch runs only as long
+            # as the longest live candidate — minimization candidates
+            # shrink far below the shared static record shape.
+            n_rec = records.shape[0]
+
+            def cond(carry):
+                s, _ig, i = carry
+                kind = records[jnp.minimum(i, n_rec - 1), 0]
+                return (i < n_rec) & (kind != REC_NONE) & (s.status < ST_DONE)
+
+            def wl_body(carry):
+                s, ig, i = carry
+                rec = records[jnp.minimum(i, n_rec - 1)]
+                s, ig = apply_one(s, ig, rec)
+                return (s, ig, i + 1)
+
+            state, ignored, _ = jax.lax.while_loop(
+                cond, wl_body, (state, jnp.int32(0), jnp.int32(0))
+            )
+        else:
+            def body(carry, rec):
+                state, ignored = carry
+                state, ignored = apply_one(state, ignored, rec)
+                return (state, ignored), None
+
+            (state, ignored), _ = jax.lax.scan(
+                body, (state, jnp.int32(0)), records
+            )
         # Aborted lanes (overflow) must not report a verdict computed from
         # truncated state — mask their violation to 0 so batched-oracle
         # consumers reading only `violation` never count them as

@@ -177,3 +177,98 @@ def test_pool_overflow_flags_lane():
     progs = stack_programs([lower_program(app, cfg, program)])
     res = kernel(progs, jax.random.split(jax.random.PRNGKey(0), 1))
     assert int(res.status[0]) == ST_OVERFLOW
+
+
+def test_early_exit_matches_scan_results():
+    """early_exit (while_loop) produces bit-identical lane results to the
+    fixed-length scan — it only changes how long the loop runs."""
+    import dataclasses
+
+    import numpy as np
+    import jax
+
+    from demi_tpu.apps.broadcast import make_broadcast_app
+    from demi_tpu.apps.common import dsl_start_events
+    from demi_tpu.device import DeviceConfig, make_explore_kernel
+    from demi_tpu.device.encoding import lower_program, stack_programs
+    from demi_tpu.external_events import Kill, MessageConstructor, Send, WaitQuiescence
+
+    app = make_broadcast_app(4, reliable=False)
+    cfg = DeviceConfig.for_app(
+        app, pool_capacity=64, max_steps=96, max_external_ops=16,
+        invariant_interval=1,
+    )
+    program = dsl_start_events(app) + [
+        Send(app.actor_name(0), MessageConstructor(lambda: (1, 0))),
+        Kill(app.actor_name(1)),
+        WaitQuiescence(),
+    ]
+    B = 64
+    progs = stack_programs([lower_program(app, cfg, program)] * B)
+    keys = jax.random.split(jax.random.PRNGKey(3), B)
+    scan_res = make_explore_kernel(app, cfg)(progs, keys)
+    wl_cfg = dataclasses.replace(cfg, early_exit=True)
+    wl_res = make_explore_kernel(app, wl_cfg)(progs, keys)
+    for field in ("status", "violation", "deliveries"):
+        assert np.array_equal(
+            np.asarray(getattr(scan_res, field)),
+            np.asarray(getattr(wl_res, field)),
+        ), field
+
+
+def test_replay_early_exit_matches_scan_results():
+    """The replay kernel's early-exit path (the minimization default via
+    default_device_config) is verdict-identical to the scan path across a
+    batch of variable-length candidates."""
+    import dataclasses
+
+    import numpy as np
+    import jax
+
+    from demi_tpu.apps.common import dsl_start_events, make_host_invariant
+    from demi_tpu.apps.raft import make_raft_app
+    from demi_tpu.config import SchedulerConfig
+    from demi_tpu.device import DeviceConfig
+    from demi_tpu.device.encoding import lower_expected_trace
+    from demi_tpu.device.replay import make_replay_kernel
+    from demi_tpu.external_events import WaitQuiescence
+    from demi_tpu.minimization.internal import (
+        remove_delivery,
+        removable_delivery_indices,
+    )
+    from demi_tpu.schedulers import RandomScheduler
+
+    app = make_raft_app(3, bug="multivote")
+    config = SchedulerConfig(invariant_check=make_host_invariant(app))
+    program = dsl_start_events(app) + [WaitQuiescence()]
+    found = None
+    for seed in range(30):
+        r = RandomScheduler(config, seed=seed, max_messages=120,
+                            invariant_check_interval=1).execute(program)
+        if r.violation is not None:
+            found = r
+            break
+    assert found is not None
+
+    cfg = DeviceConfig.for_app(
+        app, pool_capacity=192, max_steps=200, max_external_ops=16,
+        invariant_interval=1,
+    )
+    # Variable-length candidates: the full trace + several single-removals.
+    candidates = [found.trace]
+    for idx in removable_delivery_indices(found.trace)[:6]:
+        candidates.append(remove_delivery(found.trace, idx))
+    records = np.stack([
+        lower_expected_trace(app, cfg, c, program, 216) for c in candidates
+    ])
+    keys = jax.random.split(jax.random.PRNGKey(0), len(candidates))
+
+    scan_res = make_replay_kernel(app, cfg)(records, keys)
+    wl_res = make_replay_kernel(
+        app, dataclasses.replace(cfg, early_exit=True)
+    )(records, keys)
+    for field in ("status", "violation", "deliveries", "ignored_absent"):
+        assert np.array_equal(
+            np.asarray(getattr(scan_res, field)),
+            np.asarray(getattr(wl_res, field)),
+        ), field
