@@ -189,8 +189,10 @@ def lower_expected_trace(
         raise ValueError(f"expected trace has {len(recs)} records > {max_records}")
     # Records are compact (no mid-sequence REC_NONE holes): the replay
     # kernel's early-exit path terminates at the first zero-kind record,
-    # which must therefore only ever be trailing padding.
-    assert all(r[0] != 0 for r in recs), "REC_NONE hole in expected trace"
+    # which must therefore only ever be trailing padding. (ValueError, not
+    # assert: this guard must survive python -O.)
+    if any(r[0] == 0 for r in recs):
+        raise ValueError("REC_NONE hole in expected trace records")
     # Rows are kind/a/b/msg; right-pad to the cfg's record width (a
     # record_parents cfg has a trailing parent column, zero here).
     out = np.zeros((max_records, cfg.rec_width), np.int32)

@@ -74,23 +74,19 @@ def analysis_native_available() -> bool:
 
 
 def _py_racing_pairs(recs: np.ndarray) -> np.ndarray:
+    """Same semantics as the C++ scan: (i, j) both deliveries, same
+    receiver, creator(j) < i. Co-enabledness needs no explicit
+    happens-before test here — see native/trace_analysis.cpp's header for
+    the derivation (causal pasts only contain positions below
+    creator(j) < i, so the branch-point delivery can never be in m_j's)."""
     n, w = recs.shape
     parent_col = w - 1
     is_delivery = np.isin(recs[:, 0], _delivery_kinds())
     positions = np.nonzero(is_delivery)[0]
-    anc = {}
-    for pos in range(n):
-        p = int(recs[pos, parent_col]) if is_delivery[pos] else -1
-        if p < 0 or p >= pos:
-            anc[pos] = 0
-        else:
-            anc[pos] = anc.get(p, 0) | (1 << p)
     out = []
     for ii, i in enumerate(positions):
         for j in positions[ii + 1:]:
             if recs[i, 2] != recs[j, 2]:
-                continue
-            if (anc[int(j)] >> int(i)) & 1:
                 continue
             if int(recs[j, parent_col]) >= int(i):
                 continue

@@ -1,21 +1,28 @@
 // Racing-pair scan over parent-tracked device trace records — the host
 // side of batched device DPOR (demi_tpu/device/dpor_sweep.py). Mirrors the
-// reference's O(n^2) co-enabled pair scan (DPORwHeuristics.scala:1122-1139)
-// over the record encoding:
+// reference's co-enabled pair scan (DPORwHeuristics.scala:1122-1139) over
+// the record encoding:
 //
 //   record row (int32 x rec_width): kind, a, b, msg..., parent
 //   kind 1 = message delivery (a=src, b=dst), kind 2 = timer (a=b=dst);
 //   parent = trace index of the record that created this message (-1 none).
 //
-// Pair (i, j), i < j, qualifies iff both are delivery kinds, same receiver,
-// i is NOT on j's creation-ancestor chain (concurrent), and j's creating
-// record precedes i (the flipped message already existed at i).
+// Pair (i, j), i < j, qualifies iff both are delivery kinds, same
+// receiver, and j's creating record precedes i (the flipped message was
+// already pending at the branch point).
 //
-// Ancestry is a bitset per record over trace positions, built by one
-// forward pass: anc[pos] = anc[parent] | bit(parent).
+// Why no explicit happens-before test: the prescription scheme flips j to
+// the position of i, which requires m_j pending at i, i.e. creator(j) < i.
+// Happens-before closures only ever contain positions strictly below the
+// event (parents and program-order predecessors precede their successors
+// in the trace), so everything in m_j's causal past lies below
+// creator(j) < i — the branch-point delivery i can never be in it.
+// Co-enabledness is therefore implied by the creator(j) < i check; the
+// reference needs the explicit graph-path query only because its
+// backtracks are expressed over event IDs rather than trace positions.
 
+#include <cstddef>
 #include <cstdint>
-#include <cstring>
 #include <vector>
 
 namespace {
@@ -30,21 +37,10 @@ int64_t demi_racing_pairs(const int32_t* recs, int64_t n, int64_t w,
                           int32_t* out, int64_t max_pairs) {
     if (n <= 0 || w < 4) return 0;
     const int64_t parent_col = w - 1;
-    const int64_t words = (n + 63) / 64;
-    std::vector<uint64_t> anc(static_cast<size_t>(n * words), 0);
     std::vector<int64_t> deliveries;
     deliveries.reserve(static_cast<size_t>(n));
     for (int64_t pos = 0; pos < n; ++pos) {
-        const int32_t kind = recs[pos * w];
-        if (!is_delivery(kind)) continue;
-        deliveries.push_back(pos);
-        const int64_t p = recs[pos * w + parent_col];
-        if (p >= 0 && p < pos) {
-            uint64_t* dst = &anc[pos * words];
-            const uint64_t* src = &anc[p * words];
-            for (int64_t k = 0; k < words; ++k) dst[k] = src[k];
-            dst[p / 64] |= (uint64_t(1) << (p % 64));
-        }
+        if (is_delivery(recs[pos * w])) deliveries.push_back(pos);
     }
     int64_t count = 0;
     for (size_t ii = 0; ii < deliveries.size(); ++ii) {
@@ -53,7 +49,6 @@ int64_t demi_racing_pairs(const int32_t* recs, int64_t n, int64_t w,
         for (size_t jj = ii + 1; jj < deliveries.size(); ++jj) {
             const int64_t j = deliveries[jj];
             if (recs[j * w + 2] != rcv_i) continue;  // same receiver only
-            if (anc[j * words + i / 64] >> (i % 64) & 1) continue;  // i hb j
             const int64_t cj = recs[j * w + parent_col];
             if (cj >= i) continue;  // j's message didn't exist yet at i
             if (count < max_pairs) {

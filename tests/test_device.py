@@ -196,7 +196,7 @@ def test_early_exit_matches_scan_results():
     app = make_broadcast_app(4, reliable=False)
     cfg = DeviceConfig.for_app(
         app, pool_capacity=64, max_steps=96, max_external_ops=16,
-        invariant_interval=1,
+        invariant_interval=1, record_trace=True,
     )
     program = dsl_start_events(app) + [
         Send(app.actor_name(0), MessageConstructor(lambda: (1, 0))),
@@ -209,7 +209,7 @@ def test_early_exit_matches_scan_results():
     scan_res = make_explore_kernel(app, cfg)(progs, keys)
     wl_cfg = dataclasses.replace(cfg, early_exit=True)
     wl_res = make_explore_kernel(app, wl_cfg)(progs, keys)
-    for field in ("status", "violation", "deliveries"):
+    for field in ("status", "violation", "deliveries", "trace", "trace_len"):
         assert np.array_equal(
             np.asarray(getattr(scan_res, field)),
             np.asarray(getattr(wl_res, field)),
