@@ -11,13 +11,16 @@ Extra keys reported for the record:
   - time_to_first_violation_s: wall-clock for the device sweep to find the
     first violation on the unreliable-broadcast fixture (BASELINE.md's
     other headline metric).
+  - config4: BASELINE config 4 — Spark DAGScheduler fuzz sweep with the
+    job-completion invariant on the seeded stale_task bug
+    (schedules/sec + violations found).
   - config5: BASELINE config 5 — 64-actor reliable broadcast sweep
     (schedules/sec + lanes swept; 1M lanes on TPU, smaller on CPU
     fallback; override with DEMI_BENCH_CONFIG5_LANES).
   - platform: the JAX platform the numbers were measured on.
 
-Modes: `python bench.py` runs everything; `--config 5` runs only the
-64-actor sweep (prints the same one-line JSON with config5 populated).
+Modes: `python bench.py` runs everything; `--config 4` / `--config 5`
+run a single section (same one-line JSON with that key populated).
 """
 
 import argparse
@@ -134,6 +137,44 @@ def bench_time_to_first_violation(jax):
     return secs
 
 
+def bench_config4(jax):
+    """BASELINE config 4: Spark DAGScheduler fuzz, job-completion
+    invariant — device sweep throughput + violation count on the seeded
+    stale_task bug."""
+    from demi_tpu.apps.common import dsl_start_events
+    from demi_tpu.apps.spark_dag import T_SUBMIT, make_spark_app
+    from demi_tpu.device import DeviceConfig, make_explore_kernel
+    from demi_tpu.device.encoding import lower_program, stack_programs
+    from demi_tpu.external_events import MessageConstructor, Send, WaitQuiescence
+
+    app = make_spark_app(
+        num_workers=3, num_stages=2, tasks_per_stage=4, bug="stale_task"
+    )
+    cfg = DeviceConfig.for_app(
+        app, pool_capacity=128, max_steps=200, max_external_ops=8,
+        invariant_interval=1, early_exit=True,
+    )
+    program = dsl_start_events(app) + [
+        Send(app.actor_name(0), MessageConstructor(lambda: (T_SUBMIT, 0, 0))),
+        WaitQuiescence(),
+    ]
+    platform = jax.devices()[0].platform
+    batch = 2048 if platform not in ("cpu",) else 256
+    kernel = make_explore_kernel(app, cfg)
+    progs = stack_programs([lower_program(app, cfg, program)] * batch)
+    warm = kernel(progs, jax.random.split(jax.random.PRNGKey(99), batch))
+    jax.block_until_ready(warm)  # async dispatch must not leak into timing
+    t0 = time.perf_counter()
+    res = kernel(progs, jax.random.split(jax.random.PRNGKey(0), batch))
+    violations = int((np.asarray(res.violation) != 0).sum())
+    secs = time.perf_counter() - t0
+    return {
+        "lanes": batch,
+        "schedules_per_sec": round(batch / secs, 1),
+        "violations": violations,
+    }
+
+
 def bench_config5(jax, total_lanes=None):
     """BASELINE config 5: 64-actor reliable broadcast schedule sweep."""
     from demi_tpu.apps.broadcast import make_broadcast_app
@@ -199,7 +240,7 @@ def bench_config5(jax, total_lanes=None):
 def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--config", type=int, default=None,
-                        help="run only one BASELINE config (5 supported)")
+                        help="run only one BASELINE config (4 or 5)")
     args = parser.parse_args()
 
     from demi_tpu._axon_guard import reexec_on_wedge
@@ -220,6 +261,12 @@ def main():
         "unit": "schedules/sec",
         "platform": platform,
     }
+    if args.config == 4:
+        out["config4"] = bench_config4(jax)
+        out["value"] = out["config4"]["schedules_per_sec"]
+        out["vs_baseline"] = round(out["value"] / 10_000.0, 3)
+        print(json.dumps(out))
+        return
     if args.config == 5:
         out["config5"] = bench_config5(jax)
         out["value"] = out["config5"]["schedules_per_sec"]
@@ -230,6 +277,7 @@ def main():
     value = bench_device_raft(jax)
     host = bench_host_raft()
     ttfv = bench_time_to_first_violation(jax)
+    config4 = bench_config4(jax)
     config5 = bench_config5(jax)
     out.update(
         {
@@ -242,6 +290,7 @@ def main():
             "time_to_first_violation_s": (
                 round(ttfv, 3) if ttfv is not None else None
             ),
+            "config4": config4,
             "config5": config5,
         }
     )
