@@ -263,6 +263,24 @@ def cmd_dpor(args) -> int:
     return 0 if trace is not None else 1
 
 
+def cmd_shiviz(args) -> int:
+    """Export a saved experiment's trace for the ShiViz visualizer
+    (reference: RunnerUtils.visualizeDeliveries, RunnerUtils.scala:1341)."""
+    from .serialization import ExperimentDeserializer
+    from .utils.shiviz import trace_to_shiviz, write_shiviz
+
+    app = build_app(args)
+    de = ExperimentDeserializer(args.experiment, app)
+    externals = de.get_externals()
+    trace = de.get_trace(externals)
+    if args.output:
+        write_shiviz(trace, args.output)
+        print(f"ShiViz log written to {args.output}")
+    else:
+        print(trace_to_shiviz(trace))
+    return 0
+
+
 def cmd_interactive(args) -> int:
     from .schedulers.interactive import InteractiveScheduler
 
@@ -344,6 +362,12 @@ def main(argv: Optional[list] = None) -> int:
     p.add_argument("--pool", type=int, default=256)
     p.add_argument("--rounds", type=int, default=10)
     p.set_defaults(fn=cmd_dpor)
+
+    p = sub.add_parser("shiviz", help="export an experiment trace for ShiViz")
+    common(p)
+    p.add_argument("-e", "--experiment", required=True)
+    p.add_argument("-o", "--output", default=None)
+    p.set_defaults(fn=cmd_shiviz)
 
     p = sub.add_parser("interactive", help="hand-drive a schedule")
     common(p)

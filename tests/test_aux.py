@@ -214,6 +214,14 @@ def test_cli_fuzz_minimize_replay(tmp_path):
               "-e", exp])
         == 0
     )
+    out = str(tmp_path / "trace.shiviz")
+    assert (
+        main(["shiviz", "--app", "broadcast", "--nodes", "3", "--bug", "x",
+              "-e", exp, "-o", out])
+        == 0
+    )
+    with open(out) as f:
+        assert "deliver" in f.read()
 
 
 def test_cli_sweep(tmp_path, capsys):
