@@ -51,7 +51,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..dsl import DSLApp
+from ..dsl import DSLApp, row_set, vgather, vget, vset
 from .common import DSLSendGenerator
 
 # Message tags.
@@ -115,7 +115,7 @@ def make_raft_app(
     def log_term_at(state, idx):
         """Term of log entry idx; 0 when idx == -1 (empty prefix)."""
         safe = jnp.clip(idx, 0, log_cap - 1)
-        t = state[LOG_START + 2 * safe]
+        t = vget(state, LOG_START + 2 * safe)
         return jnp.where(idx < 0, jnp.int32(0), t)
 
     def last_log(state):
@@ -140,7 +140,7 @@ def make_raft_app(
         row = jnp.stack(
             [jnp.asarray(valid, jnp.int32), dst, tag, term, a, b, c, d, e]
         ).astype(jnp.int32)
-        return outbox.at[slot].set(jnp.where(valid, row, outbox[slot]))
+        return row_set(outbox, slot, row, valid)
 
     def maybe_step_down(state, term):
         """Adopt a newer term as follower (votes + leader hint cleared)."""
@@ -161,12 +161,16 @@ def make_raft_app(
         prev_idx = next_idx - 1
         safe_prev = jnp.clip(prev_idx, 0, log_cap - 1)
         prev_term = jnp.where(
-            prev_idx < 0, 0, state[LOG_START + 2 * safe_prev]
+            prev_idx < 0, 0, vgather(state, LOG_START + 2 * safe_prev)
         )
         has_entry = next_idx < state[LOG_LEN]
         safe_next = jnp.clip(next_idx, 0, log_cap - 1)
-        ent_term = jnp.where(has_entry, state[LOG_START + 2 * safe_next], 0)
-        ent_val = jnp.where(has_entry, state[LOG_START + 2 * safe_next + 1], 0)
+        ent_term = jnp.where(
+            has_entry, vgather(state, LOG_START + 2 * safe_next), 0
+        )
+        ent_val = jnp.where(
+            has_entry, vgather(state, LOG_START + 2 * safe_next + 1), 0
+        )
         valid = (dsts != actor_id).astype(jnp.int32)
         zeros = jnp.zeros(n, jnp.int32)
         return jnp.stack(
@@ -202,7 +206,7 @@ def make_raft_app(
         st = jax.lax.dynamic_update_slice(
             st, jnp.full((n,), st[LOG_LEN], jnp.int32), (NEXT,)
         )
-        match = jnp.full((n,), -1, jnp.int32).at[actor_id].set(st[LOG_LEN] - 1)
+        match = vset(jnp.full((n,), -1, jnp.int32), actor_id, st[LOG_LEN] - 1)
         st = jax.lax.dynamic_update_slice(st, match, (MATCH,))
         return st
 
@@ -297,12 +301,8 @@ def make_raft_app(
         existing_term = log_term_at(state, write_idx)
         conflict = had_existing & (existing_term != ent_term)
         safe_w = jnp.clip(write_idx, 0, log_cap - 1)
-        state = state.at[LOG_START + 2 * safe_w].set(
-            jnp.where(can_write, ent_term, state[LOG_START + 2 * safe_w])
-        )
-        state = state.at[LOG_START + 2 * safe_w + 1].set(
-            jnp.where(can_write, ent_val, state[LOG_START + 2 * safe_w + 1])
-        )
+        state = vset(state, LOG_START + 2 * safe_w, ent_term, can_write)
+        state = vset(state, LOG_START + 2 * safe_w + 1, ent_val, can_write)
         state = state.at[LOG_LEN].set(
             jnp.where(
                 can_write,
@@ -338,21 +338,23 @@ def make_raft_app(
         matches = jax.lax.dynamic_slice(state, (MATCH,), (n,))
         ok = relevant & (success != 0)
         fail = relevant & (success == 0)
-        new_match = jnp.maximum(matches[snd], match_idx)
-        matches = matches.at[snd].set(jnp.where(ok, new_match, matches[snd]))
-        nexts = nexts.at[snd].set(
-            jnp.where(ok, new_match + 1, jnp.maximum(nexts[snd] - 1, 0))
+        prev_match = vget(matches, snd)
+        new_match = jnp.maximum(prev_match, match_idx)
+        matches = vset(matches, snd, new_match, ok)
+        nexts = vset(
+            nexts, snd,
+            jnp.where(ok, new_match + 1, jnp.maximum(vget(nexts, snd) - 1, 0)),
         )
         nexts = jnp.where(relevant, nexts, jax.lax.dynamic_slice(state, (NEXT,), (n,)))
         state = jax.lax.dynamic_update_slice(state, nexts, (NEXT,))
         state = jax.lax.dynamic_update_slice(state, matches, (MATCH,))
         # Commit advancement: highest i with log_term[i]==term replicated on
         # a majority. (bug="stale_commit": self counted twice.)
-        matches = jax.lax.dynamic_update_slice(
-            matches, jnp.asarray([state[LOG_LEN] - 1]), (actor_id,)
-        )
+        matches = vset(matches, actor_id, state[LOG_LEN] - 1)
         idxs = jnp.arange(log_cap, dtype=jnp.int32)
-        terms = state[LOG_START + 2 * idxs]
+        terms = state[LOG_START : LOG_START + 2 * log_cap].reshape(
+            log_cap, 2
+        )[:, 0]
         repl_count = jnp.sum(
             (matches[None, :] >= idxs[:, None]).astype(jnp.int32), axis=1
         )
@@ -373,22 +375,13 @@ def make_raft_app(
         value = msg[2]
         can = (state[ROLE] == LEADER) & (state[LOG_LEN] < log_cap)
         idx = jnp.clip(state[LOG_LEN], 0, log_cap - 1)
-        state = state.at[LOG_START + 2 * idx].set(
-            jnp.where(can, state[TERM], state[LOG_START + 2 * idx])
-        )
-        state = state.at[LOG_START + 2 * idx + 1].set(
-            jnp.where(can, value, state[LOG_START + 2 * idx + 1])
-        )
+        state = vset(state, LOG_START + 2 * idx, state[TERM], can)
+        state = vset(state, LOG_START + 2 * idx + 1, value, can)
         state = state.at[LOG_LEN].set(
             jnp.where(can, state[LOG_LEN] + 1, state[LOG_LEN])
         )
         # Leader's own match_index tracks its log.
-        own_match = jax.lax.dynamic_slice(state, (MATCH + actor_id,), (1,))
-        state = jax.lax.dynamic_update_slice(
-            state,
-            jnp.where(can, jnp.asarray([state[LOG_LEN] - 1]), own_match),
-            (MATCH + actor_id,),
-        )
+        state = vset(state, MATCH + actor_id, state[LOG_LEN] - 1, can)
         # Replicate eagerly (standard Raft): AppendEntries go out on append,
         # not only on the next heartbeat timer.
         out = jnp.where(

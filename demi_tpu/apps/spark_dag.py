@@ -27,7 +27,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..dsl import DSLApp
+from ..dsl import DSLApp, vget, vset
 from .common import DSLSendGenerator
 
 T_SUBMIT = 1
@@ -86,10 +86,8 @@ def make_spark_app(
         is_worker = actor_id != 0
         safe_stage = jnp.clip(stage, 0, S - 1)
         bit = jnp.where((task >= 0) & (task < T), jnp.int32(1) << task, 0)
-        new_mask = state[MASKS + safe_stage] | bit
-        state = state.at[MASKS + safe_stage].set(
-            jnp.where(is_worker, new_mask, state[MASKS + safe_stage])
-        )
+        new_mask = vget(state, MASKS + safe_stage) | bit
+        state = vset(state, MASKS + safe_stage, new_mask, is_worker)
         out = jnp.zeros((max_outbox, 2 + MSG_W), jnp.int32)
         row = jnp.stack(
             [jnp.int32(1), jnp.int32(0), jnp.int32(T_DONE), stage, task]
@@ -110,8 +108,8 @@ def make_spark_app(
             relevant = is_master & running & (stage == cur)
         safe_cur = jnp.clip(cur, 0, S - 1)
         bit = jnp.where((task >= 0) & (task < T), jnp.int32(1) << task, 0)
-        mask = state[MASKS + safe_cur] | jnp.where(relevant, bit, 0)
-        state = state.at[MASKS + safe_cur].set(mask)
+        mask = vget(state, MASKS + safe_cur) | jnp.where(relevant, bit, 0)
+        state = vset(state, MASKS + safe_cur, mask)
         stage_complete = relevant & (mask == full_mask)
         next_stage = cur + 1
         state = state.at[CUR].set(jnp.where(stage_complete, next_stage, cur))

@@ -33,6 +33,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..dsl import DSLApp
+from . import ops
 
 # External-op codes (device program encoding of ExternalEvents; WaitCondition
 # and CodeBlock are host-tier-only features — see demi_tpu/dsl.py docstring).
@@ -89,6 +90,23 @@ class DeviceConfig:
     # sweeps); ~9% loop overhead when every lane runs the full budget —
     # hence opt-in.
     early_exit: bool = False
+    # Dynamic-index strategy for the kernels (see device/ops.py): 'auto'
+    # uses one-hot compare+where on TPU (vmapped scatters serialize there)
+    # and native gathers/scatters elsewhere; 'onehot'/'scatter' force.
+    index_mode: str = "auto"
+
+    def __post_init__(self):
+        if self.index_mode not in ("auto", "onehot", "scatter"):
+            raise ValueError(
+                f"index_mode must be 'auto', 'onehot' or 'scatter', "
+                f"got {self.index_mode!r}"
+            )
+
+    @property
+    def use_onehot(self) -> bool:
+        if self.index_mode == "auto":
+            return jax.default_backend() == "tpu"
+        return self.index_mode == "onehot"
 
     @property
     def rec_width(self) -> int:
@@ -187,13 +205,18 @@ def deliverable_mask(state: ScheduleState, cfg: DeviceConfig) -> jnp.ndarray:
     """Which pool entries could be delivered right now. Mirrors the host
     ControlledActorSystem.deliverable predicate exactly."""
     n = cfg.num_actors
+    oh = cfg.use_onehot
     dst = state.pool_dst
     src = state.pool_src
-    dst_ok = state.started[dst] & ~state.stopped[dst]
-    dst_reachable = ~state.isolated[dst]
+    dst_ok = ops.gather_vec(state.started, dst, oh) & ~ops.gather_vec(
+        state.stopped, dst, oh
+    )
+    dst_reachable = ~ops.gather_vec(state.isolated, dst, oh)
     src_is_external = src >= n
     src_clamped = jnp.minimum(src, n - 1)
-    link_cut = state.cut[src_clamped, dst] | state.isolated[src_clamped]
+    link_cut = ops.gather_mat(state.cut, src_clamped, dst, oh) | ops.gather_vec(
+        state.isolated, src_clamped, oh
+    )
     # timers/externals only need the receiver un-isolated; internal messages
     # must not cross a partition (either endpoint isolated or link cut).
     passes_network = jnp.where(
@@ -230,14 +253,37 @@ def insert_rows(
     prefix = jnp.cumsum(free.astype(jnp.int32))
     want = jnp.cumsum(row_valid.astype(jnp.int32))  # i-th valid row wants want[i]-th free slot
     # slot index for each row: first index where prefix == want[i] and free
-    slots = jnp.searchsorted(prefix, want, side="left")  # [K]
+    slots = ops.rank_slots(prefix, want, cfg.use_onehot)  # [K]
     n_free = prefix[-1]
     overflow = jnp.any(row_valid & (want > n_free))
     ok = row_valid & (want <= n_free)
-    slots = jnp.where(ok, slots, cfg.pool_capacity)  # out-of-range => dropped
 
     seqs = state.seq_counter + want  # arrival order follows row order
     k = row_valid.shape[0]
+    if cfg.use_onehot:
+        oh_kp = ok[:, None] & (
+            slots[:, None] == jnp.arange(cfg.pool_capacity)[None, :]
+        )  # [K, P] — at most one True per column (slots strictly increase)
+        hit = jnp.any(oh_kp, axis=0)
+        new_state = state._replace(
+            pool_valid=state.pool_valid | hit,
+            pool_src=ops.scatter_vec_int(state.pool_src, oh_kp, row_src),
+            pool_dst=ops.scatter_vec_int(state.pool_dst, oh_kp, row_dst),
+            pool_timer=ops.scatter_vec_bool(state.pool_timer, oh_kp, row_timer),
+            pool_parked=ops.scatter_vec_bool(
+                state.pool_parked, oh_kp, row_parked
+            ),
+            pool_msg=ops.scatter_rows_int(state.pool_msg, oh_kp, row_msg),
+            pool_seq=ops.scatter_vec_int(state.pool_seq, oh_kp, seqs),
+            seq_counter=state.seq_counter + want[-1],
+            status=jnp.where(overflow, jnp.int32(ST_OVERFLOW), state.status),
+        )
+        if crec is not None:
+            new_state = new_state._replace(
+                pool_crec=jnp.where(hit, crec, state.pool_crec)
+            )
+        return new_state
+    slots = jnp.where(ok, slots, cfg.pool_capacity)  # out-of-range => dropped
     new_state = state._replace(
         pool_valid=state.pool_valid.at[slots].set(True, mode="drop"),
         pool_src=state.pool_src.at[slots].set(row_src, mode="drop"),
@@ -298,15 +344,16 @@ def delivery_effects(
     ``idx`` must point at a deliverable entry; an invalid index
     (== pool_capacity) makes the whole pass a no-op."""
     n = cfg.num_actors
+    oh = cfg.use_onehot
     valid_idx = idx < cfg.pool_capacity
     safe_idx = jnp.minimum(idx, cfg.pool_capacity - 1)
-    src = state.pool_src[safe_idx]
-    dst = state.pool_dst[safe_idx]
-    msg = state.pool_msg[safe_idx]
-    is_timer = state.pool_timer[safe_idx]
-    parent_rec = state.pool_crec[safe_idx]
+    src = ops.get_scalar(state.pool_src, safe_idx, oh)
+    dst = ops.get_scalar(state.pool_dst, safe_idx, oh)
+    msg = ops.get_row(state.pool_msg, safe_idx, oh)
+    is_timer = ops.get_scalar(state.pool_timer, safe_idx, oh)
+    parent_rec = ops.get_scalar(state.pool_crec, safe_idx, oh)
 
-    handler_state = state.actor_state[dst]
+    handler_state = ops.get_row(state.actor_state, dst, oh)
     new_row, outbox = app.handler(dst, handler_state, src, msg)
     # outbox: [K, 2+W] (valid, dst, msg...)
     k = outbox.shape[0]
@@ -322,18 +369,20 @@ def delivery_effects(
         is_timer_tag = jnp.zeros(k, bool)
     ob_timer = is_timer_tag & (ob_dst == dst)
     # Park re-armed copies of the remembered timer (loop avoidance).
-    mem_match = jnp.all(ob_msg == state.timer_mem[ob_dst], axis=1) & state.timer_mem_valid[ob_dst]
+    mem_match = jnp.all(
+        ob_msg == ops.gather_rows(state.timer_mem, ob_dst, oh), axis=1
+    ) & ops.gather_vec(state.timer_mem_valid, ob_dst, oh)
     ob_parked = ob_timer & mem_match
 
     # Apply handler effects only when the delivery really happened.
-    new_actor_state = state.actor_state.at[dst].set(
-        jnp.where(valid_idx, new_row, handler_state)
+    new_actor_state = ops.set_row(
+        state.actor_state, dst, new_row, valid_idx, oh
     )
     # Consume the entry.
     state = state._replace(
         actor_state=new_actor_state,
-        pool_valid=state.pool_valid.at[safe_idx].set(
-            jnp.where(valid_idx, False, state.pool_valid[safe_idx])
+        pool_valid=ops.set_scalar(
+            state.pool_valid, safe_idx, False, valid_idx, oh
         ),
         deliveries=state.deliveries + valid_idx.astype(jnp.int32),
     )
@@ -343,19 +392,16 @@ def delivery_effects(
     # justScheduledTimers cleared + timersToResend flushed on non-timer
     # delivery, RandomScheduler.scala:100-117).
     delivered_timer = is_timer & valid_idx
+    cleared = valid_idx & ~is_timer
     timer_mem = jnp.where(
-        delivered_timer,
-        state.timer_mem.at[dst].set(msg),
-        jnp.where(valid_idx & ~is_timer, jnp.zeros_like(state.timer_mem), state.timer_mem),
+        cleared,
+        jnp.zeros_like(state.timer_mem),
+        ops.set_row(state.timer_mem, dst, msg, delivered_timer, oh),
     )
     timer_mem_valid = jnp.where(
-        delivered_timer,
-        state.timer_mem_valid.at[dst].set(True),
-        jnp.where(
-            valid_idx & ~is_timer,
-            jnp.zeros_like(state.timer_mem_valid),
-            state.timer_mem_valid,
-        ),
+        cleared,
+        jnp.zeros_like(state.timer_mem_valid),
+        ops.set_scalar(state.timer_mem_valid, dst, True, delivered_timer, oh),
     )
     pool_parked = jnp.where(
         valid_idx & ~is_timer, jnp.zeros_like(state.pool_parked), state.pool_parked
@@ -395,9 +441,7 @@ def deliver_index(
 
 def _append_record(state: ScheduleState, cfg: DeviceConfig, rec, enabled) -> ScheduleState:
     pos = jnp.minimum(state.trace_len, cfg.max_steps - 1)
-    new_trace = state.trace.at[pos].set(
-        jnp.where(enabled, rec, state.trace[pos])
-    )
+    new_trace = ops.set_row(state.trace, pos, rec, enabled, cfg.use_onehot)
     return state._replace(
         trace=new_trace, trace_len=state.trace_len + enabled.astype(jnp.int32)
     )
@@ -423,6 +467,7 @@ def external_effects(
     (Start's initial messages + Send's external message), the trace record,
     and its enabled flag. Pass OP_END to make the whole pass a no-op."""
     n = cfg.num_actors
+    oh = cfg.use_onehot
     a_c = jnp.clip(a, 0, n - 1)
     b_c = jnp.clip(b, 0, n - 1)
 
@@ -433,29 +478,40 @@ def external_effects(
     is_partition = op == OP_PARTITION
     is_unpartition = op == OP_UNPARTITION
 
-    was_started = state.started[a_c]
-    was_stopped = state.stopped[a_c]
+    was_started = ops.get_scalar(state.started, a_c, oh)
+    was_stopped = ops.get_scalar(state.stopped, a_c, oh)
     # Fresh start = first Start or restart after HardKill; a Start for a
     # merely isolated actor is recovery (un-isolate, keep state, no re-emit)
     # — host semantics: ControlledActorSystem.spawn.
     fresh_start = is_start & (~was_started | was_stopped)
     # Start: begin (or recover) actor a.
-    started = state.started.at[a_c].set(
-        jnp.where(is_start, True, state.started[a_c])
+    started = ops.set_scalar(state.started, a_c, True, is_start, oh)
+    isolated = ops.set_scalar(
+        state.isolated, a_c, is_kill, is_start | is_kill, oh
     )
-    isolated = state.isolated.at[a_c].set(
-        jnp.where(is_start, False, jnp.where(is_kill, True, state.isolated[a_c]))
-    )
-    stopped = state.stopped.at[a_c].set(
-        jnp.where(is_start, False, jnp.where(is_hardkill, True, state.stopped[a_c]))
+    stopped = ops.set_scalar(
+        state.stopped, a_c, is_hardkill, is_start | is_hardkill, oh
     )
     # Start after HardKill resets app state.
-    actor_state = state.actor_state.at[a_c].set(
-        jnp.where(fresh_start, init_states[a_c], state.actor_state[a_c])
+    actor_state = ops.set_row(
+        state.actor_state, a_c, ops.get_row(init_states, a_c, oh),
+        fresh_start, oh,
     )
-    cut_val = jnp.where(is_partition, True, jnp.where(is_unpartition, False, state.cut[a_c, b_c]))
-    cut = state.cut.at[a_c, b_c].set(cut_val)
-    cut = cut.at[b_c, a_c].set(cut_val)
+    if oh:
+        oh_a = ops.onehot(a_c, n)
+        oh_b = ops.onehot(b_c, n)
+        sym = (oh_a[:, None] & oh_b[None, :]) | (oh_b[:, None] & oh_a[None, :])
+        cut = jnp.where(
+            sym & (is_partition | is_unpartition), is_partition, state.cut
+        )
+    else:
+        cut_val = jnp.where(
+            is_partition,
+            True,
+            jnp.where(is_unpartition, False, state.cut[a_c, b_c]),
+        )
+        cut = state.cut.at[a_c, b_c].set(cut_val)
+        cut = cut.at[b_c, a_c].set(cut_val)
 
     # HardKill scrub, branchless (the fused step can't afford a lax.cond
     # whose both sides run under vmap anyway).
@@ -470,7 +526,9 @@ def external_effects(
     # Send's external message, as one [K0+1]-row proposal.
     k0 = initial_rows.shape[1]
     if k0 > 0:
-        rows = initial_rows[a_c]
+        rows = ops.get_row(
+            initial_rows.reshape(n, -1), a_c, oh
+        ).reshape(k0, 2 + cfg.msg_width)
         r_valid = (rows[:, 0] != 0) & fresh_start
         r_dst = jnp.clip(rows[:, 1], 0, n - 1)
         r_msg = rows[:, 2:]

@@ -25,6 +25,7 @@ from ..config import SchedulerConfig
 from ..dsl import DSLApp
 from ..external_events import ExternalEvent
 from ..schedulers.dpor import arvind_distance
+from . import ops
 from .core import (
     REC_DELIVERY,
     REC_TIMER,
@@ -72,11 +73,15 @@ def make_dpor_kernel(app: DSLApp, cfg: DeviceConfig):
     def step(carry, presc, prog):
         state, cursor = carry
 
+        oh = cfg.use_onehot
+
         def prescribed_dispatch(state, cursor):
             # Skip past absent prescribed records to the first matchable one.
             def cond(c3):
                 c, idx, _ = c3
-                rec_kind = presc[jnp.minimum(c, r_max - 1), 0]
+                rec_kind = ops.get_scalar(
+                    presc[:, 0], jnp.minimum(c, r_max - 1), oh
+                )
                 in_range = (c < r_max) & (
                     (rec_kind == REC_DELIVERY) | (rec_kind == REC_TIMER)
                 )
@@ -84,7 +89,9 @@ def make_dpor_kernel(app: DSLApp, cfg: DeviceConfig):
 
             def body(c3):
                 c, _, skips = c3
-                idx = match_record(state, presc[jnp.minimum(c, r_max - 1)])
+                idx = match_record(
+                    state, ops.get_row(presc, jnp.minimum(c, r_max - 1), oh)
+                )
                 found = idx < cfg.pool_capacity
                 return (
                     jnp.where(found, c, c + 1),
@@ -115,7 +122,9 @@ def make_dpor_kernel(app: DSLApp, cfg: DeviceConfig):
             return new_state, jnp.where(found, c + 1, c), found
 
         in_dispatch = state.status == ST_DISPATCH
-        rec_kind = presc[jnp.minimum(cursor, r_max - 1), 0]
+        rec_kind = ops.get_scalar(
+            presc[:, 0], jnp.minimum(cursor, r_max - 1), oh
+        )
         presc_active = in_dispatch & (cursor < r_max) & (
             (rec_kind == REC_DELIVERY) | (rec_kind == REC_TIMER)
         )

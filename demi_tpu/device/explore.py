@@ -20,6 +20,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..dsl import DSLApp
+from . import ops
 from .core import (
     OP_END,
     OP_WAIT,
@@ -84,6 +85,7 @@ def make_step_fn(app: DSLApp, cfg: DeviceConfig):
     passes dominate step cost. Fusing removes a full insert pass and both
     cond selects."""
     init_states, initial_rows = _precomputed(app, cfg)
+    oh = cfg.use_onehot
 
     def step(state: ScheduleState, prog: ExtProgram) -> ScheduleState:
         # Frozen lanes (done/violation/overflow) need no outer guard: every
@@ -99,13 +101,17 @@ def make_step_fn(app: DSLApp, cfg: DeviceConfig):
         e = prog.op.shape[0]
         cur = jnp.clip(state.ext_cursor, 0, e - 1)
         exhausted = state.ext_cursor >= e
-        op = jnp.where(injecting & ~exhausted, prog.op[cur], OP_END)
+        cur_op = ops.get_scalar(prog.op, cur, oh)
+        op = jnp.where(injecting & ~exhausted, cur_op, OP_END)
         state, inj_rows, inj_rec, inj_enabled = external_effects(
             state, cfg, app, initial_rows, init_states,
-            op, prog.a[cur], prog.b[cur], prog.msg[cur],
+            op,
+            ops.get_scalar(prog.a, cur, oh),
+            ops.get_scalar(prog.b, cur, oh),
+            ops.get_row(prog.msg, cur, oh),
         )
         new_cursor = state.ext_cursor + (injecting & ~exhausted).astype(jnp.int32)
-        raw_op = jnp.where(exhausted, OP_END, prog.op[cur])
+        raw_op = jnp.where(exhausted, OP_END, cur_op)
         to_dispatch = injecting & (
             (raw_op == OP_WAIT) | (raw_op == OP_END) | (new_cursor >= e)
         )
@@ -117,7 +123,7 @@ def make_step_fn(app: DSLApp, cfg: DeviceConfig):
             injecting,
             jnp.where(
                 raw_op == OP_WAIT,
-                prog.a[cur],
+                ops.get_scalar(prog.a, cur, oh),
                 jnp.where(
                     (raw_op == OP_END) | (new_cursor >= e), 0, state.seg_budget
                 ),
@@ -129,7 +135,9 @@ def make_step_fn(app: DSLApp, cfg: DeviceConfig):
         # final if this op is OP_END / past-the-end, or a WAIT with nothing
         # but OP_END after it.
         next_cur = jnp.clip(new_cursor, 0, e - 1)
-        next_op = jnp.where(new_cursor >= e, OP_END, prog.op[next_cur])
+        next_op = jnp.where(
+            new_cursor >= e, OP_END, ops.get_scalar(prog.op, next_cur, oh)
+        )
         final_seg = to_dispatch & (
             (raw_op == OP_END)
             | (new_cursor >= e)
@@ -170,8 +178,7 @@ def make_step_fn(app: DSLApp, cfg: DeviceConfig):
             count = jnp.where(pick_timer, tcount, mcount)
         u = jax.random.uniform(sub)
         k = jnp.minimum((u * count).astype(jnp.int32), jnp.maximum(count - 1, 0))
-        cum = jnp.cumsum(mask.astype(jnp.int32))
-        idx = jnp.searchsorted(cum, k + 1, side="left").astype(jnp.int32)
+        idx = ops.first_true_index(mask, k, oh)
         idx = jnp.where(
             any_deliverable & dispatching, idx, jnp.int32(cfg.pool_capacity)
         )

@@ -17,6 +17,7 @@ import jax
 import jax.numpy as jnp
 
 from ..dsl import DSLApp
+from . import ops
 from .core import (
     OP_END,
     REC_NONE,
@@ -137,14 +138,18 @@ def make_replay_run_lane(app: DSLApp, cfg: DeviceConfig):
             # shrink far below the shared static record shape.
             n_rec = records.shape[0]
 
+            oh = cfg.use_onehot
+
             def cond(carry):
                 s, _ig, i = carry
-                kind = records[jnp.minimum(i, n_rec - 1), 0]
+                kind = ops.get_scalar(
+                    records[:, 0], jnp.minimum(i, n_rec - 1), oh
+                )
                 return (i < n_rec) & (kind != REC_NONE) & (s.status < ST_DONE)
 
             def wl_body(carry):
                 s, ig, i = carry
-                rec = records[jnp.minimum(i, n_rec - 1)]
+                rec = ops.get_row(records, jnp.minimum(i, n_rec - 1), oh)
                 s, ig = apply_one(s, ig, rec)
                 return (s, ig, i + 1)
 

@@ -31,6 +31,7 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Callable, Optional, Sequence, Tuple
 
+import jax.numpy as jnp
 import numpy as np
 
 # Outbox row layout: (valid, dst, msg[0..W-1])
@@ -79,6 +80,44 @@ class DSLApp:
         if 0 <= tag < len(self.tag_names):
             return self.tag_names[tag]
         return str(tag)
+
+
+# -- traced-index helpers for handlers --------------------------------------
+#
+# Handlers run inside the vmapped device kernels; a traced-index read/write
+# (``state[i]`` / ``state.at[i].set``) there lowers to a batched gather or
+# scatter, which XLA serializes on TPU (profiled at ms each inside the step
+# scan — see device/ops.py). These one-hot forms are pure elementwise code.
+# State/outbox vectors are narrow (tens of lanes), so the O(width) cost is
+# negligible on every backend — handlers should ALWAYS use these for traced
+# indices (static python-int indices are fine to index directly).
+
+def vget(vec, i):
+    """vec[i] for a traced scalar index into a 1-D vector."""
+    oh = jnp.arange(vec.shape[0]) == i
+    if vec.dtype == jnp.bool_:
+        return jnp.any(oh & vec)
+    return jnp.sum(jnp.where(oh, vec, 0))
+
+
+def vset(vec, i, val, enabled=True):
+    """Functional ``vec[i] = val if enabled`` for a traced scalar index."""
+    oh = (jnp.arange(vec.shape[0]) == i) & enabled
+    return jnp.where(oh, val, vec)
+
+
+def vgather(vec, idx):
+    """vec[idx] for a traced index *vector* -> same shape as ``idx``."""
+    oh = idx[:, None] == jnp.arange(vec.shape[0])[None, :]
+    if vec.dtype == jnp.bool_:
+        return jnp.any(oh & vec[None, :], axis=1)
+    return jnp.sum(jnp.where(oh, vec[None, :], 0), axis=1)
+
+
+def row_set(mat, i, row, enabled=True):
+    """Functional ``mat[i] = row if enabled`` for a traced row index."""
+    oh = (jnp.arange(mat.shape[0]) == i) & enabled
+    return jnp.where(oh[:, None], row[None, :], mat)
 
 
 def outbox_rows(max_outbox: int, msg_width: int, *rows: Sequence[int]) -> np.ndarray:

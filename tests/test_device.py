@@ -21,7 +21,9 @@ from demi_tpu.device.explore import make_single_lane_trace_kernel
 from demi_tpu.external_events import (
     Kill,
     MessageConstructor,
+    Partition,
     Send,
+    UnPartition,
     WaitQuiescence,
 )
 from demi_tpu.schedulers import RandomScheduler, sts_oracle
@@ -272,3 +274,55 @@ def test_replay_early_exit_matches_scan_results():
             np.asarray(getattr(scan_res, field)),
             np.asarray(getattr(wl_res, field)),
         ), field
+
+
+def test_index_mode_parity_explore_and_replay():
+    """'onehot' (TPU form: compare+where/reduce, no dynamic-index ops) and
+    'scatter' (CPU form: native gathers/scatters) kernels are bit-identical
+    — they are alternative lowerings of the same semantics (device/ops.py).
+    Covers explore (traced, with kills + partitions in the program) and
+    replay (wildcards included via a traced lane's own records)."""
+    import dataclasses
+
+    from demi_tpu.apps.raft import T_CLIENT, make_raft_app
+    from demi_tpu.device.encoding import lower_program, stack_programs
+
+    app = make_raft_app(3, bug="multivote")
+    program = dsl_start_events(app) + [
+        Send(app.actor_name(0), MessageConstructor(lambda: (T_CLIENT, 0, 7, 0, 0, 0, 0))),
+        Partition(app.actor_name(0), app.actor_name(1)),
+        UnPartition(app.actor_name(0), app.actor_name(1)),
+        Kill(app.actor_name(2)),
+        WaitQuiescence(budget=40),
+    ]
+    B = 32
+    res = {}
+    for mode in ("scatter", "onehot"):
+        cfg = DeviceConfig.for_app(
+            app, pool_capacity=64, max_steps=96, max_external_ops=16,
+            invariant_interval=1, timer_weight=0.2, record_trace=True,
+            index_mode=mode,
+        )
+        kernel = make_explore_kernel(app, cfg)
+        progs = stack_programs([lower_program(app, cfg, program)] * B)
+        keys = jax.random.split(jax.random.PRNGKey(11), B)
+        res[mode] = (cfg, kernel(progs, keys))
+    cfg_s, a = res["scatter"]
+    _, b = res["onehot"]
+    for field in ("status", "violation", "deliveries", "trace", "trace_len"):
+        assert np.array_equal(
+            np.asarray(getattr(a, field)), np.asarray(getattr(b, field))
+        ), f"explore {field}"
+
+    # Replay each traced lane's own records in both modes.
+    recs = np.asarray(a.trace)
+    keys = jax.random.split(jax.random.PRNGKey(12), B)
+    out = {}
+    for mode in ("scatter", "onehot"):
+        cfg = dataclasses.replace(cfg_s, record_trace=False, index_mode=mode)
+        out[mode] = make_replay_kernel(app, cfg)(recs, keys)
+    for field in ("status", "violation", "deliveries", "ignored_absent"):
+        assert np.array_equal(
+            np.asarray(getattr(out["scatter"], field)),
+            np.asarray(getattr(out["onehot"], field)),
+        ), f"replay {field}"
