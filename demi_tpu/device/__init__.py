@@ -1,3 +1,27 @@
+import os as _os
+
+import jax as _jax
+
+# Persistent compilation cache: the CLI builds the same kernel configs
+# run after run (and the batch oracle re-buckets to a handful of shapes);
+# caching compiled executables on disk turns repeat compiles into loads.
+# CPU is excluded by default: XLA:CPU AOT reloads warn about machine-
+# feature mismatches ("could lead to SIGILL") on this host — set
+# DEMI_TPU_CACHE_DIR to opt in anyway. (Backend choice is read from env,
+# not jax.default_backend(), to avoid initializing a possibly-wedged axon
+# backend at import time.)
+try:
+    _cache_dir = _os.environ.get("DEMI_TPU_CACHE_DIR")
+    if _cache_dir is None and _os.environ.get("JAX_PLATFORMS") != "cpu":
+        _cache_dir = _os.path.join(
+            _os.path.expanduser("~"), ".cache", "demi_tpu_xla"
+        )
+    if _cache_dir:
+        _jax.config.update("jax_compilation_cache_dir", _cache_dir)
+        _jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+except Exception:  # pragma: no cover
+    pass
+
 from .core import DeviceConfig, ScheduleState
 from .explore import make_explore_kernel, make_single_lane_trace_kernel
 from .replay import make_replay_kernel

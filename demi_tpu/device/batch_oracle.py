@@ -91,9 +91,21 @@ class DeviceReplayChecker:
                 for cand, ext in zip(candidates, externals_per_candidate)
             ]
         )
-        keys = jax.random.split(jax.random.PRNGKey(0), len(candidates))
+        # Pad the batch axis to a power-of-two bucket: DDMin levels and
+        # removal rounds shrink the candidate count every iteration, and an
+        # unpadded batch would recompile the kernel per distinct size
+        # (profiled: a 150-delivery raft case spent ~4 min, ~100 compiles,
+        # in ONE internal stage). Padding rows replay candidate 0 again;
+        # their verdicts are sliced off.
+        n = len(candidates)
+        bucket = max(8, 1 << (n - 1).bit_length())
+        if bucket > n:
+            records = np.concatenate(
+                [records, np.repeat(records[:1], bucket - n, axis=0)]
+            )
+        keys = jax.random.split(jax.random.PRNGKey(0), bucket)
         res = self.kernel(records, keys)
-        codes = np.asarray(res.violation)
+        codes = np.asarray(res.violation)[:n]
         return [int(c) == target_code for c in codes]
 
     def host_executed_trace(

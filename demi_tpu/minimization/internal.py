@@ -211,6 +211,10 @@ class BatchedInternalMinimizer:
             candidates = [remove_delivery(last_failing, i) for i in indices]
             results = self.batch_check(candidates)
             adopted = next((r for r in results if r is not None), None)
+            # Every device lane is a replay trial (the host-sequential
+            # minimizer would have run each one through the STS oracle).
+            for _ in candidates:
+                self.stats.record_replay()
             self.stats.record_internal_size(len(indices))
             if adopted is None:
                 break
