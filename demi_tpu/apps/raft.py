@@ -40,6 +40,13 @@ in for the akka-raft raft-NN branches):
                       its own log length: a heartbeat reordered ahead of
                       its AppendEntries commits an entry the follower
                       doesn't have yet (committed-prefix violation).
+
+One more case study needs NO bug flag: this fixture keeps voted_for/term
+in memory only (the DSL has no durable storage), so HardKill+restart wipes
+them and a restarted voter can grant a second vote in a term it already
+voted in — two same-term leaders (raft-66-class lost-durability bug;
+tests/test_raft_case_studies.py::test_lost_vote_durability_on_crash_recovery,
+found by crash-recovery fuzzing with bounded WaitQuiescence budgets).
 """
 
 from __future__ import annotations

@@ -16,6 +16,7 @@ from typing import Callable, List, Optional, Sequence
 
 from ..external_events import (
     ExternalEvent,
+    HardKill,
     Kill,
     Partition,
     Send,
@@ -47,6 +48,12 @@ class FuzzerWeights:
     wait_quiescence: float = 0.1
     partition: float = 0.0
     unpartition: float = 0.0
+    # Crash-recovery language: HardKill really stops an actor (state +
+    # pending scrubbed); restart re-issues the prefix Start for a killed
+    # name (recovery, EventOrchestrator.trigger_start semantics). Off by
+    # default — crash/recovery fuzzing is opt-in like partitions.
+    hard_kill: float = 0.0
+    restart: float = 0.0
 
 
 class Fuzzer:
@@ -58,6 +65,7 @@ class Fuzzer:
         prefix: Sequence[ExternalEvent],
         postfix: Sequence[ExternalEvent] = (),
         max_kills: Optional[int] = None,
+        wait_budget: Optional[tuple] = None,
     ):
         self.num_events = num_events
         self.weights = weights
@@ -67,12 +75,20 @@ class Fuzzer:
         # Keeping a quorum alive is the app's concern; cap kills so fuzz runs
         # don't trivially kill everyone (the reference relies on weights).
         self.max_kills = max_kills
+        # (lo, hi) delivery budget for generated WaitQuiescence events.
+        # Bounded waits leave messages PENDING at the segment boundary, so
+        # later externals (crashes, restarts) interleave mid-flood — without
+        # this, every generated wait drains the network and crash-recovery
+        # races (e.g. lost-vote-durability) are unreachable. The trailing
+        # drain wait stays unlimited.
+        self.wait_budget = wait_budget
 
     def generate_fuzz_test(self, seed: int) -> List[ExternalEvent]:
         rng = _random.Random(seed)
         self.message_gen.reset()
-        names = [e.name for e in self.prefix if isinstance(e, Start)]
-        alive = list(names)
+        starts = {e.name: e for e in self.prefix if isinstance(e, Start)}
+        alive = list(starts)
+        killed: List[str] = []
         kills = 0
         partitions: List[tuple] = []
 
@@ -83,6 +99,8 @@ class Fuzzer:
             ("wait", self.weights.wait_quiescence),
             ("partition", self.weights.partition),
             ("unpartition", self.weights.unpartition),
+            ("hard_kill", self.weights.hard_kill),
+            ("restart", self.weights.restart),
         ]
         total = sum(w for _, w in choices)
         generated = 0
@@ -100,13 +118,24 @@ class Fuzzer:
                     kind = name
                     break
                 r -= w
-            if kind == "kill":
+            if kind in ("kill", "hard_kill"):
                 can_kill = self.max_kills is None or kills < self.max_kills
                 if alive and can_kill:
                     victim = rng.choice(alive)
                     alive.remove(victim)
+                    killed.append(victim)
                     kills += 1
-                    events.append(Kill(victim))
+                    events.append(
+                        Kill(victim) if kind == "kill" else HardKill(victim)
+                    )
+                    generated += 1
+            elif kind == "restart":
+                if killed:
+                    name = rng.choice(killed)
+                    killed.remove(name)
+                    alive.append(name)
+                    orig = starts[name]
+                    events.append(Start(name, ctor=orig.ctor))
                     generated += 1
             elif kind == "send":
                 send = self.message_gen.generate(rng, alive)
@@ -115,7 +144,12 @@ class Fuzzer:
                     generated += 1
             elif kind == "wait":
                 if events and not isinstance(events[-1], WaitQuiescence):
-                    events.append(WaitQuiescence())
+                    budget = (
+                        rng.randint(*self.wait_budget)
+                        if self.wait_budget is not None
+                        else None
+                    )
+                    events.append(WaitQuiescence(budget=budget))
                     generated += 1
             elif kind == "partition":
                 pairs = [
@@ -140,5 +174,9 @@ class Fuzzer:
         events.extend(self.postfix)
         if not events or not isinstance(events[-1], WaitQuiescence):
             events.append(WaitQuiescence())
+        elif events[-1].budget is not None:
+            # The run ends with the last segment (reference semantics); a
+            # budgeted trailing wait would cap the final drain.
+            events[-1] = WaitQuiescence()
         sanity_check_externals(events)
         return events

@@ -116,3 +116,62 @@ def test_correct_raft_clean_under_same_sweep():
     keys = jax.random.split(jax.random.PRNGKey(0), B)
     res = kernel(progs, keys)
     assert int((np.asarray(res.violation) != 0).sum()) == 0
+
+
+def test_lost_vote_durability_on_crash_recovery():
+    """raft-66-class persistence case study on UNMODIFIED Raft: the fixture
+    keeps voted_for/term in memory only, so HardKill+restart wipes them —
+    a restarted voter grants a second vote in a term it already voted in,
+    electing two same-term leaders. Needs crash/recovery externals fired
+    mid-flood (bounded WaitQuiescence budgets leave messages pending at
+    segment boundaries) — unreachable with full-drain waits, which is why
+    the fuzzer's wait_budget knob exists. Reference analog: the raft-NN
+    known-bug branches exercised via Kill/Start atoms
+    (tools/rerun_experiments.sh:7, ExternalEvents.scala:62-91)."""
+    from demi_tpu.device.encoding import device_trace_to_guide
+    from demi_tpu.device.explore import make_single_lane_trace_kernel
+    from demi_tpu.fuzzing import Fuzzer, FuzzerWeights
+    from demi_tpu.apps.raft import raft_send_generator
+    from demi_tpu.schedulers.guided import GuidedScheduler
+
+    app = make_raft_app(3)  # no seeded bug flag: volatility IS the bug
+    cfg = DeviceConfig.for_app(
+        app, pool_capacity=96, max_steps=224, max_external_ops=24,
+        invariant_interval=1, timer_weight=0.05,
+    )
+    fz = Fuzzer(
+        num_events=10,
+        weights=FuzzerWeights(
+            send=0.1, wait_quiescence=0.35, hard_kill=0.25, restart=0.3
+        ),
+        message_gen=raft_send_generator(app),
+        prefix=dsl_start_events(app),
+        max_kills=2,
+        wait_budget=(1, 25),
+    )
+    base, B = 768, 256  # empirically violating region of the seed space
+    programs = [fz.generate_fuzz_test(seed=base + s) for s in range(B)]
+    kernel = make_explore_kernel(app, cfg)
+    progs = stack_programs([lower_program(app, cfg, p) for p in programs])
+    keys = jax.random.split(jax.random.PRNGKey(base), B)
+    res = kernel(progs, keys)
+    statuses = np.asarray(res.status)
+    assert int((statuses == ST_OVERFLOW).sum()) == 0
+    lanes = np.flatnonzero(statuses == ST_VIOLATION)
+    assert len(lanes) > 0, "crash-recovery sweep missed the durability race"
+    assert set(np.asarray(res.violation)[lanes]) == {1}  # two leaders
+
+    # Host lift: the violating lane's schedule must reproduce on the
+    # sequential oracle (host/device parity for HardKill+restart flows).
+    lane = int(lanes[0])
+    traced = make_single_lane_trace_kernel(app, cfg)
+    single = traced(
+        jax.tree_util.tree_map(lambda x: x[lane], progs), keys[lane]
+    )
+    assert int(single.violation) == 1
+    guide = device_trace_to_guide(
+        app, np.asarray(single.trace), int(single.trace_len)
+    )
+    config = SchedulerConfig(invariant_check=make_host_invariant(app))
+    host = GuidedScheduler(config, app).execute_guide(guide)
+    assert host.violation is not None and host.violation.code == 1

@@ -134,3 +134,48 @@ def test_srcdst_fifo_strategy_runs():
     result = sched.execute(program)
     assert result.violation is None
     assert result.deliveries >= 4
+
+
+def test_fuzzer_crash_recovery_vocabulary():
+    """hard_kill/restart weights + bounded wait budgets: restarts only
+    target killed names, re-using the prefix Start ctor; generated waits
+    carry budgets in range; the trailing drain stays unlimited."""
+    import random
+
+    from demi_tpu.apps.broadcast import broadcast_send_generator, make_broadcast_app
+    from demi_tpu.apps.common import dsl_start_events
+    from demi_tpu.external_events import HardKill, Kill, Start, WaitQuiescence
+    from demi_tpu.fuzzing import Fuzzer, FuzzerWeights
+
+    app = make_broadcast_app(4, reliable=False)
+    fz = Fuzzer(
+        num_events=12,
+        weights=FuzzerWeights(
+            send=0.2, wait_quiescence=0.2, hard_kill=0.3, restart=0.3
+        ),
+        message_gen=broadcast_send_generator(app),
+        prefix=dsl_start_events(app),
+        wait_budget=(1, 9),
+    )
+    saw_hard_kill = saw_restart = False
+    for seed in range(40):
+        events = fz.generate_fuzz_test(seed=seed)
+        n_prefix = app.num_actors
+        killed = set()
+        for e in events[n_prefix:]:
+            if isinstance(e, (Kill, HardKill)):
+                killed.add(e.name)
+                saw_hard_kill |= isinstance(e, HardKill)
+            elif isinstance(e, Start):
+                assert e.name in killed, "restart of a live actor"
+                assert e.ctor is not None, "restart lost the Start ctor"
+                killed.discard(e.name)
+                saw_restart = True
+        mid_waits = [
+            e for e in events[:-1] if isinstance(e, WaitQuiescence)
+        ]
+        assert all(
+            w.budget is None or 1 <= w.budget <= 9 for w in mid_waits
+        )
+        assert events[-1].budget is None  # trailing drain unlimited
+    assert saw_hard_kill and saw_restart
