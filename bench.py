@@ -53,8 +53,16 @@ def _raft_workload():
 
 
 def bench_device_raft(jax):
-    """Device explore throughput on the 5-node raft workload."""
-    from demi_tpu.device import DeviceConfig, make_explore_kernel
+    """Device explore throughput on the 5-node raft workload.
+
+    DEMI_BENCH_IMPL selects the kernel backend: 'xla' (default) or
+    'pallas' (VMEM-resident lane blocks; DEMI_BENCH_BLOCK_LANES sets the
+    block size)."""
+    from demi_tpu.device import (
+        DeviceConfig,
+        make_explore_kernel,
+        make_explore_kernel_pallas,
+    )
     from demi_tpu.device.encoding import lower_program, stack_programs
 
     app, program = _raft_workload()
@@ -69,7 +77,13 @@ def bench_device_raft(jax):
     platform = jax.devices()[0].platform
     default_batch = 8192 if platform not in ("cpu",) else 1024
     batch = int(os.environ.get("DEMI_BENCH_BATCH", default_batch))
-    kernel = make_explore_kernel(app, cfg)
+    if os.environ.get("DEMI_BENCH_IMPL", "xla") == "pallas":
+        kernel = make_explore_kernel_pallas(
+            app, cfg,
+            block_lanes=int(os.environ.get("DEMI_BENCH_BLOCK_LANES", 256)),
+        )
+    else:
+        kernel = make_explore_kernel(app, cfg)
     progs = stack_programs([lower_program(app, cfg, program)] * batch)
     keys = jax.random.split(jax.random.PRNGKey(0), batch)
 

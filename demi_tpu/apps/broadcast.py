@@ -45,9 +45,9 @@ def make_broadcast_app(
         )
         already = (state[0] & bit) != 0
         deliver = (tag == TAG_BCAST) & ~already & (bit != 0)
-        new_state = state.at[0].set(
-            jnp.where(deliver, state[0] | bit, state[0])
-        )
+        # Index-free write (width-1 state): keeps the handler free of
+        # scatter ops, which have no Mosaic lowering (pallas kernels).
+        new_state = jnp.where(deliver, state[0] | bit, state[0])[None]
         dsts = jnp.arange(max_outbox, dtype=jnp.int32)
         if reliable:
             valid = deliver & (dsts != actor_id) & (dsts < num_actors)

@@ -58,7 +58,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..dsl import DSLApp, row_set, vgather, vget, vset
+from ..dsl import DSLApp, row_set, seg_set, vgather, vget, vset
 from .common import DSLSendGenerator
 
 # Message tags.
@@ -152,11 +152,11 @@ def make_raft_app(
     def maybe_step_down(state, term):
         """Adopt a newer term as follower (votes + leader hint cleared)."""
         newer = term > state[TERM]
-        state = state.at[TERM].set(jnp.where(newer, term, state[TERM]))
-        state = state.at[ROLE].set(jnp.where(newer, FOLLOWER, state[ROLE]))
-        state = state.at[VOTED_FOR].set(jnp.where(newer, -1, state[VOTED_FOR]))
-        state = state.at[VOTES].set(jnp.where(newer, 0, state[VOTES]))
-        state = state.at[LEADER_HINT].set(jnp.where(newer, -1, state[LEADER_HINT]))
+        state = vset(state, TERM, jnp.where(newer, term, state[TERM]))
+        state = vset(state, ROLE, jnp.where(newer, FOLLOWER, state[ROLE]))
+        state = vset(state, VOTED_FOR, jnp.where(newer, -1, state[VOTED_FOR]))
+        state = vset(state, VOTES, jnp.where(newer, 0, state[VOTES]))
+        state = vset(state, LEADER_HINT, jnp.where(newer, -1, state[LEADER_HINT]))
         return state
 
     def heartbeat_rows(actor_id, state):
@@ -164,7 +164,7 @@ def make_raft_app(
         one exists, else an empty heartbeat. One entry per message (bounded
         payloads; SURVEY.md §7.3)."""
         dsts = jnp.arange(n, dtype=jnp.int32)
-        next_idx = jax.lax.dynamic_slice(state, (NEXT,), (n,))
+        next_idx = state[NEXT : NEXT + n]
         prev_idx = next_idx - 1
         safe_prev = jnp.clip(prev_idx, 0, log_cap - 1)
         prev_term = jnp.where(
@@ -192,10 +192,10 @@ def make_raft_app(
         is_leader = state[ROLE] == LEADER
         new_term = state[TERM] + 1
         cand = state
-        cand = cand.at[ROLE].set(CANDIDATE)
-        cand = cand.at[TERM].set(new_term)
-        cand = cand.at[VOTED_FOR].set(actor_id)
-        cand = cand.at[VOTES].set(jnp.int32(1) << actor_id)
+        cand = vset(cand, ROLE, CANDIDATE)
+        cand = vset(cand, TERM, new_term)
+        cand = vset(cand, VOTED_FOR, actor_id)
+        cand = vset(cand, VOTES, jnp.int32(1) << actor_id)
         state = jnp.where(is_leader, state, cand)
 
         lli, llt = last_log(state)
@@ -208,13 +208,11 @@ def make_raft_app(
         return state, out
 
     def _become_leader(actor_id, state):
-        st = state.at[ROLE].set(LEADER)
+        st = vset(state, ROLE, LEADER)
         # next_index = log_len for all; match_index self = log_len-1, others -1.
-        st = jax.lax.dynamic_update_slice(
-            st, jnp.full((n,), st[LOG_LEN], jnp.int32), (NEXT,)
-        )
+        st = seg_set(st, NEXT, jnp.full((n,), st[LOG_LEN], jnp.int32))
         match = vset(jnp.full((n,), -1, jnp.int32), actor_id, st[LOG_LEN] - 1)
-        st = jax.lax.dynamic_update_slice(st, match, (MATCH,))
+        st = seg_set(st, MATCH, match)
         return st
 
     def _arm_heartbeat(actor_id, outbox):
@@ -242,7 +240,7 @@ def make_raft_app(
         else:
             free_vote = (state[VOTED_FOR] == -1) | (state[VOTED_FOR] == snd)
         grant = (term == state[TERM]) & (state[ROLE] == FOLLOWER) & free_vote & log_ok
-        state = state.at[VOTED_FOR].set(
+        state = vset(state, VOTED_FOR,
             jnp.where(grant, snd, state[VOTED_FOR])
         )
         out = one_row(empty_outbox(), 0, snd, jnp.int32(T_VOTE_REPLY),
@@ -262,7 +260,7 @@ def make_raft_app(
         votes = jnp.where(
             count, state[VOTES] | (jnp.int32(1) << snd), state[VOTES]
         )
-        state = state.at[VOTES].set(votes)
+        state = vset(state, VOTES, votes)
         popcount = jnp.sum(
             (votes[None] >> jnp.arange(n, dtype=jnp.int32)) & 1
         )
@@ -283,10 +281,10 @@ def make_raft_app(
         current = term == state[TERM]
         # A current-term AppendEntries deposes a same-term candidate and
         # names the current leader.
-        state = state.at[ROLE].set(
+        state = vset(state, ROLE,
             jnp.where(current & (state[ROLE] == CANDIDATE), FOLLOWER, state[ROLE])
         )
-        state = state.at[LEADER_HINT].set(
+        state = vset(state, LEADER_HINT,
             jnp.where(current, snd, state[LEADER_HINT])
         )
         if bug == "gap_append":
@@ -310,7 +308,7 @@ def make_raft_app(
         safe_w = jnp.clip(write_idx, 0, log_cap - 1)
         state = vset(state, LOG_START + 2 * safe_w, ent_term, can_write)
         state = vset(state, LOG_START + 2 * safe_w + 1, ent_val, can_write)
-        state = state.at[LOG_LEN].set(
+        state = vset(state, LOG_LEN,
             jnp.where(
                 can_write,
                 jnp.where(conflict | ~had_existing, write_idx + 1, state[LOG_LEN]),
@@ -331,7 +329,7 @@ def make_raft_app(
                             jnp.minimum(leader_commit, state[LOG_LEN] - 1)),
                 state[COMMIT],
             )
-        state = state.at[COMMIT].set(new_commit)
+        state = vset(state, COMMIT, new_commit)
         match = jnp.where(ok, jnp.where(has_entry & can_write, write_idx, prev_idx), -1)
         out = one_row(empty_outbox(), 0, snd, jnp.int32(T_APPEND_REPLY),
                       state[TERM], a=ok.astype(jnp.int32), b=match)
@@ -341,8 +339,8 @@ def make_raft_app(
         term, success, match_idx = msg[1], msg[2], msg[3]
         state = maybe_step_down(state, term)
         relevant = (state[ROLE] == LEADER) & (term == state[TERM])
-        nexts = jax.lax.dynamic_slice(state, (NEXT,), (n,))
-        matches = jax.lax.dynamic_slice(state, (MATCH,), (n,))
+        nexts = state[NEXT : NEXT + n]
+        matches = state[MATCH : MATCH + n]
         ok = relevant & (success != 0)
         fail = relevant & (success == 0)
         prev_match = vget(matches, snd)
@@ -352,9 +350,9 @@ def make_raft_app(
             nexts, snd,
             jnp.where(ok, new_match + 1, jnp.maximum(vget(nexts, snd) - 1, 0)),
         )
-        nexts = jnp.where(relevant, nexts, jax.lax.dynamic_slice(state, (NEXT,), (n,)))
-        state = jax.lax.dynamic_update_slice(state, nexts, (NEXT,))
-        state = jax.lax.dynamic_update_slice(state, matches, (MATCH,))
+        nexts = jnp.where(relevant, nexts, state[NEXT : NEXT + n])
+        state = seg_set(state, NEXT, nexts)
+        state = seg_set(state, MATCH, matches)
         # Commit advancement: highest i with log_term[i]==term replicated on
         # a majority. (bug="stale_commit": self counted twice.)
         matches = vset(matches, actor_id, state[LOG_LEN] - 1)
@@ -373,7 +371,7 @@ def make_raft_app(
             & (repl_count >= majority)
         )
         best = jnp.max(jnp.where(committable, idxs, -1))
-        state = state.at[COMMIT].set(
+        state = vset(state, COMMIT,
             jnp.where(relevant, jnp.maximum(state[COMMIT], best), state[COMMIT])
         )
         return state, empty_outbox()
@@ -384,7 +382,7 @@ def make_raft_app(
         idx = jnp.clip(state[LOG_LEN], 0, log_cap - 1)
         state = vset(state, LOG_START + 2 * idx, state[TERM], can)
         state = vset(state, LOG_START + 2 * idx + 1, value, can)
-        state = state.at[LOG_LEN].set(
+        state = vset(state, LOG_LEN,
             jnp.where(can, state[LOG_LEN] + 1, state[LOG_LEN])
         )
         # Leader's own match_index tracks its log.

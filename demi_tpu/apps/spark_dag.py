@@ -27,7 +27,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..dsl import DSLApp, vget, vset
+from ..dsl import DSLApp, row_set, vget, vset
 from .common import DSLSendGenerator
 
 T_SUBMIT = 1
@@ -92,7 +92,7 @@ def make_spark_app(
         row = jnp.stack(
             [jnp.int32(1), jnp.int32(0), jnp.int32(T_DONE), stage, task]
         )
-        out = out.at[0].set(jnp.where(is_worker, row, out[0]))
+        out = row_set(out, 0, jnp.where(is_worker, row, out[0]))
         return state, out
 
     def on_done(actor_id, state, snd, msg):
@@ -112,9 +112,9 @@ def make_spark_app(
         state = vset(state, MASKS + safe_cur, mask)
         stage_complete = relevant & (mask == full_mask)
         next_stage = cur + 1
-        state = state.at[CUR].set(jnp.where(stage_complete, next_stage, cur))
+        state = vset(state, CUR, jnp.where(stage_complete, next_stage, cur))
         job_done = stage_complete & (next_stage >= S)
-        state = state.at[DONE_FLAG].set(
+        state = vset(state, DONE_FLAG,
             jnp.where(job_done, 1, state[DONE_FLAG])
         )
         launch_next = stage_complete & (next_stage < S)
@@ -131,7 +131,7 @@ def make_spark_app(
     def invariant(states, alive):
         """job_done ⇒ every credited task was executed by some worker."""
         master = states[0]
-        credited = jax.lax.dynamic_slice(master, (MASKS,), (S,))
+        credited = master[MASKS : MASKS + S]
         executed = states[1:, MASKS : MASKS + S]  # [workers, S]
         executed_union = jnp.bitwise_or.reduce(executed, axis=0)
         phantom = credited & ~executed_union

@@ -24,12 +24,14 @@ except Exception:  # pragma: no cover
 
 from .core import DeviceConfig, ScheduleState
 from .explore import make_explore_kernel, make_single_lane_trace_kernel
+from .pallas_explore import make_explore_kernel_pallas
 from .replay import make_replay_kernel
 
 __all__ = [
     "DeviceConfig",
     "ScheduleState",
     "make_explore_kernel",
+    "make_explore_kernel_pallas",
     "make_single_lane_trace_kernel",
     "make_replay_kernel",
 ]

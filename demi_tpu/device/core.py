@@ -250,11 +250,16 @@ def insert_rows(
     rows than free slots) flips the lane status to ST_OVERFLOW."""
     free = ~state.pool_valid
     # rank among free slots: 1-indexed prefix count
-    prefix = jnp.cumsum(free.astype(jnp.int32))
-    want = jnp.cumsum(row_valid.astype(jnp.int32))  # i-th valid row wants want[i]-th free slot
+    prefix = ops.prefix_sum(free.astype(jnp.int32), cfg.use_onehot)
+    want = ops.prefix_sum(
+        row_valid.astype(jnp.int32), cfg.use_onehot
+    )  # i-th valid row wants want[i]-th free slot
     # slot index for each row: first index where prefix == want[i] and free
     slots = ops.rank_slots(prefix, want, cfg.use_onehot)  # [K]
-    n_free = prefix[-1]
+    # Totals as reductions, not prefix[-1]/want[-1] reads: trailing-element
+    # gathers have no Mosaic lowering (bit-identical either way).
+    n_free = jnp.sum(free.astype(jnp.int32))
+    n_rows = jnp.sum(row_valid.astype(jnp.int32))
     overflow = jnp.any(row_valid & (want > n_free))
     ok = row_valid & (want <= n_free)
 
@@ -275,7 +280,7 @@ def insert_rows(
             ),
             pool_msg=ops.scatter_rows_int(state.pool_msg, oh_kp, row_msg),
             pool_seq=ops.scatter_vec_int(state.pool_seq, oh_kp, seqs),
-            seq_counter=state.seq_counter + want[-1],
+            seq_counter=state.seq_counter + n_rows,
             status=jnp.where(overflow, jnp.int32(ST_OVERFLOW), state.status),
         )
         if crec is not None:
@@ -292,7 +297,7 @@ def insert_rows(
         pool_parked=state.pool_parked.at[slots].set(row_parked, mode="drop"),
         pool_msg=state.pool_msg.at[slots].set(row_msg, mode="drop"),
         pool_seq=state.pool_seq.at[slots].set(seqs, mode="drop"),
-        seq_counter=state.seq_counter + want[-1],
+        seq_counter=state.seq_counter + n_rows,
         status=jnp.where(overflow, jnp.int32(ST_OVERFLOW), state.status),
     )
     if crec is not None:

@@ -157,7 +157,7 @@ def make_step_fn(app: DSLApp, cfg: DeviceConfig):
         count = jnp.sum(mask.astype(jnp.int32))
         any_deliverable = count > 0
 
-        key, sub = jax.random.split(state.rng)
+        key, sub = ops.rng_split(state.rng)  # Mosaic-safe split (pallas)
         if cfg.timer_weight != 1.0:
             # Two-stage choice: class (timer vs message) by weighted counts,
             # then uniform within class (host counterpart: FullyRandom with
@@ -166,7 +166,7 @@ def make_step_fn(app: DSLApp, cfg: DeviceConfig):
             mmask = mask & ~state.pool_timer
             tcount = jnp.sum(tmask.astype(jnp.int32))
             mcount = jnp.sum(mmask.astype(jnp.int32))
-            sub, sub2 = jax.random.split(sub)
+            sub, sub2 = ops.rng_split(sub)
             wt = cfg.timer_weight * tcount
             p_timer = jnp.where(
                 (tcount > 0) & (mcount > 0),

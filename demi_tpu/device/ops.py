@@ -29,6 +29,39 @@ from ..dsl import vget as _vget
 from ..dsl import vset as _vset
 
 
+def prefix_sum(x: jnp.ndarray, oh: bool) -> jnp.ndarray:
+    """Inclusive prefix sum over an int vector.
+
+    One-hot mode uses Hillis-Steele shifted adds (log2(n) pad+slice+add
+    rounds): bit-identical to cumsum (integer adds are associative) while
+    avoiding the ``cumsum`` primitive, which has no Mosaic lowering — this
+    keeps the kernels traceable inside Pallas TPU kernels
+    (device/pallas_explore.py)."""
+    if not oh:
+        return jnp.cumsum(x)
+    n = x.shape[0]
+    d = 1
+    while d < n:
+        x = x + jnp.pad(x[:-d], (d, 0))
+        d *= 2
+    return x
+
+
+def rng_split(key: jnp.ndarray, n: int = 2) -> jnp.ndarray:
+    """``jax.random.split`` replacement that traces to threefry2x32 +
+    iota_2x32_shape instead of the opaque ``random_split`` primitive
+    (unsupported by Mosaic). Bit-identical to jax.random.split for raw
+    uint32 keys (verified in tests/test_pallas.py)."""
+    try:
+        from jax._src import prng as _prng
+
+        return _prng.threefry_split(key, (n,))
+    except (ImportError, AttributeError):  # pragma: no cover - jax internals moved
+        import jax
+
+        return jax.random.split(key, n)
+
+
 def onehot(i, n: int) -> jnp.ndarray:
     """bool[n], True at position ``i`` (all-False when i is out of range —
     the mask-style analog of a dropped scatter)."""
@@ -99,7 +132,7 @@ def first_true_index(mask: jnp.ndarray, k, oh: bool):
     """Index of the (k+1)-th True in ``mask`` (k 0-based); mask.shape[0] when
     there are fewer. The one-hot form avoids searchsorted (binary-search
     gathers serialize on TPU)."""
-    cum = jnp.cumsum(mask.astype(jnp.int32))
+    cum = prefix_sum(mask.astype(jnp.int32), oh)
     if oh:
         return jnp.sum((cum < k + 1).astype(jnp.int32))
     return jnp.searchsorted(cum, k + 1, side="left").astype(jnp.int32)

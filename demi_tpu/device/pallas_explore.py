@@ -1,0 +1,199 @@
+"""Pallas backend for the explore sweep: the whole step loop runs inside
+one kernel, with each grid cell holding a block of lanes' full schedule
+state in VMEM for the entire run.
+
+Why: the XLA explore kernel (device/explore.py) is a `lax.while_loop`
+whose carry — the complete per-lane ScheduleState — round-trips HBM every
+step.  At 8k lanes the carry is tens of MB, so the loop is
+HBM-bandwidth-bound even after the one-hot rewrite removed the serialized
+scatters.  A Pallas kernel gridded over lane blocks keeps a block's state
+resident in VMEM across all `max_steps` iterations: HBM traffic drops to
+one read of the programs/keys and one write of the verdicts per lane,
+regardless of step count.  This is the TPU-native answer to the
+reference's per-message JVM dispatch cycle (SURVEY.md §3.1,
+Instrumenter.scala:913-1109) at its hottest.
+
+Semantics are single-source: the kernel body calls the SAME
+`make_run_lane` step machinery as the XLA kernel (vmapped over the lane
+block), so the two backends are bit-identical — including the
+`jax.random` schedule stream, which the traced single-lane re-run
+(device/explore.py make_single_lane_trace_kernel) depends on when lifting
+a violating lane to the host oracle.
+
+On non-TPU backends the kernel runs in Pallas interpret mode, which is
+how the parity suite validates it on the CPU mesh (tests/test_pallas.py).
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+from ..dsl import DSLApp
+from .core import DeviceConfig
+from .explore import ExtProgram, LaneResult, make_run_lane
+
+
+def _pad_to(x, b: int):
+    """Pad axis 0 of ``x`` up to a multiple of ``b`` with zeros."""
+    n = x.shape[0]
+    rem = (-n) % b
+    if rem == 0:
+        return x
+    pad = [(0, rem)] + [(0, 0)] * (x.ndim - 1)
+    return jnp.pad(x, pad)
+
+
+def make_explore_kernel_pallas(
+    app: DSLApp,
+    cfg: DeviceConfig,
+    block_lanes: int = 128,
+    interpret: Optional[bool] = None,
+):
+    """Pallas twin of ``make_explore_kernel``: ``kernel(progs, keys) ->
+    LaneResult`` with empty traces (sweeps record verdicts only; traced
+    re-runs of interesting lanes use the XLA single-lane kernel).
+
+    ``block_lanes`` sets the VMEM working set: one block's ScheduleState
+    (~pool_capacity * (7 + msg_width) ints per lane) must fit. The lane
+    batch is padded to a block multiple with inert all-zero programs.
+    """
+    if cfg.record_trace:
+        raise ValueError(
+            "pallas explore kernel records verdicts only; use the XLA "
+            "single-lane trace kernel for trace extraction"
+        )
+    run_lane = make_run_lane(app, cfg)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    if not interpret and not cfg.use_onehot:
+        # Scatter-mode kernels trace cumsum/searchsorted/scatter, none of
+        # which have Mosaic lowerings — fail fast instead of deep inside
+        # the Mosaic compiler.
+        raise ValueError(
+            "pallas explore kernel requires the one-hot index mode on TPU "
+            "(DeviceConfig(index_mode='onehot' or 'auto'))"
+        )
+
+    e, w = cfg.max_external_ops, cfg.msg_width
+
+    # Pallas kernels may not capture constant arrays (the app's init-state
+    # table, initial-message rows, timer-tag vectors...). closure_convert
+    # hoists them out of the traced lane function; they become extra kernel
+    # operands, broadcast to every grid cell. Bools ride as int32 (Mosaic
+    # mask operands are awkward) and scalars as [1] vectors.
+    def lane_block_fn(progs: ExtProgram, keys):
+        return jax.vmap(run_lane)(progs, keys)
+
+    ex_progs = ExtProgram(
+        op=jax.ShapeDtypeStruct((block_lanes, e), jnp.int32),
+        a=jax.ShapeDtypeStruct((block_lanes, e), jnp.int32),
+        b=jax.ShapeDtypeStruct((block_lanes, e), jnp.int32),
+        msg=jax.ShapeDtypeStruct((block_lanes, e, w), jnp.int32),
+    )
+    ex_keys = jax.ShapeDtypeStruct((block_lanes, 2), jnp.uint32)
+    # jax.closure_convert hoists only inexact-dtype constants; this state
+    # machine is all-integer, so hoist every const by tracing to a jaxpr
+    # and threading jaxpr.consts as explicit arguments.
+    closed_jaxpr, out_shape_tree = jax.make_jaxpr(
+        lane_block_fn, return_shape=True
+    )(ex_progs, ex_keys)
+    consts = closed_jaxpr.consts
+    out_treedef = jax.tree_util.tree_structure(out_shape_tree)
+
+    def closed_fn(progs, keys, *cvals):
+        flat_args = jax.tree_util.tree_leaves((progs, keys))
+        out_flat = jax.core.eval_jaxpr(
+            closed_jaxpr.jaxpr, cvals, *flat_args
+        )
+        return jax.tree_util.tree_unflatten(out_treedef, out_flat)
+
+    def _wire(c):
+        """(operand_to_pass, restore_fn) for one hoisted constant."""
+        arr = jnp.asarray(c)
+        restore_dtype = arr.dtype
+        if arr.dtype == jnp.bool_:
+            arr = arr.astype(jnp.int32)
+        shaped = arr.reshape((1,)) if arr.ndim == 0 else arr
+        squeeze = arr.ndim == 0
+
+        def restore(v):
+            if squeeze:
+                v = v.reshape(())
+            return v.astype(restore_dtype)
+
+        return shaped, restore
+
+    const_ops, const_restores = (
+        zip(*(_wire(c) for c in consts)) if consts else ((), ())
+    )
+
+    def kernel(op_ref, a_ref, b_ref, msg_ref, key_ref, *rest):
+        const_refs = rest[: len(const_ops)]
+        st_ref, vio_ref, del_ref = rest[len(const_ops):]
+        progs = ExtProgram(
+            op=op_ref[...], a=a_ref[...], b=b_ref[...], msg=msg_ref[...]
+        )
+        cvals = [
+            restore(ref[...])
+            for ref, restore in zip(const_refs, const_restores)
+        ]
+        res = closed_fn(progs, key_ref[...], *cvals)
+        st_ref[...] = res.status
+        vio_ref[...] = res.violation
+        del_ref[...] = res.deliveries
+
+    def call(progs: ExtProgram, keys) -> LaneResult:
+        n_lanes = keys.shape[0]
+        op = _pad_to(jnp.asarray(progs.op, jnp.int32), block_lanes)
+        a = _pad_to(jnp.asarray(progs.a, jnp.int32), block_lanes)
+        b = _pad_to(jnp.asarray(progs.b, jnp.int32), block_lanes)
+        msg = _pad_to(jnp.asarray(progs.msg, jnp.int32), block_lanes)
+        keys_p = _pad_to(jnp.asarray(keys), block_lanes)
+        padded = op.shape[0]
+        grid = (padded // block_lanes,)
+        lane_block = lambda i: (i, 0)
+        out_shape = [
+            jax.ShapeDtypeStruct((padded,), jnp.int32),  # status
+            jax.ShapeDtypeStruct((padded,), jnp.int32),  # violation
+            jax.ShapeDtypeStruct((padded,), jnp.int32),  # deliveries
+        ]
+        const_specs = [
+            pl.BlockSpec(c.shape, lambda i, nd=c.ndim: (0,) * nd)
+            for c in const_ops
+        ]
+        st, vio, dl = pl.pallas_call(
+            kernel,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((block_lanes, e), lane_block),
+                pl.BlockSpec((block_lanes, e), lane_block),
+                pl.BlockSpec((block_lanes, e), lane_block),
+                pl.BlockSpec((block_lanes, e, w), lambda i: (i, 0, 0)),
+                pl.BlockSpec((block_lanes, 2), lane_block),
+                *const_specs,
+            ],
+            out_specs=[
+                pl.BlockSpec((block_lanes,), lambda i: (i,)),
+                pl.BlockSpec((block_lanes,), lambda i: (i,)),
+                pl.BlockSpec((block_lanes,), lambda i: (i,)),
+            ],
+            out_shape=out_shape,
+            interpret=interpret,
+        )(op, a, b, msg, keys_p, *const_ops)
+        empty = jnp.zeros((n_lanes, 0, 0), jnp.int32)
+        return LaneResult(
+            status=st[:n_lanes],
+            violation=vio[:n_lanes],
+            deliveries=dl[:n_lanes],
+            trace=empty,
+            trace_len=jnp.zeros((n_lanes,), jnp.int32),
+        )
+
+    return jax.jit(call)

@@ -114,6 +114,13 @@ def vgather(vec, idx):
     return jnp.sum(jnp.where(oh, vec[None, :], 0), axis=1)
 
 
+def seg_set(vec, start: int, seg):
+    """Functional ``vec[start:start+len(seg)] = seg`` for a STATIC start.
+    Static slice + concatenate instead of dynamic_update_slice: under vmap
+    the latter lowers to scatter, which has no Mosaic lowering (pallas)."""
+    return jnp.concatenate([vec[:start], seg, vec[start + seg.shape[0]:]])
+
+
 def row_set(mat, i, row, enabled=True):
     """Functional ``mat[i] = row if enabled`` for a traced row index."""
     oh = (jnp.arange(mat.shape[0]) == i) & enabled

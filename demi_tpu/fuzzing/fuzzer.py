@@ -171,12 +171,15 @@ class Fuzzer:
                     generated += 1
             futile = futile + 1 if generated == before else 0
 
+        had_postfix = bool(self.postfix)
         events.extend(self.postfix)
         if not events or not isinstance(events[-1], WaitQuiescence):
             events.append(WaitQuiescence())
-        elif events[-1].budget is not None:
+        elif events[-1].budget is not None and not had_postfix:
             # The run ends with the last segment (reference semantics); a
-            # budgeted trailing wait would cap the final drain.
+            # *generated* budgeted trailing wait would cap the final drain.
+            # A user-supplied postfix wait is kept verbatim — a bounded
+            # final drain there is deliberate.
             events[-1] = WaitQuiescence()
         sanity_check_externals(events)
         return events
