@@ -24,7 +24,7 @@ except Exception:  # pragma: no cover
 
 from .core import DeviceConfig, ScheduleState
 from .explore import make_explore_kernel, make_single_lane_trace_kernel
-from .pallas_explore import make_explore_kernel_pallas
+from .pallas_explore import make_explore_kernel_pallas, make_replay_kernel_pallas
 from .replay import make_replay_kernel
 
 __all__ = [
@@ -32,6 +32,7 @@ __all__ = [
     "ScheduleState",
     "make_explore_kernel",
     "make_explore_kernel_pallas",
+    "make_replay_kernel_pallas",
     "make_single_lane_trace_kernel",
     "make_replay_kernel",
 ]

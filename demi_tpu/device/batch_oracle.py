@@ -13,6 +13,7 @@ compiled kernel.
 
 from __future__ import annotations
 
+import os
 from typing import Any, Callable, List, Optional, Sequence
 
 import numpy as np
@@ -68,11 +69,22 @@ class DeviceReplayChecker:
         app: DSLApp,
         cfg: DeviceConfig,
         config: SchedulerConfig,
+        impl: Optional[str] = None,
     ):
         self.app = app
         self.cfg = cfg
         self.config = config
-        self.kernel = make_replay_kernel(app, cfg)
+        # Kernel backend: 'xla' (default) or 'pallas' (VMEM-resident lane
+        # blocks, device/pallas_explore.py). DEMI_DEVICE_IMPL sets the
+        # default so a whole minimize pipeline can be flipped from the
+        # environment for TPU experiments.
+        impl = impl or os.environ.get("DEMI_DEVICE_IMPL", "xla")
+        if impl == "pallas":
+            from .pallas_explore import make_replay_kernel_pallas
+
+            self.kernel = make_replay_kernel_pallas(app, cfg)
+        else:
+            self.kernel = make_replay_kernel(app, cfg)
         self.max_records = cfg.max_steps + cfg.max_external_ops
 
     def verdicts(

@@ -1,34 +1,32 @@
-"""Pallas backend for the explore sweep: the whole step loop runs inside
+"""Pallas backends for the device kernels: the whole step loop runs inside
 one kernel, with each grid cell holding a block of lanes' full schedule
 state in VMEM for the entire run.
 
-Why: the XLA explore kernel (device/explore.py) is a `lax.while_loop`
+Why: the XLA kernels (device/explore.py, device/replay.py) are step loops
 whose carry — the complete per-lane ScheduleState — round-trips HBM every
 step.  At 8k lanes the carry is tens of MB, so the loop is
 HBM-bandwidth-bound even after the one-hot rewrite removed the serialized
 scatters.  A Pallas kernel gridded over lane blocks keeps a block's state
-resident in VMEM across all `max_steps` iterations: HBM traffic drops to
-one read of the programs/keys and one write of the verdicts per lane,
-regardless of step count.  This is the TPU-native answer to the
-reference's per-message JVM dispatch cycle (SURVEY.md §3.1,
-Instrumenter.scala:913-1109) at its hottest.
+resident in VMEM across all steps: HBM traffic drops to one read of the
+inputs and one write of the verdicts per lane, regardless of step count.
+This is the TPU-native answer to the reference's per-message JVM dispatch
+cycle (SURVEY.md §3.1, Instrumenter.scala:913-1109) at its hottest.
 
-Semantics are single-source: the kernel body calls the SAME
-`make_run_lane` step machinery as the XLA kernel (vmapped over the lane
-block), so the two backends are bit-identical — including the
-`jax.random` schedule stream, which the traced single-lane re-run
-(device/explore.py make_single_lane_trace_kernel) depends on when lifting
-a violating lane to the host oracle.
+Semantics are single-source: the kernel bodies call the SAME
+`make_run_lane` / `make_replay_run_lane` step machinery as the XLA
+kernels (vmapped over the lane block), so the backends are bit-identical
+— including the `jax.random` schedule stream, which the traced
+single-lane re-run (device/explore.py make_single_lane_trace_kernel)
+depends on when lifting a violating lane to the host oracle.
 
-On non-TPU backends the kernel runs in Pallas interpret mode, which is
-how the parity suite validates it on the CPU mesh (tests/test_pallas.py).
+On non-TPU backends the kernels run in Pallas interpret mode, which is
+how the parity suite validates them on the CPU mesh (tests/test_pallas.py).
 """
 
 from __future__ import annotations
 
-import math
-from functools import partial
-from typing import Optional
+import dataclasses
+from typing import Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -38,6 +36,7 @@ from jax.experimental import pallas as pl
 from ..dsl import DSLApp
 from .core import DeviceConfig
 from .explore import ExtProgram, LaneResult, make_run_lane
+from .replay import ReplayResult, make_replay_run_lane
 
 
 def _pad_to(x, b: int):
@@ -48,6 +47,112 @@ def _pad_to(x, b: int):
         return x
     pad = [(0, rem)] + [(0, 0)] * (x.ndim - 1)
     return jnp.pad(x, pad)
+
+
+def _check_pallas_cfg(cfg: DeviceConfig, interpret: Optional[bool]):
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    if not interpret and not cfg.use_onehot:
+        # Scatter-mode kernels trace cumsum/searchsorted/scatter, none of
+        # which have Mosaic lowerings — fail fast instead of deep inside
+        # the Mosaic compiler.
+        raise ValueError(
+            "pallas kernels require the one-hot index mode on TPU "
+            "(DeviceConfig(index_mode='onehot' or 'auto'))"
+        )
+    return interpret
+
+
+def _make_blocked_kernel(
+    block_fn,
+    in_structs: Sequence[jax.ShapeDtypeStruct],
+    n_outputs: int,
+    block_lanes: int,
+    interpret: bool,
+):
+    """Generic lane-blocked pallas_call wrapper.
+
+    ``block_fn(*block_arrays) -> tuple of [block_lanes] int32 arrays``
+    is traced once on ``in_structs`` (each with leading dim block_lanes);
+    every constant the trace closes over (init-state tables, timer-tag
+    vectors, ...) is hoisted into an explicit kernel operand, because
+    Pallas kernels may not capture constant arrays. jax.closure_convert
+    only hoists inexact-dtype constants, and this state machine is
+    all-integer — hence the manual jaxpr-consts threading. Bools ride as
+    int32 (Mosaic mask operands are awkward) and scalars as [1] vectors.
+    """
+    closed_jaxpr = jax.make_jaxpr(block_fn)(*in_structs)
+    consts = closed_jaxpr.consts
+
+    def _wire(c):
+        """(operand_to_pass, restore_fn) for one hoisted constant."""
+        arr = jnp.asarray(c)
+        restore_dtype = arr.dtype
+        if arr.dtype == jnp.bool_:
+            arr = arr.astype(jnp.int32)
+        shaped = arr.reshape((1,)) if arr.ndim == 0 else arr
+        squeeze = arr.ndim == 0
+
+        def restore(v):
+            if squeeze:
+                v = v.reshape(())
+            return v.astype(restore_dtype)
+
+        return shaped, restore
+
+    const_ops, const_restores = (
+        zip(*(_wire(c) for c in consts)) if consts else ((), ())
+    )
+    n_in = len(in_structs)
+
+    def kernel(*refs):
+        in_refs = refs[:n_in]
+        const_refs = refs[n_in : n_in + len(const_ops)]
+        out_refs = refs[n_in + len(const_ops):]
+        cvals = [
+            restore(ref[...])
+            for ref, restore in zip(const_refs, const_restores)
+        ]
+        outs = jax.core.eval_jaxpr(
+            closed_jaxpr.jaxpr, cvals, *(r[...] for r in in_refs)
+        )
+        for ref, val in zip(out_refs, outs):
+            ref[...] = val
+
+    def call(*arrays):
+        n_lanes = arrays[0].shape[0]
+        padded_arrays = [_pad_to(jnp.asarray(a), block_lanes) for a in arrays]
+        padded = padded_arrays[0].shape[0]
+        grid = (padded // block_lanes,)
+
+        def lane_spec(struct):
+            nd = len(struct.shape)
+            return pl.BlockSpec(
+                (block_lanes,) + tuple(struct.shape[1:]),
+                lambda i, nd=nd: (i,) + (0,) * (nd - 1),
+            )
+
+        const_specs = [
+            pl.BlockSpec(c.shape, lambda i, nd=c.ndim: (0,) * nd)
+            for c in const_ops
+        ]
+        outs = pl.pallas_call(
+            kernel,
+            grid=grid,
+            in_specs=[lane_spec(s) for s in in_structs] + const_specs,
+            out_specs=[
+                pl.BlockSpec((block_lanes,), lambda i: (i,))
+                for _ in range(n_outputs)
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((padded,), jnp.int32)
+                for _ in range(n_outputs)
+            ],
+            interpret=interpret,
+        )(*padded_arrays, *const_ops)
+        return [o[:n_lanes] for o in outs]
+
+    return call
 
 
 def make_explore_kernel_pallas(
@@ -69,131 +174,90 @@ def make_explore_kernel_pallas(
             "pallas explore kernel records verdicts only; use the XLA "
             "single-lane trace kernel for trace extraction"
         )
+    interpret = _check_pallas_cfg(cfg, interpret)
     run_lane = make_run_lane(app, cfg)
-    if interpret is None:
-        interpret = jax.default_backend() != "tpu"
-    if not interpret and not cfg.use_onehot:
-        # Scatter-mode kernels trace cumsum/searchsorted/scatter, none of
-        # which have Mosaic lowerings — fail fast instead of deep inside
-        # the Mosaic compiler.
-        raise ValueError(
-            "pallas explore kernel requires the one-hot index mode on TPU "
-            "(DeviceConfig(index_mode='onehot' or 'auto'))"
-        )
-
     e, w = cfg.max_external_ops, cfg.msg_width
 
-    # Pallas kernels may not capture constant arrays (the app's init-state
-    # table, initial-message rows, timer-tag vectors...). closure_convert
-    # hoists them out of the traced lane function; they become extra kernel
-    # operands, broadcast to every grid cell. Bools ride as int32 (Mosaic
-    # mask operands are awkward) and scalars as [1] vectors.
-    def lane_block_fn(progs: ExtProgram, keys):
-        return jax.vmap(run_lane)(progs, keys)
+    def block_fn(op, a, b, msg, keys):
+        res = jax.vmap(run_lane)(ExtProgram(op=op, a=a, b=b, msg=msg), keys)
+        return res.status, res.violation, res.deliveries
 
-    ex_progs = ExtProgram(
-        op=jax.ShapeDtypeStruct((block_lanes, e), jnp.int32),
-        a=jax.ShapeDtypeStruct((block_lanes, e), jnp.int32),
-        b=jax.ShapeDtypeStruct((block_lanes, e), jnp.int32),
-        msg=jax.ShapeDtypeStruct((block_lanes, e, w), jnp.int32),
-    )
-    ex_keys = jax.ShapeDtypeStruct((block_lanes, 2), jnp.uint32)
-    # jax.closure_convert hoists only inexact-dtype constants; this state
-    # machine is all-integer, so hoist every const by tracing to a jaxpr
-    # and threading jaxpr.consts as explicit arguments.
-    closed_jaxpr, out_shape_tree = jax.make_jaxpr(
-        lane_block_fn, return_shape=True
-    )(ex_progs, ex_keys)
-    consts = closed_jaxpr.consts
-    out_treedef = jax.tree_util.tree_structure(out_shape_tree)
-
-    def closed_fn(progs, keys, *cvals):
-        flat_args = jax.tree_util.tree_leaves((progs, keys))
-        out_flat = jax.core.eval_jaxpr(
-            closed_jaxpr.jaxpr, cvals, *flat_args
-        )
-        return jax.tree_util.tree_unflatten(out_treedef, out_flat)
-
-    def _wire(c):
-        """(operand_to_pass, restore_fn) for one hoisted constant."""
-        arr = jnp.asarray(c)
-        restore_dtype = arr.dtype
-        if arr.dtype == jnp.bool_:
-            arr = arr.astype(jnp.int32)
-        shaped = arr.reshape((1,)) if arr.ndim == 0 else arr
-        squeeze = arr.ndim == 0
-
-        def restore(v):
-            if squeeze:
-                v = v.reshape(())
-            return v.astype(restore_dtype)
-
-        return shaped, restore
-
-    const_ops, const_restores = (
-        zip(*(_wire(c) for c in consts)) if consts else ((), ())
-    )
-
-    def kernel(op_ref, a_ref, b_ref, msg_ref, key_ref, *rest):
-        const_refs = rest[: len(const_ops)]
-        st_ref, vio_ref, del_ref = rest[len(const_ops):]
-        progs = ExtProgram(
-            op=op_ref[...], a=a_ref[...], b=b_ref[...], msg=msg_ref[...]
-        )
-        cvals = [
-            restore(ref[...])
-            for ref, restore in zip(const_refs, const_restores)
-        ]
-        res = closed_fn(progs, key_ref[...], *cvals)
-        st_ref[...] = res.status
-        vio_ref[...] = res.violation
-        del_ref[...] = res.deliveries
+    bl = block_lanes
+    in_structs = [
+        jax.ShapeDtypeStruct((bl, e), jnp.int32),
+        jax.ShapeDtypeStruct((bl, e), jnp.int32),
+        jax.ShapeDtypeStruct((bl, e), jnp.int32),
+        jax.ShapeDtypeStruct((bl, e, w), jnp.int32),
+        jax.ShapeDtypeStruct((bl, 2), jnp.uint32),
+    ]
+    blocked = _make_blocked_kernel(block_fn, in_structs, 3, bl, interpret)
 
     def call(progs: ExtProgram, keys) -> LaneResult:
         n_lanes = keys.shape[0]
-        op = _pad_to(jnp.asarray(progs.op, jnp.int32), block_lanes)
-        a = _pad_to(jnp.asarray(progs.a, jnp.int32), block_lanes)
-        b = _pad_to(jnp.asarray(progs.b, jnp.int32), block_lanes)
-        msg = _pad_to(jnp.asarray(progs.msg, jnp.int32), block_lanes)
-        keys_p = _pad_to(jnp.asarray(keys), block_lanes)
-        padded = op.shape[0]
-        grid = (padded // block_lanes,)
-        lane_block = lambda i: (i, 0)
-        out_shape = [
-            jax.ShapeDtypeStruct((padded,), jnp.int32),  # status
-            jax.ShapeDtypeStruct((padded,), jnp.int32),  # violation
-            jax.ShapeDtypeStruct((padded,), jnp.int32),  # deliveries
-        ]
-        const_specs = [
-            pl.BlockSpec(c.shape, lambda i, nd=c.ndim: (0,) * nd)
-            for c in const_ops
-        ]
-        st, vio, dl = pl.pallas_call(
-            kernel,
-            grid=grid,
-            in_specs=[
-                pl.BlockSpec((block_lanes, e), lane_block),
-                pl.BlockSpec((block_lanes, e), lane_block),
-                pl.BlockSpec((block_lanes, e), lane_block),
-                pl.BlockSpec((block_lanes, e, w), lambda i: (i, 0, 0)),
-                pl.BlockSpec((block_lanes, 2), lane_block),
-                *const_specs,
-            ],
-            out_specs=[
-                pl.BlockSpec((block_lanes,), lambda i: (i,)),
-                pl.BlockSpec((block_lanes,), lambda i: (i,)),
-                pl.BlockSpec((block_lanes,), lambda i: (i,)),
-            ],
-            out_shape=out_shape,
-            interpret=interpret,
-        )(op, a, b, msg, keys_p, *const_ops)
+        st, vio, dl = blocked(progs.op, progs.a, progs.b, progs.msg, keys)
         empty = jnp.zeros((n_lanes, 0, 0), jnp.int32)
         return LaneResult(
-            status=st[:n_lanes],
-            violation=vio[:n_lanes],
-            deliveries=dl[:n_lanes],
+            status=st,
+            violation=vio,
+            deliveries=dl,
             trace=empty,
             trace_len=jnp.zeros((n_lanes,), jnp.int32),
         )
 
     return jax.jit(call)
+
+
+def make_replay_kernel_pallas(
+    app: DSLApp,
+    cfg: DeviceConfig,
+    block_lanes: int = 128,
+    interpret: Optional[bool] = None,
+):
+    """Pallas twin of ``make_replay_kernel``: ``kernel(records[B, R, W],
+    keys[B]) -> ReplayResult[B]`` — the batched STS ignore-absent oracle
+    with VMEM-resident lane blocks.
+
+    The record loop always runs in the early-exit (while_loop + one-hot
+    record fetch) form: the non-early-exit ``lax.scan`` over records
+    slices its xs with dynamic_slice, which has no Mosaic lowering.
+    Results are identical either way (the scan form is just the padded
+    equivalent)."""
+    if cfg.record_trace:
+        raise ValueError("pallas replay kernel records verdicts only")
+    interpret = _check_pallas_cfg(cfg, interpret)
+    if not cfg.early_exit:
+        cfg = dataclasses.replace(cfg, early_exit=True)
+    run_lane = make_replay_run_lane(app, cfg)
+
+    def _kernel_for(n_records: int):
+        def block_fn(records, keys):
+            res = jax.vmap(run_lane)(records, keys)
+            return (
+                res.status,
+                res.violation,
+                res.deliveries,
+                res.ignored_absent,
+            )
+
+        in_structs = [
+            jax.ShapeDtypeStruct(
+                (block_lanes, n_records, cfg.rec_width), jnp.int32
+            ),
+            jax.ShapeDtypeStruct((block_lanes, 2), jnp.uint32),
+        ]
+        return _make_blocked_kernel(
+            block_fn, in_structs, 4, block_lanes, interpret
+        )
+
+    cache = {}
+
+    def call(records, keys) -> ReplayResult:
+        n_records = records.shape[1]
+        if n_records not in cache:
+            cache[n_records] = jax.jit(_kernel_for(n_records))
+        st, vio, dl, ig = cache[n_records](records, keys)
+        return ReplayResult(
+            status=st, violation=vio, deliveries=dl, ignored_absent=ig
+        )
+
+    return call
