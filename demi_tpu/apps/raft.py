@@ -40,6 +40,12 @@ in for the akka-raft raft-NN branches):
                       its own log length: a heartbeat reordered ahead of
                       its AppendEntries commits an entry the follower
                       doesn't have yet (committed-prefix violation).
+  bug="dyn_quorum"  — quorum computed from *discovered* membership (the
+                      heard-from bitmask) instead of the configured
+                      cluster size: a node electing before any peer
+                      exchange sees a 1-node cluster and instantly wins
+                      (raft-58-initialization-class bug; two such nodes =
+                      two same-term leaders).
 
 One more case study needs NO bug flag: this fixture keeps voted_for/term
 in memory only (the DSL has no durable storage), so HardKill+restart wipes
@@ -87,7 +93,8 @@ LOG_START = 7  # LOG_CAP x (term, value) interleaved
 
 
 def state_width(n: int, log_cap: int) -> int:
-    return LOG_START + 2 * log_cap + 2 * n  # + next_index[n] + match_index[n]
+    # + next_index[n] + match_index[n] + heard-from bitmask
+    return LOG_START + 2 * log_cap + 2 * n + 1
 
 
 def make_raft_app(
@@ -102,6 +109,7 @@ def make_raft_app(
     S = state_width(n, log_cap)
     NEXT = LOG_START + 2 * log_cap
     MATCH = NEXT + n
+    HEARD = MATCH + n  # bitmask of peers this node has received from
     majority = n // 2 + 1
 
     def init_state(actor_id: int) -> np.ndarray:
@@ -201,10 +209,31 @@ def make_raft_app(
         lli, llt = last_log(state)
         rv = broadcast(actor_id, T_REQ_VOTE, state[TERM], a=lli, b=llt)
         out = jnp.where(is_leader, jnp.zeros_like(rv), rv)
+        wins_alone = jnp.bool_(False)
+        if bug == "dyn_quorum":
+            # BUG (raft-58-initialization class): quorum is computed from
+            # the nodes this one has *discovered* (heard from), not the
+            # configured cluster size. A node whose election timer fires
+            # before it has heard from anyone sees a 1-node "cluster",
+            # wins its own vote instantly, and two such nodes elect two
+            # same-term leaders.
+            known = jnp.sum(
+                (state[HEARD] >> jnp.arange(n, dtype=jnp.int32)) & 1
+            )
+            wins_alone = ~is_leader & (1 >= known // 2 + 1)
+            state = jnp.where(
+                wins_alone, _become_leader(actor_id, state), state
+            )
+            out = jnp.where(
+                wins_alone,
+                _arm_heartbeat(actor_id, heartbeat_rows(actor_id, state)),
+                out,
+            )
         # Re-arm the election timer in the self slot (broadcast never
-        # targets self, so that row is free).
+        # targets self, so that row is free; an instant dyn_quorum winner
+        # keeps its heartbeat arm there instead).
         out = one_row(out, actor_id, jnp.int32(actor_id), jnp.int32(T_ELECTION),
-                      jnp.int32(0))
+                      jnp.int32(0), valid=~wins_alone)
         return state, out
 
     def _become_leader(actor_id, state):
@@ -404,6 +433,17 @@ def make_raft_app(
         return state, out
 
     def handler(actor_id, state, snd, msg):
+        # Membership discovery: remember every peer we've received from
+        # (self counts; external/timer senders are masked off). Only the
+        # dyn_quorum bug *reads* this, but it is tracked unconditionally so
+        # the layout doesn't depend on the bug flag.
+        peer_bit = jnp.where(
+            (snd >= 0) & (snd < n), jnp.int32(1) << jnp.clip(snd, 0, n - 1), 0
+        )
+        state = vset(
+            state, HEARD,
+            state[HEARD] | peer_bit | (jnp.int32(1) << actor_id),
+        )
         tag = jnp.clip(msg[0], 1, 7) - 1
         branches = [
             on_election, on_heartbeat, on_request_vote, on_vote_reply,

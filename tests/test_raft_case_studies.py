@@ -118,6 +118,54 @@ def test_correct_raft_clean_under_same_sweep():
     assert int((np.asarray(res.violation) != 0).sum()) == 0
 
 
+def test_dyn_quorum_initialization_bug():
+    """raft-58-initialization-class case study: quorum computed from the
+    membership a node has *discovered* instead of the configured cluster
+    size. Two nodes whose election timers fire before any peer exchange
+    each see a 1-node cluster and both become term-1 leaders. Detected by
+    the host fuzzer, minimized to its 2-Start core, and the same sweep on
+    correct raft stays clean (the discovery tracking itself is benign)."""
+    app = make_raft_app(3, bug="dyn_quorum")
+    config = SchedulerConfig(invariant_check=make_host_invariant(app))
+    program = _program(app)
+    found = None
+    for seed in range(20):
+        r = RandomScheduler(
+            config, seed=seed, max_messages=200,
+            invariant_check_interval=1, timer_weight=0.3,
+        ).execute(program)
+        if r.violation is not None:
+            found = r
+            break
+    assert found is not None, "dyn_quorum never produced two leaders"
+    assert found.violation.code == 1  # Election Safety
+
+    mcs, verified = sts_sched_ddmin(
+        config, found.trace, program, found.violation
+    )
+    assert verified is not None
+    kept = mcs.get_all_events()
+    # The bug needs nothing beyond two nodes starting and their timers
+    # firing: every client Send must be pruned.
+    from demi_tpu.external_events import Send as _Send
+
+    assert not any(isinstance(e, _Send) for e in kept)
+    assert len(kept) < len(program)
+
+    # Device sweep agrees (host/device parity for the HEARD tracking).
+    cfg = _device_cfg(app)
+    B = 128
+    kernel = make_explore_kernel(app, cfg)
+    progs = stack_programs([lower_program(app, cfg, program)] * B)
+    keys = jax.random.split(jax.random.PRNGKey(0), B)
+    res = kernel(progs, keys)
+    statuses = np.asarray(res.status)
+    assert int((statuses == ST_OVERFLOW).sum()) == 0
+    lanes = np.flatnonzero(statuses == ST_VIOLATION)
+    assert len(lanes) > 0
+    assert set(np.asarray(res.violation)[lanes]) == {1}
+
+
 def test_lost_vote_durability_on_crash_recovery():
     """raft-66-class persistence case study on UNMODIFIED Raft: the fixture
     keeps voted_for/term in memory only, so HardKill+restart wipes them —
