@@ -14,6 +14,7 @@ bit math; only the frontier priority queue stays host-side.
 
 from __future__ import annotations
 
+import os
 from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 import numpy as np
@@ -43,9 +44,11 @@ from .encoding import lower_program
 from .explore import ExtProgram, LaneResult, _finalize, make_step_fn
 
 
-def make_dpor_kernel(app: DSLApp, cfg: DeviceConfig):
-    """jitted ``kernel(progs[B], prescriptions[B, R, recw], keys[B]) ->
-    LaneResult[B]``. cfg must have record_trace and record_parents on.
+def make_dpor_run_lane(app: DSLApp, cfg: DeviceConfig):
+    """Unjitted single-lane DPOR sweep ``run_lane(prog, prescription, key)
+    -> LaneResult`` (composable with vmap/jit by callers — the XLA kernel
+    below and the pallas twin in pallas_explore.py).
+    cfg must have record_trace and record_parents on.
 
     Dispatch follows the prescription while records match (absent records
     are skipped — divergence tolerance), then falls back to the explore
@@ -170,7 +173,13 @@ def make_dpor_kernel(app: DSLApp, cfg: DeviceConfig):
             trace_len=state.trace_len,
         )
 
-    return jax.jit(jax.vmap(run_lane))
+    return run_lane
+
+
+def make_dpor_kernel(app: DSLApp, cfg: DeviceConfig):
+    """jitted ``kernel(progs[B], prescriptions[B, R, recw], keys[B]) ->
+    LaneResult[B]`` (see make_dpor_run_lane)."""
+    return jax.jit(jax.vmap(make_dpor_run_lane(app, cfg)))
 
 
 # ---------------------------------------------------------------------------
@@ -347,11 +356,20 @@ class DeviceDPOR:
         cfg: DeviceConfig,
         program: Sequence[ExternalEvent],
         batch_size: int = 64,
+        impl: Optional[str] = None,
     ):
         assert cfg.record_trace and cfg.record_parents
         self.app = app
         self.cfg = cfg
-        self.kernel = make_dpor_kernel(app, cfg)
+        impl = impl or os.environ.get("DEMI_DEVICE_IMPL", "xla")
+        if impl == "pallas":
+            from .pallas_explore import make_dpor_kernel_pallas
+
+            self.kernel = make_dpor_kernel_pallas(
+                app, cfg, block_lanes=min(64, batch_size)
+            )
+        else:
+            self.kernel = make_dpor_kernel(app, cfg)
         self.prog = lower_program(app, cfg, list(program))
         self.batch_size = batch_size
         self.explored: Set[Tuple] = set()

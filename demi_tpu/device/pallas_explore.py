@@ -66,15 +66,15 @@ def _check_pallas_cfg(cfg: DeviceConfig, interpret: Optional[bool]):
 def _make_blocked_kernel(
     block_fn,
     in_structs: Sequence[jax.ShapeDtypeStruct],
-    n_outputs: int,
     block_lanes: int,
     interpret: bool,
 ):
     """Generic lane-blocked pallas_call wrapper.
 
-    ``block_fn(*block_arrays) -> tuple of [block_lanes] int32 arrays``
-    is traced once on ``in_structs`` (each with leading dim block_lanes);
-    every constant the trace closes over (init-state tables, timer-tag
+    ``block_fn(*block_arrays) -> tuple of arrays with leading dim
+    block_lanes`` is traced once on ``in_structs`` (each with leading dim
+    block_lanes); output shapes/dtypes come from the traced jaxpr.
+    Every constant the trace closes over (init-state tables, timer-tag
     vectors, ...) is hoisted into an explicit kernel operand, because
     Pallas kernels may not capture constant arrays. jax.closure_convert
     only hoists inexact-dtype constants, and this state machine is
@@ -83,6 +83,13 @@ def _make_blocked_kernel(
     """
     closed_jaxpr = jax.make_jaxpr(block_fn)(*in_structs)
     consts = closed_jaxpr.consts
+    out_avals = closed_jaxpr.out_avals
+    for a in out_avals:
+        if not a.shape or a.shape[0] != block_lanes:
+            raise ValueError(
+                f"block_fn outputs must have leading dim {block_lanes}, "
+                f"got {a.shape}"
+            )
 
     def _wire(c):
         """(operand_to_pass, restore_fn) for one hoisted constant."""
@@ -140,13 +147,10 @@ def _make_blocked_kernel(
             kernel,
             grid=grid,
             in_specs=[lane_spec(s) for s in in_structs] + const_specs,
-            out_specs=[
-                pl.BlockSpec((block_lanes,), lambda i: (i,))
-                for _ in range(n_outputs)
-            ],
+            out_specs=[lane_spec(a) for a in out_avals],
             out_shape=[
-                jax.ShapeDtypeStruct((padded,), jnp.int32)
-                for _ in range(n_outputs)
+                jax.ShapeDtypeStruct((padded,) + tuple(a.shape[1:]), a.dtype)
+                for a in out_avals
             ],
             interpret=interpret,
         )(*padded_arrays, *const_ops)
@@ -190,7 +194,7 @@ def make_explore_kernel_pallas(
         jax.ShapeDtypeStruct((bl, e, w), jnp.int32),
         jax.ShapeDtypeStruct((bl, 2), jnp.uint32),
     ]
-    blocked = _make_blocked_kernel(block_fn, in_structs, 3, bl, interpret)
+    blocked = _make_blocked_kernel(block_fn, in_structs, bl, interpret)
 
     def call(progs: ExtProgram, keys) -> LaneResult:
         n_lanes = keys.shape[0]
@@ -202,6 +206,55 @@ def make_explore_kernel_pallas(
             deliveries=dl,
             trace=empty,
             trace_len=jnp.zeros((n_lanes,), jnp.int32),
+        )
+
+    return jax.jit(call)
+
+
+def make_dpor_kernel_pallas(
+    app: DSLApp,
+    cfg: DeviceConfig,
+    block_lanes: int = 64,
+    interpret: Optional[bool] = None,
+):
+    """Pallas twin of ``make_dpor_kernel``: the frontier-batched DPOR
+    sweep with VMEM-resident lane blocks, traces included — each lane's
+    parent-tracked trace ([max_steps, rec_width]) is a kernel output, so
+    the VMEM working set per lane is pool + trace (size accordingly:
+    block_lanes * max_steps * rec_width * 4 bytes for the traces alone).
+    """
+    from .dpor_sweep import make_dpor_run_lane
+
+    interpret = _check_pallas_cfg(cfg, interpret)
+    run_lane = make_dpor_run_lane(app, cfg)
+    e, w = cfg.max_external_ops, cfg.msg_width
+    bl = block_lanes
+
+    def block_fn(op, a, b, msg, prescs, keys):
+        res = jax.vmap(run_lane)(
+            ExtProgram(op=op, a=a, b=b, msg=msg), prescs, keys
+        )
+        return (
+            res.status, res.violation, res.deliveries, res.trace,
+            res.trace_len,
+        )
+
+    in_structs = [
+        jax.ShapeDtypeStruct((bl, e), jnp.int32),
+        jax.ShapeDtypeStruct((bl, e), jnp.int32),
+        jax.ShapeDtypeStruct((bl, e), jnp.int32),
+        jax.ShapeDtypeStruct((bl, e, w), jnp.int32),
+        jax.ShapeDtypeStruct((bl, cfg.max_steps, cfg.rec_width), jnp.int32),
+        jax.ShapeDtypeStruct((bl, 2), jnp.uint32),
+    ]
+    blocked = _make_blocked_kernel(block_fn, in_structs, bl, interpret)
+
+    def call(progs: ExtProgram, prescs, keys) -> LaneResult:
+        st, vio, dl, tr, tl = blocked(
+            progs.op, progs.a, progs.b, progs.msg, prescs, keys
+        )
+        return LaneResult(
+            status=st, violation=vio, deliveries=dl, trace=tr, trace_len=tl
         )
 
     return jax.jit(call)
@@ -246,7 +299,7 @@ def make_replay_kernel_pallas(
             jax.ShapeDtypeStruct((block_lanes, 2), jnp.uint32),
         ]
         return _make_blocked_kernel(
-            block_fn, in_structs, 4, block_lanes, interpret
+            block_fn, in_structs, block_lanes, interpret
         )
 
     cache = {}

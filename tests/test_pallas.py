@@ -188,6 +188,37 @@ def test_batched_ddmin_on_pallas_backend():
     )
 
 
+def test_pallas_dpor_parity():
+    """The pallas DPOR sweep twin (trace outputs included) must be
+    bit-identical to the XLA kernel — the host racing-pair analysis
+    consumes the traces directly."""
+    from demi_tpu.device.dpor_sweep import make_dpor_kernel
+    from demi_tpu.device.encoding import lower_program, stack_programs
+    from demi_tpu.device.pallas_explore import make_dpor_kernel_pallas
+
+    app = make_broadcast_app(3, reliable=False)
+    cfg = DeviceConfig.for_app(
+        app, pool_capacity=64, max_steps=48, max_external_ops=8,
+        invariant_interval=1, record_trace=True, record_parents=True,
+    )
+    prog = dsl_start_events(app) + [
+        Send(app.actor_name(0), MessageConstructor(lambda: (1, 0))),
+        WaitQuiescence(),
+    ]
+    B = 12
+    progs = stack_programs([lower_program(app, cfg, prog)] * B)
+    prescs = np.zeros((B, cfg.max_steps, cfg.rec_width), np.int32)
+    keys = jax.random.split(jax.random.PRNGKey(5), B)
+    xla = make_dpor_kernel(app, cfg)(progs, prescs, keys)
+    pal = make_dpor_kernel_pallas(app, cfg, block_lanes=4)(
+        progs, prescs, keys
+    )
+    for field in ("status", "violation", "deliveries", "trace", "trace_len"):
+        av = np.asarray(getattr(xla, field))
+        bv = np.asarray(getattr(pal, field))
+        assert (av == bv).all(), field
+
+
 def test_rng_split_bit_identical():
     """ops.rng_split must match jax.random.split exactly — the pallas and
     XLA backends must draw the same schedule stream."""
