@@ -1,0 +1,97 @@
+"""Kernel benchmark matrix: explore throughput across backends and sizes.
+
+For the (scarce) real-TPU windows: one run measures the XLA and pallas
+explore kernels across batch sizes and pallas block sizes on the 5-node
+raft headline workload, printing one JSON line per cell as it goes (so a
+killed run still leaves data).
+
+    python -m demi_tpu.tools.bench_matrix
+    python -m demi_tpu.tools.bench_matrix --batches 4096,8192 --blocks 128,256
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--batches", default="2048,8192,16384")
+    p.add_argument("--blocks", default="128,256,512")
+    p.add_argument("--reps", type=int, default=3)
+    args = p.parse_args(argv)
+
+    import jax
+
+    sys.path.insert(0, ".")
+    from bench import _raft_workload
+
+    from ..device import (
+        DeviceConfig,
+        make_explore_kernel,
+        make_explore_kernel_pallas,
+    )
+    from ..device.encoding import lower_program, stack_programs
+
+    app, program = _raft_workload()
+    cfg = DeviceConfig.for_app(
+        app, pool_capacity=96, max_steps=144, max_external_ops=24,
+        invariant_interval=1, timer_weight=0.2,
+    )
+    platform = jax.devices()[0].platform
+    prog1 = lower_program(app, cfg, program)
+
+    def measure(kernel, batch):
+        progs = stack_programs([prog1] * batch)
+        keys = jax.random.split(jax.random.PRNGKey(0), batch)
+        t0 = time.perf_counter()
+        jax.block_until_ready(kernel(progs, keys))
+        compile_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        for r in range(1, args.reps + 1):
+            res = kernel(progs, jax.random.split(jax.random.PRNGKey(r), batch))
+        jax.block_until_ready(res)
+        secs = time.perf_counter() - t0
+        return args.reps * batch / secs, compile_s
+
+    batches = [int(x) for x in args.batches.split(",")]
+    blocks = [int(x) for x in args.blocks.split(",")]
+    for batch in batches:
+        try:
+            sps, comp = measure(make_explore_kernel(app, cfg), batch)
+            print(json.dumps({
+                "impl": "xla", "platform": platform, "batch": batch,
+                "schedules_per_sec": round(sps, 1),
+                "compile_s": round(comp, 1),
+            }), flush=True)
+        except Exception as e:
+            print(json.dumps({
+                "impl": "xla", "batch": batch, "error": repr(e)[:300]
+            }), flush=True)
+    for batch in batches:
+        for bl in blocks:
+            if bl > batch:
+                continue
+            try:
+                sps, comp = measure(
+                    make_explore_kernel_pallas(app, cfg, block_lanes=bl),
+                    batch,
+                )
+                print(json.dumps({
+                    "impl": "pallas", "platform": platform, "batch": batch,
+                    "block_lanes": bl,
+                    "schedules_per_sec": round(sps, 1),
+                    "compile_s": round(comp, 1),
+                }), flush=True)
+            except Exception as e:
+                print(json.dumps({
+                    "impl": "pallas", "batch": batch, "block_lanes": bl,
+                    "error": repr(e)[:300],
+                }), flush=True)
+
+
+if __name__ == "__main__":
+    main()
