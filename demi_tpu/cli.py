@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 from typing import Optional
 
@@ -90,6 +91,8 @@ def cmd_fuzz(args) -> int:
 
 
 def cmd_minimize(args) -> int:
+    if getattr(args, "impl", "xla") == "pallas":
+        os.environ["DEMI_DEVICE_IMPL"] = "pallas"
     from .runner import FuzzResult, print_minimization_stats, run_the_gamut
     from .serialization import ExperimentDeserializer, ExperimentSerializer
 
@@ -189,7 +192,11 @@ def cmd_sweep(args) -> int:
     import numpy as np
     import jax
 
-    from .device import DeviceConfig, make_explore_kernel
+    from .device import (
+        DeviceConfig,
+        make_explore_kernel,
+        make_explore_kernel_pallas,
+    )
     from .device.core import ST_VIOLATION
     from .device.encoding import lower_program, stack_programs
 
@@ -208,7 +215,10 @@ def cmd_sweep(args) -> int:
     ]
     progs = stack_programs([lower_program(app, cfg, p) for p in programs])
     keys = jax.random.split(jax.random.PRNGKey(args.seed), args.batch)
-    kernel = make_explore_kernel(app, cfg)
+    if getattr(args, "impl", "xla") == "pallas":
+        kernel = make_explore_kernel_pallas(app, cfg)
+    else:
+        kernel = make_explore_kernel(app, cfg)
     res = kernel(progs, keys)
     violations = np.asarray(res.violation)
     lanes = np.nonzero(np.asarray(res.status) == ST_VIOLATION)[0]
@@ -231,6 +241,8 @@ def cmd_sweep(args) -> int:
 
 def cmd_dpor(args) -> int:
     """Systematic batched DPOR search (BASELINE config 2 shape)."""
+    if getattr(args, "impl", "xla") == "pallas":
+        os.environ["DEMI_DEVICE_IMPL"] = "pallas"
     from .device import DeviceConfig
     from .device.dpor_sweep import DeviceDPOROracle
 
@@ -317,6 +329,10 @@ def main(argv: Optional[list] = None) -> int:
     p.set_defaults(fn=cmd_fuzz)
 
     p = sub.add_parser("minimize", help="run the minimization gamut on an experiment")
+    p.add_argument(
+        "--impl", choices=("xla", "pallas"), default="xla",
+        help="device-batched oracle backend",
+    )
     common(p)
     p.add_argument("-e", "--experiment", required=True)
     p.add_argument("--no-wildcards", action="store_true")
@@ -345,6 +361,10 @@ def main(argv: Optional[list] = None) -> int:
     p.set_defaults(fn=cmd_replay)
 
     p = sub.add_parser("sweep", help="device-batched fuzz sweep")
+    p.add_argument(
+        "--impl", choices=("xla", "pallas"), default="xla",
+        help="kernel backend: xla (default) or pallas VMEM-resident blocks",
+    )
     common(p)
     p.add_argument("--batch", type=int, default=256)
     p.add_argument("--pool", type=int, default=256)
@@ -357,6 +377,10 @@ def main(argv: Optional[list] = None) -> int:
     p.set_defaults(fn=cmd_sweep)
 
     p = sub.add_parser("dpor", help="systematic batched DPOR search")
+    p.add_argument(
+        "--impl", choices=("xla", "pallas"), default="xla",
+        help="DPOR sweep kernel backend",
+    )
     common(p)
     p.add_argument("--batch", type=int, default=64)
     p.add_argument("--pool", type=int, default=256)
