@@ -94,6 +94,12 @@ class DeviceConfig:
     # uses one-hot compare+where on TPU (vmapped scatters serialize there)
     # and native gathers/scatters elsewhere; 'onehot'/'scatter' force.
     index_mode: str = "auto"
+    # SrcDstFIFO randomization (reference: RandomScheduler.scala:702-909,
+    # host twin schedulers/random.py SrcDstFIFO): per-(src,dst) channels
+    # are TCP-ordered — only each channel's FIFO head is a delivery
+    # candidate; timers stay individually choosable. Costs an O(P^2)
+    # same-channel compare per step, so opt-in.
+    srcdst_fifo: bool = False
 
     def __post_init__(self):
         if self.index_mode not in ("auto", "onehot", "scatter"):
@@ -223,6 +229,22 @@ def deliverable_mask(state: ScheduleState, cfg: DeviceConfig) -> jnp.ndarray:
         state.pool_timer | src_is_external, True, ~link_cut
     ) & dst_reachable
     return state.pool_valid & ~state.pool_parked & dst_ok & passes_network
+
+
+def fifo_head_mask(state: ScheduleState) -> jnp.ndarray:
+    """Entries that are their (src,dst) channel's FIFO head (earliest
+    arrival seq among valid non-timer entries of the same pair). Timers are
+    not channelized and pass through unconditionally."""
+    chan = state.pool_valid & ~state.pool_timer
+    same_pair = (
+        (state.pool_src[:, None] == state.pool_src[None, :])
+        & (state.pool_dst[:, None] == state.pool_dst[None, :])
+        & chan[:, None]
+        & chan[None, :]
+    )
+    earlier = same_pair & (state.pool_seq[None, :] < state.pool_seq[:, None])
+    is_head = chan & ~jnp.any(earlier, axis=1)
+    return state.pool_timer | is_head
 
 
 def alive_mask(state: ScheduleState) -> jnp.ndarray:

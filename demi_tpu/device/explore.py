@@ -36,6 +36,7 @@ from .core import (
     delivery_effects,
     deliverable_mask,
     external_effects,
+    fifo_head_mask,
     init_state,
     insert_rows,
 )
@@ -154,6 +155,9 @@ def make_step_fn(app: DSLApp, cfg: DeviceConfig):
 
         # ----- dispatch side (inert unless `dispatching`: idx -> P) -------
         mask = deliverable_mask(state, cfg) & dispatching
+        if cfg.srcdst_fifo:
+            # TCP-ordered channels: only FIFO heads (and timers) compete.
+            mask = mask & fifo_head_mask(state)
         count = jnp.sum(mask.astype(jnp.int32))
         any_deliverable = count > 0
 

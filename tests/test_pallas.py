@@ -290,7 +290,7 @@ def test_mosaic_primitive_coverage():
             dict(timer_weight=0.2, early_exit=True),
         ),
         (make_spark_app(num_workers=3, bug="stale_task"), dict(early_exit=True)),
-        (make_broadcast_app(8, reliable=True), {}),
+        (make_broadcast_app(8, reliable=True), dict(srcdst_fifo=True)),
     ]
     for app, overrides in cases:
         cfg = DeviceConfig.for_app(
