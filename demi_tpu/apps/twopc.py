@@ -1,0 +1,236 @@
+"""Two-phase commit: the transaction-atomicity fixture.
+
+A classic distributed-commit protocol as a fourth app family alongside
+broadcast/raft/spark (standing in for the reference's out-of-repo
+demi-applications suite, SURVEY.md §4). Actor 0 is the coordinator; the
+rest are participants.
+
+Protocol: an external ``BEGIN(txn)`` starts a round — the coordinator
+broadcasts ``PREPARE(txn)``; each participant either vetoes (votes no and
+aborts locally — a no-voter may abort unilaterally) or becomes prepared
+and votes yes; on all-yes the coordinator decides commit, on any no it
+decides abort, and broadcasts ``DECIDE``; a coordinator timeout during
+collection decides abort (the presumed-abort rule). The veto rule is
+deterministic — participant p vetoes txn iff (txn + p) % 3 == 0 — so
+fuzzed runs mix clean commits and vetoed rounds.
+
+Safety invariant (code 1, atomicity): no two alive nodes may finalize the
+SAME txn differently (one committed, one aborted).
+
+Seeded bug ``bug="presume_commit"``: the coordinator's collection timeout
+presumes commit instead of abort. A schedule that delivers the timeout
+before a veto's no-vote commits the fast voters while the vetoing
+participant has already aborted — atomicity violated. Needs the timeout
+racing the vote messages: a scheduler-controlled interleaving bug in the
+reference's style (timers are just deliverable events, WeaveActor.aj's
+timer conversion).
+"""
+
+from __future__ import annotations
+
+import random as _random
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..dsl import DSLApp, vset
+from .common import DSLSendGenerator
+
+T_BEGIN = 1  # (tag, txn, 0) external -> coordinator
+T_PREPARE = 2  # (tag, txn, 0) coordinator -> participants
+T_VOTE = 3  # (tag, txn, yes) participant -> coordinator
+T_DECIDE = 4  # (tag, txn, commit) coordinator -> participants
+T_TIMEOUT = 5  # coordinator self-timer
+
+MSG_W = 3
+
+# State layout (shared by coordinator and participants).
+STATUS = 0  # 0 idle, 1 prepared, 2 committed, 3 aborted
+TXN = 1  # txn the STATUS refers to (-1 none)
+YES = 2  # coordinator: yes-vote bitmask for the collecting txn
+PHASE = 3  # coordinator: 0 idle, 1 collecting
+
+IDLE, PREPARED, COMMITTED, ABORTED = 0, 1, 2, 3
+
+
+def make_twopc_app(
+    num_actors: int, bug: Optional[str] = None, name: str = "t"
+) -> DSLApp:
+    n = num_actors
+    assert n >= 3, "2PC fixture needs a coordinator + >=2 participants"
+    state_width = 4
+    max_outbox = n  # broadcast to participants + self-timer re-arm
+    part_mask = ((1 << n) - 1) & ~1  # participants = actors 1..n-1
+
+    def init_state(actor_id: int) -> np.ndarray:
+        s = np.zeros(state_width, np.int32)
+        s[TXN] = -1
+        return s
+
+    def initial_msgs(actor_id: int) -> np.ndarray:
+        rows = np.zeros((1, 2 + MSG_W), np.int32)
+        if actor_id == 0:  # coordinator arms its collection timeout
+            rows[0, 0] = 1
+            rows[0, 1] = 0
+            rows[0, 2] = T_TIMEOUT
+        return rows
+
+    def _broadcast(tag, txn, flag):
+        dsts = jnp.arange(n, dtype=jnp.int32)
+        valid = (dsts != 0).astype(jnp.int32)
+        zeros = jnp.zeros(n, jnp.int32)
+        return jnp.stack(
+            [valid, dsts, zeros + tag, zeros + txn, zeros + flag], axis=1
+        )
+
+    def _rearm(out):
+        row = jnp.stack(
+            [jnp.int32(1), jnp.int32(0), jnp.int32(T_TIMEOUT), jnp.int32(0),
+             jnp.int32(0)]
+        )
+        return jnp.where(jnp.arange(n)[:, None] == 0, row[None, :], out)
+
+    def empty_out():
+        return jnp.zeros((max_outbox, 2 + MSG_W), jnp.int32)
+
+    def _veto(pid, txn):
+        # txn % n picks the vetoing participant (txn % n == 0 names the
+        # coordinator, i.e. nobody: that txn can commit cleanly).
+        return (txn % n) == pid
+
+    def on_begin(actor_id, state, snd, msg):
+        txn = msg[1]
+        is_coord = actor_id == 0
+        fresh = is_coord & (state[PHASE] == 0)
+        state = vset(state, PHASE, 1, fresh)
+        state = vset(state, TXN, txn, fresh)
+        state = vset(state, YES, 0, fresh)
+        state = vset(state, STATUS, IDLE, fresh)
+        out = jnp.where(fresh, _broadcast(T_PREPARE, txn, 0), empty_out())
+        return state, out
+
+    def on_prepare(actor_id, state, snd, msg):
+        txn = msg[1]
+        is_part = actor_id != 0
+        veto = _veto(actor_id, txn)
+        state = vset(state, TXN, txn, is_part)
+        state = vset(
+            state, STATUS, jnp.where(veto, ABORTED, PREPARED), is_part
+        )
+        row = jnp.stack(
+            [jnp.int32(1), jnp.int32(0), jnp.int32(T_VOTE), txn,
+             (~veto).astype(jnp.int32)]
+        )
+        out = jnp.where(
+            is_part & (jnp.arange(n)[:, None] == 0), row[None, :], empty_out()
+        )
+        return state, out
+
+    def on_vote(actor_id, state, snd, msg):
+        txn, yes = msg[1], msg[2]
+        is_coord = actor_id == 0
+        relevant = is_coord & (state[PHASE] == 1) & (txn == state[TXN])
+        no_vote = relevant & (yes == 0)
+        yes_mask = jnp.where(
+            relevant & (yes != 0), state[YES] | (jnp.int32(1) << snd),
+            state[YES],
+        )
+        state = vset(state, YES, yes_mask)
+        all_yes = relevant & (yes_mask == part_mask)
+        decide = all_yes | no_vote
+        commit = all_yes & ~no_vote
+        state = vset(state, PHASE, 0, decide)
+        state = vset(
+            state, STATUS, jnp.where(commit, COMMITTED, ABORTED), decide
+        )
+        out = jnp.where(
+            decide,
+            _broadcast(T_DECIDE, txn, commit.astype(jnp.int32)),
+            empty_out(),
+        )
+        return state, out
+
+    def on_decide(actor_id, state, snd, msg):
+        txn, commit = msg[1], msg[2]
+        is_part = actor_id != 0
+        # A participant that vetoed already aborted unilaterally; a late
+        # DECIDE for the same txn must not overwrite it (and can't
+        # disagree under the correct protocol).
+        relevant = is_part & (txn == state[TXN]) & (state[STATUS] == PREPARED)
+        state = vset(
+            state, STATUS,
+            jnp.where(commit != 0, COMMITTED, ABORTED), relevant,
+        )
+        return state, empty_out()
+
+    def on_timeout(actor_id, state, snd, msg):
+        is_coord = actor_id == 0
+        collecting = is_coord & (state[PHASE] == 1)
+        txn = state[TXN]
+        if bug == "presume_commit":
+            # BUG: the collection timeout presumes commit. Racing the
+            # timeout ahead of a pending no-vote commits the yes-voters
+            # while the vetoing participant already aborted.
+            decision = jnp.int32(1)
+            final = COMMITTED
+        else:
+            # Presumed abort: a timed-out collection aborts.
+            decision = jnp.int32(0)
+            final = ABORTED
+        state = vset(state, PHASE, 0, collecting)
+        state = vset(state, STATUS, final, collecting)
+        out = jnp.where(
+            collecting, _broadcast(T_DECIDE, txn, decision), empty_out()
+        )
+        # Re-arm the self-timer (row 0 is free: broadcasts never target the
+        # coordinator). Timers only ever live at actor 0.
+        out = jnp.where(is_coord, _rearm(out), empty_out())
+        return state, out
+
+    def handler(actor_id, state, snd, msg):
+        tag = jnp.clip(msg[0], 1, 5) - 1
+        return jax.lax.switch(
+            tag, [on_begin, on_prepare, on_vote, on_decide, on_timeout],
+            actor_id, state, snd, msg,
+        )
+
+    def invariant(states, alive):
+        """Atomicity: same txn finalized differently on two alive nodes."""
+        status = states[:, STATUS]
+        txn = states[:, TXN]
+        both = alive[:, None] & alive[None, :]
+        same_txn = (txn[:, None] == txn[None, :]) & (txn[:, None] >= 0)
+        split = (
+            (status[:, None] == COMMITTED) & (status[None, :] == ABORTED)
+        )
+        return jnp.where(
+            jnp.any(both & same_txn & split), jnp.int32(1), jnp.int32(0)
+        )
+
+    return DSLApp(
+        name=name,
+        num_actors=n,
+        state_width=state_width,
+        msg_width=MSG_W,
+        max_outbox=max_outbox,
+        init_state=init_state,
+        initial_msgs=initial_msgs,
+        handler=handler,
+        invariant=invariant,
+        timer_tags=(T_TIMEOUT,),
+        tag_names=("", "Begin", "Prepare", "Vote", "Decide", "Timeout"),
+    )
+
+
+def twopc_send_generator(app: DSLApp) -> DSLSendGenerator:
+    """External BEGINs with increasing txn ids (wrong-recipient BEGINs are
+    ignored by participants, like the spark generator's submits)."""
+
+    def make_msg(rng: _random.Random, counter: int):
+        if counter > 5:
+            return None
+        return (T_BEGIN, counter, 0)
+
+    return DSLSendGenerator(app, make_msg)

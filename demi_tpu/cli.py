@@ -33,15 +33,32 @@ def build_app(args) -> DSLApp:
         return make_broadcast_app(args.nodes, reliable=args.bug is None)
     if args.app == "raft":
         return make_raft_app(args.nodes, bug=args.bug)
-    raise SystemExit(f"unknown app {args.app!r} (choices: broadcast, raft)")
+    if args.app == "spark":
+        from .apps.spark_dag import make_spark_app
+
+        return make_spark_app(num_workers=max(1, args.nodes - 1), bug=args.bug)
+    if args.app == "twopc":
+        from .apps.twopc import make_twopc_app
+
+        return make_twopc_app(args.nodes, bug=args.bug)
+    raise SystemExit(
+        f"unknown app {args.app!r} (choices: broadcast, raft, spark, twopc)"
+    )
 
 
 def build_fuzzer(app: DSLApp, args) -> Fuzzer:
-    gen = (
-        broadcast_send_generator(app)
-        if args.app == "broadcast"
-        else raft_send_generator(app)
-    )
+    if args.app == "spark":
+        from .apps.spark_dag import spark_send_generator
+
+        gen = spark_send_generator(app)
+    elif args.app == "twopc":
+        from .apps.twopc import twopc_send_generator
+
+        gen = twopc_send_generator(app)
+    elif args.app == "broadcast":
+        gen = broadcast_send_generator(app)
+    else:
+        gen = raft_send_generator(app)
     weights = FuzzerWeights(
         kill=args.kill_weight,
         send=0.6,

@@ -284,6 +284,8 @@ def test_mosaic_primitive_coverage():
         getattr(k, "name", str(k)) for k in per_kernel_type[0].keys()
     } | {"jit", "pjit", "closed_call", "custom_jvp_call"}
 
+    from demi_tpu.apps.twopc import make_twopc_app
+
     cases = [
         (
             make_raft_app(5),
@@ -291,6 +293,7 @@ def test_mosaic_primitive_coverage():
         ),
         (make_spark_app(num_workers=3, bug="stale_task"), dict(early_exit=True)),
         (make_broadcast_app(8, reliable=True), dict(srcdst_fifo=True)),
+        (make_twopc_app(4, bug="presume_commit"), dict(timer_weight=0.1)),
     ]
     for app, overrides in cases:
         cfg = DeviceConfig.for_app(

@@ -1,0 +1,114 @@
+"""Two-phase-commit fixture: atomicity invariant, presumed-commit timeout
+bug found + minimized on the host, found + lifted on the device sweep,
+and clean under the correct protocol.
+"""
+
+import numpy as np
+
+import jax
+
+from demi_tpu.apps.common import dsl_start_events, make_host_invariant
+from demi_tpu.apps.twopc import (
+    T_BEGIN,
+    make_twopc_app,
+    twopc_send_generator,
+)
+from demi_tpu.config import SchedulerConfig
+from demi_tpu.device import DeviceConfig, make_explore_kernel
+from demi_tpu.device.core import ST_OVERFLOW, ST_VIOLATION
+from demi_tpu.device.encoding import (
+    device_trace_to_guide,
+    lower_program,
+    stack_programs,
+)
+from demi_tpu.device.explore import make_single_lane_trace_kernel
+from demi_tpu.external_events import MessageConstructor, Send, WaitQuiescence
+from demi_tpu.fuzzing import Fuzzer, FuzzerWeights
+from demi_tpu.runner import sts_sched_ddmin
+from demi_tpu.schedulers import RandomScheduler
+from demi_tpu.schedulers.guided import GuidedScheduler
+
+
+def _fuzzer(app):
+    return Fuzzer(
+        num_events=8,
+        weights=FuzzerWeights(send=0.7, wait_quiescence=0.3),
+        message_gen=twopc_send_generator(app),
+        prefix=dsl_start_events(app),
+    )
+
+
+def _device_cfg(app):
+    return DeviceConfig.for_app(
+        app, pool_capacity=64, max_steps=160, max_external_ops=16,
+        invariant_interval=1, timer_weight=0.1,
+    )
+
+
+def test_presume_commit_found_and_minimized_on_host():
+    app = make_twopc_app(4, bug="presume_commit")
+    config = SchedulerConfig(invariant_check=make_host_invariant(app))
+    fz = _fuzzer(app)
+    found = program = None
+    for seed in range(60):
+        program = fz.generate_fuzz_test(seed=seed)
+        r = RandomScheduler(
+            config, seed=seed, max_messages=300,
+            invariant_check_interval=1, timer_weight=0.1,
+        ).execute(program)
+        if r.violation is not None:
+            found = r
+            break
+    assert found is not None, "presume_commit never violated atomicity"
+    assert found.violation.code == 1
+
+    mcs, verified = sts_sched_ddmin(
+        config, found.trace, program, found.violation
+    )
+    assert verified is not None
+    assert len(mcs.get_all_events()) < len(program)
+
+
+def test_presume_commit_device_sweep_and_lift():
+    app = make_twopc_app(4, bug="presume_commit")
+    config = SchedulerConfig(invariant_check=make_host_invariant(app))
+    cfg = _device_cfg(app)
+    program = dsl_start_events(app) + [
+        Send(app.actor_name(0), MessageConstructor(lambda: (T_BEGIN, 4, 0))),
+        Send(app.actor_name(0), MessageConstructor(lambda: (T_BEGIN, 1, 0))),
+        WaitQuiescence(budget=80),
+    ]
+    B = 256
+    kernel = make_explore_kernel(app, cfg)
+    progs = stack_programs([lower_program(app, cfg, program)] * B)
+    keys = jax.random.split(jax.random.PRNGKey(0), B)
+    res = kernel(progs, keys)
+    statuses = np.asarray(res.status)
+    assert int((statuses == ST_OVERFLOW).sum()) == 0
+    lanes = np.flatnonzero(statuses == ST_VIOLATION)
+    assert len(lanes) > 0, "device sweep missed the timeout/vote race"
+    assert set(np.asarray(res.violation)[lanes]) == {1}
+
+    lane = int(lanes[0])
+    traced = make_single_lane_trace_kernel(app, cfg)
+    single = traced(
+        jax.tree_util.tree_map(lambda x: x[lane], progs), keys[lane]
+    )
+    assert int(single.violation) == 1
+    guide = device_trace_to_guide(
+        app, np.asarray(single.trace), int(single.trace_len)
+    )
+    host = GuidedScheduler(config, app).execute_guide(guide)
+    assert host.violation is not None and host.violation.code == 1
+
+
+def test_correct_twopc_clean():
+    app = make_twopc_app(4)
+    config = SchedulerConfig(invariant_check=make_host_invariant(app))
+    fz = _fuzzer(app)
+    for seed in range(30):
+        r = RandomScheduler(
+            config, seed=seed, max_messages=300,
+            invariant_check_interval=1, timer_weight=0.1,
+        ).execute(fz.generate_fuzz_test(seed=seed))
+        assert r.violation is None, f"correct 2PC violated at seed {seed}"
