@@ -12,6 +12,7 @@ from demi_tpu.apps.spark_dag import (
     DONE_FLAG,
     T_SUBMIT,
     make_spark_app,
+    spark_send_generator,
 )
 from demi_tpu.config import SchedulerConfig
 from demi_tpu.device import DeviceConfig, make_explore_kernel
@@ -89,3 +90,67 @@ def test_stale_task_bug_found_by_device_sweep():
             found = True
             break
     assert found
+
+
+def test_lost_executor_credit_on_crash_recovery():
+    """Crash-recovery case study on UNMODIFIED spark (the raft-66-style
+    volatile-state finding, on the second fixture family): a worker's
+    executed-task mask lives in memory only, so HardKill+restart wipes it
+    — the master's credited work then has no surviving executor witness,
+    and the phantom-credit invariant fires at job completion. Found by
+    crash-recovery fuzzing (hard_kill/restart weights + bounded waits),
+    lifted to the host oracle."""
+    import jax
+
+    from demi_tpu.apps.common import make_host_invariant
+    from demi_tpu.config import SchedulerConfig
+    from demi_tpu.device import DeviceConfig, make_explore_kernel
+    from demi_tpu.device.core import ST_OVERFLOW, ST_VIOLATION
+    from demi_tpu.device.encoding import (
+        device_trace_to_guide,
+        lower_program,
+        stack_programs,
+    )
+    from demi_tpu.device.explore import make_single_lane_trace_kernel
+    from demi_tpu.fuzzing import Fuzzer, FuzzerWeights
+    from demi_tpu.schedulers.guided import GuidedScheduler
+
+    app = make_spark_app(num_workers=3, num_stages=2, tasks_per_stage=4)
+    cfg = DeviceConfig.for_app(
+        app, pool_capacity=128, max_steps=220, max_external_ops=24,
+        invariant_interval=0, early_exit=True,
+    )
+    fz = Fuzzer(
+        num_events=8,
+        weights=FuzzerWeights(
+            send=0.3, wait_quiescence=0.25, hard_kill=0.25, restart=0.2
+        ),
+        message_gen=spark_send_generator(app),
+        prefix=dsl_start_events(app),
+        max_kills=2,
+        wait_budget=(5, 40),
+    )
+    B = 128  # seeds 0..127 contain violating lanes (57, 115)
+    programs = [fz.generate_fuzz_test(seed=s) for s in range(B)]
+    kernel = make_explore_kernel(app, cfg)
+    progs = stack_programs([lower_program(app, cfg, p) for p in programs])
+    keys = jax.random.split(jax.random.PRNGKey(0), B)
+    res = kernel(progs, keys)
+    statuses = np.asarray(res.status)
+    assert int((statuses == ST_OVERFLOW).sum()) == 0
+    lanes = np.flatnonzero(statuses == ST_VIOLATION)
+    assert len(lanes) > 0, "crash-recovery sweep missed the lost-credit case"
+    assert set(np.asarray(res.violation)[lanes]) == {1}
+
+    lane = int(lanes[0])
+    traced = make_single_lane_trace_kernel(app, cfg)
+    single = traced(
+        jax.tree_util.tree_map(lambda x: x[lane], progs), keys[lane]
+    )
+    assert int(single.violation) == 1
+    guide = device_trace_to_guide(
+        app, np.asarray(single.trace), int(single.trace_len)
+    )
+    config = SchedulerConfig(invariant_check=make_host_invariant(app))
+    host = GuidedScheduler(config, app).execute_guide(guide)
+    assert host.violation is not None and host.violation.code == 1
