@@ -11,8 +11,9 @@ aborts locally — a no-voter may abort unilaterally) or becomes prepared
 and votes yes; on all-yes the coordinator decides commit, on any no it
 decides abort, and broadcasts ``DECIDE``; a coordinator timeout during
 collection decides abort (the presumed-abort rule). The veto rule is
-deterministic — participant p vetoes txn iff (txn + p) % 3 == 0 — so
-fuzzed runs mix clean commits and vetoed rounds.
+deterministic — participant txn % n vetoes txn (txn % n == 0 names the
+coordinator, i.e. nobody: that txn commits cleanly) — so fuzzed runs mix
+clean commits and vetoed rounds.
 
 Safety invariant (code 1, atomicity): no two alive nodes may finalize the
 SAME txn differently (one committed, one aborted).

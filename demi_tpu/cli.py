@@ -108,8 +108,9 @@ def cmd_fuzz(args) -> int:
 
 
 def cmd_minimize(args) -> int:
-    if getattr(args, "impl", "xla") == "pallas":
-        os.environ["DEMI_DEVICE_IMPL"] = "pallas"
+    # The flag is authoritative: it must also override a pre-set
+    # DEMI_DEVICE_IMPL in the caller's environment.
+    os.environ["DEMI_DEVICE_IMPL"] = getattr(args, "impl", "xla")
     from .runner import FuzzResult, print_minimization_stats, run_the_gamut
     from .serialization import ExperimentDeserializer, ExperimentSerializer
 
@@ -258,8 +259,7 @@ def cmd_sweep(args) -> int:
 
 def cmd_dpor(args) -> int:
     """Systematic batched DPOR search (BASELINE config 2 shape)."""
-    if getattr(args, "impl", "xla") == "pallas":
-        os.environ["DEMI_DEVICE_IMPL"] = "pallas"
+    os.environ["DEMI_DEVICE_IMPL"] = getattr(args, "impl", "xla")
     from .device import DeviceConfig
     from .device.dpor_sweep import DeviceDPOROracle
 

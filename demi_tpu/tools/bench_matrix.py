@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import pathlib
 import sys
 import time
 
@@ -26,7 +27,8 @@ def main(argv=None):
 
     import jax
 
-    sys.path.insert(0, ".")
+    # bench.py lives at the repo root, not in the package.
+    sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[2]))
     from bench import _raft_workload
 
     from ..device import (

@@ -93,16 +93,10 @@ def test_gap_append_device_sweep_and_host_lift():
     assert set(violations[lanes]) == {2}
 
     # Traced re-run of the first violating lane, lifted to the host.
-    lane = int(lanes[0])
-    traced = make_single_lane_trace_kernel(app, cfg)
-    single = traced(
-        jax.tree_util.tree_map(lambda x: x[lane], progs), keys[lane]
-    )
+    from helpers import lift_lane_to_host
+
+    single, host = lift_lane_to_host(app, cfg, progs, keys, int(lanes[0]), config)
     assert int(single.violation) == 2
-    guide = device_trace_to_guide(
-        app, np.asarray(single.trace), int(single.trace_len)
-    )
-    host = GuidedScheduler(config, app).execute_guide(guide)
     assert host.violation is not None and host.violation.code == 2
 
 
@@ -211,15 +205,8 @@ def test_lost_vote_durability_on_crash_recovery():
 
     # Host lift: the violating lane's schedule must reproduce on the
     # sequential oracle (host/device parity for HardKill+restart flows).
-    lane = int(lanes[0])
-    traced = make_single_lane_trace_kernel(app, cfg)
-    single = traced(
-        jax.tree_util.tree_map(lambda x: x[lane], progs), keys[lane]
-    )
+    from helpers import lift_lane_to_host
+
+    single, host = lift_lane_to_host(app, cfg, progs, keys, int(lanes[0]))
     assert int(single.violation) == 1
-    guide = device_trace_to_guide(
-        app, np.asarray(single.trace), int(single.trace_len)
-    )
-    config = SchedulerConfig(invariant_check=make_host_invariant(app))
-    host = GuidedScheduler(config, app).execute_guide(guide)
     assert host.violation is not None and host.violation.code == 1

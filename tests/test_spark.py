@@ -142,15 +142,8 @@ def test_lost_executor_credit_on_crash_recovery():
     assert len(lanes) > 0, "crash-recovery sweep missed the lost-credit case"
     assert set(np.asarray(res.violation)[lanes]) == {1}
 
-    lane = int(lanes[0])
-    traced = make_single_lane_trace_kernel(app, cfg)
-    single = traced(
-        jax.tree_util.tree_map(lambda x: x[lane], progs), keys[lane]
-    )
+    from helpers import lift_lane_to_host
+
+    single, host = lift_lane_to_host(app, cfg, progs, keys, int(lanes[0]))
     assert int(single.violation) == 1
-    guide = device_trace_to_guide(
-        app, np.asarray(single.trace), int(single.trace_len)
-    )
-    config = SchedulerConfig(invariant_check=make_host_invariant(app))
-    host = GuidedScheduler(config, app).execute_guide(guide)
     assert host.violation is not None and host.violation.code == 1

@@ -89,16 +89,10 @@ def test_presume_commit_device_sweep_and_lift():
     assert len(lanes) > 0, "device sweep missed the timeout/vote race"
     assert set(np.asarray(res.violation)[lanes]) == {1}
 
-    lane = int(lanes[0])
-    traced = make_single_lane_trace_kernel(app, cfg)
-    single = traced(
-        jax.tree_util.tree_map(lambda x: x[lane], progs), keys[lane]
-    )
+    from helpers import lift_lane_to_host
+
+    single, host = lift_lane_to_host(app, cfg, progs, keys, int(lanes[0]), config)
     assert int(single.violation) == 1
-    guide = device_trace_to_guide(
-        app, np.asarray(single.trace), int(single.trace_len)
-    )
-    host = GuidedScheduler(config, app).execute_guide(guide)
     assert host.violation is not None and host.violation.code == 1
 
 
