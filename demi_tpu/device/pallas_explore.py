@@ -39,13 +39,14 @@ from .explore import ExtProgram, LaneResult, make_run_lane
 from .replay import ReplayResult, make_replay_run_lane
 
 
-def _pad_to(x, b: int):
-    """Pad axis 0 of ``x`` up to a multiple of ``b`` with zeros."""
-    n = x.shape[0]
+def _pad_to(x, b: int, axis: int = 0):
+    """Pad ``axis`` of ``x`` up to a multiple of ``b`` with zeros."""
+    axis = axis % x.ndim
+    n = x.shape[axis]
     rem = (-n) % b
     if rem == 0:
         return x
-    pad = [(0, rem)] + [(0, 0)] * (x.ndim - 1)
+    pad = [(0, rem) if i == axis else (0, 0) for i in range(x.ndim)]
     return jnp.pad(x, pad)
 
 
@@ -68,6 +69,7 @@ def _make_blocked_kernel(
     in_structs: Sequence[jax.ShapeDtypeStruct],
     block_lanes: int,
     interpret: bool,
+    lane_dim_in: int = 0,
 ):
     """Generic lane-blocked pallas_call wrapper.
 
@@ -127,15 +129,30 @@ def _make_blocked_kernel(
             ref[...] = val
 
     def call(*arrays):
-        n_lanes = arrays[0].shape[0]
-        padded_arrays = [_pad_to(jnp.asarray(a), block_lanes) for a in arrays]
-        padded = padded_arrays[0].shape[0]
+        n_lanes = arrays[0].shape[lane_dim_in]
+        padded_arrays = [
+            _pad_to(jnp.asarray(a), block_lanes, axis=lane_dim_in)
+            for a in arrays
+        ]
+        padded = padded_arrays[0].shape[lane_dim_in]
         grid = (padded // block_lanes,)
 
-        def lane_spec(struct):
+        def in_spec(struct):
             nd = len(struct.shape)
+            if lane_dim_in == 0:
+                return pl.BlockSpec(
+                    (block_lanes,) + tuple(struct.shape[1:]),
+                    lambda i, nd=nd: (i,) + (0,) * (nd - 1),
+                )
             return pl.BlockSpec(
-                (block_lanes,) + tuple(struct.shape[1:]),
+                tuple(struct.shape[:-1]) + (block_lanes,),
+                lambda i, nd=nd: (0,) * (nd - 1) + (i,),
+            )
+
+        def out_spec(aval):
+            nd = len(aval.shape)
+            return pl.BlockSpec(
+                (block_lanes,) + tuple(aval.shape[1:]),
                 lambda i, nd=nd: (i,) + (0,) * (nd - 1),
             )
 
@@ -146,8 +163,8 @@ def _make_blocked_kernel(
         outs = pl.pallas_call(
             kernel,
             grid=grid,
-            in_specs=[lane_spec(s) for s in in_structs] + const_specs,
-            out_specs=[lane_spec(a) for a in out_avals],
+            in_specs=[in_spec(s) for s in in_structs] + const_specs,
+            out_specs=[out_spec(a) for a in out_avals],
             out_shape=[
                 jax.ShapeDtypeStruct((padded,) + tuple(a.shape[1:]), a.dtype)
                 for a in out_avals
@@ -164,6 +181,7 @@ def make_explore_kernel_pallas(
     cfg: DeviceConfig,
     block_lanes: int = 128,
     interpret: Optional[bool] = None,
+    lane_axis: str = "leading",
 ):
     """Pallas twin of ``make_explore_kernel``: ``kernel(progs, keys) ->
     LaneResult`` with empty traces (sweeps record verdicts only; traced
@@ -172,33 +190,66 @@ def make_explore_kernel_pallas(
     ``block_lanes`` sets the VMEM working set: one block's ScheduleState
     (~pool_capacity * (7 + msg_width) ints per lane) must fit. The lane
     batch is padded to a block multiple with inert all-zero programs.
+
+    ``lane_axis='trailing'`` batches lanes along the LAST array axis
+    inside the kernel (vmap in_axes=-1): elementwise/reduce ops then see
+    [pool, lanes]-shaped data whose minor dimension is the lane block —
+    the axis Mosaic vectorizes — instead of a 96-wide pool axis. Same
+    results bit-for-bit; a pure layout experiment for the TPU (the
+    bench matrix measures both).
     """
     if cfg.record_trace:
         raise ValueError(
             "pallas explore kernel records verdicts only; use the XLA "
             "single-lane trace kernel for trace extraction"
         )
+    if lane_axis not in ("leading", "trailing"):
+        raise ValueError(f"lane_axis must be leading/trailing, got {lane_axis!r}")
     interpret = _check_pallas_cfg(cfg, interpret)
     run_lane = make_run_lane(app, cfg)
     e, w = cfg.max_external_ops, cfg.msg_width
-
-    def block_fn(op, a, b, msg, keys):
-        res = jax.vmap(run_lane)(ExtProgram(op=op, a=a, b=b, msg=msg), keys)
-        return res.status, res.violation, res.deliveries
-
     bl = block_lanes
-    in_structs = [
-        jax.ShapeDtypeStruct((bl, e), jnp.int32),
-        jax.ShapeDtypeStruct((bl, e), jnp.int32),
-        jax.ShapeDtypeStruct((bl, e), jnp.int32),
-        jax.ShapeDtypeStruct((bl, e, w), jnp.int32),
-        jax.ShapeDtypeStruct((bl, 2), jnp.uint32),
-    ]
-    blocked = _make_blocked_kernel(block_fn, in_structs, bl, interpret)
+    trailing = lane_axis == "trailing"
+
+    if trailing:
+        def block_fn(op, a, b, msg, keys):
+            res = jax.vmap(run_lane, in_axes=-1, out_axes=0)(
+                ExtProgram(op=op, a=a, b=b, msg=msg), keys
+            )
+            return res.status, res.violation, res.deliveries
+
+        in_structs = [
+            jax.ShapeDtypeStruct((e, bl), jnp.int32),
+            jax.ShapeDtypeStruct((e, bl), jnp.int32),
+            jax.ShapeDtypeStruct((e, bl), jnp.int32),
+            jax.ShapeDtypeStruct((e, w, bl), jnp.int32),
+            jax.ShapeDtypeStruct((2, bl), jnp.uint32),
+        ]
+        blocked = _make_blocked_kernel(
+            block_fn, in_structs, bl, interpret, lane_dim_in=-1
+        )
+    else:
+        def block_fn(op, a, b, msg, keys):
+            res = jax.vmap(run_lane)(
+                ExtProgram(op=op, a=a, b=b, msg=msg), keys
+            )
+            return res.status, res.violation, res.deliveries
+
+        in_structs = [
+            jax.ShapeDtypeStruct((bl, e), jnp.int32),
+            jax.ShapeDtypeStruct((bl, e), jnp.int32),
+            jax.ShapeDtypeStruct((bl, e), jnp.int32),
+            jax.ShapeDtypeStruct((bl, e, w), jnp.int32),
+            jax.ShapeDtypeStruct((bl, 2), jnp.uint32),
+        ]
+        blocked = _make_blocked_kernel(block_fn, in_structs, bl, interpret)
 
     def call(progs: ExtProgram, keys) -> LaneResult:
         n_lanes = keys.shape[0]
-        st, vio, dl = blocked(progs.op, progs.a, progs.b, progs.msg, keys)
+        ins = (progs.op, progs.a, progs.b, progs.msg, keys)
+        if trailing:
+            ins = tuple(jnp.moveaxis(jnp.asarray(x), 0, -1) for x in ins)
+        st, vio, dl = blocked(*ins)
         empty = jnp.zeros((n_lanes, 0, 0), jnp.int32)
         return LaneResult(
             status=st,

@@ -73,26 +73,30 @@ def main(argv=None):
             print(json.dumps({
                 "impl": "xla", "batch": batch, "error": repr(e)[:300]
             }), flush=True)
-    for batch in batches:
-        for bl in blocks:
-            if bl > batch:
-                continue
-            try:
-                sps, comp = measure(
-                    make_explore_kernel_pallas(app, cfg, block_lanes=bl),
-                    batch,
-                )
-                print(json.dumps({
-                    "impl": "pallas", "platform": platform, "batch": batch,
-                    "block_lanes": bl,
-                    "schedules_per_sec": round(sps, 1),
-                    "compile_s": round(comp, 1),
-                }), flush=True)
-            except Exception as e:
-                print(json.dumps({
-                    "impl": "pallas", "batch": batch, "block_lanes": bl,
-                    "error": repr(e)[:300],
-                }), flush=True)
+    for lane_axis in ("leading", "trailing"):
+        for batch in batches:
+            for bl in blocks:
+                if bl > batch:
+                    continue
+                tag = f"pallas-{lane_axis}"
+                try:
+                    sps, comp = measure(
+                        make_explore_kernel_pallas(
+                            app, cfg, block_lanes=bl, lane_axis=lane_axis
+                        ),
+                        batch,
+                    )
+                    print(json.dumps({
+                        "impl": tag, "platform": platform, "batch": batch,
+                        "block_lanes": bl,
+                        "schedules_per_sec": round(sps, 1),
+                        "compile_s": round(comp, 1),
+                    }), flush=True)
+                except Exception as e:
+                    print(json.dumps({
+                        "impl": tag, "batch": batch, "block_lanes": bl,
+                        "error": repr(e)[:300],
+                    }), flush=True)
 
 
 if __name__ == "__main__":

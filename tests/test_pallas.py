@@ -52,9 +52,12 @@ def test_pallas_parity_broadcast():
     progs = stack_programs([lower_program(app, cfg, prog)] * B)
     keys = jax.random.split(jax.random.PRNGKey(0), B)
     xla = make_explore_kernel(app, cfg)(progs, keys)
-    pal = make_explore_kernel_pallas(app, cfg, block_lanes=16)(progs, keys)
-    _assert_lane_results_equal(xla, pal)
-    assert int((np.asarray(pal.violation) != 0).sum()) > 0
+    for lane_axis in ("leading", "trailing"):
+        pal = make_explore_kernel_pallas(
+            app, cfg, block_lanes=16, lane_axis=lane_axis
+        )(progs, keys)
+        _assert_lane_results_equal(xla, pal)
+        assert int((np.asarray(pal.violation) != 0).sum()) > 0
 
 
 def test_pallas_parity_raft_faults():
