@@ -314,12 +314,35 @@ def make_run_lane(app: DSLApp, cfg: DeviceConfig):
     return run_lane
 
 
-def make_explore_kernel(app: DSLApp, cfg: DeviceConfig):
+def make_explore_kernel(app: DSLApp, cfg: DeviceConfig, lane_axis: str = "leading"):
     """Returns jitted ``kernel(progs: ExtProgram[B], keys[B]) -> LaneResult[B]``.
 
     Each lane runs its external program to completion (or a cap) delivering
-    uniformly-random deliverable messages — the device RandomScheduler."""
-    return jax.jit(jax.vmap(make_run_lane(app, cfg)))
+    uniformly-random deliverable messages — the device RandomScheduler.
+
+    ``lane_axis='trailing'`` runs the batch along the LAST axis of every
+    internal array (vmap in_axes=-1): per-lane [pool]-shaped ops become
+    [pool, B] with the big batch dimension minor — the axis the TPU VPU
+    vectorizes — instead of a pool-sized minor axis padded to the vector
+    width. The public interface is unchanged (inputs/outputs stay
+    lane-leading; transposes happen inside the jit) and results are
+    bit-identical."""
+    run_lane = make_run_lane(app, cfg)
+    if lane_axis == "leading":
+        return jax.jit(jax.vmap(run_lane))
+    if lane_axis != "trailing":
+        raise ValueError(f"lane_axis must be leading/trailing, got {lane_axis!r}")
+
+    vmapped = jax.vmap(run_lane, in_axes=-1, out_axes=0)
+
+    def call(progs: ExtProgram, keys) -> LaneResult:
+        progs_t = ExtProgram(
+            *(jnp.moveaxis(jnp.asarray(x), 0, -1) for x in progs)
+        )
+        keys_t = jnp.moveaxis(jnp.asarray(keys), 0, -1)
+        return vmapped(progs_t, keys_t)
+
+    return jax.jit(call)
 
 
 def make_single_lane_trace_kernel(app: DSLApp, cfg: DeviceConfig):

@@ -61,18 +61,22 @@ def main(argv=None):
 
     batches = [int(x) for x in args.batches.split(",")]
     blocks = [int(x) for x in args.blocks.split(",")]
-    for batch in batches:
-        try:
-            sps, comp = measure(make_explore_kernel(app, cfg), batch)
-            print(json.dumps({
-                "impl": "xla", "platform": platform, "batch": batch,
-                "schedules_per_sec": round(sps, 1),
-                "compile_s": round(comp, 1),
-            }), flush=True)
-        except Exception as e:
-            print(json.dumps({
-                "impl": "xla", "batch": batch, "error": repr(e)[:300]
-            }), flush=True)
+    for lane_axis in ("leading", "trailing"):
+        for batch in batches:
+            tag = "xla" if lane_axis == "leading" else "xla-trailing"
+            try:
+                sps, comp = measure(
+                    make_explore_kernel(app, cfg, lane_axis=lane_axis), batch
+                )
+                print(json.dumps({
+                    "impl": tag, "platform": platform, "batch": batch,
+                    "schedules_per_sec": round(sps, 1),
+                    "compile_s": round(comp, 1),
+                }), flush=True)
+            except Exception as e:
+                print(json.dumps({
+                    "impl": tag, "batch": batch, "error": repr(e)[:300]
+                }), flush=True)
     for lane_axis in ("leading", "trailing"):
         for batch in batches:
             for bl in blocks:

@@ -52,6 +52,8 @@ def test_pallas_parity_broadcast():
     progs = stack_programs([lower_program(app, cfg, prog)] * B)
     keys = jax.random.split(jax.random.PRNGKey(0), B)
     xla = make_explore_kernel(app, cfg)(progs, keys)
+    xla_t = make_explore_kernel(app, cfg, lane_axis="trailing")(progs, keys)
+    _assert_lane_results_equal(xla, xla_t)
     for lane_axis in ("leading", "trailing"):
         pal = make_explore_kernel_pallas(
             app, cfg, block_lanes=16, lane_axis=lane_axis
