@@ -73,6 +73,7 @@ def bench_device_raft(jax):
     cfg = DeviceConfig.for_app(
         app, pool_capacity=96, max_steps=144, max_external_ops=24,
         invariant_interval=1, timer_weight=0.2,
+        msg_dtype=os.environ.get("DEMI_BENCH_MSG_DTYPE", "int32"),
     )
     platform = jax.devices()[0].platform
     default_batch = 8192 if platform not in ("cpu",) else 1024

@@ -100,6 +100,13 @@ class DeviceConfig:
     # candidate; timers stay individually choosable. Costs an O(P^2)
     # same-channel compare per step, so opt-in.
     srcdst_fifo: bool = False
+    # Message-payload storage dtype for the pool/timer-memory columns
+    # ('int32' or 'int16'). The [P, W] pool_msg array dominates the
+    # per-lane carry, so halving it halves the HBM traffic of the XLA
+    # step loop. Handlers always see int32 (cast at the boundary);
+    # requires every app message field to fit the narrow range — the
+    # app's contract, unchecked on device.
+    msg_dtype: str = "int32"
 
     def __post_init__(self):
         if self.index_mode not in ("auto", "onehot", "scatter"):
@@ -107,6 +114,14 @@ class DeviceConfig:
                 f"index_mode must be 'auto', 'onehot' or 'scatter', "
                 f"got {self.index_mode!r}"
             )
+        if self.msg_dtype not in ("int32", "int16"):
+            raise ValueError(
+                f"msg_dtype must be 'int32' or 'int16', got {self.msg_dtype!r}"
+            )
+
+    @property
+    def msg_jnp_dtype(self):
+        return jnp.int16 if self.msg_dtype == "int16" else jnp.int32
 
     @property
     def use_onehot(self) -> bool:
@@ -184,10 +199,10 @@ def init_state(app: DSLApp, cfg: DeviceConfig, key) -> ScheduleState:
         pool_dst=jnp.zeros(p, jnp.int32),
         pool_timer=jnp.zeros(p, bool),
         pool_parked=jnp.zeros(p, bool),
-        pool_msg=jnp.zeros((p, w), jnp.int32),
+        pool_msg=jnp.zeros((p, w), cfg.msg_jnp_dtype),
         pool_seq=jnp.zeros(p, jnp.int32),
         pool_crec=jnp.full(p, -1, jnp.int32),
-        timer_mem=jnp.zeros((n, w), jnp.int32),
+        timer_mem=jnp.zeros((n, w), cfg.msg_jnp_dtype),
         timer_mem_valid=jnp.zeros(n, bool),
         ext_cursor=jnp.int32(0),
         seq_counter=jnp.int32(0),
@@ -270,6 +285,8 @@ def insert_rows(
 ) -> ScheduleState:
     """Scatter up to K new entries into free pool slots. Overflow (more valid
     rows than free slots) flips the lane status to ST_OVERFLOW."""
+    # Proposals carry int32 payloads; storage may be narrower (msg_dtype).
+    row_msg = row_msg.astype(state.pool_msg.dtype)
     free = ~state.pool_valid
     # rank among free slots: 1-indexed prefix count
     prefix = ops.prefix_sum(free.astype(jnp.int32), cfg.use_onehot)
@@ -376,7 +393,9 @@ def delivery_effects(
     safe_idx = jnp.minimum(idx, cfg.pool_capacity - 1)
     src = ops.get_scalar(state.pool_src, safe_idx, oh)
     dst = ops.get_scalar(state.pool_dst, safe_idx, oh)
-    msg = ops.get_row(state.pool_msg, safe_idx, oh)
+    # Handlers (and trace records) always see int32 payloads regardless
+    # of the pool's storage dtype.
+    msg = ops.get_row(state.pool_msg, safe_idx, oh).astype(jnp.int32)
     is_timer = ops.get_scalar(state.pool_timer, safe_idx, oh)
     parent_rec = ops.get_scalar(state.pool_crec, safe_idx, oh)
 
@@ -423,7 +442,10 @@ def delivery_effects(
     timer_mem = jnp.where(
         cleared,
         jnp.zeros_like(state.timer_mem),
-        ops.set_row(state.timer_mem, dst, msg, delivered_timer, oh),
+        ops.set_row(
+            state.timer_mem, dst, msg.astype(state.timer_mem.dtype),
+            delivered_timer, oh,
+        ),
     )
     timer_mem_valid = jnp.where(
         cleared,

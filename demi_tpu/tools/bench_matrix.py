@@ -23,6 +23,8 @@ def main(argv=None):
     p.add_argument("--batches", default="2048,8192,16384")
     p.add_argument("--blocks", default="128,256,512")
     p.add_argument("--reps", type=int, default=3)
+    p.add_argument("--msg-dtype", default="int32", dest="msg_dtype",
+                   choices=("int32", "int16"))
     args = p.parse_args(argv)
 
     import jax
@@ -41,7 +43,7 @@ def main(argv=None):
     app, program = _raft_workload()
     cfg = DeviceConfig.for_app(
         app, pool_capacity=96, max_steps=144, max_external_ops=24,
-        invariant_interval=1, timer_weight=0.2,
+        invariant_interval=1, timer_weight=0.2, msg_dtype=args.msg_dtype,
     )
     platform = jax.devices()[0].platform
     prog1 = lower_program(app, cfg, program)

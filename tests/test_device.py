@@ -326,3 +326,44 @@ def test_index_mode_parity_explore_and_replay():
             np.asarray(getattr(out["scatter"], field)),
             np.asarray(getattr(out["onehot"], field)),
         ), f"replay {field}"
+
+
+def test_int16_msg_storage_parity():
+    """msg_dtype='int16' (halved pool-payload storage, the HBM-bandwidth
+    lever for the step-loop carry) is bit-identical to int32 storage on
+    both index modes, for explore and batched replay."""
+    from demi_tpu.apps.raft import T_CLIENT, make_raft_app
+
+    app = make_raft_app(3, bug="gap_append")
+
+    def cmd(node, v):
+        return Send(
+            app.actor_name(node),
+            MessageConstructor(lambda vv=v: (T_CLIENT, 0, vv, 0, 0, 0, 0)),
+        )
+
+    program = dsl_start_events(app) + [
+        WaitQuiescence(budget=40),
+        cmd(0, 10), cmd(1, 11),
+        WaitQuiescence(budget=100),
+    ]
+    B = 32
+    results = {}
+    for index_mode in ("scatter", "onehot"):
+        for dt in ("int32", "int16"):
+            cfg = DeviceConfig.for_app(
+                app, pool_capacity=96, max_steps=180, max_external_ops=16,
+                invariant_interval=1, timer_weight=0.05,
+                index_mode=index_mode, msg_dtype=dt,
+            )
+            progs = stack_programs([lower_program(app, cfg, program)] * B)
+            keys = jax.random.split(jax.random.PRNGKey(0), B)
+            results[(index_mode, dt)] = make_explore_kernel(app, cfg)(
+                progs, keys
+            )
+    base = results[("scatter", "int32")]
+    for key, res in results.items():
+        for f in ("status", "violation", "deliveries"):
+            assert (
+                np.asarray(getattr(base, f)) == np.asarray(getattr(res, f))
+            ).all(), (key, f)
