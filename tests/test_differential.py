@@ -1,0 +1,89 @@
+"""Randomized differential testing: device kernel vs host oracle.
+
+For fuzzed programs drawn across the whole external-event language
+(sends, kills, hard-kills + restarts, partitions, bounded waits), every
+traced device lane must lift to the host oracle WITHOUT divergence and
+reproduce the same violation code. This is the semantic net over the
+host/device pair that the reference never needed (one engine) but a
+dual-tier design lives or dies by (SURVEY.md §4 implication).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from demi_tpu.apps.broadcast import broadcast_send_generator, make_broadcast_app
+from demi_tpu.apps.common import dsl_start_events, make_host_invariant
+from demi_tpu.apps.raft import make_raft_app, raft_send_generator
+from demi_tpu.config import SchedulerConfig
+from demi_tpu.device import DeviceConfig
+from demi_tpu.device.core import ST_OVERFLOW
+from demi_tpu.device.encoding import lower_program
+from demi_tpu.device.explore import make_single_lane_trace_kernel
+from demi_tpu.fuzzing import Fuzzer, FuzzerWeights
+from demi_tpu.schedulers.guided import GuidedScheduler
+
+from helpers import lift_lane_to_host
+
+
+CASES = [
+    (
+        "raft-faults",
+        lambda: make_raft_app(3, bug="multivote"),
+        raft_send_generator,
+        FuzzerWeights(
+            send=0.3, kill=0.1, partition=0.1, unpartition=0.1,
+            wait_quiescence=0.2, hard_kill=0.1, restart=0.1,
+        ),
+        dict(pool_capacity=96, max_steps=200, max_external_ops=24,
+             invariant_interval=1, timer_weight=0.1),
+    ),
+    (
+        "broadcast-faults",
+        lambda: make_broadcast_app(4, reliable=False),
+        broadcast_send_generator,
+        FuzzerWeights(
+            send=0.5, kill=0.15, wait_quiescence=0.25, hard_kill=0.05,
+            restart=0.05,
+        ),
+        dict(pool_capacity=64, max_steps=96, max_external_ops=24,
+             invariant_interval=1),
+    ),
+]
+
+
+@pytest.mark.parametrize(
+    "name,make_app,make_gen,weights,cfg_kw", CASES,
+    ids=[c[0] for c in CASES],
+)
+def test_fuzzed_lanes_lift_without_divergence(
+    name, make_app, make_gen, weights, cfg_kw
+):
+    app = make_app()
+    cfg = DeviceConfig.for_app(app, **cfg_kw)
+    config = SchedulerConfig(invariant_check=make_host_invariant(app))
+    fz = Fuzzer(
+        num_events=10, weights=weights, message_gen=make_gen(app),
+        prefix=dsl_start_events(app), max_kills=2, wait_budget=(5, 40),
+    )
+    kernel = make_single_lane_trace_kernel(app, cfg)
+    checked = violations = 0
+    for seed in range(16):
+        program = fz.generate_fuzz_test(seed=seed)
+        prog = lower_program(app, cfg, program)
+        key = jax.random.PRNGKey(seed)
+        single = kernel(prog, key)
+        if int(single.status) == ST_OVERFLOW:
+            continue  # config problem, not a semantics case
+        # lift_lane_to_host indexes lane 0 of a batch: wrap as batch-of-1.
+        progs1 = jax.tree_util.tree_map(lambda x: np.asarray(x)[None], prog)
+        keys1 = key[None]
+        single2, host = lift_lane_to_host(app, cfg, progs1, keys1, 0, config)
+        assert int(single2.violation) == int(single.violation), (name, seed)
+        host_code = 0 if host.violation is None else host.violation.code
+        assert host_code == int(single.violation), (name, seed)
+        checked += 1
+        violations += int(int(single.violation) != 0)
+    assert checked >= 12, f"{name}: too many overflow lanes ({checked} checked)"
+    assert violations > 0, f"{name}: differential corpus never violated"
