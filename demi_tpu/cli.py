@@ -310,6 +310,31 @@ def cmd_shiviz(args) -> int:
     return 0
 
 
+def cmd_dot(args) -> int:
+    """Export a saved experiment as Graphviz DOT: the delivery chain, plus
+    the happens-before forest when a dep graph was saved (reference:
+    schedulers/Util.scala getDot:580-618)."""
+    from .fingerprints import FingerprintFactory
+    from .serialization import ExperimentDeserializer, load_dep_graph
+    from .utils.dot import dep_tracker_to_dot, event_trace_to_dot
+
+    app = build_app(args)
+    de = ExperimentDeserializer(args.experiment, app)
+    externals = de.get_externals()
+    trace = de.get_trace(externals)
+    out = event_trace_to_dot(trace)
+    tracker = load_dep_graph(args.experiment, FingerprintFactory())
+    if tracker is not None:
+        out += "\n" + dep_tracker_to_dot(tracker)
+    if args.output:
+        with open(args.output, "w") as f:
+            f.write(out + "\n")
+        print(f"DOT written to {args.output}")
+    else:
+        print(out)
+    return 0
+
+
 def cmd_interactive(args) -> int:
     from .schedulers.interactive import InteractiveScheduler
 
@@ -403,6 +428,12 @@ def main(argv: Optional[list] = None) -> int:
     p.add_argument("--pool", type=int, default=256)
     p.add_argument("--rounds", type=int, default=10)
     p.set_defaults(fn=cmd_dpor)
+
+    p = sub.add_parser("dot", help="export an experiment as Graphviz DOT")
+    common(p)
+    p.add_argument("-e", "--experiment", required=True)
+    p.add_argument("-o", "--output", default=None)
+    p.set_defaults(fn=cmd_dot)
 
     p = sub.add_parser("shiviz", help="export an experiment trace for ShiViz")
     common(p)

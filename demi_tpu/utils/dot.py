@@ -1,0 +1,63 @@
+"""Graphviz DOT export of the happens-before forest and event traces.
+
+Reference: the dep-graph DOT export of schedulers/Util.scala
+(getDot:580-618) used to eyeball DPOR dependency structure. Here the
+graph is the DepTracker forest (parent edges = happens-before), plus an
+EventTrace variant that chains deliveries in schedule order.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Optional
+
+from ..events import MsgEvent, TimerDelivery
+from ..schedulers.dep_tracker import ROOT, DepTracker
+from ..trace import EventTrace
+
+
+def _quote(s: str) -> str:
+    return '"' + str(s).replace("\\", "\\\\").replace('"', '\\"') + '"'
+
+
+def dep_tracker_to_dot(
+    tracker: DepTracker, highlight: Optional[Iterable[int]] = None
+) -> str:
+    """The happens-before forest as DOT: one node per tracked delivery
+    (label: id / snd→rcv / fingerprint), parent edges child -> parent as
+    in the reference's depGraph. ``highlight`` ids render filled."""
+    hi = set(highlight or ())
+    lines = ["digraph deps {", "  rankdir=BT;", '  root [label="root"];']
+    for eid, ev in sorted(tracker.events.items()):
+        label = f"{eid}: {ev.snd}->{ev.rcv}\\n{ev.fingerprint}"
+        style = ' style=filled fillcolor="lightblue"' if eid in hi else ""
+        kind = " shape=box" if ev.is_timer else ""
+        lines.append(f"  e{eid} [label={_quote(label)}{kind}{style}];")
+        parent = "root" if ev.parent == ROOT else f"e{ev.parent}"
+        lines.append(f"  e{eid} -> {parent};")
+    lines.append("}")
+    return "\n".join(lines)
+
+
+def event_trace_to_dot(trace: EventTrace) -> str:
+    """Deliveries of one recorded execution chained in schedule order
+    (the quick eyeball view of what happened)."""
+    lines = ["digraph trace {", "  rankdir=LR;"]
+    prev = None
+    k = 0
+    for unique in trace.events:
+        ev = unique.event
+        if isinstance(ev, MsgEvent):
+            label = f"{ev.snd}->{ev.rcv}\\n{ev.msg}"
+        elif isinstance(ev, TimerDelivery):
+            label = f"timer@{ev.rcv}\\n{ev.msg}"
+        else:
+            continue
+        node = f"d{k}"
+        shape = " shape=box" if isinstance(ev, TimerDelivery) else ""
+        lines.append(f"  {node} [label={_quote(label)}{shape}];")
+        if prev is not None:
+            lines.append(f"  {prev} -> {node};")
+        prev = node
+        k += 1
+    lines.append("}")
+    return "\n".join(lines)

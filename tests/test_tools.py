@@ -78,3 +78,35 @@ def test_stats_graph_tool(tmp_path, capsys):
     out = capsys.readouterr().out
     assert "csv written" in out
     assert os.path.exists(str(tmp_path / "minimization_stats.csv"))
+
+
+def test_dot_export():
+    """DOT export: delivery chain + happens-before forest (reference:
+    schedulers/Util.scala getDot:580-618)."""
+    from demi_tpu.fingerprints import FingerprintFactory
+    from demi_tpu.schedulers.dep_tracker import ROOT, DepTracker
+    from demi_tpu.utils.dot import dep_tracker_to_dot, event_trace_to_dot
+    from demi_tpu.apps.broadcast import make_broadcast_app
+    from demi_tpu.apps.common import dsl_start_events, make_host_invariant
+    from demi_tpu.config import SchedulerConfig
+    from demi_tpu.external_events import MessageConstructor, Send, WaitQuiescence
+    from demi_tpu.schedulers import RandomScheduler
+
+    app = make_broadcast_app(3, reliable=True)
+    config = SchedulerConfig(invariant_check=make_host_invariant(app))
+    program = dsl_start_events(app) + [
+        Send(app.actor_name(0), MessageConstructor(lambda: (1, 0))),
+        WaitQuiescence(),
+    ]
+    result = RandomScheduler(config, seed=0).execute(program)
+    dot = event_trace_to_dot(result.trace)
+    assert dot.startswith("digraph trace {") and dot.endswith("}")
+    assert "->" in dot and "n0" in dot
+
+    tracker = DepTracker(FingerprintFactory())
+    e1 = tracker.event_for("n0", "n1", (1, 0), ROOT)
+    e2 = tracker.event_for("n1", "n2", (1, 0), e1.id)
+    out = dep_tracker_to_dot(tracker, highlight=[e2.id])
+    assert f"e{e1.id} -> root;" in out
+    assert f"e{e2.id} -> e{e1.id};" in out
+    assert "fillcolor" in out
