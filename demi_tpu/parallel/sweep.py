@@ -14,6 +14,7 @@ headline metric against the JVM reference.
 
 from __future__ import annotations
 
+import os
 import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, List, Optional, Sequence, Tuple
@@ -74,13 +75,24 @@ class SweepDriver:
         self.app = app
         self.cfg = cfg
         self.program_gen = program_gen
+        impl = os.environ.get("DEMI_DEVICE_IMPL", "xla")
         if use_mesh:
             self.mesh = mesh or make_mesh()
-            self.kernel = shard_explore_kernel(app, cfg, self.mesh)
+            if impl == "pallas":
+                from .mesh import shard_explore_kernel_pallas
+
+                self.kernel = shard_explore_kernel_pallas(app, cfg, self.mesh)
+            else:
+                self.kernel = shard_explore_kernel(app, cfg, self.mesh)
             self._align = self.mesh.shape[LANES]
         else:
             self.mesh = None
-            self.kernel = make_explore_kernel(app, cfg)
+            if impl == "pallas":
+                from ..device.pallas_explore import make_explore_kernel_pallas
+
+                self.kernel = make_explore_kernel_pallas(app, cfg)
+            else:
+                self.kernel = make_explore_kernel(app, cfg)
             self._align = 1
     def _programs(self, seeds: Sequence[int]):
         # Lowered per call: seeds are disjoint across chunks, so a
