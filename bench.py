@@ -95,20 +95,24 @@ def bench_device_raft(jax):
     impl = os.environ.get("DEMI_BENCH_IMPL")
     block_lanes = int(os.environ.get("DEMI_BENCH_BLOCK_LANES", 256))
     per_impl = {}
-    # Default on an accelerator: measure BOTH backends while we have the
-    # chip (the tunnel is precious); headline = the best. CPU default
-    # stays xla-only (interpret-mode pallas is an emulation, not a
-    # measurement). DEMI_BENCH_IMPL=xla|pallas forces a single backend.
+    # Default on an accelerator: measure the whole backend/layout family
+    # while we have the chip (the tunnel is precious); headline = the
+    # best. CPU default measures the two XLA layouts (interpret-mode
+    # pallas is an emulation, not a measurement). DEMI_BENCH_IMPL forces
+    # a single variant: xla | xla-trailing | pallas | pallas-trailing.
     impls = [impl] if impl else (
-        ["xla", "pallas"] if platform not in ("cpu",) else ["xla"]
+        ["xla", "xla-trailing", "pallas", "pallas-trailing"]
+        if platform not in ("cpu",)
+        else ["xla", "xla-trailing"]
     )
     for name in impls:
-        if name == "pallas":
+        lane_axis = "trailing" if name.endswith("-trailing") else "leading"
+        if name.startswith("pallas"):
             kernel = make_explore_kernel_pallas(
-                app, cfg, block_lanes=block_lanes
+                app, cfg, block_lanes=block_lanes, lane_axis=lane_axis
             )
         else:
-            kernel = make_explore_kernel(app, cfg)
+            kernel = make_explore_kernel(app, cfg, lane_axis=lane_axis)
         try:
             per_impl[name] = measure(kernel)
         except Exception as e:  # pragma: no cover - accelerator-dependent
