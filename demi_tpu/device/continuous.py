@@ -1,0 +1,182 @@
+"""Continuous sweep: segment-stepped exploration with mid-flight lane
+refill — the continuous-batching trick applied to schedule exploration.
+
+A fixed-length sweep pays for its slowest lane: with heavy-tailed
+schedule lengths most of the batch idles (status frozen, steps masked to
+no-ops) while a few long lanes finish. Here the kernel runs SHORT
+segments and returns the full state batch; between segments the host
+harvests finished lanes' verdicts and re-initializes exactly those lanes
+with fresh programs/keys (a masked where-merge, no recompilation). Lane
+occupancy stays ~100% for any schedule-length distribution.
+
+Per-seed results are bit-identical to the plain explore kernel: a lane's
+step stream depends only on its own state/key, frozen lanes are no-ops,
+and refill replaces whole lanes atomically (tests/test_continuous.py).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..dsl import DSLApp
+from .core import ST_DONE, ST_VIOLATION, DeviceConfig, ScheduleState
+from .explore import ExtProgram, _finalize, init_state, make_step_fn
+
+
+def make_segment_kernel(app: DSLApp, cfg: DeviceConfig, seg_steps: int):
+    """jitted ``(state[B], progs[B]) -> state'[B]``: advance every lane by
+    ``seg_steps`` steps (finished lanes are frozen no-ops)."""
+    step = make_step_fn(app, cfg)
+
+    def run_segment(state: ScheduleState, prog: ExtProgram) -> ScheduleState:
+        def body(s, _):
+            return step(s, prog), None
+
+        state, _ = jax.lax.scan(body, state, None, length=seg_steps)
+        return state
+
+    return jax.jit(jax.vmap(run_segment))
+
+
+def make_init_kernel(app: DSLApp, cfg: DeviceConfig):
+    """jitted ``keys[B] -> ScheduleState[B]`` batch initializer."""
+    return jax.jit(jax.vmap(lambda key: init_state(app, cfg, key)))
+
+
+def make_refill_kernel(app: DSLApp, cfg: DeviceConfig):
+    """jitted ``(state[B], refill[B] bool, fresh[B]) -> state'[B]``:
+    lanes with ``refill`` set are replaced by the fresh state wholesale."""
+
+    def refill(state: ScheduleState, mask, fresh: ScheduleState):
+        def merge(old, new):
+            m = mask.reshape((-1,) + (1,) * (old.ndim - 1))
+            return jnp.where(m, new, old)
+
+        return jax.tree_util.tree_map(merge, state, fresh)
+
+    return jax.jit(refill)
+
+
+def make_finalize_kernel(app: DSLApp, cfg: DeviceConfig):
+    """jitted forced finalization for lanes that exhausted their step
+    budget mid-flight (parity: the plain kernel's run-out path)."""
+
+    def fin(state: ScheduleState):
+        return jax.lax.cond(
+            state.status < ST_DONE,
+            lambda s: _finalize(s, app, cfg),
+            lambda s: s,
+            state,
+        )
+
+    return jax.jit(jax.vmap(fin))
+
+
+class ContinuousSweepDriver:
+    """Seed-space sweep with continuous refill.
+
+    ``program_gen(seed) -> [ExternalEvent]`` as in SweepDriver; verdicts
+    per seed are identical to running each seed through the plain explore
+    kernel with ``PRNGKey(seed)``."""
+
+    def __init__(
+        self,
+        app: DSLApp,
+        cfg: DeviceConfig,
+        program_gen: Callable,
+        batch: int = 256,
+        seg_steps: int = 32,
+    ):
+        from .encoding import lower_program, stack_programs
+
+        self.app = app
+        self.cfg = cfg
+        self.program_gen = program_gen
+        self.batch = batch
+        self.seg_steps = seg_steps
+        self._lower = lambda seed: lower_program(
+            app, cfg, program_gen(seed)
+        )
+        self._stack = stack_programs
+        self.segment = make_segment_kernel(app, cfg, seg_steps)
+        self.init = make_init_kernel(app, cfg)
+        self.refill = make_refill_kernel(app, cfg)
+        self.finalize = make_finalize_kernel(app, cfg)
+
+    def sweep(self, total_lanes: int):
+        """Run ``total_lanes`` seeds; returns (statuses, violations) keyed
+        by seed."""
+        b = min(self.batch, total_lanes)
+        next_seed = 0
+
+        def keys_for(seeds):
+            return jnp.stack(
+                [jax.random.PRNGKey(s) for s in seeds]
+            )
+
+        lane_seed = list(range(b))
+        next_seed = b
+        progs_host: List = [self._lower(s) for s in lane_seed]
+        progs = self._stack(progs_host)
+        state = self.init(keys_for(lane_seed))
+        steps_run = np.zeros(b, np.int64)
+        statuses = {}
+        violations = {}
+        done_count = 0
+        active = np.ones(b, bool)
+
+        while done_count < total_lanes:
+            state = self.segment(state, progs)
+            steps_run += self.seg_steps
+            # Budget exhaustion: force-finalize overdue live lanes (the
+            # plain kernel's run-out-of-steps semantics).
+            status = np.asarray(state.status)
+            overdue = (
+                active & (status < ST_DONE) & (steps_run >= self.cfg.max_steps)
+            )
+            if overdue.any():
+                finalized = self.finalize(state)
+                state = self.refill(state, jnp.asarray(overdue), finalized)
+                status = np.asarray(state.status)
+            finished = active & (status >= ST_DONE)
+            if not finished.any():
+                continue
+            vio = np.asarray(state.violation)
+            for lane in np.flatnonzero(finished):
+                statuses[lane_seed[lane]] = int(status[lane])
+                violations[lane_seed[lane]] = int(vio[lane])
+                done_count += 1
+            # Refill finished lanes with fresh seeds (or park them).
+            refill_lanes = [
+                int(x) for x in np.flatnonzero(finished)
+            ][: max(0, total_lanes - next_seed)]
+            for lane in np.flatnonzero(finished):
+                active[lane] = False
+            if refill_lanes:
+                fresh_seeds = list(
+                    range(next_seed, next_seed + len(refill_lanes))
+                )
+                next_seed += len(refill_lanes)
+                mask = np.zeros(b, bool)
+                full_seeds = []
+                k = 0
+                for lane in range(b):
+                    if lane in refill_lanes and k < len(fresh_seeds):
+                        mask[lane] = True
+                        lane_seed[lane] = fresh_seeds[k]
+                        progs_host[lane] = self._lower(fresh_seeds[k])
+                        full_seeds.append(fresh_seeds[k])
+                        active[lane] = True
+                        steps_run[lane] = 0
+                        k += 1
+                    else:
+                        full_seeds.append(lane_seed[lane])
+                progs = self._stack(progs_host)
+                fresh = self.init(keys_for(full_seeds))
+                state = self.refill(state, jnp.asarray(mask), fresh)
+        return statuses, violations
