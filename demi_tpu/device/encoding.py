@@ -96,7 +96,20 @@ def lower_program(
             ops[i], a[i], b[i] = OP_UNPARTITION, app.actor_id(ev.a), app.actor_id(ev.b)
         else:
             raise TypeError(f"{type(ev).__name__} is not lowerable to the device tier")
+    _check_msg_range(cfg, msg)
     return ExtProgram(op=ops, a=a, b=b, msg=msg)
+
+
+def _check_msg_range(cfg: DeviceConfig, msg: np.ndarray) -> None:
+    """Narrow storage (msg_dtype='int16') silently wraps out-of-range
+    payloads on device; reject them at the host lowering boundary."""
+    if cfg.msg_dtype == "int16" and msg.size:
+        lo, hi = np.iinfo(np.int16).min, np.iinfo(np.int16).max
+        if msg.min() < lo or msg.max() > hi:
+            raise ValueError(
+                "message payload exceeds int16 range; use msg_dtype='int32' "
+                f"(got values in [{msg.min()}, {msg.max()}])"
+            )
 
 
 def stack_programs(programs: Sequence[ExtProgram]) -> ExtProgram:
@@ -198,6 +211,7 @@ def lower_expected_trace(
     out = np.zeros((max_records, cfg.rec_width), np.int32)
     for i, r in enumerate(recs):
         out[i, : len(r)] = r
+    _check_msg_range(cfg, out[:, 3 : 3 + cfg.msg_width])
     return out
 
 

@@ -367,3 +367,23 @@ def test_int16_msg_storage_parity():
             assert (
                 np.asarray(getattr(base, f)) == np.asarray(getattr(res, f))
             ).all(), (key, f)
+
+
+def test_int16_out_of_range_payload_rejected():
+    """Narrow storage silently wraps on device, so the host lowering
+    boundary must reject out-of-range payloads loudly."""
+    import pytest
+
+    from demi_tpu.apps.broadcast import make_broadcast_app
+
+    app = make_broadcast_app(3, reliable=False)
+    cfg = DeviceConfig.for_app(
+        app, pool_capacity=32, max_steps=32, max_external_ops=8,
+        msg_dtype="int16",
+    )
+    program = dsl_start_events(app) + [
+        Send(app.actor_name(0), MessageConstructor(lambda: (1, 70000))),
+        WaitQuiescence(),
+    ]
+    with pytest.raises(ValueError, match="int16 range"):
+        lower_program(app, cfg, program)
