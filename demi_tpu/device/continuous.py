@@ -108,9 +108,34 @@ class ContinuousSweepDriver:
         self.refill = make_refill_kernel(app, cfg)
         self.finalize = make_finalize_kernel(app, cfg)
 
+    def time_to_first_violation(self, max_lanes: int = 1_000_000):
+        """Wall-clock seconds until the first violating lane finishes (the
+        BASELINE.md headline #2 shape, continuous-refill form). Returns
+        (seconds, seed) or (None, None) if ``max_lanes`` seeds stay clean."""
+        import time
+
+        t0 = time.perf_counter()
+        for seed, code in self.sweep_iter(max_lanes):
+            if code != 0:
+                return time.perf_counter() - t0, seed
+        return None, None
+
+    def sweep_iter(self, total_lanes: int):
+        """Generator form of ``sweep``: yields (seed, violation_code) as
+        lanes finish."""
+        for seed, _st, code in self._run(total_lanes):
+            yield seed, code
+
     def sweep(self, total_lanes: int):
         """Run ``total_lanes`` seeds; returns (statuses, violations) keyed
         by seed."""
+        statuses, violations = {}, {}
+        for seed, st, code in self._run(total_lanes):
+            statuses[seed] = st
+            violations[seed] = code
+        return statuses, violations
+
+    def _run(self, total_lanes: int):
         b = min(self.batch, total_lanes)
         next_seed = 0
 
@@ -125,8 +150,6 @@ class ContinuousSweepDriver:
         progs = self._stack(progs_host)
         state = self.init(keys_for(lane_seed))
         steps_run = np.zeros(b, np.int64)
-        statuses = {}
-        violations = {}
         done_count = 0
         active = np.ones(b, bool)
 
@@ -148,8 +171,7 @@ class ContinuousSweepDriver:
                 continue
             vio = np.asarray(state.violation)
             for lane in np.flatnonzero(finished):
-                statuses[lane_seed[lane]] = int(status[lane])
-                violations[lane_seed[lane]] = int(vio[lane])
+                yield lane_seed[lane], int(status[lane]), int(vio[lane])
                 done_count += 1
             # Refill finished lanes with fresh seeds (or park them).
             refill_lanes = [
@@ -179,4 +201,3 @@ class ContinuousSweepDriver:
                 progs = self._stack(progs_host)
                 fresh = self.init(keys_for(full_seeds))
                 state = self.refill(state, jnp.asarray(mask), fresh)
-        return statuses, violations

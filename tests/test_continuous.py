@@ -66,3 +66,23 @@ def test_continuous_matches_plain_kernel_raft_faults():
         prefix=dsl_start_events(app), max_kills=2, wait_budget=(5, 30),
     )
     _parity(app, cfg, lambda s: fz.generate_fuzz_test(seed=s), 24, 8, 32)
+
+
+def test_continuous_time_to_first_violation():
+    app = make_broadcast_app(4, reliable=False)
+    cfg = DeviceConfig.for_app(
+        app, pool_capacity=64, max_steps=96, max_external_ops=24
+    )
+    fz = Fuzzer(
+        num_events=8,
+        weights=FuzzerWeights(send=0.6, wait_quiescence=0.25, kill=0.15),
+        message_gen=broadcast_send_generator(app),
+        prefix=dsl_start_events(app), max_kills=1,
+    )
+    drv = ContinuousSweepDriver(
+        app, cfg, lambda s: fz.generate_fuzz_test(seed=s), batch=8,
+        seg_steps=16,
+    )
+    secs, seed = drv.time_to_first_violation(max_lanes=64)
+    assert secs is not None and secs > 0
+    assert seed is not None
