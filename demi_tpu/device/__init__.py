@@ -22,12 +22,14 @@ try:
 except Exception:  # pragma: no cover
     pass
 
+from .continuous import ContinuousSweepDriver
 from .core import DeviceConfig, ScheduleState
 from .explore import make_explore_kernel, make_single_lane_trace_kernel
 from .pallas_explore import make_explore_kernel_pallas, make_replay_kernel_pallas
 from .replay import make_replay_kernel
 
 __all__ = [
+    "ContinuousSweepDriver",
     "DeviceConfig",
     "ScheduleState",
     "make_explore_kernel",
