@@ -335,6 +335,21 @@ def cmd_dot(args) -> int:
     return 0
 
 
+def cmd_report(args) -> int:
+    """Markdown report of a saved experiment (summary of the artifacts the
+    reference spreads over stats printing + graphing, RunnerUtils.scala:1200)."""
+    from .tools.report import render_report
+
+    text = render_report(args.experiment)
+    if args.output:
+        with open(args.output, "w") as f:
+            f.write(text)
+        print(f"report written to {args.output}")
+    else:
+        print(text)
+    return 0
+
+
 def cmd_interactive(args) -> int:
     from .schedulers.interactive import InteractiveScheduler
 
@@ -428,6 +443,11 @@ def main(argv: Optional[list] = None) -> int:
     p.add_argument("--pool", type=int, default=256)
     p.add_argument("--rounds", type=int, default=10)
     p.set_defaults(fn=cmd_dpor)
+
+    p = sub.add_parser("report", help="markdown report of a saved experiment")
+    p.add_argument("-e", "--experiment", required=True)
+    p.add_argument("-o", "--output", default=None)
+    p.set_defaults(fn=cmd_report)
 
     p = sub.add_parser("dot", help="export an experiment as Graphviz DOT")
     common(p)

@@ -1,0 +1,108 @@
+"""Experiment report: one markdown artifact summarizing a saved run.
+
+The reference scatters its outputs across printMinimizationStats
+(RunnerUtils.scala:1200-1266), minimization_stats.json graphs, and
+experiment-dir files; this collects a saved experiment into a single
+readable report — violation, external program vs MCS, per-stage
+minimization table, and the artifact inventory.
+
+    python -m demi_tpu report --app raft --nodes 3 -e exp/ [-o report.md]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import List, Optional
+
+
+def _load(directory: str, name: str):
+    path = os.path.join(directory, name)
+    if not os.path.exists(path):
+        return None
+    with open(path) as f:
+        return json.load(f)
+
+
+def render_report(directory: str, app=None) -> str:
+    meta = _load(directory, "metadata.json") or {}
+    violation = _load(directory, "violation.json")
+    externals = _load(directory, "externals.json") or []
+    mcs = _load(directory, "mcs.json")
+    stats = _load(directory, "minimization_stats.json")
+    trace = _load(directory, "event_trace.json")
+    min_trace = _load(directory, "minimized_trace.json")
+
+    lines: List[str] = [f"# Experiment report: `{directory}`", ""]
+    if meta:
+        lines += [
+            f"- app: **{meta.get('app', '?')}**",
+            f"- saved: {meta.get('timestamp', '?')} on {meta.get('host', '?')} "
+            f"(git {meta.get('git_sha', '?')[:9]})",
+        ]
+    if violation is not None:
+        lines += ["", "## Violation", "", f"```\n{json.dumps(violation)}\n```"]
+
+    def _count_events(t):
+        if not t:
+            return None
+        events = t.get("events", t) if isinstance(t, dict) else t
+        return len(events)
+
+    lines += ["", "## Minimization", ""]
+    rows = [("original externals", len(externals), _count_events(trace))]
+    if mcs is not None:
+        rows.append(("MCS externals", len(mcs), _count_events(min_trace)))
+    lines.append("| stage | externals | trace events |")
+    lines.append("|---|---|---|")
+    for name, ext, deliv in rows:
+        lines.append(f"| {name} | {ext} | {deliv if deliv is not None else '—'} |")
+    if mcs is not None and externals:
+        factor = len(externals) / max(1, len(mcs))
+        lines.append(f"\nExternal reduction: **{len(externals)} → {len(mcs)}** "
+                     f"({factor:.1f}×)")
+
+    if stats:
+        # Either a bare stage list or {"stages": [...]}.
+        stages = stats if isinstance(stats, list) else stats.get("stages", [])
+        if stages:
+            lines += ["", "### Pipeline stages", "",
+                      "| strategy | oracle | trials | prune s | replay s |",
+                      "|---|---|---|---|---|"]
+            total = 0
+            for st in stages:
+                total += st.get("total_replays", 0)
+                lines.append(
+                    "| {strategy} | {oracle} | {total_replays} | "
+                    "{prune_duration_seconds:.2f} | "
+                    "{replay_duration_seconds:.2f} |".format(
+                        **{
+                            "strategy": st.get("strategy", "?"),
+                            "oracle": st.get("oracle", "?"),
+                            "total_replays": st.get("total_replays", 0),
+                            "prune_duration_seconds": st.get(
+                                "prune_duration_seconds", 0.0
+                            ),
+                            "replay_duration_seconds": st.get(
+                                "replay_duration_seconds", 0.0
+                            ),
+                        }
+                    )
+                )
+            lines.append(f"\nTotal oracle trials: **{total}**")
+
+    inventory = sorted(
+        f for f in os.listdir(directory) if os.path.isfile(
+            os.path.join(directory, f)
+        )
+    )
+    lines += ["", "## Artifacts", ""]
+    for f in inventory:
+        size = os.path.getsize(os.path.join(directory, f))
+        lines.append(f"- `{f}` ({size} bytes)")
+    lines += [
+        "",
+        "Export views: `python -m demi_tpu shiviz -e {d} ...` (ShiViz), "
+        "`python -m demi_tpu dot -e {d} ...` (Graphviz).".format(d=directory),
+    ]
+    return "\n".join(lines) + "\n"

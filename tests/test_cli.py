@@ -62,3 +62,14 @@ def test_cli_shiviz_and_dot(exp_dir, capsys, tmp_path):
     assert rc == 0
     text = out_file.read_text()
     assert text.startswith("digraph trace {")
+
+
+def test_cli_report(exp_dir, capsys):
+    rc = main(["minimize"] + _common(exp_dir)) if not (exp_dir / "mcs.json").exists() else 0
+    assert rc == 0
+    rc = main(["report", "-e", str(exp_dir)])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "# Experiment report" in out
+    assert "## Violation" in out
+    assert "External reduction" in out
