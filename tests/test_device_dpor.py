@@ -8,7 +8,7 @@ from demi_tpu.apps.common import dsl_start_events
 from demi_tpu.device import DeviceConfig
 from demi_tpu.device.core import REC_DELIVERY
 from demi_tpu.device.dpor_sweep import DeviceDPOR, racing_prescriptions
-from demi_tpu.dsl import DSLApp
+from demi_tpu.dsl import DSLApp, vset
 from demi_tpu.external_events import MessageConstructor, Send, WaitQuiescence
 
 
@@ -25,10 +25,10 @@ def make_reversal_app(k: int) -> DSLApp:
         expect = k - pos
         ok_so_far = state[1] == 0
         hit = (msg[1] == expect) & ok_so_far
-        state = state.at[1].set(jnp.where(hit, 0, 1))
-        state = state.at[0].set(pos + 1)
+        state = vset(state, 1, jnp.where(hit, 0, 1))
+        state = vset(state, 0, pos + 1)
         done = (pos + 1 == k) & (state[1] == 0)
-        state = state.at[2].set(jnp.where(done, 1, state[2]))
+        state = vset(state, 2, jnp.where(done, 1, state[2]))
         return state, jnp.zeros((1, 4), jnp.int32)
 
     def invariant(states, alive):
@@ -201,3 +201,12 @@ def test_incremental_ddmin_with_device_oracle():
     kept = mcs.get_all_events()
     assert noise not in kept
     assert len(kept) < len(program)
+
+
+def test_device_dpor_pallas_backend_finds_reversal():
+    """DeviceDPOR on the pallas kernel (impl='pallas'): the systematic
+    frontier search finds the 1/k!-rare reversal just like the XLA path."""
+    app, cfg, program = _setup(4)
+    dpor = DeviceDPOR(app, cfg, program, batch_size=8, impl="pallas")
+    found = dpor.explore(target_code=1, max_rounds=40)
+    assert found is not None, "pallas DPOR sweep missed the reversal"
