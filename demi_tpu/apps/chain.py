@@ -1,0 +1,174 @@
+"""Chain replication: the storage-protocol fixture.
+
+Fifth app family (with broadcast/raft/spark/twopc, standing in for the
+reference's out-of-repo demi-applications suite, SURVEY.md §4). Actors
+form a chain head=0 → … → tail=n-1: external WRITEs enter at the head
+and replicate down the chain; a version is COMMITTED when it reaches the
+tail, which sends an ACK back up — each node's committed watermark only
+ever advances via tail-originated ACKs. External READs may hit any node
+and are served from the committed watermark.
+
+Safety invariant (code 1, phantom read): no alive node may ever have
+SERVED a version newer than the tail's committed version — a served
+value that never commits was observed by a client and then lost.
+
+Seeded bug ``bug="read_uncommitted"``: reads are served from the latest
+*received* version instead of the committed watermark. Harmless until a
+mid-chain Kill strands the write: the head serves v, the replication
+dies between head and tail, v never commits — the classic dirty-read
+anomaly chain replication's commit rule exists to prevent. Needs
+fault injection (Kill) + a read racing the replication: a
+scheduler-and-fault bug in the reference's style.
+"""
+
+from __future__ import annotations
+
+import random as _random
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..dsl import DSLApp, vset
+from .common import DSLSendGenerator
+
+T_WRITE = 1  # (tag, value, 0) external -> head
+T_REPL = 2  # (tag, version, value) node i -> i+1
+T_ACK = 3  # (tag, version, 0) node i -> i-1 (originates at tail)
+T_READ = 4  # (tag, 0, 0) external -> any node
+
+MSG_W = 3
+
+VERSION = 0  # latest version received
+VALUE = 1
+COMMITTED = 2  # committed watermark (tail-originated)
+SERVED = 3  # newest version this node ever served to a read
+
+
+def make_chain_app(
+    num_actors: int, bug: Optional[str] = None, name: str = "c"
+) -> DSLApp:
+    n = num_actors
+    assert n >= 2, "chain needs at least head and tail"
+    state_width = 4
+    max_outbox = 1
+
+    def init_state(actor_id: int) -> np.ndarray:
+        return np.zeros(state_width, np.int32)
+
+    def _one(dst, tag, a, b):
+        row = jnp.stack(
+            [jnp.int32(1), dst.astype(jnp.int32), tag.astype(jnp.int32),
+             a.astype(jnp.int32), b.astype(jnp.int32)]
+        )
+        return row[None, :]
+
+    def _none():
+        return jnp.zeros((max_outbox, 2 + MSG_W), jnp.int32)
+
+    def on_write(actor_id, state, snd, msg):
+        value = msg[1]
+        is_head = actor_id == 0
+        version = state[VERSION] + 1
+        state = vset(state, VERSION, version, is_head)
+        state = vset(state, VALUE, value, is_head)
+        # Single-node chain commits immediately; else replicate to node 1.
+        if n == 1:  # pragma: no cover - guarded by assert n >= 2
+            return state, _none()
+        tail_here = is_head & (n == 1)
+        out = jnp.where(
+            is_head,
+            _one(jnp.int32(1), jnp.int32(T_REPL), version, value),
+            _none(),
+        )
+        return state, out
+
+    def on_repl(actor_id, state, snd, msg):
+        version, value = msg[1], msg[2]
+        in_chain = actor_id != 0
+        newer = version > state[VERSION]
+        apply_ = in_chain & newer
+        state = vset(state, VERSION, version, apply_)
+        state = vset(state, VALUE, value, apply_)
+        is_tail = actor_id == n - 1
+        # Tail: commit + ack upstream. Middle: forward down the chain.
+        state = vset(
+            state, COMMITTED,
+            jnp.maximum(state[COMMITTED], version), apply_ & is_tail,
+        )
+        nxt = jnp.clip(actor_id + 1, 0, n - 1)
+        prv = jnp.clip(actor_id - 1, 0, n - 1)
+        out = jnp.where(
+            apply_,
+            jnp.where(
+                is_tail,
+                _one(jnp.asarray(prv), jnp.int32(T_ACK), version, jnp.int32(0)),
+                _one(jnp.asarray(nxt), jnp.int32(T_REPL), version, value),
+            ),
+            _none(),
+        )
+        return state, out
+
+    def on_ack(actor_id, state, snd, msg):
+        version = msg[1]
+        state = vset(
+            state, COMMITTED, jnp.maximum(state[COMMITTED], version)
+        )
+        prv = jnp.clip(actor_id - 1, 0, n - 1)
+        out = jnp.where(
+            actor_id > 0,
+            _one(jnp.asarray(prv), jnp.int32(T_ACK), version, jnp.int32(0)),
+            _none(),
+        )
+        return state, out
+
+    def on_read(actor_id, state, snd, msg):
+        if bug == "read_uncommitted":
+            # BUG: serve the latest received version — observable before
+            # it commits, lost if the chain dies mid-replication.
+            served = jnp.maximum(state[SERVED], state[VERSION])
+        else:
+            served = jnp.maximum(state[SERVED], state[COMMITTED])
+        state = vset(state, SERVED, served)
+        return state, _none()
+
+    def handler(actor_id, state, snd, msg):
+        tag = jnp.clip(msg[0], 1, 4) - 1
+        return jax.lax.switch(
+            tag, [on_write, on_repl, on_ack, on_read],
+            actor_id, state, snd, msg,
+        )
+
+    def invariant(states, alive):
+        """Phantom read: an alive node served a version beyond the alive
+        tail's committed watermark."""
+        committed_tail = states[n - 1, COMMITTED]
+        served = states[:, SERVED]
+        bad = jnp.any(alive & (served > committed_tail)) & alive[n - 1]
+        return jnp.where(bad, jnp.int32(1), jnp.int32(0))
+
+    return DSLApp(
+        name=name,
+        num_actors=n,
+        state_width=state_width,
+        msg_width=MSG_W,
+        max_outbox=max_outbox,
+        init_state=init_state,
+        handler=handler,
+        invariant=invariant,
+        tag_names=("", "Write", "Repl", "Ack", "Read"),
+    )
+
+
+def chain_send_generator(app: DSLApp) -> DSLSendGenerator:
+    """Writes (to whoever — non-heads ignore) interleaved with reads."""
+
+    def make_msg(rng: _random.Random, counter: int):
+        if counter > 8:
+            return None
+        if rng.random() < 0.5:
+            return (T_WRITE, 10 + counter, 0)
+        return (T_READ, 0, 0)
+
+    return DSLSendGenerator(app, make_msg)
