@@ -29,15 +29,28 @@ from .explore import ExtProgram, _finalize, init_state, make_step_fn
 
 
 def make_segment_kernel(app: DSLApp, cfg: DeviceConfig, seg_steps: int):
-    """jitted ``(state[B], progs[B]) -> state'[B]``: advance every lane by
-    ``seg_steps`` steps (finished lanes are frozen no-ops)."""
+    """jitted ``(state[B], progs[B], steps_run[B]) -> state'[B]``: advance
+    every lane by ``seg_steps`` steps (finished lanes are frozen no-ops).
+
+    ``steps_run`` is each lane's step count so far; steps at or past
+    ``cfg.max_steps`` are masked out per lane, so bit-parity with the plain
+    explore kernel holds for ANY seg_steps, including ones that don't
+    divide max_steps (a lane refilled mid-stream stops exactly on budget
+    instead of running to the segment boundary)."""
     step = make_step_fn(app, cfg)
 
-    def run_segment(state: ScheduleState, prog: ExtProgram) -> ScheduleState:
-        def body(s, _):
-            return step(s, prog), None
+    def run_segment(
+        state: ScheduleState, prog: ExtProgram, steps_run
+    ) -> ScheduleState:
+        def body(s, i):
+            live = (steps_run + i) < cfg.max_steps
+            s2 = step(s, prog)
+            s = jax.tree_util.tree_map(
+                lambda a, b: jnp.where(live, b, a), s, s2
+            )
+            return s, None
 
-        state, _ = jax.lax.scan(body, state, None, length=seg_steps)
+        state, _ = jax.lax.scan(body, state, jnp.arange(seg_steps))
         return state
 
     return jax.jit(jax.vmap(run_segment))
@@ -154,8 +167,12 @@ class ContinuousSweepDriver:
         active = np.ones(b, bool)
 
         while done_count < total_lanes:
-            state = self.segment(state, progs)
-            steps_run += self.seg_steps
+            state = self.segment(
+                state, progs, jnp.asarray(steps_run, jnp.int32)
+            )
+            steps_run = np.minimum(
+                steps_run + self.seg_steps, self.cfg.max_steps
+            )
             # Budget exhaustion: force-finalize overdue live lanes (the
             # plain kernel's run-out-of-steps semantics).
             status = np.asarray(state.status)

@@ -56,7 +56,7 @@ def rng_split(key: jnp.ndarray, n: int = 2) -> jnp.ndarray:
         from jax._src import prng as _prng
 
         return _prng.threefry_split(key, (n,))
-    except (ImportError, AttributeError):  # pragma: no cover - jax internals moved
+    except (ImportError, AttributeError, TypeError):  # pragma: no cover - jax internals moved
         import jax
 
         return jax.random.split(key, n)

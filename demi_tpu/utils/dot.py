@@ -15,8 +15,14 @@ from ..schedulers.dep_tracker import ROOT, DepTracker
 from ..trace import EventTrace
 
 
-def _quote(s: str) -> str:
-    return '"' + str(s).replace("\\", "\\\\").replace('"', '\\"') + '"'
+def _escape(s: str) -> str:
+    return str(s).replace("\\", "\\\\").replace('"', '\\"')
+
+
+def _label(*parts) -> str:
+    """Multi-line DOT label: each part fully escaped, joined by the DOT
+    line-break escape (inserted AFTER escaping so it survives as \\n)."""
+    return '"' + "\\n".join(_escape(p) for p in parts) + '"'
 
 
 def dep_tracker_to_dot(
@@ -28,10 +34,10 @@ def dep_tracker_to_dot(
     hi = set(highlight or ())
     lines = ["digraph deps {", "  rankdir=BT;", '  root [label="root"];']
     for eid, ev in sorted(tracker.events.items()):
-        label = f"{eid}: {ev.snd}->{ev.rcv}\\n{ev.fingerprint}"
+        label = _label(f"{eid}: {ev.snd}->{ev.rcv}", ev.fingerprint)
         style = ' style=filled fillcolor="lightblue"' if eid in hi else ""
         kind = " shape=box" if ev.is_timer else ""
-        lines.append(f"  e{eid} [label={_quote(label)}{kind}{style}];")
+        lines.append(f"  e{eid} [label={label}{kind}{style}];")
         parent = "root" if ev.parent == ROOT else f"e{ev.parent}"
         lines.append(f"  e{eid} -> {parent};")
     lines.append("}")
@@ -47,14 +53,14 @@ def event_trace_to_dot(trace: EventTrace) -> str:
     for unique in trace.events:
         ev = unique.event
         if isinstance(ev, MsgEvent):
-            label = f"{ev.snd}->{ev.rcv}\\n{ev.msg}"
+            label = _label(f"{ev.snd}->{ev.rcv}", ev.msg)
         elif isinstance(ev, TimerDelivery):
-            label = f"timer@{ev.rcv}\\n{ev.msg}"
+            label = _label(f"timer@{ev.rcv}", ev.msg)
         else:
             continue
         node = f"d{k}"
         shape = " shape=box" if isinstance(ev, TimerDelivery) else ""
-        lines.append(f"  {node} [label={_quote(label)}{shape}];")
+        lines.append(f"  {node} [label={label}{shape}];")
         if prev is not None:
             lines.append(f"  {prev} -> {node};")
         prev = node

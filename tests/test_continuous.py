@@ -68,6 +68,28 @@ def test_continuous_matches_plain_kernel_raft_faults():
     _parity(app, cfg, lambda s: fz.generate_fuzz_test(seed=s), 24, 8, 32)
 
 
+def test_continuous_nondivisible_seg_steps():
+    """seg_steps that does NOT divide max_steps: the segment kernel must
+    clamp each lane exactly at the step budget (advisor repro: raft
+    multivote, max_steps=40, seg_steps=28 — seed 59 diverged before the
+    per-lane budget mask)."""
+    app = make_raft_app(3, bug="multivote")
+    cfg = DeviceConfig.for_app(
+        app, pool_capacity=96, max_steps=40, max_external_ops=24,
+        invariant_interval=1, timer_weight=0.1,
+    )
+    fz = Fuzzer(
+        num_events=10,
+        weights=FuzzerWeights(
+            send=0.3, kill=0.1, wait_quiescence=0.3, hard_kill=0.15,
+            restart=0.15,
+        ),
+        message_gen=raft_send_generator(app),
+        prefix=dsl_start_events(app), max_kills=2, wait_budget=(5, 30),
+    )
+    _parity(app, cfg, lambda s: fz.generate_fuzz_test(seed=s), 64, 8, 28)
+
+
 def test_continuous_time_to_first_violation():
     app = make_broadcast_app(4, reliable=False)
     cfg = DeviceConfig.for_app(
