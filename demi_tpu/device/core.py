@@ -176,6 +176,11 @@ class ScheduleState(NamedTuple):
     final_seg: jnp.ndarray  # bool: this dispatch segment is the program's last
     status: jnp.ndarray  # int32 (ST_*)
     violation: jnp.ndarray  # int32 fingerprint (0 = none)
+    # Rolling FNV-style fold of every delivered (src, dst, timer?, payload):
+    # two lanes share sched_hash iff they delivered the same sequence (modulo
+    # 32-bit collisions), making "unique schedules explored" measurable
+    # without trace recording (BASELINE.json metric name).
+    sched_hash: jnp.ndarray  # uint32
     rng: jnp.ndarray  # PRNG key
     # Optional trace recording.
     trace: jnp.ndarray  # [T, rec_width] int32 (or [0,0] when disabled)
@@ -212,6 +217,7 @@ def init_state(app: DSLApp, cfg: DeviceConfig, key) -> ScheduleState:
         final_seg=jnp.bool_(False),
         status=jnp.int32(ST_INJECT),
         violation=jnp.int32(0),
+        sched_hash=jnp.uint32(0x811C9DC5),  # FNV-1a offset basis
         rng=key,
         trace=jnp.zeros(trace_shape, jnp.int32),
         trace_len=jnp.int32(0),
@@ -424,6 +430,20 @@ def delivery_effects(
     new_actor_state = ops.set_row(
         state.actor_state, dst, new_row, valid_idx, oh
     )
+    # Fold this delivery into the lane's schedule fingerprint (uint32
+    # FNV-style: multiply by an odd prime, mix in src/dst/timer/payload).
+    # Wraparound is the modulus; identical delivered sequences hash equal.
+    w = msg.shape[0]
+    pw = jnp.asarray(
+        [pow(31, j, 1 << 32) for j in range(w)], jnp.uint32
+    )
+    mix = (
+        jnp.sum(msg.astype(jnp.uint32) * pw)
+        + src.astype(jnp.uint32) * jnp.uint32(0x9E3779B1)
+        + dst.astype(jnp.uint32) * jnp.uint32(0x85EBCA77)
+        + is_timer.astype(jnp.uint32) * jnp.uint32(0xC2B2AE35)
+    )
+    folded = state.sched_hash * jnp.uint32(0x01000193) + mix
     # Consume the entry.
     state = state._replace(
         actor_state=new_actor_state,
@@ -431,6 +451,7 @@ def delivery_effects(
             state.pool_valid, safe_idx, False, valid_idx, oh
         ),
         deliveries=state.deliveries + valid_idx.astype(jnp.int32),
+        sched_hash=jnp.where(valid_idx, folded, state.sched_hash),
     )
 
     # Timer memory update: delivering a timer remembers it; delivering a

@@ -171,6 +171,7 @@ def make_dpor_run_lane(app: DSLApp, cfg: DeviceConfig):
             deliveries=state.deliveries,
             trace=state.trace,
             trace_len=state.trace_len,
+            sched_hash=state.sched_hash,
         )
 
     return run_lane

@@ -57,6 +57,10 @@ class LaneResult(NamedTuple):
     deliveries: jnp.ndarray  # int32
     trace: jnp.ndarray  # [T, rec_width] (zero-size when not recording)
     trace_len: jnp.ndarray  # int32
+    # uint32 fingerprint of the delivered sequence (core.ScheduleState
+    # .sched_hash): equal hashes = identical schedules, so sweeps can
+    # report UNIQUE schedules explored, not just lanes swept.
+    sched_hash: jnp.ndarray  # uint32
 
 
 def _precomputed(app: DSLApp, cfg: DeviceConfig):
@@ -309,6 +313,7 @@ def make_run_lane(app: DSLApp, cfg: DeviceConfig):
             deliveries=state.deliveries,
             trace=state.trace,
             trace_len=state.trace_len,
+            sched_hash=state.sched_hash,
         )
 
     return run_lane

@@ -216,7 +216,7 @@ def make_explore_kernel_pallas(
             res = jax.vmap(run_lane, in_axes=-1, out_axes=0)(
                 ExtProgram(op=op, a=a, b=b, msg=msg), keys
             )
-            return res.status, res.violation, res.deliveries
+            return res.status, res.violation, res.deliveries, res.sched_hash
 
         in_structs = [
             jax.ShapeDtypeStruct((e, bl), jnp.int32),
@@ -233,7 +233,7 @@ def make_explore_kernel_pallas(
             res = jax.vmap(run_lane)(
                 ExtProgram(op=op, a=a, b=b, msg=msg), keys
             )
-            return res.status, res.violation, res.deliveries
+            return res.status, res.violation, res.deliveries, res.sched_hash
 
         in_structs = [
             jax.ShapeDtypeStruct((bl, e), jnp.int32),
@@ -249,7 +249,7 @@ def make_explore_kernel_pallas(
         ins = (progs.op, progs.a, progs.b, progs.msg, keys)
         if trailing:
             ins = tuple(jnp.moveaxis(jnp.asarray(x), 0, -1) for x in ins)
-        st, vio, dl = blocked(*ins)
+        st, vio, dl, sh = blocked(*ins)
         empty = jnp.zeros((n_lanes, 0, 0), jnp.int32)
         return LaneResult(
             status=st,
@@ -257,6 +257,7 @@ def make_explore_kernel_pallas(
             deliveries=dl,
             trace=empty,
             trace_len=jnp.zeros((n_lanes,), jnp.int32),
+            sched_hash=sh,
         )
 
     return jax.jit(call)
@@ -287,7 +288,7 @@ def make_dpor_kernel_pallas(
         )
         return (
             res.status, res.violation, res.deliveries, res.trace,
-            res.trace_len,
+            res.trace_len, res.sched_hash,
         )
 
     in_structs = [
@@ -301,11 +302,12 @@ def make_dpor_kernel_pallas(
     blocked = _make_blocked_kernel(block_fn, in_structs, bl, interpret)
 
     def call(progs: ExtProgram, prescs, keys) -> LaneResult:
-        st, vio, dl, tr, tl = blocked(
+        st, vio, dl, tr, tl, sh = blocked(
             progs.op, progs.a, progs.b, progs.msg, prescs, keys
         )
         return LaneResult(
-            status=st, violation=vio, deliveries=dl, trace=tr, trace_len=tl
+            status=st, violation=vio, deliveries=dl, trace=tr, trace_len=tl,
+            sched_hash=sh,
         )
 
     return jax.jit(call)

@@ -90,7 +90,7 @@ def shard_explore_kernel_pallas(
     in_specs = (ExtProgram(op=lane, a=lane, b=lane, msg=lane), lane)
     out_specs = LaneResult(
         status=lane, violation=lane, deliveries=lane, trace=lane,
-        trace_len=lane,
+        trace_len=lane, sched_hash=lane,
     )
     return jax.jit(
         jax.shard_map(

@@ -43,6 +43,9 @@ class SweepChunkResult:
     # Lanes aborted with ST_OVERFLOW (pool too small): these completed no
     # verdict, so any nonzero count means the sweep's numbers undercount.
     overflow_lanes: int = 0
+    # Deduped device-side schedule fingerprints (LaneResult.sched_hash)
+    # for this chunk's real lanes: the honest "unique schedules" numerator.
+    unique_hashes: Optional[np.ndarray] = None
 
 
 @dataclass
@@ -61,6 +64,17 @@ class SweepResult:
     def schedules_per_sec(self) -> float:
         secs = sum(c.seconds for c in self.chunks)
         return self.lanes / secs if secs > 0 else 0.0
+
+    @property
+    def unique_schedules(self) -> int:
+        """Distinct delivered sequences across the whole sweep (union of
+        per-chunk fingerprint sets)."""
+        parts = [
+            c.unique_hashes for c in self.chunks if c.unique_hashes is not None
+        ]
+        if not parts:
+            return 0
+        return int(np.unique(np.concatenate(parts)).size)
 
 
 class SweepDriver:
@@ -147,6 +161,11 @@ class SweepDriver:
             ),
             seconds=seconds,
             overflow_lanes=int((statuses == ST_OVERFLOW).sum()),
+            # Overflowed lanes aborted mid-schedule: their truncated
+            # fingerprints are not explored schedules, keep them out.
+            unique_hashes=np.unique(
+                np.asarray(res.sched_hash)[:n_real][statuses != ST_OVERFLOW]
+            ),
         )
 
     def sweep(
