@@ -201,8 +201,9 @@ def bench_time_to_first_violation(jax):
     )
     driver = SweepDriver(app, cfg, lambda s: fuzzer.generate_fuzz_test(seed=s))
     chunk = 256
-    # Warm-up: compile the kernel outside the timed window.
-    driver.run_chunk(range(chunk), base_key=999)
+    # Warm-up: compile the continuous-sweep kernels outside the timed
+    # window (sweep() defaults to lane-compacted continuous mode).
+    driver.sweep(chunk, chunk)
     secs, result = driver.time_to_first_violation(chunk_size=chunk)
     return secs
 
@@ -302,7 +303,7 @@ def bench_config5(jax, total_lanes=None):
         total_lanes = int(os.environ.get("DEMI_BENCH_CONFIG5_LANES", default))
     chunk = min(2048 if platform not in ("cpu",) else 32, total_lanes)
     driver = SweepDriver(app, cfg, program_gen)
-    driver.run_chunk(range(chunk), base_key=999)  # compile outside timing
+    driver.sweep(chunk, chunk)  # compile (continuous kernels) outside timing
     t0 = time.perf_counter()
     result = driver.sweep(total_lanes, chunk)
     secs = time.perf_counter() - t0
@@ -315,6 +316,9 @@ def bench_config5(jax, total_lanes=None):
         "violations": result.violations,
         "seconds": round(secs, 2),
         "overflow_lanes": overflow_lanes,
+        "occupancy": (
+            round(result.occupancy, 3) if result.occupancy else None
+        ),
     }
 
 

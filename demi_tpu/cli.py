@@ -207,16 +207,9 @@ def cmd_sweep(args) -> int:
         print(json.dumps(summary))
         return 0
 
-    import numpy as np
-    import jax
-
-    from .device import (
-        DeviceConfig,
-        make_explore_kernel,
-        make_explore_kernel_pallas,
-    )
-    from .device.core import ST_VIOLATION
-    from .device.encoding import lower_program, stack_programs
+    os.environ["DEMI_DEVICE_IMPL"] = getattr(args, "impl", "xla")
+    from .device import DeviceConfig
+    from .parallel.sweep import SweepDriver
 
     app = build_app(args)
     cfg = DeviceConfig.for_app(
@@ -228,32 +221,25 @@ def cmd_sweep(args) -> int:
         timer_weight=args.timer_weight,
     )
     fuzzer = build_fuzzer(app, args)
-    programs = [
-        fuzzer.generate_fuzz_test(seed=args.seed + i) for i in range(args.batch)
-    ]
-    progs = stack_programs([lower_program(app, cfg, p) for p in programs])
-    keys = jax.random.split(jax.random.PRNGKey(args.seed), args.batch)
-    if getattr(args, "impl", "xla") == "pallas":
-        kernel = make_explore_kernel_pallas(app, cfg)
-    else:
-        kernel = make_explore_kernel(app, cfg)
-    res = kernel(progs, keys)
-    violations = np.asarray(res.violation)
-    lanes = np.nonzero(np.asarray(res.status) == ST_VIOLATION)[0]
-    print(
-        json.dumps(
-            {
-                "lanes": args.batch,
-                "violations": int((violations != 0).sum()),
-                "codes": {
-                    str(int(c)): int((violations == c).sum())
-                    for c in np.unique(violations)
-                    if c != 0
-                },
-                "first_violating_lane": int(lanes[0]) if len(lanes) else None,
-            }
-        )
+    driver = SweepDriver(
+        app, cfg, lambda s: fuzzer.generate_fuzz_test(seed=args.seed + s)
     )
+    # Default: lane-compacted continuous sweep (finished lanes are
+    # harvested and refilled at segment boundaries). --sweep-mode chunked
+    # launches fixed whole-batch kernels instead.
+    chunk = min(args.batch, getattr(args, "chunk", None) or args.batch)
+    result = driver.sweep(args.batch, chunk, mode=args.sweep_mode)
+    summary = {
+        "lanes": result.lanes,
+        "unique_schedules": result.unique_schedules,
+        "violations": result.violations,
+        "codes": {str(c): n for c, n in result.codes.items()},
+        "first_violating_seed": result.first_violating_seed,
+        "overflow_lanes": result.overflow_lanes,
+    }
+    if result.occupancy is not None:
+        summary["occupancy"] = round(result.occupancy, 3)
+    print(json.dumps(summary))
     return 0
 
 
@@ -425,6 +411,15 @@ def main(argv: Optional[list] = None) -> int:
     common(p)
     p.add_argument("--batch", type=int, default=256)
     p.add_argument("--pool", type=int, default=256)
+    p.add_argument(
+        "--sweep-mode", choices=("continuous", "chunked"), default=None,
+        help="continuous (default): lane-compacted sweep with mid-flight "
+             "refill; chunked: fixed whole-batch kernel launches",
+    )
+    p.add_argument(
+        "--chunk", type=int, default=None,
+        help="device batch size per launch (default: --batch)",
+    )
     p.add_argument(
         "--processes", type=int, default=1,
         help=">1: multi-process jax.distributed sweep (seed-space "

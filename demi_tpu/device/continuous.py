@@ -16,7 +16,7 @@ and refill replaces whole lanes atomically (tests/test_continuous.py).
 
 from __future__ import annotations
 
-from typing import Callable, List, Sequence
+from typing import Callable, List, Optional, Sequence
 
 import numpy as np
 
@@ -104,6 +104,7 @@ class ContinuousSweepDriver:
         program_gen: Callable,
         batch: int = 256,
         seg_steps: int = 32,
+        key_fn: Optional[Callable] = None,
     ):
         from .encoding import lower_program, stack_programs
 
@@ -112,6 +113,10 @@ class ContinuousSweepDriver:
         self.program_gen = program_gen
         self.batch = batch
         self.seg_steps = seg_steps
+        # key_fn(seed) -> PRNGKey; default matches the plain explore
+        # kernel driven with PRNGKey(seed). SweepDriver passes its
+        # fold_in(base_key, seed) scheme for cross-mode parity.
+        self.key_fn = key_fn or jax.random.PRNGKey
         self._lower = lambda seed: lower_program(
             app, cfg, program_gen(seed)
         )
@@ -120,6 +125,14 @@ class ContinuousSweepDriver:
         self.init = make_init_kernel(app, cfg)
         self.refill = make_refill_kernel(app, cfg)
         self.finalize = make_finalize_kernel(app, cfg)
+        # Occupancy accounting for the last _run: lane-steps spent with a
+        # live (unfinished, unparked) lane vs total lane-steps scanned —
+        # the number the compaction exists to maximize. A fixed sweep
+        # without early exit scans lanes * max_steps; compare
+        # last_total_lane_steps against that to see the saving.
+        self.last_occupancy: Optional[float] = None
+        self.last_total_lane_steps: int = 0
+        self.last_live_lane_steps: int = 0
 
     def time_to_first_violation(self, max_lanes: int = 1_000_000):
         """Wall-clock seconds until the first violating lane finishes (the
@@ -136,14 +149,14 @@ class ContinuousSweepDriver:
     def sweep_iter(self, total_lanes: int):
         """Generator form of ``sweep``: yields (seed, violation_code) as
         lanes finish."""
-        for seed, _st, code in self._run(total_lanes):
+        for seed, _st, code, _h in self._run(total_lanes):
             yield seed, code
 
     def sweep(self, total_lanes: int):
         """Run ``total_lanes`` seeds; returns (statuses, violations) keyed
         by seed."""
         statuses, violations = {}, {}
-        for seed, st, code in self._run(total_lanes):
+        for seed, st, code, _h in self._run(total_lanes):
             statuses[seed] = st
             violations[seed] = code
         return statuses, violations
@@ -151,11 +164,11 @@ class ContinuousSweepDriver:
     def _run(self, total_lanes: int):
         b = min(self.batch, total_lanes)
         next_seed = 0
+        live_lane_steps = 0
+        total_lane_steps = 0
 
         def keys_for(seeds):
-            return jnp.stack(
-                [jax.random.PRNGKey(s) for s in seeds]
-            )
+            return jnp.stack([self.key_fn(s) for s in seeds])
 
         lane_seed = list(range(b))
         next_seed = b
@@ -167,6 +180,11 @@ class ContinuousSweepDriver:
         active = np.ones(b, bool)
 
         while done_count < total_lanes:
+            total_lane_steps += b * self.seg_steps
+            live_lane_steps += int(active.sum()) * self.seg_steps
+            self.last_occupancy = live_lane_steps / total_lane_steps
+            self.last_total_lane_steps = total_lane_steps
+            self.last_live_lane_steps = live_lane_steps
             state = self.segment(
                 state, progs, jnp.asarray(steps_run, jnp.int32)
             )
@@ -187,8 +205,12 @@ class ContinuousSweepDriver:
             if not finished.any():
                 continue
             vio = np.asarray(state.violation)
+            sh = np.asarray(state.sched_hash)
             for lane in np.flatnonzero(finished):
-                yield lane_seed[lane], int(status[lane]), int(vio[lane])
+                yield (
+                    lane_seed[lane], int(status[lane]), int(vio[lane]),
+                    int(sh[lane]),
+                )
                 done_count += 1
             # Refill finished lanes with fresh seeds (or park them).
             refill_lanes = [

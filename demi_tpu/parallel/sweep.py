@@ -37,9 +37,12 @@ class SweepChunkResult:
     lanes: int
     violations: int
     codes: dict
-    first_violating_lane: Optional[int]
+    first_violating_lane: Optional[int]  # chunk-local lane index (None: continuous)
     first_violation_code: Optional[int]
     seconds: float
+    # The SEED of the first violating lane (global, replayable) — what
+    # callers should report; first_violating_lane is chunk-local.
+    first_violating_seed: Optional[int] = None
     # Lanes aborted with ST_OVERFLOW (pool too small): these completed no
     # verdict, so any nonzero count means the sweep's numbers undercount.
     overflow_lanes: int = 0
@@ -51,6 +54,9 @@ class SweepChunkResult:
 @dataclass
 class SweepResult:
     chunks: List[SweepChunkResult] = field(default_factory=list)
+    # Lane-step occupancy of the sweep (continuous mode only): fraction of
+    # scanned lane-steps spent on live lanes. Chunked sweeps leave it None.
+    occupancy: Optional[float] = None
 
     @property
     def lanes(self) -> int:
@@ -64,6 +70,26 @@ class SweepResult:
     def schedules_per_sec(self) -> float:
         secs = sum(c.seconds for c in self.chunks)
         return self.lanes / secs if secs > 0 else 0.0
+
+    @property
+    def codes(self) -> dict:
+        """Violation-code counts summed across chunks."""
+        merged: dict = {}
+        for c in self.chunks:
+            for code, n in c.codes.items():
+                merged[code] = merged.get(code, 0) + n
+        return merged
+
+    @property
+    def first_violating_seed(self) -> Optional[int]:
+        for c in self.chunks:
+            if c.first_violating_seed is not None:
+                return c.first_violating_seed
+        return None
+
+    @property
+    def overflow_lanes(self) -> int:
+        return sum(c.overflow_lanes for c in self.chunks)
 
     @property
     def unique_schedules(self) -> int:
@@ -90,6 +116,7 @@ class SweepDriver:
         self.cfg = cfg
         self.program_gen = program_gen
         impl = os.environ.get("DEMI_DEVICE_IMPL", "xla")
+        self.impl = impl
         if use_mesh:
             self.mesh = mesh or make_mesh()
             if impl == "pallas":
@@ -108,6 +135,7 @@ class SweepDriver:
             else:
                 self.kernel = make_explore_kernel(app, cfg)
             self._align = 1
+        self._cont_cache = None
     def _programs(self, seeds: Sequence[int]):
         # Lowered per call: seeds are disjoint across chunks, so a
         # driver-lifetime cache would only ever grow (sweeps can cover 1M+
@@ -159,6 +187,9 @@ class SweepDriver:
             first_violation_code=(
                 int(violations[lanes[0]]) if len(lanes) else None
             ),
+            first_violating_seed=(
+                int(real[lanes[0]]) if len(lanes) else None
+            ),
             seconds=seconds,
             overflow_lanes=int((statuses == ST_OVERFLOW).sum()),
             # Overflowed lanes aborted mid-schedule: their truncated
@@ -174,11 +205,41 @@ class SweepDriver:
         chunk_size: int,
         num_slices: int = 1,
         stop_on_violation: bool = False,
+        mode: Optional[str] = None,
     ) -> SweepResult:
         """Partition ``total_lanes`` seeds into chunks round-robined over
         ``num_slices`` logical slices (in one process they run
         sequentially; in a jax.distributed deployment each process runs its
-        own slice_index's chunks)."""
+        own slice_index's chunks).
+
+        ``mode``: 'continuous' (default for single-slice, non-mesh sweeps)
+        harvests+refills finished lanes at short segment boundaries, so a
+        fixed sweep never pays max_steps for its short lanes (TPU-first
+        lane compaction; per-seed verdicts bit-identical to 'chunked' —
+        tests/test_continuous.py). 'chunked' launches fixed whole-batch
+        kernels; mesh-sharded and multi-slice sweeps always use it."""
+        if mode is None:
+            # Continuous kernels are built from the XLA step function;
+            # a pallas-backend driver must keep launching its own kernel.
+            mode = (
+                "continuous"
+                if self.mesh is None and num_slices == 1 and self.impl == "xla"
+                else "chunked"
+            )
+        if mode == "continuous":
+            if self.mesh is not None or num_slices != 1:
+                raise ValueError(
+                    "continuous sweeps are single-slice, non-mesh only"
+                )
+            if self.impl != "xla":
+                raise ValueError(
+                    "continuous sweeps run the XLA step function; "
+                    f"impl={self.impl!r} has no segment kernel — use "
+                    "mode='chunked'"
+                )
+            return self._sweep_continuous(
+                total_lanes, chunk_size, stop_on_violation
+            )
         result = SweepResult()
         seed = 0
         chunk_idx = 0
@@ -192,6 +253,63 @@ class SweepDriver:
             chunk_idx += 1
             if stop_on_violation and chunk.violations:
                 break
+        return result
+
+    def _continuous_driver(self, batch: int, base_key: int = 0):
+        from ..device.continuous import ContinuousSweepDriver
+
+        key = (batch, base_key)
+        if getattr(self, "_cont_cache", None) and self._cont_cache[0] == key:
+            return self._cont_cache[1]
+        seg = max(8, min(64, self.cfg.max_steps // 4))
+        drv = ContinuousSweepDriver(
+            self.app, self.cfg, self.program_gen, batch=batch,
+            seg_steps=seg,
+            # Same per-seed key scheme as run_chunk => identical verdicts.
+            key_fn=lambda s: jax.random.fold_in(
+                jax.random.PRNGKey(base_key), np.uint32(s)
+            ),
+        )
+        self._cont_cache = (key, drv)
+        return drv
+
+    def _sweep_continuous(
+        self, total_lanes: int, batch: int, stop_on_violation: bool
+    ) -> SweepResult:
+        drv = self._continuous_driver(batch)
+        codes: dict = {}
+        hashes: List[int] = []
+        lanes = violations = overflow = 0
+        first_seed = first_code = None
+        t0 = time.perf_counter()
+        for seed, st, code, h in drv._run(total_lanes):
+            lanes += 1
+            if st == ST_OVERFLOW:
+                overflow += 1
+            else:
+                hashes.append(h)
+            if code != 0:
+                violations += 1
+                codes[code] = codes.get(code, 0) + 1
+                if first_seed is None:
+                    first_seed = seed
+                    first_code = code
+                if stop_on_violation:
+                    break
+        chunk = SweepChunkResult(
+            slice_index=0,
+            lanes=lanes,
+            violations=violations,
+            codes=codes,
+            first_violating_lane=None,  # continuous mode has no chunk-local index
+            first_violation_code=first_code,
+            seconds=time.perf_counter() - t0,
+            overflow_lanes=overflow,
+            unique_hashes=np.unique(np.asarray(hashes, np.uint32)),
+            first_violating_seed=first_seed,
+        )
+        result = SweepResult(chunks=[chunk])
+        result.occupancy = drv.last_occupancy
         return result
 
     def time_to_first_violation(

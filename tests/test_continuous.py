@@ -90,6 +90,46 @@ def test_continuous_nondivisible_seg_steps():
     _parity(app, cfg, lambda s: fz.generate_fuzz_test(seed=s), 64, 8, 28)
 
 
+def test_sweep_driver_continuous_parity_and_occupancy():
+    """SweepDriver.sweep defaults to the lane-compacted continuous path:
+    per-seed verdicts must match chunked mode exactly (same fold_in key
+    scheme), and on a heavy-tailed corpus the compacted sweep's lane-step
+    occupancy must stay high (the whole point of the refill)."""
+    from demi_tpu.parallel.sweep import SweepDriver
+
+    app = make_raft_app(3, bug="multivote")
+    cfg = DeviceConfig.for_app(
+        app, pool_capacity=96, max_steps=160, max_external_ops=24,
+        invariant_interval=1, timer_weight=0.1,
+    )
+    fz = Fuzzer(
+        num_events=10,
+        weights=FuzzerWeights(
+            send=0.3, kill=0.1, wait_quiescence=0.3, hard_kill=0.15,
+            restart=0.15,
+        ),
+        message_gen=raft_send_generator(app),
+        prefix=dsl_start_events(app), max_kills=2, wait_budget=(5, 30),
+    )
+    driver = SweepDriver(app, cfg, lambda s: fz.generate_fuzz_test(seed=s))
+    cont = driver.sweep(48, 8)  # default mode: continuous
+    chunked = driver.sweep(48, 8, mode="chunked")
+    assert cont.occupancy is not None and cont.occupancy > 0.5
+    assert chunked.occupancy is None
+    assert cont.lanes == chunked.lanes == 48
+    assert cont.violations == chunked.violations > 0
+    assert cont.codes == chunked.codes
+    assert cont.unique_schedules == chunked.unique_schedules
+    # Heavy-tailed corpus: quick-crash lanes end far below max_steps, so
+    # the compacted sweep must scan meaningfully fewer lane-steps than
+    # the fixed sweep's lanes * max_steps.
+    drv = driver._continuous_driver(8)
+    assert 0 < drv.last_total_lane_steps < 48 * cfg.max_steps
+    # first_violating_seed is a real, replayable seed in BOTH modes.
+    assert chunked.first_violating_seed in range(48)
+    assert cont.first_violating_seed in range(48)
+
+
 def test_continuous_time_to_first_violation():
     app = make_broadcast_app(4, reliable=False)
     cfg = DeviceConfig.for_app(

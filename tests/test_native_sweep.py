@@ -97,7 +97,7 @@ def test_sweep_driver_finds_violation_and_reports_rate():
 
     ttfv, partial = driver.time_to_first_violation(chunk_size=16, max_lanes=64)
     assert ttfv is not None and ttfv > 0
-    assert partial.chunks[0].first_violating_lane is not None
+    assert partial.first_violating_seed is not None
 
 
 def test_native_racing_scan_matches_python():
