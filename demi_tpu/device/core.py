@@ -131,7 +131,11 @@ class DeviceConfig:
 
     @property
     def rec_width(self) -> int:
-        return 3 + self.msg_width + (1 if self.record_parents else 0)
+        # record_parents appends TWO happens-before columns: `parent`
+        # (trace index of the record that created this message — the
+        # creation edge) and `prev` (trace index of the previous delivery
+        # at the same receiver — the program-order edge). Both -1 if none.
+        return 3 + self.msg_width + (2 if self.record_parents else 0)
 
     @staticmethod
     def for_app(app: DSLApp, **overrides) -> "DeviceConfig":
@@ -166,6 +170,10 @@ class ScheduleState(NamedTuple):
     # device: one remembered timer per actor).
     timer_mem: jnp.ndarray  # [N, W] int32
     timer_mem_valid: jnp.ndarray  # [N] bool
+    # Per-actor trace index of the last delivery processed by that actor
+    # (-1 none): the program-order HB link recorded alongside pool_crec's
+    # creation link when record_parents is on.
+    last_rec: jnp.ndarray  # [N] int32
     # Program + bookkeeping.
     ext_cursor: jnp.ndarray  # int32: next external op
     seq_counter: jnp.ndarray  # int32
@@ -209,6 +217,7 @@ def init_state(app: DSLApp, cfg: DeviceConfig, key) -> ScheduleState:
         pool_crec=jnp.full(p, -1, jnp.int32),
         timer_mem=jnp.zeros((n, w), cfg.msg_jnp_dtype),
         timer_mem_valid=jnp.zeros(n, bool),
+        last_rec=jnp.full(n, -1, jnp.int32),
         ext_cursor=jnp.int32(0),
         seq_counter=jnp.int32(0),
         deliveries=jnp.int32(0),
@@ -485,7 +494,18 @@ def delivery_effects(
         kind = jnp.where(is_timer, REC_TIMER, REC_DELIVERY)
         parts = [jnp.stack([kind, src, dst]), msg]
         if cfg.record_parents:
+            # Two HB columns: creation link (pool_crec) + program-order
+            # link (previous delivery at this receiver). This record will
+            # land at trace index state.trace_len, which also becomes the
+            # receiver's new last_rec.
+            prev_rec = ops.get_scalar(state.last_rec, dst, oh)
             parts.append(parent_rec[None])
+            parts.append(prev_rec[None])
+            state = state._replace(
+                last_rec=ops.set_scalar(
+                    state.last_rec, dst, state.trace_len, valid_idx, oh
+                )
+            )
         rec = jnp.concatenate(parts)
     else:
         rec = jnp.zeros((0,), jnp.int32)
@@ -628,7 +648,9 @@ def external_effects(
     if cfg.record_trace:
         parts = [jnp.stack([REC_EXT_BASE + op, a, b]), msg]
         if cfg.record_parents:
-            parts.append(jnp.asarray([-1], jnp.int32))
+            # External injections have neither creation nor program-order
+            # predecessors (both HB columns -1).
+            parts.append(jnp.asarray([-1, -1], jnp.int32))
         rec = jnp.concatenate(parts)
     else:
         rec = jnp.zeros((0,), jnp.int32)

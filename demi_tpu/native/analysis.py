@@ -75,21 +75,34 @@ def analysis_native_available() -> bool:
 
 def _py_racing_pairs(recs: np.ndarray) -> np.ndarray:
     """Same semantics as the C++ scan: (i, j) both deliveries, same
-    receiver, creator(j) < i. Co-enabledness needs no explicit
-    happens-before test here — see native/trace_analysis.cpp's header for
-    the derivation (causal pasts only contain positions below
-    creator(j) < i, so the branch-point delivery can never be in m_j's)."""
+    receiver, j's message already created at i (parent(j) < i), and the
+    race is IMMEDIATE under the two-edge happens-before closure (creation
+    `parent` + program-order `prev` columns): no k with i in past(k) and
+    k in past(j). See native/trace_analysis.cpp's header for why pruning
+    non-immediate pairs keeps violation recall."""
     n, w = recs.shape
-    parent_col = w - 1
+    parent_col, prev_col = w - 2, w - 1
+    words = (n + 63) // 64
+    past = np.zeros((n, words), np.uint64)
+    interp = np.zeros((n, words), np.uint64)
+    for p in range(n):
+        for q in (int(recs[p, parent_col]), int(recs[p, prev_col])):
+            if 0 <= q < p:
+                interp[p] |= past[q] | interp[q]
+                past[p] |= past[q]
+                past[p, q // 64] |= np.uint64(1) << np.uint64(q % 64)
     is_delivery = np.isin(recs[:, 0], _delivery_kinds())
     positions = np.nonzero(is_delivery)[0]
     out = []
-    for ii, i in enumerate(positions):
-        for j in positions[ii + 1:]:
+    for jj, j in enumerate(positions):
+        cj = int(recs[j, parent_col])
+        for i in positions[:jj]:
             if recs[i, 2] != recs[j, 2]:
                 continue
-            if int(recs[j, parent_col]) >= int(i):
+            if cj >= int(i):
                 continue
+            if (interp[j, i // 64] >> np.uint64(i % 64)) & np.uint64(1):
+                continue  # interposed: not an immediate race
             out.append((int(i), int(j)))
     return np.asarray(out, np.int32).reshape(-1, 2)
 

@@ -131,28 +131,46 @@ class DepTracker:
     # -- the racing-pair scan (vectorized) --------------------------------
     def racing_pairs(self, trace: List[int]) -> List[Tuple[int, int]]:
         """All (i, j) index pairs in ``trace`` (i < j) whose events race:
-        same receiver, concurrent (neither is the other's ancestor).
+        same receiver, j's message already created at i, and the race is
+        IMMEDIATE under the happens-before closure over creation edges
+        (parent chain) plus program-order edges (delivery order per
+        receiver): no k with i in past(k) and k in past(j).
 
-        The O(n²) scan the reference does pairwise with graph-path queries
-        (DPORwHeuristics.scala:1122-1139) — here a handful of boolean
-        matrix ops over ancestor bitsets."""
+        The reference's pairwise graph-path scan
+        (DPORwHeuristics.scala:1122-1139) is creation-graph-only; the
+        program-order edges prune its already-ordered pairs (every pair of
+        a same-receiver delivery chain is "concurrent" under creation-only
+        HB), which only inflate the backtrack frontier: a non-immediate
+        flip is reachable by composing the immediate ones, each exposed by
+        the rescan of the flipped execution (source-set DPOR's race
+        relation). Device twin: native/trace_analysis.cpp."""
         n = len(trace)
         if n < 2:
             return []
-        ids = np.asarray(trace)
-        rcvs = np.asarray([hash(self.events[e].rcv) for e in trace])
-        max_words = max(len(self._ancestors[e]) for e in trace)
-        anc = np.zeros((n, max_words), np.uint64)
-        for k, e in enumerate(trace):
-            bits = self._ancestors[e]
-            anc[k, : len(bits)] = bits
-        # ancestor_matrix[i, j] = trace[i] happens-before trace[j]
-        word = ids // 64
-        bit = (ids % 64).astype(np.uint64)
-        hb = (anc[:, word] >> bit[None, :]) & np.uint64(1)  # [j, i] -> i in anc(j)
-        ancestor = hb.T.astype(bool)  # [i, j]
-        same_rcv = rcvs[:, None] == rcvs[None, :]
-        upper = np.triu(np.ones((n, n), bool), k=1)
-        racing = upper & same_rcv & ~ancestor & ~ancestor.T
-        out = np.argwhere(racing)
-        return [(int(i), int(j)) for i, j in out]
+        rcvs = [self.events[e].rcv for e in trace]
+        pos_of_id = {e: k for k, e in enumerate(trace)}
+        words = (n + 63) // 64
+        past = np.zeros((n, words), np.uint64)
+        interp = np.zeros((n, words), np.uint64)
+        parent_pos = np.full(n, -1, np.int64)
+        last_at: Dict[Any, int] = {}
+        for p, e in enumerate(trace):
+            parent_pos[p] = pos_of_id.get(self.events[e].parent, -1)
+            prev_p = last_at.get(rcvs[p], -1)
+            last_at[rcvs[p]] = p
+            for q in (int(parent_pos[p]), prev_p):
+                if 0 <= q < p:
+                    interp[p] |= past[q] | interp[q]
+                    past[p] |= past[q]
+                    past[p, q // 64] |= np.uint64(1) << np.uint64(q % 64)
+        out = []
+        for j in range(1, n):
+            for i in range(j):
+                if rcvs[i] != rcvs[j]:
+                    continue
+                if parent_pos[j] >= i:
+                    continue  # j's message didn't exist yet at i
+                if (interp[j, i // 64] >> np.uint64(i % 64)) & np.uint64(1):
+                    continue  # interposed: not an immediate race
+                out.append((i, j))
+        return out

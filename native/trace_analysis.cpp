@@ -1,25 +1,30 @@
-// Racing-pair scan over parent-tracked device trace records — the host
-// side of batched device DPOR (demi_tpu/device/dpor_sweep.py). Mirrors the
+// Racing-pair scan over HB-tracked device trace records — the host side
+// of batched device DPOR (demi_tpu/device/dpor_sweep.py). Mirrors the
 // reference's co-enabled pair scan (DPORwHeuristics.scala:1122-1139) over
-// the record encoding:
+// the record encoding, tightened with program-order edges:
 //
-//   record row (int32 x rec_width): kind, a, b, msg..., parent
+//   record row (int32 x rec_width): kind, a, b, msg..., parent, prev
 //   kind 1 = message delivery (a=src, b=dst), kind 2 = timer (a=b=dst);
-//   parent = trace index of the record that created this message (-1 none).
+//   parent = trace index of the record that created this message (-1 none)
+//   prev   = trace index of the previous delivery at the same receiver
+//            (-1 none) — the program-order edge.
 //
-// Pair (i, j), i < j, qualifies iff both are delivery kinds, same
-// receiver, and j's creating record precedes i (the flipped message was
-// already pending at the branch point).
+// Happens-before is the closure over both edge kinds. Pair (i, j), i < j,
+// qualifies iff both are delivery kinds, same receiver, j's message
+// already existed at i (parent(j) < i — the flip must be deliverable at
+// the branch point), and the race is IMMEDIATE: no event k with
+// i ∈ past(k) and k ∈ past(j). A non-immediate pair (i ... k ... j, all
+// HB-chained) is prunable without losing violations: flipping (k, j)
+// first yields an execution whose own scan exposes (i, j') — the classic
+// DPOR argument that only immediate races need backtrack points. This is
+// what keeps the frontier from quadratic blowup on same-receiver delivery
+// chains (every pair of a chain is "concurrent" under creation-only HB).
 //
-// Why no explicit happens-before test: the prescription scheme flips j to
-// the position of i, which requires m_j pending at i, i.e. creator(j) < i.
-// Happens-before closures only ever contain positions strictly below the
-// event (parents and program-order predecessors precede their successors
-// in the trace), so everything in m_j's causal past lies below
-// creator(j) < i — the branch-point delivery i can never be in it.
-// Co-enabledness is therefore implied by the creator(j) < i check; the
-// reference needs the explicit graph-path query only because its
-// backtracks are expressed over event IDs rather than trace positions.
+// past() and the interposer union U(p) = ∪_{k ∈ past(p)} past(k) are both
+// computed incrementally over position bitsets:
+//   past(p) = {parent, prev} ∪ past(parent) ∪ past(prev)
+//   U(p)    = past(parent) ∪ U(parent) ∪ past(prev) ∪ U(prev)
+// so the whole scan is O(n^2 / 64) words, no per-pair graph query.
 
 #include <cstddef>
 #include <cstdint>
@@ -35,22 +40,43 @@ extern "C" {
 // first max_pairs are written to out as (i, j) int32 pairs).
 int64_t demi_racing_pairs(const int32_t* recs, int64_t n, int64_t w,
                           int32_t* out, int64_t max_pairs) {
-    if (n <= 0 || w < 4) return 0;
-    const int64_t parent_col = w - 1;
+    if (n <= 0 || w < 5) return 0;
+    const int64_t parent_col = w - 2;
+    const int64_t prev_col = w - 1;
+    const int64_t words = (n + 63) / 64;
+    // past[p] and U[p] as bitsets over trace positions.
+    std::vector<uint64_t> past(static_cast<size_t>(n * words), 0);
+    std::vector<uint64_t> interp(static_cast<size_t>(n * words), 0);
+    auto merge_edge = [&](int64_t p, int64_t q) {
+        if (q < 0 || q >= p) return;
+        uint64_t* pp = past.data() + p * words;
+        uint64_t* up = interp.data() + p * words;
+        const uint64_t* pq = past.data() + q * words;
+        const uint64_t* uq = interp.data() + q * words;
+        for (int64_t t = 0; t < words; ++t) {
+            up[t] |= pq[t] | uq[t];
+            pp[t] |= pq[t];
+        }
+        pp[q / 64] |= uint64_t(1) << (q % 64);
+    };
     std::vector<int64_t> deliveries;
     deliveries.reserve(static_cast<size_t>(n));
     for (int64_t pos = 0; pos < n; ++pos) {
+        merge_edge(pos, recs[pos * w + parent_col]);
+        merge_edge(pos, recs[pos * w + prev_col]);
         if (is_delivery(recs[pos * w])) deliveries.push_back(pos);
     }
     int64_t count = 0;
-    for (size_t ii = 0; ii < deliveries.size(); ++ii) {
-        const int64_t i = deliveries[ii];
-        const int32_t rcv_i = recs[i * w + 2];
-        for (size_t jj = ii + 1; jj < deliveries.size(); ++jj) {
-            const int64_t j = deliveries[jj];
-            if (recs[j * w + 2] != rcv_i) continue;  // same receiver only
-            const int64_t cj = recs[j * w + parent_col];
+    for (size_t jj = 0; jj < deliveries.size(); ++jj) {
+        const int64_t j = deliveries[jj];
+        const int32_t rcv_j = recs[j * w + 2];
+        const int64_t cj = recs[j * w + parent_col];
+        const uint64_t* uj = interp.data() + j * words;
+        for (size_t ii = 0; ii < jj; ++ii) {
+            const int64_t i = deliveries[ii];
+            if (recs[i * w + 2] != rcv_j) continue;  // same receiver only
             if (cj >= i) continue;  // j's message didn't exist yet at i
+            if ((uj[i / 64] >> (i % 64)) & 1) continue;  // interposed: not immediate
             if (count < max_pairs) {
                 out[count * 2] = static_cast<int32_t>(i);
                 out[count * 2 + 1] = static_cast<int32_t>(j);

@@ -70,8 +70,12 @@ def test_dep_tracker_ancestry_and_races():
     assert not tracker.is_ancestor(b.id, a.id)
     assert tracker.concurrent(a.id, c.id)
     pairs = tracker.racing_pairs([a.id, b.id, c.id])
-    # (a,c) and (b,c) race (same receiver, concurrent); (a,b) don't.
-    assert (0, 2) in pairs and (1, 2) in pairs and (0, 1) not in pairs
+    # Only the IMMEDIATE race survives: (b,c) races (same receiver,
+    # concurrent, adjacent in program order); (a,b) are creation-ordered;
+    # (a,c) is interposed by b (a -> b in creation order, b -> c in
+    # receiver program order) — flipping c before a is reachable by first
+    # flipping (b,c), whose rescan exposes the deeper race.
+    assert pairs == [(1, 2)]
 
 
 def test_arvind_distance():

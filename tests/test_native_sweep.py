@@ -122,7 +122,10 @@ def test_native_racing_scan_matches_python():
         recs[:, 0] = rng.choice([1, 2, 13], size=n, p=[0.5, 0.2, 0.3])
         recs[:, 2] = rng.integers(0, 3, size=n)
         recs[:, 1] = rng.integers(0, 3, size=n)
+        # Randomize BOTH happens-before columns (parent @ w-2, prev @ w-1)
+        # to exercise the two-edge closure and immediate-race pruning.
         for pos in range(n):
+            recs[pos, w - 2] = rng.integers(-1, max(pos, 1))
             recs[pos, w - 1] = rng.integers(-1, max(pos, 1))
         native = racing_pair_scan(recs)
         ref = _py_racing_pairs(recs)
@@ -136,13 +139,15 @@ def test_racing_scan_capacity_regrow():
 
     from demi_tpu.native.analysis import _py_racing_pairs, racing_pair_scan
 
-    # 40 concurrent deliveries to one receiver, all created by record 0:
-    # ~40*39/2 pairs >> the initial 4n capacity.
+    # 40 concurrent deliveries to one receiver, all created by record 0
+    # with NO program-order edges (prev = -1, as if handed a creation-only
+    # trace): every pair is immediate, ~40*39/2 pairs >> the initial 4n
+    # output capacity.
     n = 41
     recs = np.zeros((n, 6), np.int32)
     recs[0] = [13, 0, 0, 0, 0, -1]
     for i in range(1, n):
-        recs[i] = [1, 1, 0, 0, i, 0]
+        recs[i] = [1, 1, 0, i, 0, -1]
     native = racing_pair_scan(recs)
     assert len(native) == 40 * 39 // 2
     assert native.tolist() == _py_racing_pairs(recs).tolist()
