@@ -1,0 +1,117 @@
+"""Axon wedge-guard policy tests (DESIGN.md "Axon probe policy").
+
+The invariant under test: a probe that may have touched the axon backend
+is NEVER killed (killing mid-grant is what re-wedges the single-tenant
+tunnel) — it is parked in the shared state dir and reused by later guard
+calls, including calls from fresh processes.
+
+No JAX here: the probe payload is monkeypatched to scripts that write the
+same verdict files a real probe would.
+"""
+
+import os
+import signal
+import subprocess
+import time
+
+import pytest
+
+import demi_tpu._axon_guard as guard
+
+OK_SRC = (
+    "import os, sys\n"
+    "open(os.path.join(sys.argv[1], 'probe.ok'), 'w').write('ok')\n"
+)
+ERR_SRC = (
+    "import os, sys\n"
+    "open(os.path.join(sys.argv[1], 'probe.err'), 'w').write('boom')\n"
+)
+# Appends a spawn marker so tests can count how many probes were launched,
+# then hangs well past the test's wait window (simulated wedge).
+HANG_SRC = (
+    "import os, sys, time\n"
+    "with open(os.path.join(sys.argv[1], 'spawns'), 'a') as f:\n"
+    "    f.write('x')\n"
+    "time.sleep(600)\n"
+)
+
+
+@pytest.fixture
+def fresh_guard(tmp_path, monkeypatch):
+    monkeypatch.setattr(guard, "STATE_DIR", str(tmp_path))
+    monkeypatch.setattr(guard, "_PROBE_WAIT", 2.0)
+    monkeypatch.setattr(guard, "_verdict", None)
+    monkeypatch.setenv("PALLAS_AXON_POOL_IPS", "127.0.0.1")
+    monkeypatch.delenv("_DEMI_TPU_CPU_REEXEC", raising=False)
+    yield tmp_path
+    # Reap any parked fake probe (it never touched axon; safe to kill in
+    # the test harness only).
+    pid_path = tmp_path / "probe.pid"
+    if pid_path.exists():
+        try:
+            os.kill(int(pid_path.read_text()), signal.SIGKILL)
+        except (OSError, ValueError):
+            pass
+
+
+def _spawn_count(tmp_path):
+    p = tmp_path / "spawns"
+    return len(p.read_text()) if p.exists() else 0
+
+
+def test_healthy_probe_reports_usable(fresh_guard, monkeypatch):
+    monkeypatch.setattr(guard, "_PROBE_SRC", OK_SRC)
+    assert guard.axon_wedged() is False
+    assert not (fresh_guard / "probe.ok").exists()  # state consumed
+
+
+def test_erroring_probe_reports_unusable(fresh_guard, monkeypatch):
+    monkeypatch.setattr(guard, "_PROBE_SRC", ERR_SRC)
+    assert guard.axon_wedged() is True
+    # err is consumed so the *next* process re-probes for recovery
+    assert not (fresh_guard / "probe.err").exists()
+
+
+def test_hung_probe_is_parked_not_killed(fresh_guard, monkeypatch):
+    monkeypatch.setattr(guard, "_PROBE_SRC", HANG_SRC)
+    assert guard.axon_wedged() is True
+    pid = int((fresh_guard / "probe.pid").read_text())
+    os.kill(pid, 0)  # alive: the guard must not have killed it
+
+
+def test_parked_probe_is_reused_across_guard_calls(fresh_guard, monkeypatch):
+    monkeypatch.setattr(guard, "_PROBE_SRC", HANG_SRC)
+    assert guard.axon_wedged() is True
+    assert _spawn_count(fresh_guard) == 1
+    # Simulate a brand-new process (per-process cache cleared): the guard
+    # must find the parked probe and NOT add load to the tunnel.
+    monkeypatch.setattr(guard, "_verdict", None)
+    t0 = time.monotonic()
+    assert guard.axon_wedged() is True
+    assert time.monotonic() - t0 < 1.0  # no fresh wait window
+    assert _spawn_count(fresh_guard) == 1
+
+
+def test_parked_probe_verdict_is_consumed(fresh_guard, monkeypatch):
+    # A parked probe that eventually succeeded: later calls see probe.ok.
+    proc = subprocess.Popen(["sleep", "600"], start_new_session=True)
+    try:
+        (fresh_guard / "probe.pid").write_text(str(proc.pid))
+        (fresh_guard / "probe.ok").write_text("ok")
+        assert guard.axon_wedged() is False
+        assert not (fresh_guard / "probe.pid").exists()
+    finally:
+        proc.kill()
+
+
+def test_dead_parked_probe_triggers_fresh_probe(fresh_guard, monkeypatch):
+    (fresh_guard / "probe.pid").write_text("999999999")  # long gone
+    monkeypatch.setattr(guard, "_PROBE_SRC", OK_SRC)
+    assert guard.axon_wedged() is False
+
+
+def test_no_axon_env_short_circuits(fresh_guard, monkeypatch):
+    monkeypatch.setenv("PALLAS_AXON_POOL_IPS", "")
+    monkeypatch.setattr(guard, "_PROBE_SRC", HANG_SRC)
+    assert guard.axon_wedged() is False
+    assert _spawn_count(fresh_guard) == 0
