@@ -54,22 +54,47 @@ _PROBE_SRC = (
 )
 
 
-def _pid_alive(pid: int) -> bool:
+def _proc_start_time(pid: int) -> Optional[int]:
+    """Kernel start time of ``pid`` (clock ticks since boot; /proc stat
+    field 22) — disambiguates a recycled pid from the recorded probe."""
+    try:
+        with open(f"/proc/{pid}/stat", "rb") as f:
+            stat = f.read().decode("ascii", "replace")
+        # comm may contain spaces/parens; fields resume after the last ')'.
+        return int(stat.rsplit(")", 1)[1].split()[19])
+    except (OSError, ValueError, IndexError):
+        return None
+
+
+def _pid_alive(pid: int, start_time: Optional[int] = None) -> bool:
     try:
         os.kill(pid, 0)
     except ProcessLookupError:
         return False
     except PermissionError:
+        pass
+    if start_time is None:
         return True
-    return True
+    now = _proc_start_time(pid)
+    # A mismatched (or unreadable) start time means the recorded probe is
+    # gone and the pid was recycled by an unrelated process.
+    return now is not None and now == start_time
 
 
-def _read_pid() -> Optional[int]:
+def _read_pid() -> Optional[tuple]:
+    """(pid, start_time_or_None) of the recorded probe, or None."""
     try:
         with open(os.path.join(STATE_DIR, "probe.pid")) as f:
-            return int(f.read().strip())
-    except (OSError, ValueError):
+            parts = f.read().split()
+        return int(parts[0]), (int(parts[1]) if len(parts) > 1 else None)
+    except (OSError, ValueError, IndexError):
         return None
+
+
+def _write_pid(pid: int) -> None:
+    start = _proc_start_time(pid)
+    with open(os.path.join(STATE_DIR, "probe.pid"), "w") as f:
+        f.write(f"{pid} {start}" if start is not None else str(pid))
 
 
 def _clear_state() -> None:
@@ -102,8 +127,9 @@ def axon_wedged() -> bool:
     os.makedirs(STATE_DIR, exist_ok=True)
 
     # A parked probe from an earlier call (possibly another process).
-    pid = _read_pid()
-    if pid is not None:
+    recorded = _read_pid()
+    if recorded is not None:
+        pid, start_time = recorded
         verdict = _verdict_file()
         if verdict == "probe.ok":
             _clear_state()
@@ -116,13 +142,18 @@ def axon_wedged() -> bool:
             _clear_state()
             _verdict = True
             return True
-        if _pid_alive(pid):
+        if _pid_alive(pid, start_time):
             # Still hanging in backend init: wedged. Do NOT kill it and
             # do NOT add another probe to the single-tenant tunnel.
             _verdict = True
             return True
         # Died without a verdict file (OOM-killed, machine reboot):
         # forget it and fall through to a fresh probe.
+        _clear_state()
+    else:
+        # No recorded probe, but a verdict file may linger from an
+        # orphan (guard process killed before it could park or consume);
+        # it describes an unknown-age probe — discard, never trust.
         _clear_state()
 
     proc = subprocess.Popen(
@@ -131,6 +162,10 @@ def axon_wedged() -> bool:
         stderr=subprocess.DEVNULL,
         start_new_session=True,
     )
+    # Record the probe immediately: if THIS process dies mid-wait, the
+    # next guard call must find (and reuse) the probe rather than spawn
+    # another and mistake this one's eventual verdict for its own.
+    _write_pid(proc.pid)
     deadline = time.monotonic() + _PROBE_WAIT
     while time.monotonic() < deadline:
         if proc.poll() is not None or _verdict_file():
@@ -145,9 +180,8 @@ def axon_wedged() -> bool:
         _clear_state()
         _verdict = True
         return True
-    # Timed out mid-init: park the probe (never kill — see module doc).
-    with open(os.path.join(STATE_DIR, "probe.pid"), "w") as f:
-        f.write(str(proc.pid))
+    # Timed out mid-init: leave the probe parked (never kill — see
+    # module doc); probe.pid already records it for later calls.
     _verdict = True
     return True
 

@@ -42,6 +42,35 @@ class FuzzResult:
     executions: int
 
 
+def lift_lane_to_host(app, cfg, progs, keys, lane, config=None):
+    """The standard device→host lift ritual: traced single-lane re-run of
+    sweep lane ``lane``, lowered to a guide, executed on the host oracle.
+
+    Returns (single_lane_result, host_execution_result). Raises
+    GuideDivergence if kernel and oracle semantics drift. The host
+    result's trace carries its own re-created externals — minimize it
+    with ``sts_sched_ddmin(config, host.trace, None, host.violation)``."""
+    import jax
+    import numpy as np
+
+    from .apps.common import make_host_invariant
+    from .device.encoding import device_trace_to_guide
+    from .device.explore import make_single_lane_trace_kernel
+    from .schedulers.guided import GuidedScheduler
+
+    single = make_single_lane_trace_kernel(app, cfg)(
+        jax.tree_util.tree_map(lambda x: x[lane], progs), keys[lane]
+    )
+    guide = device_trace_to_guide(
+        app, np.asarray(single.trace), int(single.trace_len)
+    )
+    config = config or SchedulerConfig(
+        invariant_check=make_host_invariant(app)
+    )
+    host = GuidedScheduler(config, app).execute_guide(guide)
+    return single, host
+
+
 @dataclass
 class GamutResult:
     """One entry per pipeline stage: (stage name, externals count,
@@ -101,13 +130,27 @@ def fuzz(
 def sts_sched_ddmin(
     config: SchedulerConfig,
     trace: EventTrace,
-    externals: Sequence[ExternalEvent],
+    externals: Optional[Sequence[ExternalEvent]],
     violation: Any,
     stats: Optional[MinimizationStats] = None,
     oracle=None,
 ):
     """External-event DDMin over the STS oracle
-    (reference: RunnerUtils.stsSchedDDMin, RunnerUtils.scala:642-707)."""
+    (reference: RunnerUtils.stsSchedDDMin, RunnerUtils.scala:642-707).
+
+    ``externals=None`` minimizes over ``trace.original_externals`` — the
+    only correct choice for traces that did not execute the caller's own
+    event objects (e.g. a device lane lifted through GuidedScheduler,
+    whose trace re-creates its externals from the device guide): STS
+    projection matches candidate externals to the trace by object/uid
+    linkage, so foreign objects silently project to "absent" and the
+    full-sequence precheck fails."""
+    if externals is None:
+        externals = trace.original_externals
+        if not externals:
+            raise ValueError(
+                "externals=None requires trace.original_externals to be set"
+            )
     oracle = oracle or sts_oracle(config, trace)
     ddmin = DDMin(oracle, check_unmodified=True, stats=stats or MinimizationStats())
     mcs = ddmin.minimize(make_dag(list(externals)), violation)

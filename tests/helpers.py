@@ -3,33 +3,7 @@ pytest puts this directory on sys.path)."""
 
 from __future__ import annotations
 
-import numpy as np
-
-import jax
-
-
-def lift_lane_to_host(app, cfg, progs, keys, lane, config=None):
-    """The standard device→host lift ritual: traced single-lane re-run of
-    sweep lane ``lane``, lowered to a guide, executed on the host oracle.
-
-    Returns (single_lane_result, host_execution_result). Raises
-    GuideDivergence if kernel and oracle semantics drift — which is
-    exactly what the callers are testing never happens.
-    """
-    from demi_tpu.apps.common import make_host_invariant
-    from demi_tpu.config import SchedulerConfig
-    from demi_tpu.device.encoding import device_trace_to_guide
-    from demi_tpu.device.explore import make_single_lane_trace_kernel
-    from demi_tpu.schedulers.guided import GuidedScheduler
-
-    single = make_single_lane_trace_kernel(app, cfg)(
-        jax.tree_util.tree_map(lambda x: x[lane], progs), keys[lane]
-    )
-    guide = device_trace_to_guide(
-        app, np.asarray(single.trace), int(single.trace_len)
-    )
-    config = config or SchedulerConfig(
-        invariant_check=make_host_invariant(app)
-    )
-    host = GuidedScheduler(config, app).execute_guide(guide)
-    return single, host
+# Promoted to the package in round 4 (demi_tpu.runner): the tool
+# demi_tpu/tools/verify_slice.py shares the same ritual. Re-exported here
+# so existing test imports keep working.
+from demi_tpu.runner import lift_lane_to_host  # noqa: F401

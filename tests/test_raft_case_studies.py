@@ -99,6 +99,17 @@ def test_gap_append_device_sweep_and_host_lift():
     assert int(single.violation) == 2
     assert host.violation is not None and host.violation.code == 2
 
+    # Minimize the lifted lane. externals=None selects the lifted trace's
+    # own externals — the program's objects never executed in this trace,
+    # so they would project to "absent" under STS (the round-4 verify
+    # slice caught exactly that footgun).
+    mcs, verified = sts_sched_ddmin(config, host.trace, None, host.violation)
+    kept = mcs.get_all_events()
+    assert verified is not None
+    # Real reduction required (gap_append needs at most 2 of the 3 client
+    # commands): <= would also pass for a no-op DDMin.
+    assert len(kept) < len(host.trace.original_externals)
+
 
 def test_correct_raft_clean_under_same_sweep():
     app = make_raft_app(3)
