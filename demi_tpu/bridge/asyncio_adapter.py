@@ -1,0 +1,354 @@
+"""Adapter: run UNMODIFIED asyncio datagram-protocol apps under the bridge.
+
+The reference's defining capability is testing real apps with zero app
+changes by interposing on the runtime API (its AspectJ weaving of actor
+send/receive/timer calls — reference: WeaveActor.aj:224-331). This module
+is the tpu-framework analog for the Python ecosystem's closest actor-like
+runtime surface: ``asyncio.DatagramProtocol``. An app written against the
+standard asyncio API —
+
+  - ``transport.sendto(data, addr)`` for messaging,
+  - ``loop.call_later(delay, cb, *args)`` / handle ``.cancel()`` for timers,
+  - ``loop.call_soon`` / ``loop.time`` / ``asyncio.get_running_loop()``,
+
+runs here byte-for-byte unchanged (it can still run standalone over real
+UDP with the real event loop). The adapter substitutes duck-typed
+transports and a deterministic loop, translating every interaction into
+bridge-protocol effects (bridge/session.py):
+
+  - ``sendto`` to a known peer address     -> a captured send
+  - ``call_later``                         -> an armed timer (the delay is
+                                              recorded; firing order is the
+                                              *scheduler's* choice)
+  - handle ``.cancel()``                   -> a timer cancel
+  - callback exception                     -> ``crashed``
+  - ``vars(protocol)``'s JSON subset       -> checkpoint state
+
+Timer identity must be stable under replay with skipped deliveries, so a
+timer message is ``("__timer__", <callback qualname>, <per-name arm #>)``
+— the fingerprint survives STS's ignore-absent projection the same way
+the host DSL's timer tags do. Message payloads cross the wire as
+``("__udp__", <latin-1 data>)``.
+
+Scope (v1, documented): callback-style protocols. Coroutines/tasks and
+streams are not interposed; ``create_task`` raises with this pointer.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import sys
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+TIMER_TAG = "__timer__"
+UDP_TAG = "__udp__"
+EXTERNAL_ADDR = ("0.0.0.0", 0)
+
+
+@dataclass
+class NodeSpec:
+    """One app node: a zero-arg protocol factory (exactly what the app
+    would pass to ``loop.create_datagram_endpoint``) plus the local
+    address its peers know it by."""
+
+    protocol_factory: Callable[[], asyncio.DatagramProtocol]
+    addr: Tuple[str, int]
+
+
+class _Effects:
+    """Accumulator for one command's worth of captured interactions."""
+
+    def __init__(self) -> None:
+        self.sends: List[dict] = []
+        self.timers: List[list] = []
+        self.cancels: List[list] = []
+        self.logs: List[str] = []
+        self.crashed = False
+
+    def as_reply(self) -> dict:
+        return {
+            "op": "effects",
+            "sends": self.sends,
+            "timers": self.timers,
+            "cancel": self.cancels,
+            "logs": self.logs,
+            "blocked": None,
+            "crashed": self.crashed,
+        }
+
+
+class _TimerHandle:
+    """Duck-types asyncio.TimerHandle for the app's cancel() calls."""
+
+    def __init__(self, node: "_Node", msg: list, callback, args):
+        self._node = node
+        self._msg = msg
+        self._callback = callback
+        self._args = args
+        self._cancelled = False
+
+    def cancel(self) -> None:
+        if not self._cancelled:
+            self._cancelled = True
+            self._node.cancel_timer(self._msg)
+
+    def cancelled(self) -> bool:
+        return self._cancelled
+
+    def when(self) -> float:
+        return self._node.loop._now
+
+
+class _Transport:
+    """Duck-types asyncio.DatagramTransport: sendto becomes a captured
+    bridge send (or a log line, for addresses no node owns)."""
+
+    def __init__(self, node: "_Node"):
+        self._node = node
+        self._closing = False
+
+    def sendto(self, data: bytes, addr=None) -> None:
+        self._node.capture_send(bytes(data), addr)
+
+    def close(self) -> None:
+        self._closing = True
+
+    def is_closing(self) -> bool:
+        return self._closing
+
+    def abort(self) -> None:
+        self._closing = True
+
+    def get_extra_info(self, name: str, default=None):
+        if name == "sockname":
+            return self._node.spec.addr
+        return default
+
+
+class _Loop:
+    """Duck-types the AbstractEventLoop subset callback-style protocols
+    use. One shared instance: time is a deterministic virtual clock that
+    only advances when the scheduler delivers a timer."""
+
+    def __init__(self, adapter: "AsyncioAdapter"):
+        self._adapter = adapter
+        self._now = 0.0
+        self._ready: List[Tuple[Callable, tuple]] = []
+
+    # -- interposed API -----------------------------------------------------
+    def time(self) -> float:
+        return self._now
+
+    def call_soon(self, callback, *args, context=None):
+        self._ready.append((callback, args))
+        return self  # handle-ish; call_soon handles are rarely cancelled
+
+    def call_later(self, delay, callback, *args, context=None):
+        node = self._adapter.current_node
+        if node is None:
+            raise RuntimeError("call_later outside a delivery context")
+        return node.arm_timer(float(delay), callback, args)
+
+    def call_at(self, when, callback, *args, context=None):
+        return self.call_later(max(0.0, when - self._now), callback, *args)
+
+    def call_exception_handler(self, context) -> None:
+        node = self._adapter.current_node
+        if node is not None:
+            node.effects.logs.append(f"exception_handler: {context!r}")
+
+    def get_debug(self) -> bool:
+        return False
+
+    def create_task(self, coro, **kwargs):
+        raise NotImplementedError(
+            "demi_tpu asyncio adapter v1 interposes callback-style "
+            "protocols only (see bridge/asyncio_adapter.py docstring); "
+            "coroutine tasks are not deterministically controlled"
+        )
+
+    def create_future(self):
+        raise NotImplementedError(
+            "demi_tpu asyncio adapter v1 does not interpose futures"
+        )
+
+    # -- adapter-side -------------------------------------------------------
+    def drain(self, limit: int = 10_000) -> None:
+        """Run call_soon callbacks until quiescent (each may enqueue
+        more). A bound guards against livelock loops in the app."""
+        n = 0
+        while self._ready:
+            callback, args = self._ready.pop(0)
+            callback(*args)
+            n += 1
+            if n > limit:
+                raise RuntimeError("call_soon livelock (drain limit hit)")
+
+
+class _Node:
+    """Adapter-side state for one app node."""
+
+    def __init__(self, adapter: "AsyncioAdapter", name: str, spec: NodeSpec):
+        self.adapter = adapter
+        self.loop = adapter.loop
+        self.name = name
+        self.spec = spec
+        self.protocol: Optional[asyncio.DatagramProtocol] = None
+        self.transport: Optional[_Transport] = None
+        # msg (as tuple) -> (callback, args, armed_at+delay)
+        self.armed: Dict[tuple, Tuple[Callable, tuple, float]] = {}
+        self.arm_counts: Dict[str, int] = {}
+        self.effects = _Effects()
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self) -> None:
+        self.armed.clear()
+        self.arm_counts.clear()
+        self.protocol = self.spec.protocol_factory()
+        self.transport = _Transport(self)
+        self.protocol.connection_made(self.transport)
+
+    def stop(self) -> None:
+        if self.protocol is not None:
+            try:
+                self.protocol.connection_lost(None)
+            except Exception:
+                pass
+        self.protocol = None
+
+    # -- effects capture ----------------------------------------------------
+    def capture_send(self, data: bytes, addr) -> None:
+        dst = self.adapter.addr_to_name.get(tuple(addr) if addr else None)
+        payload = [UDP_TAG, data.decode("latin-1")]
+        if dst is None:
+            self.effects.logs.append(f"sendto unknown addr {addr!r} dropped")
+        else:
+            self.effects.sends.append({"dst": dst, "msg": payload})
+
+    def arm_timer(self, delay: float, callback, args) -> _TimerHandle:
+        name = getattr(callback, "__qualname__", repr(callback))
+        k = self.arm_counts.get(name, 0)
+        self.arm_counts[name] = k + 1
+        msg = [TIMER_TAG, name, k]
+        self.armed[tuple(msg)] = (callback, args, self.loop._now + delay)
+        self.effects.timers.append(msg)
+        return _TimerHandle(self, msg, callback, args)
+
+    def cancel_timer(self, msg: list) -> None:
+        if self.armed.pop(tuple(msg), None) is not None:
+            self.effects.cancels.append(msg)
+
+    # -- delivery -----------------------------------------------------------
+    def deliver(self, src: str, msg) -> None:
+        assert self.protocol is not None, f"{self.name} not started"
+        if isinstance(msg, (list, tuple)) and msg and msg[0] == TIMER_TAG:
+            entry = self.armed.pop(tuple(msg), None)
+            if entry is None:
+                # Replay may deliver a timer this run never armed
+                # (ignore-absent projections); a no-op, like the host
+                # tier's parked-timer drop.
+                self.effects.logs.append(f"stale timer {msg!r} dropped")
+                return
+            callback, args, when = entry
+            self.loop._now = max(self.loop._now, when)
+            callback(*args)
+        elif isinstance(msg, (list, tuple)) and msg and msg[0] == UDP_TAG:
+            data = str(msg[1]).encode("latin-1")
+            addr = self.adapter.name_to_addr.get(src, EXTERNAL_ADDR)
+            self.protocol.datagram_received(data, addr)
+        else:
+            self.effects.logs.append(f"undecodable message {msg!r} dropped")
+
+    # -- checkpoint ---------------------------------------------------------
+    def checkpoint(self) -> dict:
+        if self.protocol is None:
+            return {}
+        state = {}
+        for key, value in vars(self.protocol).items():
+            if key.startswith("_"):
+                continue
+            try:
+                json.dumps(value)
+            except (TypeError, ValueError):
+                continue
+            state[key] = value
+        return state
+
+
+class AsyncioAdapter:
+    """Hosts the nodes and speaks the bridge protocol on (recv, send)
+    callables (line-JSON dicts; see bridge/session.py)."""
+
+    def __init__(self, nodes: Dict[str, NodeSpec]):
+        self.loop = _Loop(self)
+        self.nodes = {
+            name: _Node(self, name, spec) for name, spec in nodes.items()
+        }
+        self.addr_to_name = {
+            tuple(spec.addr): name for name, spec in nodes.items()
+        }
+        self.name_to_addr = {
+            name: tuple(spec.addr) for name, spec in nodes.items()
+        }
+        self.current_node: Optional[_Node] = None
+
+    def _run(self, node: _Node, fn: Callable[[], None]) -> dict:
+        """Execute one app interaction with the loop interposed, drain
+        call_soon, and return the effects reply."""
+        node.effects = _Effects()
+        self.current_node = node
+        saved = (asyncio.get_running_loop, asyncio.get_event_loop)
+        asyncio.get_running_loop = lambda: self.loop  # type: ignore
+        asyncio.get_event_loop = lambda: self.loop  # type: ignore
+        try:
+            fn()
+            self.loop.drain()
+        except Exception as e:  # app crash -> crashed effect
+            node.effects.crashed = True
+            node.effects.logs.append(f"crashed: {e!r}")
+        finally:
+            asyncio.get_running_loop, asyncio.get_event_loop = saved
+            self.current_node = None
+        return node.effects.as_reply()
+
+    def serve(self, recv, send) -> None:
+        send({"op": "register", "actors": list(self.nodes)})
+        while True:
+            cmd = recv()
+            if cmd is None or cmd.get("op") == "shutdown":
+                return
+            op = cmd["op"]
+            node = self.nodes.get(cmd.get("actor"))
+            if op == "start":
+                send(self._run(node, node.start))
+            elif op == "deliver":
+                src, msg = cmd["src"], cmd["msg"]
+                send(self._run(node, lambda: node.deliver(src, msg)))
+            elif op == "checkpoint":
+                send({"op": "state", "state": node.checkpoint()})
+            elif op == "stop":
+                node.stop()  # no reply
+            else:
+                raise SystemExit(f"unknown op {cmd!r}")
+
+
+def serve_stdio(nodes: Dict[str, NodeSpec]) -> None:
+    """Entry point for launcher scripts: speak the pipe transport."""
+
+    def recv():
+        line = sys.stdin.readline()
+        return json.loads(line) if line else None
+
+    def send(obj):
+        sys.stdout.write(json.dumps(obj) + "\n")
+        sys.stdout.flush()
+
+    AsyncioAdapter(nodes).serve(recv, send)
+
+
+def udp_send(payload: str):
+    """Host-side sugar: the message value an external Send must carry to
+    reach an adapter-hosted node as a datagram."""
+    return (UDP_TAG, payload)

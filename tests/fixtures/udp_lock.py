@@ -1,0 +1,117 @@
+"""A small distributed lock service over UDP datagrams — plain asyncio,
+no test-framework imports. Run it standalone over real sockets:
+
+    python udp_lock.py        # server + two clients on localhost UDP
+
+Protocol (ASCII datagrams):
+    client -> server: b"acquire" | b"release"
+    server -> client: b"grant"
+    anyone -> client: b"go"      (control: run one acquire/use/release)
+
+Clients retransmit un-granted acquires on a timer — and carry a classic
+request-identity bug: a grant is trusted *whenever it arrives*. A
+retransmitted acquire that the server processes after the client already
+released earns a second grant the client no longer wants ("phantom
+grant": held becomes true while wants is false).
+"""
+
+import asyncio
+
+
+class LockServer(asyncio.DatagramProtocol):
+    def __init__(self):
+        self.holder = None   # peer address currently holding the lock
+        self.waiting = []    # FIFO of peer addresses
+        self.grants = 0
+
+    def connection_made(self, transport):
+        self.transport = transport
+
+    def datagram_received(self, data, addr):
+        cmd = data.decode("latin-1").split()[0]
+        addr = list(addr)
+        if cmd == "acquire":
+            if self.holder is None:
+                self.holder = addr
+                self.grants += 1
+                self.transport.sendto(b"grant", tuple(addr))
+            elif addr != self.holder and addr not in self.waiting:
+                self.waiting.append(addr)
+        elif cmd == "release":
+            if addr == self.holder:
+                self.holder = None
+                if self.waiting:
+                    nxt = self.waiting.pop(0)
+                    self.holder = nxt
+                    self.grants += 1
+                    self.transport.sendto(b"grant", tuple(nxt))
+
+
+class LockClient(asyncio.DatagramProtocol):
+    RETRY = 0.2   # retransmit un-granted acquires
+    HOLD = 0.05   # how long the critical section runs
+
+    def __init__(self, server_addr):
+        self.server_addr = tuple(server_addr)
+        self.wants = False
+        self.held = False
+        self.cycles = 0
+        self._retry = None
+
+    def connection_made(self, transport):
+        self.transport = transport
+
+    def datagram_received(self, data, addr):
+        cmd = data.decode("latin-1").split()[0]
+        loop = asyncio.get_running_loop()
+        if cmd == "go":
+            if not self.wants and not self.held:
+                self.wants = True
+                self._send_acquire()
+        elif cmd == "grant":
+            # BUG: no request identity — any grant is trusted, even one
+            # earned by a stale retransmission after we released.
+            self.held = True
+            if self._retry is not None:
+                self._retry.cancel()
+                self._retry = None
+            loop.call_later(self.HOLD, self._release)
+
+    def _send_acquire(self):
+        self.transport.sendto(b"acquire", self.server_addr)
+        self._retry = asyncio.get_running_loop().call_later(
+            self.RETRY, self._send_acquire
+        )
+
+    def _release(self):
+        if self.held:
+            self.held = False
+            self.wants = False
+            self.cycles += 1
+            self.transport.sendto(b"release", self.server_addr)
+
+
+async def main():
+    """Standalone demo over real UDP on localhost."""
+    loop = asyncio.get_running_loop()
+    server_addr = ("127.0.0.1", 18800)
+    _, server = await loop.create_datagram_endpoint(
+        LockServer, local_addr=server_addr
+    )
+    clients = []
+    for port in (18801, 18802):
+        _, proto = await loop.create_datagram_endpoint(
+            lambda: LockClient(server_addr), local_addr=("127.0.0.1", port)
+        )
+        clients.append(proto)
+    ctrl, _ = await loop.create_datagram_endpoint(
+        asyncio.DatagramProtocol, local_addr=("127.0.0.1", 0)
+    )
+    for port in (18801, 18802):
+        ctrl.sendto(b"go", ("127.0.0.1", port))
+    await asyncio.sleep(1.0)
+    print("cycles:", [c.cycles for c in clients], "grants:", server.grants)
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

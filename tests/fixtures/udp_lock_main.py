@@ -1,0 +1,26 @@
+"""Bridge launcher for the (unmodified) udp_lock asyncio app: wires its
+protocol classes into NodeSpecs and speaks the bridge protocol on stdio.
+This file is the entire per-app integration surface — the app module
+itself has no knowledge of demi_tpu (the reference's analog: the test
+harness config that lists which actors to weave)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from udp_lock import LockClient, LockServer  # the app, untouched
+
+from demi_tpu.bridge.asyncio_adapter import NodeSpec, serve_stdio
+
+SERVER = ("10.0.0.1", 9000)
+ALICE = ("10.0.0.2", 9000)
+BOB = ("10.0.0.3", 9000)
+
+serve_stdio(
+    {
+        "server": NodeSpec(LockServer, SERVER),
+        "alice": NodeSpec(lambda: LockClient(SERVER), ALICE),
+        "bob": NodeSpec(lambda: LockClient(SERVER), BOB),
+    }
+)

@@ -1,0 +1,203 @@
+"""Asyncio-adapter tier: an UNMODIFIED asyncio DatagramProtocol app
+(tests/fixtures/udp_lock.py — plain stdlib, runnable over real UDP)
+driven deterministically through the bridge, fuzzed to a real
+message-race violation, minimized, and replayed."""
+
+import os
+import sys
+
+import pytest
+
+from demi_tpu.bridge import BridgeSession, bridge_invariant
+from demi_tpu.bridge.asyncio_adapter import (
+    TIMER_TAG,
+    AsyncioAdapter,
+    NodeSpec,
+    udp_send,
+)
+from demi_tpu.config import SchedulerConfig
+from demi_tpu.external_events import MessageConstructor, Send, Start, WaitQuiescence
+from demi_tpu.runner import sts_sched_ddmin
+from demi_tpu.schedulers import RandomScheduler
+from demi_tpu.schedulers.replay import ReplayScheduler
+
+FIXTURES = os.path.join(os.path.dirname(os.path.abspath(__file__)), "fixtures")
+sys.path.insert(0, FIXTURES)
+
+from udp_lock import LockClient, LockServer  # noqa: E402
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LAUNCHER = [sys.executable, os.path.join(FIXTURES, "udp_lock_main.py")]
+# Append, never overwrite: PYTHONPATH may carry the TPU plugin site.
+ENV = {
+    "PYTHONPATH": REPO_ROOT + os.pathsep + os.environ.get("PYTHONPATH", "")
+}
+
+SERVER = ("10.0.0.1", 9000)
+ALICE = ("10.0.0.2", 9000)
+
+
+def _adapter():
+    return AsyncioAdapter(
+        {
+            "server": NodeSpec(LockServer, SERVER),
+            "alice": NodeSpec(lambda: LockClient(SERVER), ALICE),
+        }
+    )
+
+
+# -- in-process unit tests of the interposition ---------------------------
+
+def test_adapter_captures_sends_and_timers():
+    ad = _adapter()
+    alice = ad.nodes["alice"]
+    ad._run(alice, alice.start)
+    reply = ad._run(alice, lambda: alice.deliver("ext", ("__udp__", "go")))
+    assert reply["sends"] == [{"dst": "server", "msg": ["__udp__", "acquire"]}]
+    assert reply["timers"] == [[TIMER_TAG, "LockClient._send_acquire", 0]]
+    assert not reply["crashed"]
+
+
+def test_adapter_timer_fire_advances_clock_and_rearms():
+    ad = _adapter()
+    alice = ad.nodes["alice"]
+    ad._run(alice, alice.start)
+    ad._run(alice, lambda: alice.deliver("ext", ("__udp__", "go")))
+    # Fire the retransmit timer: another acquire + the NEXT arm (stable
+    # per-name numbering), clock advanced to the armed deadline.
+    reply = ad._run(
+        alice,
+        lambda: alice.deliver(
+            "alice", (TIMER_TAG, "LockClient._send_acquire", 0)
+        ),
+    )
+    assert reply["sends"] == [{"dst": "server", "msg": ["__udp__", "acquire"]}]
+    assert reply["timers"] == [[TIMER_TAG, "LockClient._send_acquire", 1]]
+    assert ad.loop.time() == pytest.approx(LockClient.RETRY)
+
+
+def test_adapter_grant_cancels_retry_timer():
+    ad = _adapter()
+    alice = ad.nodes["alice"]
+    ad._run(alice, alice.start)
+    ad._run(alice, lambda: alice.deliver("ext", ("__udp__", "go")))
+    reply = ad._run(alice, lambda: alice.deliver("server", ("__udp__", "grant")))
+    assert reply["cancel"] == [[TIMER_TAG, "LockClient._send_acquire", 0]]
+    assert reply["timers"] == [[TIMER_TAG, "LockClient._release", 0]]
+
+
+def test_adapter_stale_timer_is_noop():
+    ad = _adapter()
+    alice = ad.nodes["alice"]
+    ad._run(alice, alice.start)
+    reply = ad._run(
+        alice,
+        lambda: alice.deliver("alice", (TIMER_TAG, "LockClient._release", 7)),
+    )
+    assert not reply["crashed"] and not reply["sends"]
+    assert any("stale timer" in line for line in reply["logs"])
+
+
+def test_adapter_checkpoint_is_json_subset_of_vars():
+    ad = _adapter()
+    alice = ad.nodes["alice"]
+    ad._run(alice, alice.start)
+    state = alice.checkpoint()
+    assert state["wants"] is False and state["held"] is False
+    assert "transport" not in state  # non-JSON dropped
+    assert "_retry" not in state  # privates dropped
+
+
+def test_adapter_create_task_points_at_scope_docs():
+    ad = _adapter()
+    alice = ad.nodes["alice"]
+    ad._run(alice, alice.start)
+
+    class TaskyProto:
+        def connection_made(self, transport):
+            pass
+
+        def datagram_received(self, data, addr):
+            import asyncio
+
+            asyncio.get_running_loop().create_task(None)
+
+    ad.nodes["alice"].protocol = TaskyProto()
+    ad.nodes["alice"].protocol.connection_made(None)
+    reply = ad._run(
+        alice, lambda: alice.deliver("ext", ("__udp__", "x"))
+    )
+    assert reply["crashed"]
+    assert any("callback-style" in line for line in reply["logs"])
+
+
+# -- end-to-end over the bridge -------------------------------------------
+
+def _phantom_grant(states):
+    """Safety property: a client must never hold a lock it no longer
+    wants (the retransmission-identity bug's signature)."""
+    for name in ("alice", "bob"):
+        st = states.get(name)
+        if st and st.get("held") and not st.get("wants"):
+            return 2
+    return None
+
+
+def _program(session):
+    starts = [
+        Start(name, ctor=session.actor_factory(name))
+        for name in ("server", "alice", "bob")
+    ]
+    return starts + [
+        Send("alice", MessageConstructor(lambda: udp_send("go"))),
+        Send("bob", MessageConstructor(lambda: udp_send("go"))),
+        WaitQuiescence(budget=60),
+    ]
+
+
+def _config():
+    return SchedulerConfig(
+        invariant_check=bridge_invariant(predicate=_phantom_grant)
+    )
+
+
+def test_udp_lock_completes_under_friendly_schedule():
+    with BridgeSession(LAUNCHER, env=ENV) as session:
+        config = _config()
+        result = RandomScheduler(
+            config, seed=0, max_messages=80, invariant_check_interval=1,
+            timer_weight=0.05,  # timers rarely beat the messages they race
+        ).execute(_program(session))
+        # go -> acquire -> grant -> release for at least one client
+        assert result.deliveries >= 6
+
+
+def test_udp_lock_phantom_grant_found_minimized_replayed():
+    """The full arc on an app not written for this framework: fuzz seeds
+    until the retransmit/release race produces a phantom grant, minimize
+    the external program, verify the MCS, and replay deterministically."""
+    with BridgeSession(LAUNCHER, env=ENV) as session:
+        config = _config()
+        program = _program(session)
+        found = None
+        for seed in range(40):
+            result = RandomScheduler(
+                config, seed=seed, max_messages=120,
+                invariant_check_interval=1, timer_weight=0.4,
+            ).execute(program)
+            if result.violation is not None:
+                found = result
+                break
+        assert found is not None, "phantom grant never surfaced"
+        assert found.violation.code == 2
+
+        mcs, verified = sts_sched_ddmin(
+            config, found.trace, program, found.violation
+        )
+        assert verified is not None
+        kept = mcs.get_all_events()
+        assert len(kept) < len(program)  # at least one external pruned
+
+        replayed = ReplayScheduler(config).replay(found.trace, program)
+        assert replayed.violation is not None
+        assert replayed.violation.matches(found.violation)
