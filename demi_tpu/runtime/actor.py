@@ -5,10 +5,15 @@ through the ``Context`` the runtime hands them, so every send/timer is
 captured without any bytecode weaving (this replaces the reference's entire
 L1 layer, WeaveActor.aj — see SURVEY.md §2.7).
 
-Blocking ``ask`` is deliberately absent: in-framework apps are written
-continuation-style (handle the reply as a message), which keeps handlers
-total and the device step function jittable (SURVEY.md §7.3; the reference's
-blocked-actor machinery is Instrumenter.scala:679-877).
+Blocking ``ask`` exists at this tier as CPS sugar (``Context.ask``): the
+asker names a continuation for the reply and is blocked — nothing else is
+deliverable to it — until a matching reply arrives, which routes to the
+continuation instead of ``receive``. This covers the reference's
+blocked-actor tracking + PromiseActorRef interposition
+(Instrumenter.scala:679-877) without temp-actor refs: replies are matched
+by (sender, predicate) rather than by a woven promise ref. The *device*
+tier stays CPS-by-construction (SURVEY.md §7.3) — handlers are total jax
+functions and never block.
 """
 
 from __future__ import annotations
@@ -42,6 +47,27 @@ class Context:
 
     def cancel_timer(self, msg: Any) -> None:
         self._system._cancel_timer(self.name, msg)
+
+    def ask(
+        self,
+        dst: str,
+        msg: Any,
+        on_reply: Callable[["Context", Any], None],
+        match: Optional[Callable[[Any], bool]] = None,
+    ) -> None:
+        """Blocking ask, CPS-style: send ``msg`` to ``dst`` and block this
+        actor until a non-timer message from ``dst`` (satisfying ``match``
+        if given) arrives; that reply is routed to ``on_reply(ctx, reply)``
+        instead of ``receive``. Everything else addressed to this actor
+        stays pending (not dropped) while blocked, exactly like the
+        reference's ask interposition (Instrumenter.scala:679-877).
+
+        ``on_reply`` may itself ``ask`` (chained asks). A reply never
+        arriving is a quiescent deadlock — visible to invariants via the
+        system's ``blocked_actors()`` and each actor's ``_blocked``-aware
+        checkpoint (see ``ask_deadlock_invariant``)."""
+        self.send(dst, msg)
+        self._system.register_ask(self.name, dst, match, on_reply)
 
     def log(self, line: str) -> None:
         self._system._capture_log(self.name, line)

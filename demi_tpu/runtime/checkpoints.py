@@ -26,6 +26,10 @@ class CheckpointRequest:
 @dataclass(frozen=True)
 class CheckpointReply:
     data: Any
+    # The actor is mid-ask (Context.ask continuation pending). Surfaced
+    # here so invariants can flag quiescent ask-deadlock without every
+    # app's checkpoint_state having to track it.
+    blocked: bool = False
 
 
 def is_checkpoint_message(msg) -> bool:
@@ -49,5 +53,33 @@ class CheckpointCollector:
                 out[name] = None
             else:
                 actor = system.actor(name)
-                out[name] = CheckpointReply(actor.checkpoint_state())
+                out[name] = CheckpointReply(
+                    actor.checkpoint_state(),
+                    blocked=name in system.blocked_asks,
+                )
         return out
+
+
+def ask_deadlock_invariant(code: int = 1, wrapped=None):
+    """Invariant flagging quiescent ask-deadlock: some live actor still
+    blocked on a ``Context.ask`` when the check runs (the canonical ask
+    pathology; bridge twin: bridge_invariant in bridge/session.py).
+    ``wrapped`` layers an app invariant underneath — it runs only when no
+    deadlock is present."""
+    from ..minimization.test_oracle import IntViolation
+
+    def invariant(externals, checkpoint):
+        blocked = tuple(
+            sorted(
+                name
+                for name, reply in checkpoint.items()
+                if reply is not None and reply.blocked
+            )
+        )
+        if blocked:
+            return IntViolation(code, blocked)
+        if wrapped is not None:
+            return wrapped(externals, checkpoint)
+        return None
+
+    return invariant

@@ -107,6 +107,9 @@ class ControlledActorSystem:
         # deliverable to that actor (reference: Instrumenter blocked-actor
         # tracking, Instrumenter.scala:679-727).
         self.blocked_asks: Dict[str, Callable[[PendingEntry], bool]] = {}
+        # CPS-ask continuations (Context.ask): name -> (reply_pred,
+        # on_reply). The matching reply runs on_reply instead of receive.
+        self.pending_asks: Dict[str, Tuple[Callable, Callable]] = {}
         self.network = Network()
         self.vector_clocks: Dict[str, Dict[str, int]] = {}
         self.log_listener: Optional[Callable[[str, str], None]] = None
@@ -183,6 +186,7 @@ class ControlledActorSystem:
         self.stopped.add(name)
         self.crashed.discard(name)
         self.blocked_asks.pop(name, None)
+        self.pending_asks.pop(name, None)
 
     # -- blocked-ask bookkeeping (bridge tier) ----------------------------
     def block_actor(self, name: str, reply_pred: Callable[[PendingEntry], bool]) -> None:
@@ -193,6 +197,29 @@ class ControlledActorSystem:
 
     def blocked_actors(self) -> List[str]:
         return sorted(self.blocked_asks.keys())
+
+    # -- CPS ask (in-framework tier; Context.ask) -------------------------
+    def register_ask(
+        self,
+        name: str,
+        dst: str,
+        match: Optional[Callable[[Any], bool]],
+        on_reply: Callable,
+    ) -> None:
+        """Block ``name`` until a non-timer message from ``dst`` (passing
+        ``match``) arrives; route that reply to ``on_reply`` instead of
+        receive (reference: blocked-actor tracking + PromiseActorRef,
+        Instrumenter.scala:679-877)."""
+
+        def reply_pred(entry: PendingEntry) -> bool:
+            return (
+                not entry.is_timer
+                and entry.snd == dst
+                and (match is None or bool(match(entry.msg)))
+            )
+
+        self.blocked_asks[name] = reply_pred
+        self.pending_asks[name] = (reply_pred, on_reply)
 
     # -- the one delivery --------------------------------------------------
     def deliver(self, entry: PendingEntry) -> List[PendingEntry]:
@@ -209,10 +236,17 @@ class ControlledActorSystem:
             return []
         actor = self.actors[entry.rcv]
         self._merge_vector_clock(entry)
+        # CPS-ask reply routing: a matching reply unblocks the asker and
+        # runs its continuation instead of receive.
+        ask = self.pending_asks.get(entry.rcv)
+        if ask is not None and ask[0](entry):
+            del self.pending_asks[entry.rcv]
+            self.unblock_actor(entry.rcv)
+            handler = lambda ctx: ask[1](ctx, entry.msg)  # noqa: E731
+        else:
+            handler = lambda ctx: actor.receive(ctx, entry.snd, entry.msg)  # noqa: E731
         try:
-            return self._with_capture(
-                entry.rcv, lambda ctx: actor.receive(ctx, entry.snd, entry.msg)
-            )
+            return self._with_capture(entry.rcv, handler)
         except HarnessError:
             raise
         except Exception:
@@ -303,14 +337,21 @@ class ControlledActorSystem:
                 self.network.snapshot(),
                 self.vector_clocks,
                 self.id_gen.state(),
+                # Ask state must survive peek rollbacks: losing a blocked
+                # ask would make deferred messages deliverable mid-probe.
+                self.blocked_asks,
+                self.pending_asks,
             )
         )
 
     def restore(self, snap) -> None:
-        actors, crashed, stopped, net, vcs, idstate = copy.deepcopy(snap)
+        (actors, crashed, stopped, net, vcs, idstate,
+         blocked, asks) = copy.deepcopy(snap)
         self.actors = actors
         self.crashed = crashed
         self.stopped = stopped
         self.network.restore(net)
         self.vector_clocks = vcs
+        self.blocked_asks = blocked
+        self.pending_asks = asks
         self.id_gen.restore(idstate)

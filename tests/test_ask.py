@@ -1,0 +1,171 @@
+"""CPS-style Context.ask: blocked-until-reply semantics at the host tier
+(reference: blocked-actor tracking + PromiseActorRef interposition,
+Instrumenter.scala:679-877)."""
+
+import pytest
+
+from demi_tpu.config import SchedulerConfig
+from demi_tpu.external_events import MessageConstructor, Send, Start, WaitQuiescence
+from demi_tpu.runtime.actor import Actor
+from demi_tpu.runtime.checkpoints import ask_deadlock_invariant
+from demi_tpu.runtime.system import ControlledActorSystem
+from demi_tpu.schedulers import BasicScheduler, RandomScheduler
+
+
+class Requester(Actor):
+    """On ("go",): asks the responder and records events in order. While
+    blocked, ("poke",) messages must be deferred, not delivered."""
+
+    def __init__(self, chained: bool = False):
+        self.events = []
+        self.chained = chained
+
+    def receive(self, ctx, snd, msg):
+        if msg[0] == "go":
+            self.events.append("go")
+            ctx.ask(
+                "responder",
+                ("ping", 1),
+                self._on_pong,
+                match=lambda m: m[0] == "pong",
+            )
+        elif msg[0] == "poke":
+            self.events.append("poke")
+
+    def _on_pong(self, ctx, reply):
+        self.events.append(("pong", reply[1]))
+        if self.chained and reply[1] == 1:
+            ctx.ask(
+                "responder",
+                ("ping", 2),
+                self._on_pong,
+                match=lambda m: m[0] == "pong",
+            )
+
+    def checkpoint_state(self):
+        return list(self.events)
+
+
+class Responder(Actor):
+    def __init__(self, deaf: bool = False, noise_first: bool = False):
+        self.deaf = deaf
+        self.noise_first = noise_first
+
+    def receive(self, ctx, snd, msg):
+        if msg[0] == "ping" and not self.deaf:
+            if self.noise_first:
+                # A non-matching message from the asked actor: the match
+                # predicate must defer it, not consume the continuation.
+                ctx.send(snd, ("noise",))
+            ctx.send(snd, ("pong", msg[1]))
+
+    def checkpoint_state(self):
+        return None
+
+
+def _program(req_factory, resp_factory, extra=()):
+    return [
+        Start("requester", ctor=req_factory),
+        Start("responder", ctor=resp_factory),
+        Send("requester", MessageConstructor(lambda: ("go",))),
+        *extra,
+        WaitQuiescence(budget=40),
+    ]
+
+
+def test_ask_blocks_and_routes_reply_to_continuation():
+    """FIFO (BasicScheduler) would deliver the poke before the pong —
+    blocking must defer it until the continuation ran."""
+    config = SchedulerConfig()
+    sched = BasicScheduler(config)
+    req = Requester()
+    program = _program(
+        lambda: req, Responder,
+        extra=[Send("requester", MessageConstructor(lambda: ("poke",)))],
+    )
+    result = sched.execute(program)
+    assert result.violation is None
+    assert req.events == ["go", ("pong", 1), "poke"]
+
+
+def test_ask_reply_routing_under_random_schedules():
+    config = SchedulerConfig()
+    for seed in range(10):
+        req = Requester()
+        program = _program(
+            lambda: req, Responder,
+            extra=[Send("requester", MessageConstructor(lambda: ("poke",)))],
+        )
+        result = RandomScheduler(config, seed=seed).execute(program)
+        assert result.violation is None
+        # The poke may precede the go (external order is the scheduler's
+        # choice) — but it must never land inside the blocked window
+        # between go and the continuation's pong.
+        go = req.events.index("go")
+        pong = req.events.index(("pong", 1))
+        assert go < pong
+        assert "poke" not in req.events[go + 1 : pong]
+
+
+def test_chained_asks():
+    config = SchedulerConfig()
+    req = Requester(chained=True)
+    result = BasicScheduler(config).execute(_program(lambda: req, Responder))
+    assert result.violation is None
+    assert req.events == ["go", ("pong", 1), ("pong", 2)]
+
+
+def test_ask_match_predicate_defers_non_matching():
+    config = SchedulerConfig()
+    req = Requester()
+    result = BasicScheduler(config).execute(
+        _program(lambda: req, lambda: Responder(noise_first=True))
+    )
+    assert result.violation is None
+    # noise arrives from the asked actor BEFORE the pong in channel order;
+    # the match predicate must skip it, deliver the pong to the
+    # continuation, then deliver the deferred noise to receive() — where
+    # Requester ignores it.
+    assert req.events == ["go", ("pong", 1)]
+
+
+def test_ask_deadlock_flagged_at_quiescence():
+    config = SchedulerConfig(invariant_check=ask_deadlock_invariant())
+    req = Requester()
+    result = RandomScheduler(config, seed=0).execute(
+        _program(lambda: req, lambda: Responder(deaf=True))
+    )
+    assert result.violation is not None
+    assert result.violation.nodes == ("requester",)
+
+
+def test_ask_state_survives_checkpoint_restore():
+    """Peek rollbacks must not lose (or leak) blocked-ask state."""
+    system = ControlledActorSystem()
+    req = Requester()
+    system.spawn("requester", lambda: req)
+    system.spawn("responder", Responder)
+    entries = system.deliver(system.inject("requester", ("go",)))
+    assert system.blocked_actors() == ["requester"]
+    snap = system.checkpoint()
+    # Deliver the pong: unblocks.
+    pong = [e for e in entries if e.rcv == "responder"]
+    reply_entries = system.deliver(pong[0])
+    system.deliver([e for e in reply_entries if e.rcv == "requester"][0])
+    assert system.blocked_actors() == []
+    # Roll back: blocked again, continuation intact.
+    system.restore(snap)
+    assert system.blocked_actors() == ["requester"]
+    assert "requester" in system.pending_asks
+
+
+def test_hardkill_clears_pending_ask():
+    system = ControlledActorSystem()
+    req = Requester()
+    system.spawn("requester", lambda: req)
+    system.spawn("responder", Responder)
+    system.deliver(system.inject("requester", ("go",)))
+    assert system.blocked_actors() == ["requester"]
+    system.hard_kill("requester")
+    assert system.blocked_actors() == []
+    assert "requester" not in system.pending_asks
