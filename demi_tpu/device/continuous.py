@@ -27,8 +27,59 @@ from ..dsl import DSLApp
 from .core import ST_DONE, ST_VIOLATION, DeviceConfig, ScheduleState
 from .explore import ExtProgram, _finalize, init_state, make_step_fn
 
+LANES = "lanes"
 
-def make_segment_kernel(app: DSLApp, cfg: DeviceConfig, seg_steps: int):
+
+def _lane_sharding(mesh, axis: str = LANES):
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    return NamedSharding(mesh, P(axis))
+
+
+def _maybe_shard(fn, mesh, n_args: int, axis: str = LANES):
+    """jit ``fn`` with every output leaf lane-sharded over ``mesh`` (all
+    leaves carry the batch on their leading axis), or plain jit when mesh
+    is None. Outputs-only on purpose: out_shardings *reshards* (host
+    inputs get distributed on first touch, state stays resident across
+    segments), while strict in_shardings would reject the zero-size
+    disabled-trace leaf, which GSPMD canonicalizes to replicated no
+    matter what. The refill loop's host side only ever pulls O(batch)
+    status/violation/hash vectors."""
+    if mesh is None:
+        return jax.jit(fn)
+    s = _lane_sharding(mesh, axis)
+    return jax.jit(fn, out_shardings=s)
+
+
+def _segment_lane_fn(app: DSLApp, cfg: DeviceConfig, seg_steps: int):
+    """Per-lane segment body shared by the XLA and pallas backends: advance
+    one lane by ``seg_steps`` steps, masking steps at or past the lane's
+    ``cfg.max_steps`` budget (finished lanes are frozen no-ops). The
+    counter rides the carry (not scan xs) so the same trace lowers under
+    Mosaic, where xs-slicing has no lowering."""
+    step = make_step_fn(app, cfg)
+
+    def seg_lane(state: ScheduleState, prog: ExtProgram, steps_run):
+        def body(carry, _):
+            s, i = carry
+            live = (steps_run + i) < cfg.max_steps
+            s2 = step(s, prog)
+            s = jax.tree_util.tree_map(
+                lambda a, b: jnp.where(live, b, a), s, s2
+            )
+            return (s, i + 1), None
+
+        (state, _), _ = jax.lax.scan(
+            body, (state, jnp.int32(0)), None, length=seg_steps
+        )
+        return state
+
+    return seg_lane
+
+
+def make_segment_kernel(
+    app: DSLApp, cfg: DeviceConfig, seg_steps: int, mesh=None
+):
     """jitted ``(state[B], progs[B], steps_run[B]) -> state'[B]``: advance
     every lane by ``seg_steps`` steps (finished lanes are frozen no-ops).
 
@@ -36,32 +87,156 @@ def make_segment_kernel(app: DSLApp, cfg: DeviceConfig, seg_steps: int):
     ``cfg.max_steps`` are masked out per lane, so bit-parity with the plain
     explore kernel holds for ANY seg_steps, including ones that don't
     divide max_steps (a lane refilled mid-stream stops exactly on budget
-    instead of running to the segment boundary)."""
-    step = make_step_fn(app, cfg)
+    instead of running to the segment boundary).
 
-    def run_segment(
-        state: ScheduleState, prog: ExtProgram, steps_run
-    ) -> ScheduleState:
-        def body(s, i):
-            live = (steps_run + i) < cfg.max_steps
-            s2 = step(s, prog)
-            s = jax.tree_util.tree_map(
-                lambda a, b: jnp.where(live, b, a), s, s2
-            )
-            return s, None
-
-        state, _ = jax.lax.scan(body, state, jnp.arange(seg_steps))
-        return state
-
-    return jax.jit(jax.vmap(run_segment))
+    ``mesh`` shards the lane batch over its axis (ICI scale-out for the
+    refill path; the batch must be a multiple of the mesh size)."""
+    seg_lane = _segment_lane_fn(app, cfg, seg_steps)
+    return _maybe_shard(jax.vmap(seg_lane), mesh, 3)
 
 
-def make_init_kernel(app: DSLApp, cfg: DeviceConfig):
+def make_segment_kernel_pallas(
+    app: DSLApp,
+    cfg: DeviceConfig,
+    seg_steps: int,
+    block_lanes: int = 128,
+    interpret: Optional[bool] = None,
+    mesh=None,
+    axis: str = LANES,
+):
+    """Pallas twin of ``make_segment_kernel``: each grid cell keeps a lane
+    block's full ScheduleState in VMEM for the whole segment, so the state
+    round-trips HBM once per *segment* instead of once per step — the
+    VMEM-residency win of the pallas explore backend composed with lane
+    refill. Bit-identical to the XLA segment kernel (same
+    ``_segment_lane_fn`` trace).
+
+    Bool state leaves ride as int32 kernel operands (Mosaic mask operands
+    are awkward); zero-size leaves (the disabled trace buffer) bypass the
+    kernel untouched. ``mesh`` wraps the blocked call in shard_map over
+    ``axis`` — each device runs the VMEM-blocked segment on its local lane
+    shard."""
+    from .pallas_explore import _check_pallas_cfg, _make_blocked_kernel
+
+    if cfg.record_trace:
+        raise ValueError(
+            "pallas segment kernel records verdicts only (sweeps re-trace "
+            "interesting lanes via the XLA single-lane kernel)"
+        )
+    interpret = _check_pallas_cfg(cfg, interpret)
+    seg_lane = _segment_lane_fn(app, cfg, seg_steps)
+
+    # Leaf inventory from the state/program avals.
+    state_avals = jax.eval_shape(
+        lambda k: init_state(app, cfg, k),
+        jax.ShapeDtypeStruct((2,), jnp.uint32),
+    )
+    state_leaves, state_def = jax.tree_util.tree_flatten(state_avals)
+    e, w = cfg.max_external_ops, cfg.msg_width
+    prog_leaf_shapes = [(e,), (e,), (e,), (e, w)]
+    bl = block_lanes
+
+    kernel_idx = [
+        i for i, leaf in enumerate(state_leaves) if np.prod(leaf.shape) > 0
+    ]
+    passthrough_idx = [
+        i for i in range(len(state_leaves)) if i not in kernel_idx
+    ]
+    leaf_dtypes = [state_leaves[i].dtype for i in kernel_idx]
+
+    def _wire_dtype(dt):
+        return jnp.int32 if dt == jnp.bool_ else dt
+
+    in_structs = [
+        jax.ShapeDtypeStruct(
+            (bl,) + tuple(state_leaves[i].shape), _wire_dtype(state_leaves[i].dtype)
+        )
+        for i in kernel_idx
+    ]
+    in_structs += [
+        jax.ShapeDtypeStruct((bl,) + shape, jnp.int32)
+        for shape in prog_leaf_shapes
+    ]
+    in_structs.append(jax.ShapeDtypeStruct((bl,), jnp.int32))
+    n_state = len(kernel_idx)
+
+    def _rebuild_state(flat_kernel, batch: int):
+        leaves = [None] * len(state_leaves)
+        for i, val in zip(kernel_idx, flat_kernel):
+            leaves[i] = val
+        for i in passthrough_idx:
+            aval = state_leaves[i]
+            leaves[i] = jnp.zeros((batch,) + tuple(aval.shape), aval.dtype)
+        return jax.tree_util.tree_unflatten(state_def, leaves)
+
+    def block_fn(*flat):
+        state_flat = [
+            v.astype(dt) for v, dt in zip(flat[:n_state], leaf_dtypes)
+        ]
+        op, a, b, msg = flat[n_state : n_state + 4]
+        steps_run = flat[n_state + 4]
+        state = _rebuild_state(state_flat, bl)
+        out = jax.vmap(seg_lane)(
+            state, ExtProgram(op=op, a=a, b=b, msg=msg), steps_run
+        )
+        out_flat = jax.tree_util.tree_leaves(out)
+        return tuple(
+            out_flat[i].astype(_wire_dtype(state_leaves[i].dtype))
+            for i in kernel_idx
+        )
+
+    blocked = _make_blocked_kernel(block_fn, in_structs, bl, interpret)
+
+    def call(state: ScheduleState, progs: ExtProgram, steps_run):
+        batch = steps_run.shape[0]
+        flat = jax.tree_util.tree_leaves(state)
+        ins = [
+            flat[i].astype(_wire_dtype(state_leaves[i].dtype))
+            for i in kernel_idx
+        ]
+        ins += [progs.op, progs.a, progs.b, progs.msg]
+        ins.append(steps_run.astype(jnp.int32))
+        outs = blocked(*ins)
+        outs = [v.astype(dt) for v, dt in zip(outs, leaf_dtypes)]
+        return _rebuild_state(outs, batch)
+
+    if mesh is None:
+        return jax.jit(call)
+
+    from jax.sharding import PartitionSpec as P
+
+    lane = P(axis)
+    spec = jax.tree_util.tree_map(lambda _: lane, state_avals)
+    prog_spec = ExtProgram(op=lane, a=lane, b=lane, msg=lane)
+    smapped = jax.shard_map(
+        call,
+        mesh=mesh,
+        in_specs=(spec, prog_spec, lane),
+        out_specs=spec,
+        # pallas_call outputs carry no varying-mesh-axes annotation;
+        # lanes are fully independent, nothing is replicated.
+        check_vma=False,
+    )
+    sharding = _lane_sharding(mesh, axis)
+
+    def sharded_call(state, progs, steps_run):
+        out = smapped(state, progs, steps_run)
+        # Zero-size passthrough leaves (the disabled trace buffer) fall
+        # out of shard_map replicated; re-constrain the whole tree so the
+        # strictly-sharded refill/finalize jits accept it.
+        return jax.lax.with_sharding_constraint(out, sharding)
+
+    return jax.jit(sharded_call)
+
+
+def make_init_kernel(app: DSLApp, cfg: DeviceConfig, mesh=None):
     """jitted ``keys[B] -> ScheduleState[B]`` batch initializer."""
-    return jax.jit(jax.vmap(lambda key: init_state(app, cfg, key)))
+    return _maybe_shard(
+        jax.vmap(lambda key: init_state(app, cfg, key)), mesh, 1
+    )
 
 
-def make_refill_kernel(app: DSLApp, cfg: DeviceConfig):
+def make_refill_kernel(app: DSLApp, cfg: DeviceConfig, mesh=None):
     """jitted ``(state[B], refill[B] bool, fresh[B]) -> state'[B]``:
     lanes with ``refill`` set are replaced by the fresh state wholesale."""
 
@@ -72,10 +247,10 @@ def make_refill_kernel(app: DSLApp, cfg: DeviceConfig):
 
         return jax.tree_util.tree_map(merge, state, fresh)
 
-    return jax.jit(refill)
+    return _maybe_shard(refill, mesh, 3)
 
 
-def make_finalize_kernel(app: DSLApp, cfg: DeviceConfig):
+def make_finalize_kernel(app: DSLApp, cfg: DeviceConfig, mesh=None):
     """jitted forced finalization for lanes that exhausted their step
     budget mid-flight (parity: the plain kernel's run-out path)."""
 
@@ -87,7 +262,7 @@ def make_finalize_kernel(app: DSLApp, cfg: DeviceConfig):
             state,
         )
 
-    return jax.jit(jax.vmap(fin))
+    return _maybe_shard(jax.vmap(fin), mesh, 1)
 
 
 class ContinuousSweepDriver:
@@ -105,6 +280,9 @@ class ContinuousSweepDriver:
         batch: int = 256,
         seg_steps: int = 32,
         key_fn: Optional[Callable] = None,
+        impl: str = "xla",
+        mesh=None,
+        block_lanes: int = 128,
     ):
         from .encoding import lower_program, stack_programs
 
@@ -113,6 +291,11 @@ class ContinuousSweepDriver:
         self.program_gen = program_gen
         self.batch = batch
         self.seg_steps = seg_steps
+        if mesh is not None and batch % mesh.size:
+            raise ValueError(
+                f"continuous batch {batch} must be a multiple of the mesh "
+                f"size {mesh.size}"
+            )
         # key_fn(seed) -> PRNGKey; default matches the plain explore
         # kernel driven with PRNGKey(seed). SweepDriver passes its
         # fold_in(base_key, seed) scheme for cross-mode parity.
@@ -121,10 +304,18 @@ class ContinuousSweepDriver:
             app, cfg, program_gen(seed)
         )
         self._stack = stack_programs
-        self.segment = make_segment_kernel(app, cfg, seg_steps)
-        self.init = make_init_kernel(app, cfg)
-        self.refill = make_refill_kernel(app, cfg)
-        self.finalize = make_finalize_kernel(app, cfg)
+        if impl == "pallas":
+            self.segment = make_segment_kernel_pallas(
+                app, cfg, seg_steps, block_lanes=block_lanes, mesh=mesh
+            )
+        elif impl == "xla":
+            self.segment = make_segment_kernel(app, cfg, seg_steps, mesh=mesh)
+        else:
+            raise ValueError(f"impl must be 'xla' or 'pallas', got {impl!r}")
+        self.mesh = mesh
+        self.init = make_init_kernel(app, cfg, mesh=mesh)
+        self.refill = make_refill_kernel(app, cfg, mesh=mesh)
+        self.finalize = make_finalize_kernel(app, cfg, mesh=mesh)
         # Occupancy accounting for the last _run: lane-steps spent with a
         # live (unfinished, unparked) lane vs total lane-steps scanned —
         # the number the compaction exists to maximize. A fixed sweep
@@ -163,21 +354,26 @@ class ContinuousSweepDriver:
 
     def _run(self, total_lanes: int):
         b = min(self.batch, total_lanes)
-        next_seed = 0
+        if self.mesh is not None:
+            # Lane-sharded kernels need a mesh-multiple batch; surplus
+            # lanes start inert (never yielded, never refilled).
+            align = self.mesh.size
+            b = max(align, ((b + align - 1) // align) * align)
         live_lane_steps = 0
         total_lane_steps = 0
 
         def keys_for(seeds):
             return jnp.stack([self.key_fn(s) for s in seeds])
 
+        n_live = min(b, total_lanes)
         lane_seed = list(range(b))
-        next_seed = b
+        next_seed = n_live
         progs_host: List = [self._lower(s) for s in lane_seed]
         progs = self._stack(progs_host)
         state = self.init(keys_for(lane_seed))
         steps_run = np.zeros(b, np.int64)
         done_count = 0
-        active = np.ones(b, bool)
+        active = np.arange(b) < n_live
 
         while done_count < total_lanes:
             total_lane_steps += b * self.seg_steps

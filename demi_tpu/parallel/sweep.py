@@ -212,30 +212,23 @@ class SweepDriver:
         sequentially; in a jax.distributed deployment each process runs its
         own slice_index's chunks).
 
-        ``mode``: 'continuous' (default for single-slice, non-mesh sweeps)
-        harvests+refills finished lanes at short segment boundaries, so a
-        fixed sweep never pays max_steps for its short lanes (TPU-first
-        lane compaction; per-seed verdicts bit-identical to 'chunked' —
-        tests/test_continuous.py). 'chunked' launches fixed whole-batch
-        kernels; mesh-sharded and multi-slice sweeps always use it."""
+        ``mode``: 'continuous' (the default for single-slice sweeps, mesh
+        or not, XLA or pallas) harvests+refills finished lanes at short
+        segment boundaries, so a fixed sweep never pays max_steps for its
+        short lanes (TPU-first lane compaction; per-seed verdicts
+        bit-identical to 'chunked' — tests/test_continuous.py). Under a
+        mesh the segment/refill kernels run lane-sharded (pallas: the
+        VMEM-blocked segment inside shard_map); only O(batch) status
+        vectors reach the host between segments. 'chunked' launches fixed
+        whole-batch kernels; multi-slice sweeps always use it (slices
+        partition the seed space — see module docstring)."""
         if mode is None:
-            # Continuous kernels are built from the XLA step function;
-            # a pallas-backend driver must keep launching its own kernel.
-            mode = (
-                "continuous"
-                if self.mesh is None and num_slices == 1 and self.impl == "xla"
-                else "chunked"
-            )
+            mode = "continuous" if num_slices == 1 else "chunked"
         if mode == "continuous":
-            if self.mesh is not None or num_slices != 1:
+            if num_slices != 1:
                 raise ValueError(
-                    "continuous sweeps are single-slice, non-mesh only"
-                )
-            if self.impl != "xla":
-                raise ValueError(
-                    "continuous sweeps run the XLA step function; "
-                    f"impl={self.impl!r} has no segment kernel — use "
-                    "mode='chunked'"
+                    "continuous sweeps are single-slice (slices partition "
+                    "the seed space; use mode='chunked')"
                 )
             return self._sweep_continuous(
                 total_lanes, chunk_size, stop_on_violation
@@ -258,6 +251,11 @@ class SweepDriver:
     def _continuous_driver(self, batch: int, base_key: int = 0):
         from ..device.continuous import ContinuousSweepDriver
 
+        if self.mesh is not None:
+            # Lane-shard the refill path too: round the batch up to a
+            # mesh multiple (refill keeps every lane busy, so padding
+            # costs nothing once the seed stream is longer than a batch).
+            batch = ((batch + self._align - 1) // self._align) * self._align
         key = (batch, base_key)
         if getattr(self, "_cont_cache", None) and self._cont_cache[0] == key:
             return self._cont_cache[1]
@@ -265,6 +263,8 @@ class SweepDriver:
         drv = ContinuousSweepDriver(
             self.app, self.cfg, self.program_gen, batch=batch,
             seg_steps=seg,
+            impl=self.impl,
+            mesh=self.mesh,
             # Same per-seed key scheme as run_chunk => identical verdicts.
             key_fn=lambda s: jax.random.fold_in(
                 jax.random.PRNGKey(base_key), np.uint32(s)
