@@ -16,6 +16,7 @@ and refill replaces whole lanes atomically (tests/test_continuous.py).
 
 from __future__ import annotations
 
+import time
 from typing import Callable, List, Optional, Sequence
 
 import numpy as np
@@ -283,6 +284,7 @@ class ContinuousSweepDriver:
         impl: str = "xla",
         mesh=None,
         block_lanes: int = 128,
+        program_key: Optional[Callable] = None,
     ):
         from .encoding import lower_program, stack_programs
 
@@ -300,9 +302,28 @@ class ContinuousSweepDriver:
         # kernel driven with PRNGKey(seed). SweepDriver passes its
         # fold_in(base_key, seed) scheme for cross-mode parity.
         self.key_fn = key_fn or jax.random.PRNGKey
-        self._lower = lambda seed: lower_program(
-            app, cfg, program_gen(seed)
-        )
+        # program_key(seed) -> hashable: callers whose generator is
+        # periodic in seed (config-5 style sweeps) pass the period key so
+        # refill skips re-lowering — at 1e5+ lanes host-side lowering
+        # otherwise dominates the harvest path. The RNG stream still uses
+        # the raw seed, so equal programs keep distinct schedules.
+        if program_key is None:
+            self._lower = lambda seed: lower_program(
+                app, cfg, program_gen(seed)
+            )
+        else:
+            memo: dict = {}
+
+            def _lower_memo(seed):
+                k = program_key(seed)
+                prog = memo.get(k)
+                if prog is None:
+                    prog = memo[k] = lower_program(
+                        app, cfg, program_gen(seed)
+                    )
+                return prog
+
+            self._lower = _lower_memo
         self._stack = stack_programs
         if impl == "pallas":
             self.segment = make_segment_kernel_pallas(
@@ -324,6 +345,12 @@ class ContinuousSweepDriver:
         self.last_occupancy: Optional[float] = None
         self.last_total_lane_steps: int = 0
         self.last_live_lane_steps: int = 0
+        # Wall-clock attribution for the last _run: device-segment time
+        # (dispatch + the status sync) vs everything else (harvest,
+        # program lowering, refill) — the scale-rehearsal metric for how
+        # much the host-side refill path costs.
+        self.last_segment_seconds: float = 0.0
+        self.last_harvest_seconds: float = 0.0
 
     def time_to_first_violation(self, max_lanes: int = 1_000_000):
         """Wall-clock seconds until the first violating lane finishes (the
@@ -375,21 +402,29 @@ class ContinuousSweepDriver:
         done_count = 0
         active = np.arange(b) < n_live
 
+        self.last_segment_seconds = 0.0
+        self.last_harvest_seconds = 0.0
         while done_count < total_lanes:
             total_lane_steps += b * self.seg_steps
             live_lane_steps += int(active.sum()) * self.seg_steps
             self.last_occupancy = live_lane_steps / total_lane_steps
             self.last_total_lane_steps = total_lane_steps
             self.last_live_lane_steps = live_lane_steps
+            t_seg = time.perf_counter()
             state = self.segment(
                 state, progs, jnp.asarray(steps_run, jnp.int32)
             )
+            # The status pull is the sync point: everything up to it is
+            # device-segment time, the rest of the iteration is harvest.
+            _status_sync = np.asarray(state.status)
+            t_harvest = time.perf_counter()
+            self.last_segment_seconds += t_harvest - t_seg
             steps_run = np.minimum(
                 steps_run + self.seg_steps, self.cfg.max_steps
             )
             # Budget exhaustion: force-finalize overdue live lanes (the
             # plain kernel's run-out-of-steps semantics).
-            status = np.asarray(state.status)
+            status = _status_sync
             overdue = (
                 active & (status < ST_DONE) & (steps_run >= self.cfg.max_steps)
             )
@@ -398,41 +433,51 @@ class ContinuousSweepDriver:
                 state = self.refill(state, jnp.asarray(overdue), finalized)
                 status = np.asarray(state.status)
             finished = active & (status >= ST_DONE)
-            if not finished.any():
-                continue
-            vio = np.asarray(state.violation)
-            sh = np.asarray(state.sched_hash)
-            for lane in np.flatnonzero(finished):
-                yield (
-                    lane_seed[lane], int(status[lane]), int(vio[lane]),
-                    int(sh[lane]),
+            out = []
+            if finished.any():
+                vio = np.asarray(state.violation)
+                sh = np.asarray(state.sched_hash)
+                for lane in np.flatnonzero(finished):
+                    out.append(
+                        (
+                            lane_seed[lane], int(status[lane]),
+                            int(vio[lane]), int(sh[lane]),
+                        )
+                    )
+                    done_count += 1
+                # Refill finished lanes with fresh seeds (or park them).
+                refill_lanes = set(
+                    int(x) for x in np.flatnonzero(finished)[
+                        : max(0, total_lanes - next_seed)
+                    ]
                 )
-                done_count += 1
-            # Refill finished lanes with fresh seeds (or park them).
-            refill_lanes = [
-                int(x) for x in np.flatnonzero(finished)
-            ][: max(0, total_lanes - next_seed)]
-            for lane in np.flatnonzero(finished):
-                active[lane] = False
-            if refill_lanes:
-                fresh_seeds = list(
-                    range(next_seed, next_seed + len(refill_lanes))
-                )
-                next_seed += len(refill_lanes)
-                mask = np.zeros(b, bool)
-                full_seeds = []
-                k = 0
-                for lane in range(b):
-                    if lane in refill_lanes and k < len(fresh_seeds):
-                        mask[lane] = True
-                        lane_seed[lane] = fresh_seeds[k]
-                        progs_host[lane] = self._lower(fresh_seeds[k])
-                        full_seeds.append(fresh_seeds[k])
-                        active[lane] = True
-                        steps_run[lane] = 0
-                        k += 1
-                    else:
-                        full_seeds.append(lane_seed[lane])
-                progs = self._stack(progs_host)
-                fresh = self.init(keys_for(full_seeds))
-                state = self.refill(state, jnp.asarray(mask), fresh)
+                for lane in np.flatnonzero(finished):
+                    active[lane] = False
+                if refill_lanes:
+                    fresh_seeds = list(
+                        range(next_seed, next_seed + len(refill_lanes))
+                    )
+                    next_seed += len(refill_lanes)
+                    mask = np.zeros(b, bool)
+                    full_seeds = []
+                    k = 0
+                    for lane in range(b):
+                        if lane in refill_lanes and k < len(fresh_seeds):
+                            mask[lane] = True
+                            lane_seed[lane] = fresh_seeds[k]
+                            progs_host[lane] = self._lower(fresh_seeds[k])
+                            full_seeds.append(fresh_seeds[k])
+                            active[lane] = True
+                            steps_run[lane] = 0
+                            k += 1
+                        else:
+                            full_seeds.append(lane_seed[lane])
+                    progs = self._stack(progs_host)
+                    fresh = self.init(keys_for(full_seeds))
+                    state = self.refill(state, jnp.asarray(mask), fresh)
+            # Yield after the timing stop so caller time (a generator
+            # consumer may do arbitrary work per item) never counts as
+            # harvest overhead.
+            self.last_harvest_seconds += time.perf_counter() - t_harvest
+            for item in out:
+                yield item

@@ -150,15 +150,11 @@ class SweepDriver:
             progs.append(prog)
         return stack_programs(progs)
 
-    def run_chunk(
-        self, seeds: Sequence[int], slice_index: int = 0, base_key: int = 0
-    ) -> SweepChunkResult:
-        """One slice-sized chunk: lanes = len(seeds). When mesh-sharded the
-        batch is padded up to a multiple of the mesh axis by repeating
-        seeds; padded lanes are excluded from every reported count."""
+    def _dispatch_chunk(self, seeds: Sequence[int], base_key: int = 0):
+        """Launch one chunk's kernel WITHOUT blocking (jax async
+        dispatch); pair with ``_harvest_chunk``."""
         real = list(seeds)
         assert real, "empty chunk"
-        n_real = len(real)
         padded = list(real)
         while len(padded) % self._align:
             padded.extend(real[: self._align - (len(padded) % self._align)])
@@ -168,6 +164,21 @@ class SweepDriver:
         )(np.asarray(padded, np.uint32))
         t0 = time.perf_counter()
         res = self.kernel(progs, keys)
+        return real, res, t0
+
+    def run_chunk(
+        self, seeds: Sequence[int], slice_index: int = 0, base_key: int = 0
+    ) -> SweepChunkResult:
+        """One slice-sized chunk: lanes = len(seeds). When mesh-sharded the
+        batch is padded up to a multiple of the mesh axis by repeating
+        seeds; padded lanes are excluded from every reported count."""
+        return self._harvest_chunk(
+            self._dispatch_chunk(seeds, base_key), slice_index
+        )
+
+    def _harvest_chunk(self, handle, slice_index: int = 0) -> SweepChunkResult:
+        real, res, t0 = handle
+        n_real = len(real)
         jax.block_until_ready(res)
         seconds = time.perf_counter() - t0
         violations = np.asarray(res.violation)[:n_real]
@@ -311,6 +322,32 @@ class SweepDriver:
         result = SweepResult(chunks=[chunk])
         result.occupancy = drv.last_occupancy
         return result
+
+    def sweep_async(
+        self, total_lanes: int, chunk_size: int, base_key: int = 0
+    ):
+        """Non-blocking explore (reference: RandomScheduler
+        .nonBlockingExplore, RandomScheduler.scala:184-211): a generator
+        yielding one SweepChunkResult per chunk while the NEXT chunk's
+        kernel is already in flight (double-buffered jax async dispatch).
+        The caller overlaps its own work — harvesting violations,
+        launching minimization — with device execution, and ends the
+        sweep early by just closing the generator (the reference's analog
+        returns a future the caller completes). Per-chunk ``seconds``
+        spans dispatch→harvest and therefore overlaps between chunks."""
+        seed = 0
+        pending = None  # (handle, slice_index)
+        chunk_idx = 0
+        while seed < total_lanes:
+            n = min(chunk_size, total_lanes - seed)
+            handle = self._dispatch_chunk(range(seed, seed + n), base_key)
+            seed += n
+            if pending is not None:
+                yield self._harvest_chunk(*pending)
+            pending = (handle, chunk_idx)
+            chunk_idx += 1
+        if pending is not None:
+            yield self._harvest_chunk(*pending)
 
     def time_to_first_violation(
         self, chunk_size: int, max_lanes: int = 1_000_000

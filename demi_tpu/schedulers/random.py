@@ -283,6 +283,24 @@ class RandomScheduler(BaseScheduler):
                 return result
         return None
 
+    def non_blocking_explore(
+        self,
+        externals: Sequence[ExternalEvent],
+        max_executions: int = 100,
+    ):
+        """Non-blocking form of ``explore`` (reference:
+        RandomScheduler.nonBlockingExplore, RandomScheduler.scala:184-211
+        — there a daemon runs exploration and hands the result to a
+        callback; the Python-idiomatic analog is a generator the caller
+        drains at its own pace). Yields every ExecutionResult as it
+        completes — violating or not — so the caller can interleave its
+        own work, harvest multiple violations, or stop early by closing
+        the generator. The device-tier twin is
+        parallel.sweep.SweepDriver.sweep_async."""
+        for _ in range(max_executions):
+            self.seed = self.rng.randrange(2**63)
+            yield self.execute(externals)
+
     # -- TestOracle interface (reference: RandomScheduler.test,
     # RandomScheduler.scala:45; used by randomDDMin) ----------------------
     def test(

@@ -103,6 +103,36 @@ def main(argv=None):
                         "impl": tag, "batch": batch, "block_lanes": bl,
                         "error": repr(e)[:300],
                     }), flush=True)
+    # Early-exit loop variant, trailing layout only (the known-best
+    # layout): while_loop tracks the slowest LIVE lane instead of paying
+    # max_steps — measured ~+10-15% on CPU for this workload (lanes
+    # quiesce at ~120/144); the TPU verdict is what this cell is for.
+    ee_cfg = DeviceConfig.for_app(
+        app, pool_capacity=96, max_steps=144, max_external_ops=24,
+        invariant_interval=1, timer_weight=0.2, msg_dtype=args.msg_dtype,
+        early_exit=True,
+    )
+    for batch in batches:
+        for tag, build in (
+            ("xla-trailing-ee",
+             lambda: make_explore_kernel(app, ee_cfg, lane_axis="trailing")),
+            ("pallas-trailing-ee",
+             lambda: make_explore_kernel_pallas(
+                 app, ee_cfg, block_lanes=blocks[len(blocks) // 2],
+                 lane_axis="trailing",
+             )),
+        ):
+            try:
+                sps, comp = measure(build(), batch)
+                print(json.dumps({
+                    "impl": tag, "platform": platform, "batch": batch,
+                    "schedules_per_sec": round(sps, 1),
+                    "compile_s": round(comp, 1),
+                }), flush=True)
+            except Exception as e:
+                print(json.dumps({
+                    "impl": tag, "batch": batch, "error": repr(e)[:300],
+                }), flush=True)
 
 
 if __name__ == "__main__":

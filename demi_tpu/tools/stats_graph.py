@@ -1,11 +1,12 @@
 """Minimization-progress graphs from minimization_stats.json.
 
 Reference: src/main/python/minimization_stats/{generate_graph.py,
-combine_graphs.py} — gnuplot charts of iteration → #events. Here: CSV for
-any plotting tool plus an inline ASCII chart (no plotting deps in the
-image).
+combine_graphs.py} — gnuplot charts of iteration → #events. Here: CSV
+for any plotting tool, an inline ASCII chart, and a rendered PNG/SVG
+(``--render``; matplotlib, headless Agg backend — skipped gracefully if
+matplotlib is absent).
 
-    python -m demi_tpu.tools.stats_graph experiment_dir/
+    python -m demi_tpu.tools.stats_graph experiment_dir/ [--render [out.png]]
 """
 
 from __future__ import annotations
@@ -47,10 +48,70 @@ def ascii_chart(stats: MinimizationStats, width: int = 60) -> str:
     return "\n".join(out) + "\n"
 
 
+def render(stats: MinimizationStats, out_path: str) -> str:
+    """Rendered progress plot (reference: generate_graph.py's gnuplot
+    output): externals remaining vs replay #, one step-line per stage,
+    stage boundaries marked. Returns the written path; raises
+    ImportError when matplotlib is unavailable."""
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    rows = progression(stats)
+    fig, ax = plt.subplots(figsize=(8, 4.5))
+    if rows:
+        stages: List[str] = []
+        for stage, _, _ in rows:
+            if not stages or stages[-1] != stage:
+                stages.append(stage)
+        colors = plt.cm.tab10.colors
+        seen_at = 0
+        for si, stage in enumerate(stages):
+            # rows are stage-ordered; take this stage's contiguous run.
+            consumed = 0
+            for s, _, _ in rows[seen_at:]:
+                if s != stage:
+                    break
+                consumed += 1
+            seg = [(r, sz) for _, r, sz in rows[seen_at : seen_at + consumed]]
+            seen_at += consumed
+            xs = [r for r, _ in seg]
+            ys = [sz for _, sz in seg]
+            ax.step(
+                xs, ys, where="post",
+                color=colors[si % len(colors)], label=stage, linewidth=2,
+            )
+            if si:
+                ax.axvline(xs[0], color="0.85", linewidth=1, zorder=0)
+        ax.legend(fontsize=8)
+    ax.set_xlabel("replay #")
+    ax.set_ylabel("external events remaining")
+    ax.set_title("minimization progress")
+    ax.grid(True, alpha=0.3)
+    fig.tight_layout()
+    fig.savefig(out_path, dpi=120)
+    plt.close(fig)
+    return out_path
+
+
 def main(argv=None) -> int:
-    args = argv if argv is not None else sys.argv[1:]
+    args = list(argv if argv is not None else sys.argv[1:])
+    do_render = False
+    render_path = None
+    if "--render" in args:
+        i = args.index("--render")
+        args.pop(i)
+        do_render = True
+        if i < len(args) and not args[i].startswith("-") and args[i].endswith(
+            (".png", ".svg", ".pdf")
+        ):
+            render_path = args.pop(i)
     if not args:
-        print("usage: stats_graph <experiment-dir-or-stats.json>")
+        print(
+            "usage: stats_graph <experiment-dir-or-stats.json> "
+            "[--render [out.png]]"
+        )
         return 2
     path = args[0]
     if os.path.isdir(path):
@@ -62,6 +123,12 @@ def main(argv=None) -> int:
         f.write(to_csv(stats))
     print(ascii_chart(stats), end="")
     print(f"csv written to {csv_path}")
+    if do_render:
+        out = render_path or os.path.splitext(path)[0] + ".png"
+        try:
+            print(f"plot written to {render(stats, out)}")
+        except ImportError:
+            print("matplotlib unavailable; skipped --render")
     return 0
 
 

@@ -246,3 +246,51 @@ def test_sweep_driver_continuous_under_mesh_and_pallas():
         assert cont_p.codes == chunked.codes
     finally:
         del os.environ["DEMI_DEVICE_IMPL"]
+
+
+def test_sweep_async_non_blocking_explore():
+    """Device-tier nonBlockingExplore analog: chunk results stream while
+    the next chunk's kernel is in flight; totals match the blocking sweep,
+    and closing the generator ends the sweep early."""
+    from demi_tpu.parallel.sweep import SweepDriver
+
+    app, cfg, gen = _broadcast_fixture()
+    driver = SweepDriver(app, cfg, gen)
+    chunks = list(driver.sweep_async(24, 8))
+    assert [c.lanes for c in chunks] == [8, 8, 8]
+    blocking = driver.sweep(24, 8, mode="chunked")
+    assert sum(c.violations for c in chunks) == blocking.violations
+    # Early stop: draining only the first chunk is legal.
+    it = driver.sweep_async(24, 8)
+    first = next(it)
+    it.close()
+    assert first.lanes == 8
+
+
+def test_host_non_blocking_explore():
+    from demi_tpu.apps.common import dsl_start_events, make_host_invariant
+    from demi_tpu.config import SchedulerConfig
+    from demi_tpu.external_events import MessageConstructor, Send, WaitQuiescence
+    from demi_tpu.schedulers import RandomScheduler
+
+    app = make_broadcast_app(4, reliable=False)
+    # Two nodes get the broadcast externally, two never do: with
+    # per-delivery invariant checks, EVERY schedule's first delivery
+    # creates disagreement — so the stream must yield a violating result
+    # on its very first execution (deterministic early stop).
+    program = dsl_start_events(app) + [
+        Send(app.actor_name(0), MessageConstructor(lambda: (1, 0))),
+        Send(app.actor_name(1), MessageConstructor(lambda: (1, 0))),
+        WaitQuiescence(),
+    ]
+    config = SchedulerConfig(invariant_check=make_host_invariant(app))
+    sched = RandomScheduler(config, seed=0, invariant_check_interval=1)
+    seen = 0
+    found = None
+    for result in sched.non_blocking_explore(program, max_executions=50):
+        seen += 1
+        if result.violation is not None:
+            found = result
+            break  # early stop mid-stream
+    assert found is not None and found.violation.code == 1
+    assert seen == 1  # first execution already violates; stream stopped

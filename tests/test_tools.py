@@ -80,6 +80,26 @@ def test_stats_graph_tool(tmp_path, capsys):
     assert os.path.exists(str(tmp_path / "minimization_stats.csv"))
 
 
+def test_stats_graph_rendered_plot(tmp_path, capsys):
+    """--render writes a real plotted artifact (reference:
+    minimization_stats/generate_graph.py's gnuplot charts)."""
+    pytest.importorskip("matplotlib")
+    stats = MinimizationStats()
+    stats.update_strategy("DDMin", "STS")
+    for size in [10, 7, 5, 3]:
+        stats.record_replay()
+        stats.record_iteration_size(size)
+    stats.update_strategy("IntMin", "STS")
+    stats.record_replay()
+    stats.record_iteration_size(2)
+    path = tmp_path / "minimization_stats.json"
+    path.write_text(stats.to_json())
+    out_png = tmp_path / "progress.png"
+    assert stats_main([str(path), "--render", str(out_png)]) == 0
+    assert "plot written" in capsys.readouterr().out
+    assert out_png.exists() and out_png.stat().st_size > 1000  # real PNG
+
+
 def test_dot_export():
     """DOT export: delivery chain + happens-before forest (reference:
     schedulers/Util.scala getDot:580-618)."""
