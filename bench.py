@@ -252,8 +252,16 @@ def bench_time_to_first_violation(jax):
     # Warm-up: compile the continuous-sweep kernels outside the timed
     # window (sweep() defaults to lane-compacted continuous mode).
     driver.sweep(chunk, chunk)
-    secs, result = driver.time_to_first_violation(chunk_size=chunk)
-    return secs
+    # The sweep itself is deterministic after warm-up, so reps measure
+    # pure timing noise; report the median (r3 runs drifted 0.1-0.5s on
+    # CPU for the same work — VERDICT r3 weak #7).
+    times = []
+    for _ in range(3):
+        secs, result = driver.time_to_first_violation(chunk_size=chunk)
+        if secs is None:
+            return None
+        times.append(secs)
+    return sorted(times)[1]
 
 
 def bench_config4(jax):
