@@ -277,8 +277,11 @@ class SweepDriver:
             impl=self.impl,
             mesh=self.mesh,
             # Same per-seed key scheme as run_chunk => identical verdicts.
+            # No np.uint32() wrapper: the seed must stay traceable so the
+            # continuous driver's vectorized key derivation applies
+            # (fold_in canonicalizes to uint32 itself).
             key_fn=lambda s: jax.random.fold_in(
-                jax.random.PRNGKey(base_key), np.uint32(s)
+                jax.random.PRNGKey(base_key), s
             ),
         )
         self._cont_cache = (key, drv)
