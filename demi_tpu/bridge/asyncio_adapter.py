@@ -100,6 +100,12 @@ class _TimerHandle:
     def when(self) -> float:
         return self._node.loop._now
 
+    def __deepcopy__(self, memo):
+        # Adapter plumbing is identity-shared across snapshots (see
+        # _Node.snapshot); cancellation keys by message, so a shared
+        # handle stays correct after restore.
+        return self
+
 
 class _Transport:
     """Duck-types asyncio.DatagramTransport: sendto becomes a captured
@@ -125,6 +131,11 @@ class _Transport:
         if name == "sockname":
             return self._node.spec.addr
         return default
+
+    def __deepcopy__(self, memo):
+        # Identity-shared across snapshots; restore() re-wires a fresh
+        # transport onto the restored protocol.
+        return self
 
 
 class _Loop:
@@ -201,6 +212,7 @@ class _Node:
         self.armed: Dict[tuple, Tuple[Callable, tuple, float]] = {}
         self.arm_counts: Dict[str, int] = {}
         self.effects = _Effects()
+        self._snapshots: Dict[int, tuple] = {}
 
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> None:
@@ -276,6 +288,37 @@ class _Node:
             state[key] = value
         return state
 
+    # -- snapshot/restore (STS peek support) --------------------------------
+    def snapshot(self) -> int:
+        """Opaque in-process rollback token: a deep copy of the protocol
+        instance plus the armed-timer table, taken in ONE deepcopy so
+        timer callbacks bound to the protocol stay bound to the copy.
+        Kept app-side (the token crossing the wire is just an index)
+        because callbacks can't serialize — the same reason the reference
+        needs app-supplied checkpoint/restore callbacks for its
+        snapshots. Adapter plumbing (_Transport, _TimerHandle) is
+        identity-shared across copies; only app state forks."""
+        import copy
+
+        token = len(self._snapshots)
+        self._snapshots[token] = copy.deepcopy(
+            (self.protocol, dict(self.armed), dict(self.arm_counts))
+        )
+        return token
+
+    def restore(self, token: int) -> None:
+        import copy
+
+        # Deepcopy AGAIN so the stored snapshot stays pristine if this
+        # state gets mutated and re-restored (peek may roll back twice).
+        proto, armed, counts = copy.deepcopy(self._snapshots[token])
+        self.protocol = proto
+        self.armed = armed
+        self.arm_counts = counts
+        self.transport = _Transport(self)
+        if hasattr(self.protocol, "transport"):
+            self.protocol.transport = self.transport
+
 
 class AsyncioAdapter:
     """Hosts the nodes and speaks the bridge protocol on (recv, send)
@@ -314,7 +357,11 @@ class AsyncioAdapter:
         return node.effects.as_reply()
 
     def serve(self, recv, send) -> None:
-        send({"op": "register", "actors": list(self.nodes)})
+        send({
+            "op": "register",
+            "actors": list(self.nodes),
+            "features": ["snapshot"],
+        })
         while True:
             cmd = recv()
             if cmd is None or cmd.get("op") == "shutdown":
@@ -328,6 +375,11 @@ class AsyncioAdapter:
                 send(self._run(node, lambda: node.deliver(src, msg)))
             elif op == "checkpoint":
                 send({"op": "state", "state": node.checkpoint()})
+            elif op == "snapshot":
+                send({"op": "state", "state": node.snapshot()})
+            elif op == "restore":
+                node.restore(cmd["state"])
+                send({"op": "effects"})
             elif op == "stop":
                 node.stop()  # no reply
             else:

@@ -73,7 +73,13 @@ class App:
 
 def serve(recv, send, bug: bool) -> None:
     app = App(bug)
-    send({"op": "register", "actors": ["client", "server", "monitor"]})
+    send({
+        "op": "register",
+        "actors": ["client", "server", "monitor"],
+        # Snapshot/restore implemented below -> STS peek works over this
+        # app (tokens are the JSON state itself; stateless handlers).
+        "features": ["snapshot"],
+    })
     while True:
         cmd = recv()
         if cmd is None or cmd.get("op") == "shutdown":
@@ -86,6 +92,11 @@ def serve(recv, send, bug: bool) -> None:
             send(app.handle(cmd["actor"], cmd["src"], cmd["msg"]))
         elif op == "checkpoint":
             send({"op": "state", "state": app.state[cmd["actor"]]})
+        elif op == "snapshot":
+            send({"op": "state", "state": dict(app.state[cmd["actor"]])})
+        elif op == "restore":
+            app.state[cmd["actor"]] = dict(cmd["state"])
+            send({"op": "effects"})
         elif op == "stop":
             app.state.pop(cmd["actor"], None)  # no reply
         else:

@@ -4,12 +4,16 @@ Protocol (line-delimited JSON; framework -> app on stdin, app -> framework
 on stdout, or over a localhost TCP socket):
 
   app -> framework, once at boot:
-    {"op": "register", "actors": ["name", ...]}
+    {"op": "register", "actors": ["name", ...], "features": ["snapshot"]?}
 
   framework -> app commands (each answered by exactly one "effects"):
     {"op": "start",   "actor": a}                  actor (re)starts, resets
     {"op": "deliver", "actor": a, "src": s, "msg": m}
     {"op": "checkpoint", "actor": a}               -> {"op":"state", ...}
+    {"op": "snapshot", "actor": a}                 -> {"op":"state", ...}
+                                                   opaque rollback token
+                                                   (feature "snapshot")
+    {"op": "restore", "actor": a, "state": S}      roll back to token S
     {"op": "stop",    "actor": a}                  HardKill (no reply)
     {"op": "shutdown"}                             process exits (no reply)
 
@@ -34,10 +38,14 @@ with no special cases — fuzz -> minimize -> replay works end to end.
 Replay determinism is the app's contract: same delivery sequence, same
 effects (the same contract the reference imposes on Akka apps).
 
-Limitations (documented, matching PARITY.md): no STS peek/system-snapshot
-over bridge actors (external state can't be deep-copied — the reference
-needs app-supplied checkpoint/restore callbacks for the same reason), and
-one process per BridgeSession.
+STS peek / system snapshots over bridge actors require the app to opt in
+with the "snapshot" feature (external state can't be deep-copied; the
+reference needs app-supplied checkpoint/restore callbacks for the same
+reason — Instrumenter.scala:63-75's checkpointer). A snapshot-capable
+BridgeActor deep-copies as a proxy holding the app's opaque rollback
+token; ControlledActorSystem.restore then calls ``post_restore`` to push
+the token back over the wire. Apps without the feature raise a clear
+HarnessError when a snapshot is attempted. One process per BridgeSession.
 """
 
 from __future__ import annotations
@@ -186,6 +194,7 @@ class BridgeSession:
             if hello.get("op") != "register":
                 raise BridgeDown(f"expected register, got {hello!r}")
             self.actor_names: List[str] = list(hello["actors"])
+            self.features = frozenset(hello.get("features") or ())
         except BaseException:
             # Don't leak the child on a failed handshake.
             self.transport.close()
@@ -230,6 +239,44 @@ class BridgeActor(Actor):
         self.session = session
         self.name = name
         self._blocked = False
+        # Opaque app-side rollback token, set only on checkpoint clones
+        # (see __deepcopy__); live actors keep it None.
+        self._snapshot = None
+
+    def __deepcopy__(self, memo):
+        """System-snapshot support (STS peek): external state can't be
+        deep-copied, so the clone is a proxy holding the app's opaque
+        rollback token, fetched over the wire (feature "snapshot")."""
+        if "snapshot" not in self.session.features:
+            raise HarnessError(
+                f"bridge app hosting {self.name!r} does not support system "
+                "snapshots (STS peek): register with features=['snapshot'] "
+                "and implement the snapshot/restore ops"
+            )
+        clone = BridgeActor(self.session, self.name)
+        clone._blocked = self._blocked
+        if self._snapshot is not None:
+            # Copy of a checkpoint clone (e.g. ControlledActorSystem
+            # .restore deep-copies the snap to keep it reusable): carry
+            # the SAME token — re-fetching would capture live state.
+            import copy as _copy
+
+            clone._snapshot = _copy.deepcopy(self._snapshot)
+        else:
+            reply = self.session.command(
+                {"op": "snapshot", "actor": self.name}
+            )
+            clone._snapshot = reply.get("state")
+        return clone
+
+    def post_restore(self) -> None:
+        """ControlledActorSystem.restore hook: push the rollback token
+        back to the external process, then become a live actor."""
+        if self._snapshot is not None:
+            self.session.command(
+                {"op": "restore", "actor": self.name, "state": self._snapshot}
+            )
+            self._snapshot = None
 
     def on_start(self, ctx) -> None:
         effects = self.session.command({"op": "start", "actor": self.name})

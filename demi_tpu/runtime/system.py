@@ -355,3 +355,9 @@ class ControlledActorSystem:
         self.blocked_asks = blocked
         self.pending_asks = asks
         self.id_gen.restore(idstate)
+        # Actors whose state lives outside this process (bridge proxies)
+        # roll their external side back now (BridgeActor.post_restore).
+        for actor in self.actors.values():
+            hook = getattr(actor, "post_restore", None)
+            if hook is not None:
+                hook()

@@ -201,3 +201,49 @@ def test_udp_lock_phantom_grant_found_minimized_replayed():
         replayed = ReplayScheduler(config).replay(found.trace, program)
         assert replayed.violation is not None
         assert replayed.violation.matches(found.violation)
+
+
+def test_adapter_snapshot_restore_roundtrip():
+    """Adapter-side rollback tokens: protocol state AND armed timers roll
+    back together, with timer callbacks re-bound to the restored protocol
+    instance (shared-memo deepcopy)."""
+    ad = _adapter()
+    alice = ad.nodes["alice"]
+    ad._run(alice, alice.start)
+    ad._run(alice, lambda: alice.deliver("ext", ("__udp__", "go")))
+    retry_msg = (TIMER_TAG, "LockClient._send_acquire", 0)
+    assert tuple(retry_msg) in alice.armed
+    token = alice.snapshot()
+
+    ad._run(alice, lambda: alice.deliver("server", ("__udp__", "grant")))
+    assert alice.protocol.held is True
+    assert tuple(retry_msg) not in alice.armed  # grant cancelled it
+
+    alice.restore(token)
+    assert alice.protocol.held is False and alice.protocol.wants is True
+    assert tuple(retry_msg) in alice.armed
+    # The restored retry timer fires against the RESTORED protocol.
+    reply = ad._run(alice, lambda: alice.deliver("alice", retry_msg))
+    assert reply["sends"] == [{"dst": "server", "msg": ["__udp__", "acquire"]}]
+
+
+def test_adapter_end_to_end_system_snapshot():
+    """Whole-system checkpoint/restore over the spawned adapter process —
+    the same machinery STS peek uses."""
+    from demi_tpu.runtime.system import ControlledActorSystem
+
+    with BridgeSession(LAUNCHER, env=ENV) as session:
+        assert "snapshot" in session.features
+        system = ControlledActorSystem()
+        for name in ("server", "alice", "bob"):
+            system.spawn(name, session.actor_factory(name))
+        entries = system.deliver(system.inject("alice", udp_send("go")))
+        assert system.actor("alice").checkpoint_state()["wants"] is True
+        snap = system.checkpoint()
+        acq = [e for e in entries if e.rcv == "server"]
+        grants = system.deliver(acq[0])
+        system.deliver([e for e in grants if e.rcv == "alice"][0])
+        assert system.actor("alice").checkpoint_state()["held"] is True
+        system.restore(snap)
+        st = system.actor("alice").checkpoint_state()
+        assert st["wants"] is True and st["held"] is False
