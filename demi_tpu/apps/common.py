@@ -30,6 +30,21 @@ def _jitted_invariant(app: DSLApp):
     return fn
 
 
+def _jitted_condition(app: DSLApp, cond_id: int):
+    """Host evaluation of DSLApp.conditions[cond_id] (WaitCondition's
+    dual-tier form); cached per app like the invariant."""
+    cache = getattr(app, "_jitted_conditions", None)
+    if cache is None:
+        cache = {}
+        object.__setattr__(app, "_jitted_conditions", cache)
+    fn = cache.get(cond_id)
+    if fn is None:
+        from ..utils.hostjit import host_jit
+
+        fn = cache[cond_id] = host_jit(app.conditions[cond_id])
+    return fn
+
+
 def make_host_invariant(app: DSLApp) -> Callable:
     """Adapt the app's jitted (states, alive) -> int32 predicate to the host
     checkpoint-based invariant. Actors absent/crashed/isolated -> not alive."""

@@ -45,6 +45,9 @@ OP_WAIT = 4
 OP_PARTITION = 5
 OP_UNPARTITION = 6
 OP_HARDKILL = 7
+# Wait until app condition `a` holds (DSLApp.conditions[a]), with optional
+# delivery budget `b` — the device-lowerable WaitCondition form.
+OP_WAITCOND = 8
 
 # Record kinds.
 REC_NONE = 0
@@ -182,6 +185,10 @@ class ScheduleState(NamedTuple):
     seg_budget: jnp.ndarray  # int32, 0 = unlimited
     seg_start: jnp.ndarray  # int32: deliveries when the segment began
     final_seg: jnp.ndarray  # bool: this dispatch segment is the program's last
+    # Condition id gating this dispatch segment (-1 = plain quiescence
+    # wait): the WaitCondition twin — the segment also ends once
+    # app.conditions[seg_cond](states, alive) holds.
+    seg_cond: jnp.ndarray  # int32
     status: jnp.ndarray  # int32 (ST_*)
     violation: jnp.ndarray  # int32 fingerprint (0 = none)
     # Rolling FNV-style fold of every delivered (src, dst, timer?, payload):
@@ -224,6 +231,7 @@ def init_state(app: DSLApp, cfg: DeviceConfig, key) -> ScheduleState:
         seg_budget=jnp.int32(0),
         seg_start=jnp.int32(0),
         final_seg=jnp.bool_(False),
+        seg_cond=jnp.int32(-1),
         status=jnp.int32(ST_INJECT),
         violation=jnp.int32(0),
         sched_hash=jnp.uint32(0x811C9DC5),  # FNV-1a offset basis
@@ -654,7 +662,7 @@ def external_effects(
         rec = jnp.concatenate(parts)
     else:
         rec = jnp.zeros((0,), jnp.int32)
-    enabled = (op != OP_END) & (op != OP_WAIT)
+    enabled = (op != OP_END) & (op != OP_WAIT) & (op != OP_WAITCOND)
     return state, proposal, rec, enabled
 
 

@@ -36,6 +36,7 @@ from ..external_events import (
     Send,
     Start,
     UnPartition,
+    WaitCondition,
     WaitQuiescence,
 )
 from ..events import WildCardMatch
@@ -49,6 +50,7 @@ from .core import (
     OP_START,
     OP_UNPARTITION,
     OP_WAIT,
+    OP_WAITCOND,
     REC_DELIVERY,
     REC_EXT_BASE,
     REC_NONE,
@@ -68,8 +70,9 @@ def _msg_row(app: DSLApp, msg, width: int) -> List[int]:
 def lower_program(
     app: DSLApp, cfg: DeviceConfig, externals: Sequence[ExternalEvent]
 ) -> ExtProgram:
-    """Lower an external-event program to op arrays. WaitCondition/CodeBlock
-    are host-tier-only and rejected here."""
+    """Lower an external-event program to op arrays. WaitCondition lowers
+    via its ``cond_id`` (DSLApp.conditions); host-closure WaitCondition
+    and CodeBlock are host-tier-only and rejected here."""
     e, w = cfg.max_external_ops, cfg.msg_width
     ops = np.zeros(e, np.int32)
     a = np.zeros(e, np.int32)
@@ -90,6 +93,21 @@ def lower_program(
         elif isinstance(ev, WaitQuiescence):
             ops[i] = OP_WAIT
             a[i] = ev.budget or 0  # field a carries the bounded-wait budget
+        elif isinstance(ev, WaitCondition):
+            if ev.cond_id is None:
+                raise TypeError(
+                    "WaitCondition with a host closure is host-tier-only; "
+                    "give the app a DSLApp.conditions table and pass "
+                    "cond_id to lower it to the device tier"
+                )
+            if not (0 <= ev.cond_id < len(app.conditions)):
+                raise ValueError(
+                    f"cond_id {ev.cond_id} out of range for "
+                    f"{len(app.conditions)} app conditions"
+                )
+            ops[i] = OP_WAITCOND
+            a[i] = ev.cond_id
+            b[i] = ev.budget or 0
         elif isinstance(ev, Partition):
             ops[i], a[i], b[i] = OP_PARTITION, app.actor_id(ev.a), app.actor_id(ev.b)
         elif isinstance(ev, UnPartition):

@@ -24,6 +24,7 @@ from . import ops
 from .core import (
     OP_END,
     OP_WAIT,
+    OP_WAITCOND,
     ST_DISPATCH,
     ST_DONE,
     ST_INJECT,
@@ -32,6 +33,7 @@ from .core import (
     RowProposal,
     ScheduleState,
     _append_record,
+    alive_mask,
     check_invariant,
     delivery_effects,
     deliverable_mask,
@@ -117,28 +119,36 @@ def make_step_fn(app: DSLApp, cfg: DeviceConfig):
         )
         new_cursor = state.ext_cursor + (injecting & ~exhausted).astype(jnp.int32)
         raw_op = jnp.where(exhausted, OP_END, cur_op)
+        is_wait_like = (raw_op == OP_WAIT) | (raw_op == OP_WAITCOND)
         to_dispatch = injecting & (
-            (raw_op == OP_WAIT) | (raw_op == OP_END) | (new_cursor >= e)
+            is_wait_like | (raw_op == OP_END) | (new_cursor >= e)
         )
-        # Bounded quiescence: a WAIT op carries its budget in field `a`
-        # (0 = strict); a final drain — entered via OP_END *or* by running
-        # off the end of a full-length program — is unlimited (stale budgets
-        # must not cap it).
+        # Bounded quiescence: a WAIT op carries its budget in field `a`, a
+        # WAITCOND in field `b` (`a` is its condition id); 0 = strict. A
+        # final drain — entered via OP_END *or* by running off the end of
+        # a full-length program — is unlimited (stale budgets must not cap
+        # it).
         seg_budget = jnp.where(
             injecting,
             jnp.where(
                 raw_op == OP_WAIT,
                 ops.get_scalar(prog.a, cur, oh),
                 jnp.where(
-                    (raw_op == OP_END) | (new_cursor >= e), 0, state.seg_budget
+                    raw_op == OP_WAITCOND,
+                    ops.get_scalar(prog.b, cur, oh),
+                    jnp.where(
+                        (raw_op == OP_END) | (new_cursor >= e),
+                        0,
+                        state.seg_budget,
+                    ),
                 ),
             ),
             state.seg_budget,
         ).astype(jnp.int32)
         # Host-parity run-end semantics (reference: execution ends with the
         # segment of the LAST external event): the segment we're entering is
-        # final if this op is OP_END / past-the-end, or a WAIT with nothing
-        # but OP_END after it.
+        # final if this op is OP_END / past-the-end, or a WAIT/WAITCOND with
+        # nothing but OP_END after it.
         next_cur = jnp.clip(new_cursor, 0, e - 1)
         next_op = jnp.where(
             new_cursor >= e, OP_END, ops.get_scalar(prog.op, next_cur, oh)
@@ -146,7 +156,7 @@ def make_step_fn(app: DSLApp, cfg: DeviceConfig):
         final_seg = to_dispatch & (
             (raw_op == OP_END)
             | (new_cursor >= e)
-            | ((raw_op == OP_WAIT) & (next_op == OP_END))
+            | (is_wait_like & (next_op == OP_END))
         )
         state = state._replace(
             ext_cursor=new_cursor,
@@ -155,10 +165,37 @@ def make_step_fn(app: DSLApp, cfg: DeviceConfig):
                 to_dispatch, state.deliveries, state.seg_start
             ).astype(jnp.int32),
             final_seg=jnp.where(to_dispatch, final_seg, state.final_seg),
+            seg_cond=jnp.where(
+                to_dispatch,
+                jnp.where(
+                    raw_op == OP_WAITCOND,
+                    ops.get_scalar(prog.a, cur, oh),
+                    jnp.int32(-1),
+                ),
+                state.seg_cond,
+            ).astype(jnp.int32),
         )
 
         # ----- dispatch side (inert unless `dispatching`: idx -> P) -------
-        mask = deliverable_mask(state, cfg) & dispatching
+        # WaitCondition gating: the host checks the condition BEFORE each
+        # delivery and ends the segment without delivering once it holds;
+        # masking every candidate reproduces that exactly (the quiescence
+        # test below sees no deliverable and flips the segment).
+        if app.conditions:
+            branches = [
+                (lambda s, fn=fn: fn(s.actor_state, alive_mask(s))
+                 .astype(jnp.bool_))
+                for fn in app.conditions
+            ]
+            cid = jnp.clip(state.seg_cond, 0, len(branches) - 1)
+            cond_met = (
+                (state.seg_cond >= 0)
+                & jax.lax.switch(cid, branches, state)
+                & dispatching
+            )
+        else:
+            cond_met = jnp.bool_(False)
+        mask = deliverable_mask(state, cfg) & dispatching & ~cond_met
         if cfg.srcdst_fifo:
             # TCP-ordered channels: only FIFO heads (and timers) compete.
             mask = mask & fifo_head_mask(state)

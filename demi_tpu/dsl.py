@@ -59,6 +59,12 @@ class DSLApp:
     invariant: Optional[Callable] = None
     timer_tags: Tuple[int, ...] = ()
     tag_names: Tuple[str, ...] = ()  # for pretty-printing
+    # Named wait predicates (states, alive) -> bool, referenced by
+    # WaitCondition(cond_id=k) — the dual-tier form of the reference's
+    # host-closure WaitCondition (ExternalEventInjector.scala:541-580):
+    # the same jax predicate gates injection on the host oracle and ends
+    # the dispatch segment inside the device kernels.
+    conditions: Tuple[Callable, ...] = ()
 
     # -- naming ------------------------------------------------------------
     def actor_name(self, actor_id: int) -> str:

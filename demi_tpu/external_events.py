@@ -173,10 +173,19 @@ class WaitQuiescence(ExternalEvent):
 
 @dataclass(frozen=True, eq=False)
 class WaitCondition(ExternalEvent):
-    """Block injection until a host-side condition holds
-    (reference: ExternalEventInjector.scala:541-580 re-arm semantics)."""
+    """Block injection until a condition holds
+    (reference: ExternalEventInjector.scala:541-580 re-arm semantics).
+
+    Two forms: ``cond`` — an arbitrary zero-arg host closure (host-tier
+    only, like the reference's); ``cond_id`` — an index into the app's
+    ``DSLApp.conditions`` jax predicates, usable on BOTH tiers (the
+    device kernels end the dispatch segment when the predicate holds).
+    ``budget`` optionally bounds the wait in deliveries, like
+    WaitQuiescence."""
 
     cond: Callable[[], bool] = field(default=None, compare=False, repr=False)
+    cond_id: Optional[int] = None
+    budget: Optional[int] = None
 
 
 @dataclass(frozen=True, eq=False)

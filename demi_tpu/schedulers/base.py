@@ -197,9 +197,49 @@ class BaseScheduler:
                 return cursor, None, event.budget
             if isinstance(event, WaitCondition):
                 self.trace.append(self._unique(BeginWaitCondition()))
-                return cursor, event.cond, None
+                cond = event.cond or self._dsl_condition(event.cond_id)
+                return cursor, cond, event.budget
             self._inject_one(event)
         return cursor, None, None
+
+    def _dsl_condition(self, cond_id: Optional[int]) -> Callable[[], bool]:
+        """Host twin of the device OP_WAITCOND segment: evaluate the app's
+        jax predicate (DSLApp.conditions[cond_id]) over the live DSL actor
+        states, with the device's alive semantics (started, not
+        isolated/stopped)."""
+        if cond_id is None:
+            raise ValueError("WaitCondition needs cond or cond_id")
+        from ..runtime.actor import DSLActorAdapter
+
+        def cond() -> bool:
+            import numpy as np
+
+            app = None
+            for actor in self.system.actors.values():
+                if isinstance(actor, DSLActorAdapter):
+                    app = actor.app
+                    break
+            if app is None:
+                raise ValueError(
+                    "WaitCondition(cond_id=...) requires DSL actors"
+                )
+            states = np.zeros((app.num_actors, app.state_width), np.int32)
+            alive = np.zeros(app.num_actors, bool)
+            for i in range(app.num_actors):
+                name = app.actor_name(i)
+                actor = self.system.actors.get(name)
+                if (
+                    isinstance(actor, DSLActorAdapter)
+                    and name not in self.system.crashed
+                    and name not in self.system.network.isolated
+                ):
+                    states[i] = actor.state
+                    alive[i] = True
+            from ..apps.common import _jitted_condition
+
+            return bool(_jitted_condition(app, cond_id)(states, alive))
+
+        return cond
 
     def _inject_one(self, event: ExternalEvent) -> None:
         system = self.system

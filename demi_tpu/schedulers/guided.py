@@ -29,6 +29,7 @@ from ..device.core import (
     OP_START,
     OP_UNPARTITION,
     OP_WAIT,
+    OP_WAITCOND,
 )
 
 
@@ -113,7 +114,7 @@ class GuidedScheduler(BaseScheduler):
             return Partition(app.actor_name(a), app.actor_name(b))
         if op == OP_UNPARTITION:
             return UnPartition(app.actor_name(a), app.actor_name(b))
-        if op == OP_WAIT:
+        if op in (OP_WAIT, OP_WAITCOND):
             return None  # waits are implicit in the guide's delivery order
         raise ValueError(f"unknown guide op {op}")
 
