@@ -73,3 +73,19 @@ def test_cli_report(exp_dir, capsys):
     assert "# Experiment report" in out
     assert "## Violation" in out
     assert "External reduction" in out
+
+
+def test_cli_bridge_fuzz(capsys):
+    import sys
+
+    rc = main([
+        "bridge-fuzz",
+        "--launcher", f"{sys.executable} -m demi_tpu.bridge.demo_app --bug",
+        "--send", '["go"]', "--to", "client", "--num-sends", "2",
+        "--max-executions", "10",
+    ])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "registered actors: client, server, monitor" in out
+    assert "violation" in out
+    assert "MCS verified" in out
