@@ -22,6 +22,7 @@ from ..external_events import (
     Send,
     Start,
     UnPartition,
+    WaitCondition,
     WaitQuiescence,
     sanity_check_externals,
 )
@@ -54,6 +55,10 @@ class FuzzerWeights:
     # default — crash/recovery fuzzing is opt-in like partitions.
     hard_kill: float = 0.0
     restart: float = 0.0
+    # Condition waits (WaitCondition(cond_id=...)): drawn only for apps
+    # with a DSLApp.conditions table (Fuzzer(num_conditions=...)); always
+    # budgeted so an unsatisfiable predicate can't wedge a lane.
+    wait_condition: float = 0.0
 
 
 class Fuzzer:
@@ -66,12 +71,16 @@ class Fuzzer:
         postfix: Sequence[ExternalEvent] = (),
         max_kills: Optional[int] = None,
         wait_budget: Optional[tuple] = None,
+        num_conditions: int = 0,
     ):
         self.num_events = num_events
         self.weights = weights
         self.message_gen = message_gen
         self.prefix = list(prefix)
         self.postfix = list(postfix)
+        # How many named wait predicates the app declares
+        # (len(DSLApp.conditions)); wait_condition draws cond_ids < this.
+        self.num_conditions = num_conditions
         # Keeping a quorum alive is the app's concern; cap kills so fuzz runs
         # don't trivially kill everyone (the reference relies on weights).
         self.max_kills = max_kills
@@ -101,6 +110,7 @@ class Fuzzer:
             ("unpartition", self.weights.unpartition),
             ("hard_kill", self.weights.hard_kill),
             ("restart", self.weights.restart),
+            ("wait_condition", self.weights.wait_condition),
         ]
         total = sum(w for _, w in choices)
         generated = 0
@@ -141,6 +151,18 @@ class Fuzzer:
                 send = self.message_gen.generate(rng, alive)
                 if send is not None:
                     events.append(send)
+                    generated += 1
+            elif kind == "wait_condition":
+                if self.num_conditions > 0 and events and not isinstance(
+                    events[-1], (WaitQuiescence, WaitCondition)
+                ):
+                    lo, hi = self.wait_budget or (5, 40)
+                    events.append(
+                        WaitCondition(
+                            cond_id=rng.randrange(self.num_conditions),
+                            budget=rng.randint(lo, hi),
+                        )
+                    )
                     generated += 1
             elif kind == "wait":
                 if events and not isinstance(events[-1], WaitQuiescence):

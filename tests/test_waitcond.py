@@ -188,3 +188,47 @@ def test_waitcond_lowering_errors():
         lower_program(app, cfg, starts + [WaitCondition(cond=lambda: True)])
     with pytest.raises(ValueError, match="out of range"):
         lower_program(app, cfg, starts + [WaitCondition(cond_id=3)])
+
+
+def test_fuzzed_waitcond_programs_device_host_parity():
+    """Fuzz with wait_condition in the language, then differential-check:
+    every traced device lane must lift to the host oracle cleanly (the
+    WaitCondition gate is part of the replayed semantics)."""
+    from demi_tpu.apps.broadcast import broadcast_send_generator
+    from demi_tpu.fuzzing import Fuzzer, FuzzerWeights
+
+    app = _app()
+    cfg = DeviceConfig.for_app(
+        app, pool_capacity=64, max_steps=96, max_external_ops=24
+    )
+    fz = Fuzzer(
+        num_events=8,
+        weights=FuzzerWeights(
+            send=0.5, wait_quiescence=0.15, kill=0.1, wait_condition=0.25
+        ),
+        message_gen=broadcast_send_generator(app),
+        prefix=dsl_start_events(app),
+        max_kills=1,
+        num_conditions=len(app.conditions),
+    )
+    # The language must actually produce condition waits.
+    assert any(
+        isinstance(e, WaitCondition)
+        for s in range(20)
+        for e in fz.generate_fuzz_test(seed=s)
+    )
+    config = SchedulerConfig(invariant_check=make_host_invariant(app))
+    B = 16
+    progs = stack_programs(
+        [lower_program(app, cfg, fz.generate_fuzz_test(seed=s)) for s in range(B)]
+    )
+    keys = jax.random.split(jax.random.PRNGKey(0), B)
+    kernel = make_explore_kernel(app, cfg)
+    res = kernel(progs, keys)
+    st = np.asarray(res.status)
+    vio = np.asarray(res.violation)
+    assert int((st == ST_OVERFLOW).sum()) == 0
+    for lane in range(B):
+        single, host = lift_lane_to_host(app, cfg, progs, keys, lane, config)
+        host_code = 0 if host.violation is None else host.violation.code
+        assert host_code == int(vio[lane]), (lane, host_code, int(vio[lane]))
