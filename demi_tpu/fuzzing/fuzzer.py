@@ -160,7 +160,10 @@ class Fuzzer:
                     events.append(
                         WaitCondition(
                             cond_id=rng.randrange(self.num_conditions),
-                            budget=rng.randint(lo, hi),
+                            # Clamp: budget 0 would encode as strict/
+                            # unbudgeted, breaking the always-budgeted
+                            # guarantee for wait_budget ranges with lo=0.
+                            budget=max(1, rng.randint(lo, hi)),
                         )
                     )
                     generated += 1
