@@ -55,6 +55,7 @@ from .external_events import (
     Send,
     Start,
     UnPartition,
+    WaitCondition,
     WaitQuiescence,
     ensure_eid_floor,
 )
@@ -192,14 +193,19 @@ def _external_to_json(e: ExternalEvent) -> Dict[str, Any]:
         rec.update(type="send", name=e.name, msg=_msg_to_json(e.message()))
     elif isinstance(e, WaitQuiescence):
         rec.update(type="wait_quiescence", budget=e.budget)
+    elif isinstance(e, WaitCondition) and e.cond_id is not None:
+        # The cond_id form is closure-free (names a DSLApp.conditions
+        # entry) and round-trips; the host-closure form below does not.
+        rec.update(type="wait_condition", cond_id=e.cond_id, budget=e.budget)
     elif isinstance(e, Partition):
         rec.update(type="partition", a=e.a, b=e.b)
     elif isinstance(e, UnPartition):
         rec.update(type="unpartition", a=e.a, b=e.b)
     else:
         raise TypeError(
-            f"{type(e).__name__} is not serializable (WaitCondition/CodeBlock "
-            "close over host code; reference sanitization drops them too)"
+            f"{type(e).__name__} is not serializable (closure-form "
+            "WaitCondition/CodeBlock close over host code; reference "
+            "sanitization drops them too)"
         )
     return rec
 
@@ -220,6 +226,8 @@ def _external_from_json(rec: Dict[str, Any], app: Optional[DSLApp]) -> ExternalE
         e = Send(rec["name"], MessageConstructor(lambda m=msg: m))
     elif t == "wait_quiescence":
         e = WaitQuiescence(budget=rec.get("budget"))
+    elif t == "wait_condition":
+        e = WaitCondition(cond_id=rec["cond_id"], budget=rec.get("budget"))
     elif t == "partition":
         e = Partition(rec["a"], rec["b"])
     elif t == "unpartition":

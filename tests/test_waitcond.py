@@ -230,3 +230,21 @@ def test_fuzzed_waitcond_programs_device_host_parity():
         single, host = lift_lane_to_host(app, cfg, progs, keys, lane, config)
         host_code = 0 if host.violation is None else host.violation.code
         assert host_code == int(vio[lane]), (lane, host_code, int(vio[lane]))
+
+
+def test_waitcond_cond_id_serializes(tmp_path):
+    """The closure-free cond_id form round-trips through experiment
+    serialization (the closure form stays rejected)."""
+    from demi_tpu.serialization import (
+        _external_from_json,
+        _external_to_json,
+    )
+
+    ev = WaitCondition(cond_id=1, budget=7)
+    rec = _external_to_json(ev)
+    back = _external_from_json(rec, None)
+    assert isinstance(back, WaitCondition)
+    assert back.cond_id == 1 and back.budget == 7 and back.eid == ev.eid
+
+    with pytest.raises(TypeError, match="closure-form"):
+        _external_to_json(WaitCondition(cond=lambda: True))
