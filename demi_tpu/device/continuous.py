@@ -364,22 +364,29 @@ class ContinuousSweepDriver:
                 return time.perf_counter() - t0, seed
         return None, None
 
-    def sweep_iter(self, total_lanes: int):
+    def sweep_iter(self, total_lanes: int, seeds: Optional[Sequence[int]] = None):
         """Generator form of ``sweep``: yields (seed, violation_code) as
         lanes finish."""
-        for seed, _st, code, _h in self._run(total_lanes):
+        for seed, _st, code, _h in self._run(total_lanes, seeds=seeds):
             yield seed, code
 
-    def sweep(self, total_lanes: int):
-        """Run ``total_lanes`` seeds; returns (statuses, violations) keyed
-        by seed."""
+    def sweep(self, total_lanes: int = 0, seeds: Optional[Sequence[int]] = None):
+        """Run ``total_lanes`` sequential seeds — or an explicit ``seeds``
+        sequence (a distributed rank's strided partition, a replay list) —
+        returning (statuses, violations) keyed by seed."""
         statuses, violations = {}, {}
-        for seed, st, code, _h in self._run(total_lanes):
+        for seed, st, code, _h in self._run(total_lanes, seeds=seeds):
             statuses[seed] = st
             violations[seed] = code
         return statuses, violations
 
-    def _run(self, total_lanes: int):
+    def _run(self, total_lanes: int, seeds: Optional[Sequence[int]] = None):
+        seed_list = (
+            list(range(total_lanes)) if seeds is None else list(seeds)
+        )
+        total_lanes = len(seed_list)
+        if total_lanes == 0:
+            return
         b = min(self.batch, total_lanes)
         if self.mesh is not None:
             # Lane-sharded kernels need a mesh-multiple batch; surplus
@@ -408,8 +415,12 @@ class ContinuousSweepDriver:
             return self._vkeys(jnp.asarray(seeds, jnp.uint32))
 
         n_live = min(b, total_lanes)
-        lane_seed = list(range(b))
-        next_seed = n_live
+        # Lane i runs seed_list[i]; surplus (mesh-alignment) lanes run the
+        # first seed inertly — never yielded, never refilled.
+        lane_seed = [
+            seed_list[i] if i < n_live else seed_list[0] for i in range(b)
+        ]
+        next_idx = n_live  # next position in seed_list to hand out
         progs_host: List = [self._lower(s) for s in lane_seed]
         progs = self._stack(progs_host)
         state = self.init(keys_for(lane_seed))
@@ -463,16 +474,16 @@ class ContinuousSweepDriver:
                 # Refill finished lanes with fresh seeds (or park them).
                 refill_lanes = set(
                     int(x) for x in np.flatnonzero(finished)[
-                        : max(0, total_lanes - next_seed)
+                        : max(0, total_lanes - next_idx)
                     ]
                 )
                 for lane in np.flatnonzero(finished):
                     active[lane] = False
                 if refill_lanes:
-                    fresh_seeds = list(
-                        range(next_seed, next_seed + len(refill_lanes))
-                    )
-                    next_seed += len(refill_lanes)
+                    fresh_seeds = seed_list[
+                        next_idx : next_idx + len(refill_lanes)
+                    ]
+                    next_idx += len(refill_lanes)
                     mask = np.zeros(b, bool)
                     full_seeds = []
                     k = 0
