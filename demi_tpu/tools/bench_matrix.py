@@ -103,6 +103,38 @@ def main(argv=None):
                         "impl": tag, "batch": batch, "block_lanes": bl,
                         "error": repr(e)[:300],
                     }), flush=True)
+    # Sustained continuous-refill throughput (the config-5 shape): the
+    # segment/refill driver on the same workload — ranks the refill
+    # path's overhead against the one-shot kernels on this hardware.
+    from ..device.continuous import ContinuousSweepDriver
+
+    for batch in batches[:1]:
+        try:
+            drv = ContinuousSweepDriver(
+                app, cfg, lambda s: program, batch=batch, seg_steps=36,
+                program_key=lambda s: 0,  # one fixed program: lower once
+            )
+            drv.sweep(batch + 64)  # warm at the real shape, incl. refill
+            total = batch * (args.reps + 1)
+            t0 = time.perf_counter()
+            n = sum(1 for _ in drv._run(total))
+            secs = time.perf_counter() - t0
+            print(json.dumps({
+                "impl": "xla-continuous", "platform": platform,
+                "batch": batch, "lanes": n,
+                "schedules_per_sec": round(n / secs, 1),
+                "occupancy": round(drv.last_occupancy or 0, 3),
+                "harvest_fraction": round(
+                    drv.last_harvest_seconds
+                    / max(drv.last_segment_seconds
+                          + drv.last_harvest_seconds, 1e-9), 3),
+            }), flush=True)
+        except Exception as e:
+            print(json.dumps({
+                "impl": "xla-continuous", "batch": batch,
+                "error": repr(e)[:300],
+            }), flush=True)
+
     # Early-exit loop variant, trailing layout only (the known-best
     # layout): while_loop tracks the slowest LIVE lane instead of paying
     # max_steps — measured ~+10-15% on CPU for this workload (lanes
