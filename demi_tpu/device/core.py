@@ -35,8 +35,9 @@ import numpy as np
 from ..dsl import DSLApp
 from . import ops
 
-# External-op codes (device program encoding of ExternalEvents; WaitCondition
-# and CodeBlock are host-tier-only features — see demi_tpu/dsl.py docstring).
+# External-op codes (device program encoding of ExternalEvents;
+# closure-form WaitCondition and CodeBlock are host-tier-only — the
+# cond_id WaitCondition form lowers to OP_WAITCOND).
 OP_END = 0
 OP_START = 1
 OP_KILL = 2
