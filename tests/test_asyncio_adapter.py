@@ -247,3 +247,36 @@ def test_adapter_end_to_end_system_snapshot():
         system.restore(snap)
         st = system.actor("alice").checkpoint_state()
         assert st["wants"] is True and st["held"] is False
+
+
+def test_udp_lock_run_the_gamut():
+    """The CANONICAL minimization pipeline (provenance -> DDMin ->
+    internal minimization -> wildcards -> internal again) over the
+    unmodified external app, host-oracle mode."""
+    from demi_tpu.runner import FuzzResult, run_the_gamut
+
+    with BridgeSession(LAUNCHER, env=ENV) as session:
+        config = _config()
+        program = _program(session)
+        found = None
+        for seed in range(40):
+            result = RandomScheduler(
+                config, seed=seed, max_messages=120,
+                invariant_check_interval=1, timer_weight=0.4,
+            ).execute(program)
+            if result.violation is not None:
+                found = result
+                break
+        assert found is not None
+        gamut = run_the_gamut(
+            config,
+            FuzzResult(
+                program=program, trace=found.trace,
+                violation=found.violation, executions=1,
+            ),
+        )
+        stages = [name for name, _, _ in gamut.stages]
+        assert "ddmin" in stages and "int_min" in stages
+        assert "wildcard" in stages  # clock-clustering ran on string msgs
+        assert len(gamut.mcs_externals) < len(program)
+        assert gamut.final_trace.deliveries()
