@@ -19,7 +19,14 @@ def main(argv=None) -> int:
     parser = argparse.ArgumentParser()
     parser.add_argument("--impl", choices=["xla", "pallas"], default="xla")
     parser.add_argument("--lanes", type=int, default=256)
+    parser.add_argument(
+        "--adapter", action="store_true",
+        help="run the external-app slice instead: unmodified asyncio app "
+             "-> fuzz -> violation -> gamut-minimize -> strict replay",
+    )
     args = parser.parse_args(argv)
+    if args.adapter:
+        return adapter_slice()
 
     import jax
     import numpy as np
@@ -85,6 +92,80 @@ def main(argv=None) -> int:
     print(f"[4/5] DDMin: {n_orig} -> {len(kept)} externals")
     assert verified is not None, "MCS failed verification"
     print("[5/5] MCS verified — SLICE OK")
+    return 0
+
+
+def adapter_slice() -> int:
+    """External-app slice: the unmodified asyncio UDP-lock fixture under
+    fuzz -> phantom-grant violation -> canonical gamut -> strict replay."""
+    import os
+
+    from ..bridge import BridgeSession, bridge_invariant
+    from ..bridge.asyncio_adapter import udp_send
+    from ..config import SchedulerConfig
+    from ..external_events import (
+        MessageConstructor,
+        Send,
+        Start,
+        WaitQuiescence,
+    )
+    from ..runner import FuzzResult, run_the_gamut
+    from ..schedulers import RandomScheduler
+    from ..schedulers.replay import ReplayScheduler
+
+    repo = os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    launcher = [
+        sys.executable, os.path.join(repo, "tests", "fixtures", "udp_lock_main.py")
+    ]
+    env = {"PYTHONPATH": repo + os.pathsep + os.environ.get("PYTHONPATH", "")}
+
+    def phantom(states):
+        for name in ("alice", "bob"):
+            st = states.get(name)
+            if st and st.get("held") and not st.get("wants"):
+                return 2
+        return None
+
+    with BridgeSession(launcher, env=env) as session:
+        print(f"[1/4] adapter registered: {', '.join(session.actor_names)}")
+        config = SchedulerConfig(
+            invariant_check=bridge_invariant(predicate=phantom)
+        )
+        program = [
+            Start(n, ctor=session.actor_factory(n))
+            for n in ("server", "alice", "bob")
+        ] + [
+            Send("alice", MessageConstructor(lambda: udp_send("go"))),
+            Send("bob", MessageConstructor(lambda: udp_send("go"))),
+            WaitQuiescence(budget=60),
+        ]
+        found = None
+        for seed in range(40):
+            r = RandomScheduler(
+                config, seed=seed, max_messages=120,
+                invariant_check_interval=1, timer_weight=0.4,
+            ).execute(program)
+            if r.violation is not None:
+                found = r
+                break
+        assert found is not None, "phantom grant never surfaced"
+        print(f"[2/4] violation {found.violation} at seed {seed}")
+        gamut = run_the_gamut(
+            config,
+            FuzzResult(program=program, trace=found.trace,
+                       violation=found.violation, executions=seed + 1),
+        )
+        print(
+            f"[3/4] gamut: {len(program)} -> {len(gamut.mcs_externals)} "
+            f"externals over {len(gamut.stages)} stages"
+        )
+        assert len(gamut.mcs_externals) < len(program)
+        replayed = ReplayScheduler(config).replay(found.trace, program)
+        assert replayed.violation is not None
+        assert replayed.violation.matches(found.violation)
+        print("[4/4] strict replay reproduced — ADAPTER SLICE OK")
     return 0
 
 
