@@ -104,6 +104,13 @@ class DeviceConfig:
     # candidate; timers stay individually choosable. Costs an O(P^2)
     # same-channel compare per step, so opt-in.
     srcdst_fifo: bool = False
+    # Batched-replay peek (device twin of STSScheduler.allow_peek /
+    # IntervalPeekScheduler): when an expected delivery has no pending
+    # match, deliver up to this many pending entries FIFO trying to
+    # ENABLE it, keeping the prefix on success and rolling the lane back
+    # wholesale on failure. 0 = ignore-absent only. Costs a second
+    # in-flight state copy per lane while replaying, so opt-in.
+    replay_peek: int = 0
     # Message-payload storage dtype for the pool/timer-memory columns
     # ('int32' or 'int16'). The [P, W] pool_msg array dominates the
     # per-lane carry, so halving it halves the HBM traffic of the XLA

@@ -343,6 +343,7 @@ def make_replay_kernel_pallas(
                 res.violation,
                 res.deliveries,
                 res.ignored_absent,
+                res.peeked,
             )
 
         in_structs = [
@@ -361,9 +362,10 @@ def make_replay_kernel_pallas(
         n_records = records.shape[1]
         if n_records not in cache:
             cache[n_records] = jax.jit(_kernel_for(n_records))
-        st, vio, dl, ig = cache[n_records](records, keys)
+        st, vio, dl, ig, pk = cache[n_records](records, keys)
         return ReplayResult(
-            status=st, violation=vio, deliveries=dl, ignored_absent=ig
+            status=st, violation=vio, deliveries=dl, ignored_absent=ig,
+            peeked=pk,
         )
 
     return call

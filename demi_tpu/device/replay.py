@@ -46,6 +46,9 @@ class ReplayResult(NamedTuple):
     violation: jnp.ndarray  # int32 final invariant code
     deliveries: jnp.ndarray
     ignored_absent: jnp.ndarray  # int32: expected deliveries with no match
+    # Expected deliveries ENABLED by a successful peek prefix
+    # (cfg.replay_peek > 0; 0 otherwise).
+    peeked: jnp.ndarray
 
 
 def _is_delivery_kind(kind):
@@ -58,27 +61,8 @@ def make_replay_run_lane(app: DSLApp, cfg: DeviceConfig):
     init_states, initial_rows = _precomputed(app, cfg)
     big = jnp.int32(2**30)
 
-    def replay_record(state: ScheduleState, rec, active) -> ScheduleState:
-        """Fused, branchless record application: the external and delivery
-        sides both run with masks (inert op / invalid index for whichever
-        doesn't apply) and share ONE pool-insert pass — same shape as the
-        fused explore step (both lax.cond branches would execute under vmap
-        anyway, and the O(pool) insert machinery dominates)."""
-        kind = rec[0]
-        # Explicit msg slice: parent-tracked records carry a trailing
-        # column that must not leak into message matching.
-        a, b, msg = rec[1], rec[2], rec[3 : 3 + cfg.msg_width]
-        is_ext = active & (kind >= REC_EXT_BASE)
-        is_delivery = active & _is_delivery_kind(kind)
-        rec_idx = state.trace_len
-
-        # External side (inert op unless is_ext).
-        op = jnp.where(is_ext, kind - REC_EXT_BASE, OP_END)
-        state, ext_rows, ext_rec, ext_enabled = external_effects(
-            state, cfg, app, initial_rows, init_states, op, a, b, msg
-        )
-
-        # Delivery side (invalid index unless is_delivery and matched).
+    def _delivery_match(state: ScheduleState, kind, a, b, msg):
+        """Pending-pool match mask for one expected delivery record."""
         is_timer_rec = kind == REC_TIMER
         is_wild = kind == REC_WILDCARD
         mask = deliverable_mask(state, cfg)
@@ -92,7 +76,91 @@ def make_replay_run_lane(app: DSLApp, cfg: DeviceConfig):
         # Wildcard (reference: WildCardMatch selectors,
         # STSScheduler.scala:696-708): receiver + class tag only.
         wild = (state.pool_dst == a) & (state.pool_msg[:, 0] == msg[0])
-        match = mask & jnp.where(is_wild, wild, exact)
+        return mask & jnp.where(is_wild, wild, exact)
+
+    def _deliver_fifo_pending(state: ScheduleState):
+        """Deliver the FIFO-earliest deliverable pending entry (the peek
+        probe's unexpected delivery), full effects + insert + trace."""
+        dmask = deliverable_mask(state, cfg)
+        seqs = jnp.where(dmask, state.pool_seq, big)
+        pidx = jnp.where(
+            jnp.any(dmask), jnp.argmin(seqs), jnp.int32(cfg.pool_capacity)
+        ).astype(jnp.int32)
+        rec_idx = state.trace_len
+        state, prow, prec = delivery_effects(state, cfg, app, pidx)
+        state = insert_rows(
+            state, cfg, prow.valid, prow.src, prow.dst, prow.timer,
+            prow.parked, prow.msg,
+            crec=rec_idx if cfg.record_parents else None,
+        )
+        if cfg.record_trace:
+            state = _append_record(
+                state, cfg, prec, pidx < cfg.pool_capacity
+            )
+        return state
+
+    def replay_record(state: ScheduleState, rec, active):
+        """Fused, branchless record application: the external and delivery
+        sides both run with masks (inert op / invalid index for whichever
+        doesn't apply) and share ONE pool-insert pass — same shape as the
+        fused explore step (both lax.cond branches would execute under vmap
+        anyway, and the O(pool) insert machinery dominates).
+
+        Returns (state', peek_hit): peek_hit is True when
+        ``cfg.replay_peek`` enabled an otherwise-absent expected delivery
+        by delivering a pending prefix (device twin of STSScheduler.peek,
+        STSScheduler.scala:314-378: keep the enabling prefix, roll the
+        whole lane back on failure)."""
+        kind = rec[0]
+        # Explicit msg slice: parent-tracked records carry a trailing
+        # column that must not leak into message matching.
+        a, b, msg = rec[1], rec[2], rec[3 : 3 + cfg.msg_width]
+        is_ext = active & (kind >= REC_EXT_BASE)
+        is_delivery = active & _is_delivery_kind(kind)
+
+        # External side (inert op unless is_ext).
+        op = jnp.where(is_ext, kind - REC_EXT_BASE, OP_END)
+        state, ext_rows, ext_rec, ext_enabled = external_effects(
+            state, cfg, app, initial_rows, init_states, op, a, b, msg
+        )
+
+        peek_hit = jnp.bool_(False)
+        if cfg.replay_peek:
+            # The snapshot is the carry itself (functional rollback): run
+            # the probe on a forked state; commit only if the expected
+            # delivery became matchable within the budget.
+            need = is_delivery & ~jnp.any(
+                _delivery_match(state, kind, a, b, msg)
+            )
+
+            def peek_cond(carry):
+                s, j, found = carry
+                return (
+                    need
+                    & (j < cfg.replay_peek)
+                    & ~found
+                    & jnp.any(deliverable_mask(s, cfg))
+                )
+
+            def peek_body(carry):
+                s, j, _ = carry
+                s = _deliver_fifo_pending(s)
+                found = jnp.any(_delivery_match(s, kind, a, b, msg))
+                return s, j + 1, found
+
+            s_peek, _, found = jax.lax.while_loop(
+                peek_cond, peek_body, (state, jnp.int32(0), jnp.bool_(False))
+            )
+            state = jax.tree_util.tree_map(
+                lambda old, new: jnp.where(found, new, old), state, s_peek
+            )
+            peek_hit = found
+
+        # Delivery side (invalid index unless is_delivery and matched).
+        # Re-capture the record index: peeked deliveries appended records.
+        rec_idx = state.trace_len
+        is_wild = kind == REC_WILDCARD
+        match = _delivery_match(state, kind, a, b, msg)
         any_match = jnp.any(match)
         # policy: FIFO (earliest arrival) or, for wildcard "last",
         # latest arrival.
@@ -119,17 +187,23 @@ def make_replay_run_lane(app: DSLApp, cfg: DeviceConfig):
             state = _append_record(
                 state, cfg, out_rec, delivered | (is_ext & ext_enabled)
             )
-        return state
+        return state, peek_hit
 
     def run_lane(records, key) -> ReplayResult:
         state = init_state(app, cfg, key)
 
-        def apply_one(state, ignored, rec):
+        def apply_one(state, ignored, peeked, rec):
             before = state.deliveries
-            state = replay_record(state, rec, state.status < ST_DONE)
+            state, peek_hit = replay_record(
+                state, rec, state.status < ST_DONE
+            )
             was_delivery = _is_delivery_kind(rec[0])
             skipped = was_delivery & (state.deliveries == before) & (state.status < ST_DONE)
-            return state, ignored + skipped.astype(jnp.int32)
+            return (
+                state,
+                ignored + skipped.astype(jnp.int32),
+                peeked + peek_hit.astype(jnp.int32),
+            )
 
         if cfg.early_exit:
             # Stop at trailing padding (REC_NONE) or a finished lane; under
@@ -141,29 +215,30 @@ def make_replay_run_lane(app: DSLApp, cfg: DeviceConfig):
             oh = cfg.use_onehot
 
             def cond(carry):
-                s, _ig, i = carry
+                s, _ig, _pk, i = carry
                 kind = ops.get_scalar(
                     records[:, 0], jnp.minimum(i, n_rec - 1), oh
                 )
                 return (i < n_rec) & (kind != REC_NONE) & (s.status < ST_DONE)
 
             def wl_body(carry):
-                s, ig, i = carry
+                s, ig, pk, i = carry
                 rec = ops.get_row(records, jnp.minimum(i, n_rec - 1), oh)
-                s, ig = apply_one(s, ig, rec)
-                return (s, ig, i + 1)
+                s, ig, pk = apply_one(s, ig, pk, rec)
+                return (s, ig, pk, i + 1)
 
-            state, ignored, _ = jax.lax.while_loop(
-                cond, wl_body, (state, jnp.int32(0), jnp.int32(0))
+            state, ignored, peeked, _ = jax.lax.while_loop(
+                cond, wl_body,
+                (state, jnp.int32(0), jnp.int32(0), jnp.int32(0)),
             )
         else:
             def body(carry, rec):
-                state, ignored = carry
-                state, ignored = apply_one(state, ignored, rec)
-                return (state, ignored), None
+                state, ignored, peeked = carry
+                state, ignored, peeked = apply_one(state, ignored, peeked, rec)
+                return (state, ignored, peeked), None
 
-            (state, ignored), _ = jax.lax.scan(
-                body, (state, jnp.int32(0)), records
+            (state, ignored, peeked), _ = jax.lax.scan(
+                body, (state, jnp.int32(0), jnp.int32(0)), records
             )
         # Aborted lanes (overflow) must not report a verdict computed from
         # truncated state — mask their violation to 0 so batched-oracle
@@ -181,6 +256,7 @@ def make_replay_run_lane(app: DSLApp, cfg: DeviceConfig):
             violation=code.astype(jnp.int32),
             deliveries=state.deliveries,
             ignored_absent=ignored,
+            peeked=peeked,
         )
 
     return run_lane
