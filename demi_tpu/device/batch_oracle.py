@@ -126,7 +126,14 @@ class DeviceReplayChecker:
         externals: Sequence[ExternalEvent],
         violation: Any,
     ) -> Optional[EventTrace]:
-        sts = STSScheduler(self.config, candidate)
+        # Keep the tiers' replay power matched: when the device kernel
+        # peeks (cfg.replay_peek), the host bookkeeping replay must too,
+        # or device-positive candidates would fail host re-execution.
+        sts = STSScheduler(
+            self.config, candidate,
+            allow_peek=self.cfg.replay_peek > 0,
+            max_peek_messages=max(self.cfg.replay_peek, 10),
+        )
         return sts.test_with_trace(candidate, list(externals), violation)
 
 
