@@ -152,9 +152,17 @@ def cmd_minimize(args) -> int:
         return 0
     # Device-batched trials are the default for DSL apps (the BASELINE
     # north-star pipeline); --host falls back to the sequential STS oracle.
+    device_cfg = None
+    if args.peek and not args.host:
+        from .device.batch_oracle import default_device_config
+
+        device_cfg = default_device_config(
+            app, trace, externals, replay_peek=args.peek
+        )
     result = run_the_gamut(
         config, fr, wildcards=not args.no_wildcards,
         app=None if args.host else app,
+        device_cfg=device_cfg,
         checkpoint_dir=args.experiment, resume=args.resume,
     )
     print_minimization_stats(result)
@@ -454,6 +462,12 @@ def main(argv: Optional[list] = None) -> int:
         "--resume", action="store_true",
         help="restart after the last completed pipeline stage "
              "(stage checkpoints live in the experiment dir)",
+    )
+    p.add_argument(
+        "--peek", type=int, default=0, metavar="K",
+        help="replay peek budget: absent expected deliveries may be "
+             "enabled by delivering up to K pending entries "
+             "(device kernel + host bookkeeping replay both peek)",
     )
     p.set_defaults(fn=cmd_minimize)
 
