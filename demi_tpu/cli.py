@@ -108,6 +108,20 @@ def cmd_fuzz(args) -> int:
 
 
 def cmd_minimize(args) -> int:
+    # --peek is a device-replay feature (the host bookkeeping replay
+    # follows the device kernel's setting): reject combinations that
+    # would silently drop it rather than minimize a different space.
+    if args.peek < 0:
+        raise SystemExit("--peek must be >= 0")
+    if args.peek and args.host:
+        raise SystemExit(
+            "--peek requires the device-batched oracle (drop --host)"
+        )
+    if args.peek and args.strategy == "incddmin":
+        raise SystemExit(
+            "--peek applies to the gamut's replay oracle; incddmin "
+            "replays exact DPOR prescriptions and never peeks"
+        )
     # The flag is authoritative: it must also override a pre-set
     # DEMI_DEVICE_IMPL in the caller's environment.
     os.environ["DEMI_DEVICE_IMPL"] = getattr(args, "impl", "xla")

@@ -89,3 +89,15 @@ def test_cli_bridge_fuzz(capsys):
     assert "registered actors: client, server, monitor" in out
     assert "violation" in out
     assert "MCS verified" in out
+
+
+def test_cli_minimize_peek_rejects_unsupported_combos(exp_dir):
+    import pytest as _pytest
+
+    with _pytest.raises(SystemExit, match="device-batched"):
+        main(["minimize"] + _common(exp_dir) + ["--peek", "3", "--host"])
+    with _pytest.raises(SystemExit, match="never peeks"):
+        main(["minimize"] + _common(exp_dir)
+             + ["--peek", "3", "--strategy", "incddmin"])
+    with _pytest.raises(SystemExit, match=">= 0"):
+        main(["minimize"] + _common(exp_dir) + ["--peek", "-1"])
