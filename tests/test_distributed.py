@@ -23,3 +23,24 @@ def test_two_process_distributed_sweep():
     # The unreliable-broadcast fuzz finds violations somewhere in 32 lanes.
     assert summary["total_violations"] >= 1
     assert summary["total_overflow"] == 0
+
+
+def test_distributed_continuous_matches_chunked():
+    """Each rank's lane-compacted (continuous) sweep over its strided
+    partition must report exactly the totals the fixed-batch loop does —
+    per-seed verdicts are key-scheme-identical across modes."""
+    kw = dict(
+        num_processes=2, total_lanes=32, chunk_size=8,
+        devices_per_process=2,
+    )
+    cont = launch_distributed_sweep(
+        workload={"app": "broadcast", "nodes": 3, "bug": "x"}, **kw
+    )
+    chunked = launch_distributed_sweep(
+        workload={"app": "broadcast", "nodes": 3, "bug": "x",
+                  "sweep_mode": "chunked"},
+        **kw,
+    )
+    assert cont["total_lanes"] == chunked["total_lanes"] == 32
+    assert cont["total_violations"] == chunked["total_violations"]
+    assert cont["total_overflow"] == chunked["total_overflow"]
