@@ -97,18 +97,14 @@ def main(argv=None) -> int:
 
 def adapter_slice() -> int:
     """External-app slice: the unmodified asyncio UDP-lock fixture under
-    fuzz -> phantom-grant violation -> canonical gamut -> strict replay."""
+    fuzz -> phantom-grant violation -> canonical gamut -> strict replay.
+    The app-specific pieces (predicate, driver program) come from the
+    fixture's integration surface (udp_lock_main.py), shared with
+    tests/test_asyncio_adapter.py."""
     import os
 
     from ..bridge import BridgeSession, bridge_invariant
-    from ..bridge.asyncio_adapter import udp_send
     from ..config import SchedulerConfig
-    from ..external_events import (
-        MessageConstructor,
-        Send,
-        Start,
-        WaitQuiescence,
-    )
     from ..runner import FuzzResult, run_the_gamut
     from ..schedulers import RandomScheduler
     from ..schedulers.replay import ReplayScheduler
@@ -116,31 +112,19 @@ def adapter_slice() -> int:
     repo = os.path.dirname(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     )
-    launcher = [
-        sys.executable, os.path.join(repo, "tests", "fixtures", "udp_lock_main.py")
-    ]
-    env = {"PYTHONPATH": repo + os.pathsep + os.environ.get("PYTHONPATH", "")}
+    fixtures = os.path.join(repo, "tests", "fixtures")
+    sys.path.insert(0, fixtures)
+    from udp_lock_main import make_program, phantom_grant
 
-    def phantom(states):
-        for name in ("alice", "bob"):
-            st = states.get(name)
-            if st and st.get("held") and not st.get("wants"):
-                return 2
-        return None
+    launcher = [sys.executable, os.path.join(fixtures, "udp_lock_main.py")]
+    env = {"PYTHONPATH": repo + os.pathsep + os.environ.get("PYTHONPATH", "")}
 
     with BridgeSession(launcher, env=env) as session:
         print(f"[1/4] adapter registered: {', '.join(session.actor_names)}")
         config = SchedulerConfig(
-            invariant_check=bridge_invariant(predicate=phantom)
+            invariant_check=bridge_invariant(predicate=phantom_grant)
         )
-        program = [
-            Start(n, ctor=session.actor_factory(n))
-            for n in ("server", "alice", "bob")
-        ] + [
-            Send("alice", MessageConstructor(lambda: udp_send("go"))),
-            Send("bob", MessageConstructor(lambda: udp_send("go"))),
-            WaitQuiescence(budget=60),
-        ]
+        program = make_program(session)
         found = None
         for seed in range(40):
             r = RandomScheduler(

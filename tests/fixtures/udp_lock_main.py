@@ -1,8 +1,10 @@
-"""Bridge launcher for the (unmodified) udp_lock asyncio app: wires its
-protocol classes into NodeSpecs and speaks the bridge protocol on stdio.
-This file is the entire per-app integration surface — the app module
-itself has no knowledge of demi_tpu (the reference's analog: the test
-harness config that lists which actors to weave)."""
+"""Bridge launcher + integration surface for the (unmodified) udp_lock
+asyncio app: wires its protocol classes into NodeSpecs, speaks the bridge
+protocol on stdio when run as a script, and hosts the app-specific pieces
+the harness side shares (safety predicate, driver program). This file is
+the entire per-app integration — the app module itself has no knowledge
+of demi_tpu (the reference's analog: the test harness config that lists
+which actors to weave)."""
 
 import os
 import sys
@@ -11,16 +13,46 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 from udp_lock import LockClient, LockServer  # the app, untouched
 
-from demi_tpu.bridge.asyncio_adapter import NodeSpec, serve_stdio
+from demi_tpu.bridge.asyncio_adapter import NodeSpec, serve_stdio, udp_send
 
 SERVER = ("10.0.0.1", 9000)
 ALICE = ("10.0.0.2", 9000)
 BOB = ("10.0.0.3", 9000)
 
-serve_stdio(
-    {
-        "server": NodeSpec(LockServer, SERVER),
-        "alice": NodeSpec(lambda: LockClient(SERVER), ALICE),
-        "bob": NodeSpec(lambda: LockClient(SERVER), BOB),
-    }
-)
+NODE_SPECS = {
+    "server": NodeSpec(LockServer, SERVER),
+    "alice": NodeSpec(lambda: LockClient(SERVER), ALICE),
+    "bob": NodeSpec(lambda: LockClient(SERVER), BOB),
+}
+
+
+def phantom_grant(states):
+    """Safety property: a client must never hold a lock it no longer
+    wants (the retransmission-identity bug's signature)."""
+    for name in ("alice", "bob"):
+        st = states.get(name)
+        if st and st.get("held") and not st.get("wants"):
+            return 2
+    return None
+
+
+def make_program(session, wait_budget: int = 60):
+    """The standard driver program: start everything, poke both clients."""
+    from demi_tpu.external_events import (
+        MessageConstructor,
+        Send,
+        Start,
+        WaitQuiescence,
+    )
+
+    return [
+        Start(name, ctor=session.actor_factory(name)) for name in NODE_SPECS
+    ] + [
+        Send("alice", MessageConstructor(lambda: udp_send("go"))),
+        Send("bob", MessageConstructor(lambda: udp_send("go"))),
+        WaitQuiescence(budget=wait_budget),
+    ]
+
+
+if __name__ == "__main__":
+    serve_stdio(NODE_SPECS)

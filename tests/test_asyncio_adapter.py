@@ -16,7 +16,6 @@ from demi_tpu.bridge.asyncio_adapter import (
     udp_send,
 )
 from demi_tpu.config import SchedulerConfig
-from demi_tpu.external_events import MessageConstructor, Send, Start, WaitQuiescence
 from demi_tpu.runner import sts_sched_ddmin
 from demi_tpu.schedulers import RandomScheduler
 from demi_tpu.schedulers.replay import ReplayScheduler
@@ -25,6 +24,7 @@ FIXTURES = os.path.join(os.path.dirname(os.path.abspath(__file__)), "fixtures")
 sys.path.insert(0, FIXTURES)
 
 from udp_lock import LockClient, LockServer  # noqa: E402
+from udp_lock_main import make_program, phantom_grant  # noqa: E402
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 LAUNCHER = [sys.executable, os.path.join(FIXTURES, "udp_lock_main.py")]
@@ -132,32 +132,16 @@ def test_adapter_create_task_points_at_scope_docs():
 
 
 # -- end-to-end over the bridge -------------------------------------------
+# The app-specific predicate and driver program live in the fixture's
+# integration surface (udp_lock_main.py), shared with
+# demi_tpu.tools.verify_slice --adapter.
 
-def _phantom_grant(states):
-    """Safety property: a client must never hold a lock it no longer
-    wants (the retransmission-identity bug's signature)."""
-    for name in ("alice", "bob"):
-        st = states.get(name)
-        if st and st.get("held") and not st.get("wants"):
-            return 2
-    return None
-
-
-def _program(session):
-    starts = [
-        Start(name, ctor=session.actor_factory(name))
-        for name in ("server", "alice", "bob")
-    ]
-    return starts + [
-        Send("alice", MessageConstructor(lambda: udp_send("go"))),
-        Send("bob", MessageConstructor(lambda: udp_send("go"))),
-        WaitQuiescence(budget=60),
-    ]
+_program = make_program
 
 
 def _config():
     return SchedulerConfig(
-        invariant_check=bridge_invariant(predicate=_phantom_grant)
+        invariant_check=bridge_invariant(predicate=phantom_grant)
     )
 
 
