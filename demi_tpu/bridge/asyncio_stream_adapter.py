@@ -1,0 +1,331 @@
+"""Adapter: run UNMODIFIED asyncio STREAM-protocol apps under the bridge.
+
+Companion to asyncio_adapter.py (datagrams): this module interposes on
+the connection-oriented half of the asyncio API — ``asyncio.Protocol``
+subclasses written against
+
+  - ``transport.write(data)`` / ``transport.close()``,
+  - ``connection_made(transport)`` / ``data_received(data)`` /
+    ``connection_lost(exc)``,
+  - ``loop.call_later`` / ``call_soon`` / ``time`` (shared with the
+    datagram adapter's deterministic loop),
+
+byte-for-byte unchanged. Topology comes from the integration surface
+(which node dials which), mirroring a real deployment's config.
+
+Determinism model: one established connection = one pair of protocol
+instances; every ``write`` becomes a bridge send carrying
+``("__tcp__", conn_id, seq, chunk)``. The SCHEDULER reorders these like
+any network packets — and the adapter reassembles them per connection in
+sequence order before invoking ``data_received``, which is exactly TCP's
+contract (ordered byte stream over an unordered packet substrate). So
+schedule exploration perturbs *cross-connection* interleavings at each
+node — the nondeterminism real TCP apps actually face — while each
+stream stays internally ordered. seq 0 is the SYN (server side
+instantiates its protocol on arrival = accept); a ``FIN`` sentinel chunk
+closes (``connection_lost(None)``).
+
+Scope (v1): server protocols are per-connection instances from the
+app's own factory (exactly what ``loop.create_server`` takes); node
+checkpoints expose the JSON subset of a spec-designated app-state
+object; the snapshot feature is not implemented for stream nodes.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from .asyncio_adapter import _Effects, _Loop
+
+TCP_TAG = "__tcp__"
+FIN = "__FIN__"
+
+
+@dataclass
+class Dial:
+    """One outbound connection this node opens at start: the protocol
+    factory is exactly what the app would pass to
+    ``loop.create_connection``."""
+
+    peer: str
+    protocol_factory: Callable
+    conn_id: Optional[str] = None  # default: "<node>-><peer>#<k>"
+
+
+@dataclass
+class StreamNodeSpec:
+    """One app node: a server factory (what ``loop.create_server`` takes;
+    None for pure clients), the connections it dials, and an optional
+    app-state object whose JSON vars become the node's checkpoint."""
+
+    server_factory: Optional[Callable] = None
+    dials: List[Dial] = field(default_factory=list)
+    app_state: Any = None
+
+
+class _StreamTransport:
+    """Duck-types asyncio.Transport: write captures a sequenced chunk
+    send to the peer node."""
+
+    def __init__(self, node: "_StreamNode", conn_id: str, peer: str):
+        self._node = node
+        self._conn_id = conn_id
+        self._peer = peer
+        self._closing = False
+        self._next_seq = 1  # 0 is the SYN
+
+    def write(self, data: bytes) -> None:
+        if self._closing:
+            return
+        self._node.capture_chunk(
+            self._peer, self._conn_id, self._next_seq, data.decode("latin-1")
+        )
+        self._next_seq += 1
+
+    def writelines(self, chunks) -> None:
+        for c in chunks:
+            self.write(c)
+
+    def close(self) -> None:
+        if not self._closing:
+            self._closing = True
+            self._node.capture_chunk(
+                self._peer, self._conn_id, self._next_seq, FIN
+            )
+            self._next_seq += 1
+
+    def is_closing(self) -> bool:
+        return self._closing
+
+    def abort(self) -> None:
+        self.close()
+
+    def get_extra_info(self, name: str, default=None):
+        if name == "peername":
+            return (self._peer, 0)
+        return default
+
+    def __deepcopy__(self, memo):
+        return self
+
+
+class _Conn:
+    """One side of one connection at one node: the protocol instance plus
+    TCP reassembly state (out-of-order chunks wait in the buffer)."""
+
+    def __init__(self, conn_id: str, peer: str):
+        self.conn_id = conn_id
+        self.peer = peer
+        self.protocol = None
+        self.transport: Optional[_StreamTransport] = None
+        self.next_seq = 0
+        self.buffer: Dict[int, str] = {}
+        self.closed = False
+
+
+class _StreamNode:
+    def __init__(self, adapter: "AsyncioStreamAdapter", name: str,
+                 spec: StreamNodeSpec):
+        self.adapter = adapter
+        self.loop = adapter.loop
+        self.name = name
+        self.spec = spec
+        self.conns: Dict[str, _Conn] = {}
+        self.effects = _Effects()
+        # Timer plumbing shared with the datagram adapter's loop.
+        self.armed: Dict[tuple, Tuple[Callable, tuple, float]] = {}
+        self.arm_counts: Dict[str, int] = {}
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self) -> None:
+        self.conns.clear()
+        self.armed.clear()
+        self.arm_counts.clear()
+        if self.spec.app_state is not None and hasattr(
+            self.spec.app_state, "reset"
+        ):
+            self.spec.app_state.reset()
+        for k, dial in enumerate(self.spec.dials):
+            conn_id = dial.conn_id or f"{self.name}->{dial.peer}#{k}"
+            conn = _Conn(conn_id, dial.peer)
+            conn.protocol = dial.protocol_factory()
+            conn.transport = _StreamTransport(self, conn_id, dial.peer)
+            conn.next_seq = None  # client side never receives a SYN
+            self.conns[conn_id] = conn
+            # SYN first so the peer's accept precedes any data chunk.
+            self.capture_chunk(dial.peer, conn_id, 0, "")
+            conn.protocol.connection_made(conn.transport)
+        # Client-side streams start expecting the peer's first chunk.
+        for conn in self.conns.values():
+            conn.next_seq = 1
+
+    def stop(self) -> None:
+        for conn in self.conns.values():
+            if conn.protocol is not None and not conn.closed:
+                try:
+                    conn.protocol.connection_lost(None)
+                except Exception:
+                    pass
+        self.conns.clear()
+
+    # -- effects capture ----------------------------------------------------
+    def capture_chunk(self, peer: str, conn_id: str, seq: int, data: str) -> None:
+        if peer not in self.adapter.nodes:
+            self.effects.logs.append(f"write to unknown node {peer!r} dropped")
+            return
+        self.effects.sends.append(
+            {"dst": peer, "msg": [TCP_TAG, conn_id, seq, data]}
+        )
+
+    def arm_timer(self, delay: float, callback, args):
+        # Same identity scheme as the datagram adapter.
+        from .asyncio_adapter import TIMER_TAG, _TimerHandle
+
+        name = getattr(callback, "__qualname__", repr(callback))
+        k = self.arm_counts.get(name, 0)
+        self.arm_counts[name] = k + 1
+        msg = [TIMER_TAG, name, k]
+        self.armed[tuple(msg)] = (callback, args, self.loop._now + delay)
+        self.effects.timers.append(msg)
+        return _TimerHandle(self, msg, callback, args)
+
+    def cancel_timer(self, msg: list) -> None:
+        if self.armed.pop(tuple(msg), None) is not None:
+            self.effects.cancels.append(msg)
+
+    # -- delivery -----------------------------------------------------------
+    def deliver(self, src: str, msg) -> None:
+        from .asyncio_adapter import TIMER_TAG
+
+        if isinstance(msg, (list, tuple)) and msg and msg[0] == TIMER_TAG:
+            entry = self.armed.pop(tuple(msg), None)
+            if entry is None:
+                self.effects.logs.append(f"stale timer {msg!r} dropped")
+                return
+            callback, args, when = entry
+            self.loop._now = max(self.loop._now, when)
+            callback(*args)
+            return
+        if not (isinstance(msg, (list, tuple)) and len(msg) == 4
+                and msg[0] == TCP_TAG):
+            self.effects.logs.append(f"undecodable message {msg!r} dropped")
+            return
+        _, conn_id, seq, data = msg
+        conn = self.conns.get(conn_id)
+        if conn is None:
+            # First packet of an inbound connection (any seq: the SYN may
+            # arrive after reordered data chunks; reassembly holds them).
+            if self.spec.server_factory is None:
+                self.effects.logs.append(
+                    f"no server for inbound conn {conn_id!r}; dropped"
+                )
+                return
+            conn = _Conn(conn_id, src)
+            conn.next_seq = 0  # server side starts at the SYN
+            self.conns[conn_id] = conn
+        conn.buffer[int(seq)] = data
+        self._drain(conn)
+
+    def _drain(self, conn: _Conn) -> None:
+        """TCP reassembly: apply buffered chunks in sequence order."""
+        while not conn.closed and conn.next_seq in conn.buffer:
+            data = conn.buffer.pop(conn.next_seq)
+            is_syn = conn.next_seq == 0
+            conn.next_seq += 1
+            if is_syn:
+                # Accept: instantiate the server-side protocol.
+                conn.protocol = self.spec.server_factory()
+                conn.transport = _StreamTransport(
+                    self, conn.conn_id, conn.peer
+                )
+                conn.protocol.connection_made(conn.transport)
+            elif data == FIN:
+                conn.closed = True
+                conn.protocol.connection_lost(None)
+            else:
+                conn.protocol.data_received(data.encode("latin-1"))
+
+    # -- checkpoint ---------------------------------------------------------
+    def checkpoint(self) -> dict:
+        state = {}
+        obj = self.spec.app_state
+        if obj is not None:
+            for key, value in vars(obj).items():
+                if key.startswith("_"):
+                    continue
+                try:
+                    json.dumps(value)
+                except (TypeError, ValueError):
+                    continue
+                state[key] = value
+        state["open_conns"] = sorted(
+            c.conn_id for c in self.conns.values() if not c.closed
+        )
+        return state
+
+
+class AsyncioStreamAdapter:
+    """Hosts stream nodes and speaks the bridge protocol on (recv, send)
+    callables; structure mirrors AsyncioAdapter."""
+
+    def __init__(self, nodes: Dict[str, StreamNodeSpec]):
+        self.loop = _Loop(self)
+        self.nodes = {
+            name: _StreamNode(self, name, spec)
+            for name, spec in nodes.items()
+        }
+        self.current_node: Optional[_StreamNode] = None
+
+    def _run(self, node: _StreamNode, fn: Callable[[], None]) -> dict:
+        import asyncio
+
+        node.effects = _Effects()
+        self.current_node = node
+        saved = (asyncio.get_running_loop, asyncio.get_event_loop)
+        asyncio.get_running_loop = lambda: self.loop  # type: ignore
+        asyncio.get_event_loop = lambda: self.loop  # type: ignore
+        try:
+            fn()
+            self.loop.drain()
+        except Exception as e:
+            node.effects.crashed = True
+            node.effects.logs.append(f"crashed: {e!r}")
+        finally:
+            asyncio.get_running_loop, asyncio.get_event_loop = saved
+            self.current_node = None
+        return node.effects.as_reply()
+
+    def serve(self, recv, send) -> None:
+        send({"op": "register", "actors": list(self.nodes)})
+        while True:
+            cmd = recv()
+            if cmd is None or cmd.get("op") == "shutdown":
+                return
+            op = cmd["op"]
+            node = self.nodes.get(cmd.get("actor"))
+            if op == "start":
+                send(self._run(node, node.start))
+            elif op == "deliver":
+                src, msg = cmd["src"], cmd["msg"]
+                send(self._run(node, lambda: node.deliver(src, msg)))
+            elif op == "checkpoint":
+                send({"op": "state", "state": node.checkpoint()})
+            elif op == "stop":
+                node.stop()  # no reply
+            else:
+                raise SystemExit(f"unknown op {cmd!r}")
+
+
+def serve_stdio(nodes: Dict[str, StreamNodeSpec]) -> None:
+    def recv():
+        line = sys.stdin.readline()
+        return json.loads(line) if line else None
+
+    def send(obj):
+        sys.stdout.write(json.dumps(obj) + "\n")
+        sys.stdout.flush()
+
+    AsyncioStreamAdapter(nodes).serve(recv, send)

@@ -1,0 +1,108 @@
+"""A line-framed TCP key-value store with read-modify-write clients —
+plain asyncio streams, no test-framework imports. Run it standalone over
+real sockets:
+
+    python tcp_counter.py     # server + two increment clients, real TCP
+
+Protocol (ASCII lines): "GET k" -> "VAL n"; "SET k n" -> "OK".
+
+Each client increments x by GET / compute / SET — the classic lost-update
+race: two clients interleaving at the server can both read the same value
+and write the same incremented result, so the final count undercounts the
+completed SETs. (No seeded bug; the race is inherent to the design.)
+"""
+
+import asyncio
+
+
+class KVStore:
+    def __init__(self):
+        self.reset()
+
+    def reset(self):
+        self.store = {"x": 0}
+        self.sets = 0
+
+
+class KVServerProtocol(asyncio.Protocol):
+    def __init__(self, kv: KVStore):
+        self.kv = kv
+        self._buf = b""
+
+    def connection_made(self, transport):
+        self.transport = transport
+
+    def data_received(self, data):
+        self._buf += data
+        while b"\n" in self._buf:
+            line, self._buf = self._buf.split(b"\n", 1)
+            self._handle(line.decode("latin-1"))
+
+    def connection_lost(self, exc):
+        pass
+
+    def _handle(self, line):
+        parts = line.split()
+        if not parts:
+            return
+        if parts[0] == "GET":
+            value = self.kv.store.get(parts[1], 0)
+            self.transport.write(f"VAL {value}\n".encode("latin-1"))
+        elif parts[0] == "SET":
+            self.kv.store[parts[1]] = int(parts[2])
+            self.kv.sets += 1
+            self.transport.write(b"OK\n")
+
+
+class IncrementClient(asyncio.Protocol):
+    """GET x, then SET x+1 — one read-modify-write cycle, then close."""
+
+    def __init__(self):
+        self.done = False
+        self._buf = b""
+
+    def connection_made(self, transport):
+        self.transport = transport
+        transport.write(b"GET x\n")
+
+    def data_received(self, data):
+        self._buf += data
+        while b"\n" in self._buf:
+            line, self._buf = self._buf.split(b"\n", 1)
+            self._handle(line.decode("latin-1"))
+
+    def connection_lost(self, exc):
+        pass
+
+    def _handle(self, line):
+        if line.startswith("VAL "):
+            value = int(line.split()[1])
+            self.transport.write(f"SET x {value + 1}\n".encode("latin-1"))
+        elif line == "OK":
+            self.done = True
+            self.transport.close()
+
+
+async def main():
+    """Standalone demo over real TCP on localhost."""
+    kv = KVStore()
+    loop = asyncio.get_running_loop()
+    server = await loop.create_server(
+        lambda: KVServerProtocol(kv), "127.0.0.1", 18900
+    )
+    clients = []
+    for _ in range(2):
+        _, proto = await loop.create_connection(
+            IncrementClient, "127.0.0.1", 18900
+        )
+        clients.append(proto)
+    await asyncio.sleep(0.5)
+    server.close()
+    print(
+        "x:", kv.store["x"], "sets:", kv.sets,
+        "done:", [c.done for c in clients],
+    )
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

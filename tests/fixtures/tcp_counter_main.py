@@ -1,0 +1,48 @@
+"""Bridge launcher + integration surface for the (unmodified) tcp_counter
+asyncio stream app: one KV server node, two increment-client nodes. The
+app module has no knowledge of demi_tpu."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from tcp_counter import IncrementClient, KVServerProtocol, KVStore  # untouched
+
+from demi_tpu.bridge.asyncio_stream_adapter import (
+    Dial,
+    StreamNodeSpec,
+    serve_stdio,
+)
+
+KV = KVStore()
+
+NODE_SPECS = {
+    "server": StreamNodeSpec(
+        server_factory=lambda: KVServerProtocol(KV), app_state=KV
+    ),
+    "alice": StreamNodeSpec(dials=[Dial("server", IncrementClient)]),
+    "bob": StreamNodeSpec(dials=[Dial("server", IncrementClient)]),
+}
+
+
+def lost_update(states):
+    """Safety: the counter must reflect every completed SET — two
+    interleaved read-modify-write cycles that both observed the same
+    value leave x < sets (the lost update)."""
+    server = states.get("server")
+    if server and server.get("sets", 0) > server.get("store", {}).get("x", 0):
+        return 1
+    return None
+
+
+def make_program(session, wait_budget: int = 60):
+    from demi_tpu.external_events import Start, WaitQuiescence
+
+    return [
+        Start(name, ctor=session.actor_factory(name)) for name in NODE_SPECS
+    ] + [WaitQuiescence(budget=wait_budget)]
+
+
+if __name__ == "__main__":
+    serve_stdio(NODE_SPECS)

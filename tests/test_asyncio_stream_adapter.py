@@ -1,0 +1,124 @@
+"""Stream-adapter tier: an UNMODIFIED asyncio.Protocol (TCP) app —
+tests/fixtures/tcp_counter.py, runnable over real sockets — driven
+deterministically. The scheduler reorders connection packets; the
+adapter's per-connection reassembly restores stream order (TCP's
+contract), so exploration perturbs CROSS-connection interleavings: the
+lost-update race surfaces, minimizes, and replays."""
+
+import os
+import sys
+
+from demi_tpu.bridge import BridgeSession, bridge_invariant
+from demi_tpu.bridge.asyncio_stream_adapter import (
+    TCP_TAG,
+    AsyncioStreamAdapter,
+)
+from demi_tpu.config import SchedulerConfig
+from demi_tpu.runner import sts_sched_ddmin
+from demi_tpu.schedulers import BasicScheduler, RandomScheduler
+from demi_tpu.schedulers.replay import ReplayScheduler
+
+FIXTURES = os.path.join(os.path.dirname(os.path.abspath(__file__)), "fixtures")
+sys.path.insert(0, FIXTURES)
+
+from tcp_counter_main import NODE_SPECS, lost_update, make_program  # noqa: E402
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LAUNCHER = [sys.executable, os.path.join(FIXTURES, "tcp_counter_main.py")]
+ENV = {
+    "PYTHONPATH": REPO_ROOT + os.pathsep + os.environ.get("PYTHONPATH", "")
+}
+
+
+def _config():
+    return SchedulerConfig(
+        invariant_check=bridge_invariant(predicate=lost_update)
+    )
+
+
+# -- in-process unit tests of the interposition ----------------------------
+
+def test_stream_dial_emits_syn_then_data():
+    ad = AsyncioStreamAdapter(NODE_SPECS)
+    alice = ad.nodes["alice"]
+    reply = ad._run(alice, alice.start)
+    msgs = [tuple(s["msg"]) for s in reply["sends"]]
+    conn = msgs[0][1]
+    assert msgs == [
+        (TCP_TAG, conn, 0, ""),           # SYN
+        (TCP_TAG, conn, 1, "GET x\n"),    # connection_made's write
+    ]
+    assert not reply["crashed"]
+
+
+def test_stream_reassembly_holds_out_of_order_chunks():
+    """The data chunk may be scheduled BEFORE the SYN: the server must
+    buffer it and process accept+data in stream order when the SYN
+    lands."""
+    ad = AsyncioStreamAdapter(NODE_SPECS)
+    server = ad.nodes["server"]
+    ad._run(server, server.start)
+    conn = "alice->server#0"
+    early = ad._run(
+        server,
+        lambda: server.deliver("alice", (TCP_TAG, conn, 1, "GET x\n")),
+    )
+    assert early["sends"] == []  # held: no accept yet
+    landed = ad._run(
+        server, lambda: server.deliver("alice", (TCP_TAG, conn, 0, ""))
+    )
+    # SYN drained the buffer: accept, then GET -> VAL reply.
+    assert [tuple(s["msg"]) for s in landed["sends"]] == [
+        (TCP_TAG, conn, 1, "VAL 0\n")
+    ]
+    assert server.checkpoint()["open_conns"] == [conn]
+
+
+def test_stream_fin_closes_connection():
+    ad = AsyncioStreamAdapter(NODE_SPECS)
+    server = ad.nodes["server"]
+    ad._run(server, server.start)
+    conn = "alice->server#0"
+    ad._run(server, lambda: server.deliver("alice", (TCP_TAG, conn, 0, "")))
+    ad._run(
+        server,
+        lambda: server.deliver("alice", (TCP_TAG, conn, 1, "__FIN__")),
+    )
+    assert server.checkpoint()["open_conns"] == []
+
+
+# -- end-to-end over the bridge ---------------------------------------------
+
+def test_tcp_lost_update_found_minimized_replayed():
+    """FIFO order already interleaves the two clients' GETs before either
+    SET (both read 0): the lost update is deterministic under
+    BasicScheduler, minimizes, and strictly replays; random schedules
+    also produce serialized (non-violating) executions — the race is
+    schedule-dependent, not a constant-failure artifact."""
+    with BridgeSession(LAUNCHER, env=ENV) as session:
+        config = _config()
+        program = make_program(session)
+        found = BasicScheduler(config).execute(program)
+        assert found.violation is not None and found.violation.code == 1
+
+        outcomes = set()
+        for seed in range(12):
+            r = RandomScheduler(
+                config, seed=seed, max_messages=80,
+                invariant_check_interval=1,
+            ).execute(program)
+            outcomes.add(r.violation is not None)
+        assert outcomes == {True, False}, outcomes
+
+        mcs, verified = sts_sched_ddmin(
+            config, found.trace, program, found.violation
+        )
+        assert verified is not None
+        # Both clients + the server are essential to the race: the MCS
+        # keeps all three Starts (nothing spurious to remove but the
+        # budgeted wait collapses into the implicit final drain).
+        assert len(mcs.get_all_events()) <= len(program)
+
+        replayed = ReplayScheduler(config).replay(found.trace, program)
+        assert replayed.violation is not None
+        assert replayed.violation.matches(found.violation)
