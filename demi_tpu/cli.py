@@ -374,15 +374,28 @@ def cmd_bridge_fuzz(args) -> int:
     from .schedulers import RandomScheduler
 
     payloads = [_normalize(json.loads(s)) for s in args.send]
-    if not payloads:
-        raise SystemExit("at least one --send JSON payload is required")
+    if not payloads and args.num_sends > 0:
+        raise SystemExit(
+            "at least one --send JSON payload is required "
+            "(or pass --num-sends 0 for apps driven purely by Starts)"
+        )
+    predicate = None
+    if args.invariant:
+        # App-specific safety predicate from the app's integration
+        # surface: "module:function" over the checkpoint-states dict.
+        import importlib
+
+        mod_name, _, fn_name = args.invariant.partition(":")
+        predicate = getattr(importlib.import_module(mod_name), fn_name)
     with BridgeSession(
         shlex.split(args.launcher), transport=args.transport
     ) as session:
         names = session.actor_names
         targets = args.to or names
         print(f"registered actors: {', '.join(names)}")
-        config = SchedulerConfig(invariant_check=bridge_invariant())
+        config = SchedulerConfig(
+            invariant_check=bridge_invariant(predicate=predicate)
+        )
         for i in range(args.max_executions):
             rng = _random.Random(args.seed + i)
             program = [
@@ -563,6 +576,12 @@ def main(argv: Optional[list] = None) -> int:
                    dest="max_messages")
     p.add_argument("--timer-weight", type=float, default=0.3,
                    dest="timer_weight")
+    p.add_argument(
+        "--invariant", default=None, metavar="MODULE:FUNCTION",
+        help="app-specific safety predicate (states dict -> violation "
+             "code or None) layered on the deadlock invariant; import "
+             "path resolved from PYTHONPATH",
+    )
     p.set_defaults(fn=cmd_bridge_fuzz)
 
     p = sub.add_parser("interactive", help="hand-drive a schedule")

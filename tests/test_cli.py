@@ -101,3 +101,21 @@ def test_cli_minimize_peek_rejects_unsupported_combos(exp_dir):
              + ["--peek", "3", "--strategy", "incddmin"])
     with _pytest.raises(SystemExit, match=">= 0"):
         main(["minimize"] + _common(exp_dir) + ["--peek", "-1"])
+
+
+def test_cli_bridge_fuzz_stream_app_with_invariant(capsys, monkeypatch):
+    import os
+    import sys
+
+    fixtures = os.path.join(os.path.dirname(__file__), "fixtures")
+    monkeypatch.syspath_prepend(fixtures)
+    rc = main([
+        "bridge-fuzz",
+        "--launcher",
+        f"{sys.executable} {os.path.join(fixtures, 'tcp_counter_main.py')}",
+        "--num-sends", "0", "--max-executions", "10",
+        "--invariant", "tcp_counter_main:lost_update",
+    ])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "violation" in out and "MCS verified" in out
