@@ -109,6 +109,13 @@ def test_cli_bridge_fuzz_stream_app_with_invariant(capsys, monkeypatch):
 
     fixtures = os.path.join(os.path.dirname(__file__), "fixtures")
     monkeypatch.syspath_prepend(fixtures)
+    # The spawned launcher child must import demi_tpu (append, never
+    # overwrite: PYTHONPATH may carry the TPU plugin site).
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    monkeypatch.setenv(
+        "PYTHONPATH",
+        repo + os.pathsep + os.environ.get("PYTHONPATH", ""),
+    )
     rc = main([
         "bridge-fuzz",
         "--launcher",
