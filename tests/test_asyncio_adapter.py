@@ -30,7 +30,9 @@ REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 LAUNCHER = [sys.executable, os.path.join(FIXTURES, "udp_lock_main.py")]
 # Append, never overwrite: PYTHONPATH may carry the TPU plugin site.
 ENV = {
-    "PYTHONPATH": REPO_ROOT + os.pathsep + os.environ.get("PYTHONPATH", "")
+    "PYTHONPATH": os.pathsep.join(
+        p for p in (REPO_ROOT, os.environ.get("PYTHONPATH")) if p
+    )
 }
 
 SERVER = ("10.0.0.1", 9000)

@@ -26,7 +26,9 @@ from tcp_counter_main import NODE_SPECS, lost_update, make_program  # noqa: E402
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 LAUNCHER = [sys.executable, os.path.join(FIXTURES, "tcp_counter_main.py")]
 ENV = {
-    "PYTHONPATH": REPO_ROOT + os.pathsep + os.environ.get("PYTHONPATH", "")
+    "PYTHONPATH": os.pathsep.join(
+        p for p in (REPO_ROOT, os.environ.get("PYTHONPATH")) if p
+    )
 }
 
 

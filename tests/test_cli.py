@@ -109,12 +109,15 @@ def test_cli_bridge_fuzz_stream_app_with_invariant(capsys, monkeypatch):
 
     fixtures = os.path.join(os.path.dirname(__file__), "fixtures")
     monkeypatch.syspath_prepend(fixtures)
-    # The spawned launcher child must import demi_tpu (append, never
-    # overwrite: PYTHONPATH may carry the TPU plugin site).
+    # The spawned launcher child must import demi_tpu. Prepend the repo
+    # but keep whatever PYTHONPATH already carries (the TPU plugin site),
+    # and never leave an empty entry (CPython reads '' as cwd).
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     monkeypatch.setenv(
         "PYTHONPATH",
-        repo + os.pathsep + os.environ.get("PYTHONPATH", ""),
+        os.pathsep.join(
+            p for p in (repo, os.environ.get("PYTHONPATH")) if p
+        ),
     )
     rc = main([
         "bridge-fuzz",
