@@ -266,3 +266,33 @@ def test_udp_lock_run_the_gamut():
         assert "wildcard" in stages  # clock-clustering ran on string msgs
         assert len(gamut.mcs_externals) < len(program)
         assert gamut.final_trace.deliveries()
+
+
+def test_udp_lock_soak_minimize_replay_every_hit():
+    """Robustness sweep: across 120 fuzz schedules, EVERY phantom-grant
+    hit must minimize (verified MCS) and strict-replay reproduce — the
+    invariant the 500-seed round-4 soak held (43/43)."""
+    with BridgeSession(LAUNCHER, env=ENV) as session:
+        config = _config()
+        program = _program(session)
+        found = minimized = replayed = 0
+        for seed in range(120):
+            r = RandomScheduler(
+                config, seed=seed, max_messages=120,
+                invariant_check_interval=1, timer_weight=0.4,
+            ).execute(program)
+            if r.violation is None:
+                continue
+            found += 1
+            _, verified = sts_sched_ddmin(
+                config, r.trace, program, r.violation
+            )
+            minimized += verified is not None
+            rep = ReplayScheduler(config).replay(r.trace, program)
+            replayed += (
+                rep.violation is not None
+                and rep.violation.matches(r.violation)
+            )
+        assert found >= 5
+        assert minimized == found
+        assert replayed == found
