@@ -26,7 +26,7 @@ import jax.numpy as jnp
 
 from ..dsl import DSLApp
 from .core import ST_DONE, ST_VIOLATION, DeviceConfig, ScheduleState
-from .explore import ExtProgram, _finalize, init_state, make_step_fn
+from .explore import ExtProgram, _finalize, init_state, make_any_step_fn
 
 LANES = "lanes"
 
@@ -58,7 +58,7 @@ def _segment_lane_fn(app: DSLApp, cfg: DeviceConfig, seg_steps: int):
     ``cfg.max_steps`` budget (finished lanes are frozen no-ops). The
     counter rides the carry (not scan xs) so the same trace lowers under
     Mosaic, where xs-slicing has no lowering."""
-    step = make_step_fn(app, cfg)
+    step = make_any_step_fn(app, cfg)
 
     def seg_lane(state: ScheduleState, prog: ExtProgram, steps_run):
         def body(carry, _):

@@ -111,6 +111,25 @@ class DeviceConfig:
     # wholesale on failure. 0 = ignore-absent only. Costs a second
     # in-flight state copy per lane while replaying, so opt-in.
     replay_peek: int = 0
+    # Synchronous-round dispatch (device-only exploration mode, no host
+    # counterpart): each dispatch step selects ONE uniformly-random
+    # deliverable entry PER RECEIVER and delivers them all, with effects
+    # computed sequential-equivalently to the ascending-receiver-id
+    # linearization (deliveries at distinct receivers commute in this
+    # actor model — a handler reads/writes only its own state row). Cuts
+    # step count for flood workloads (BASELINE config 5) by up to
+    # num_actors x; per-receiver delivery ORDER stays fully randomized,
+    # which is what the reachable state space depends on. Segment
+    # conditions/invariant intervals are evaluated at round (not
+    # delivery) granularity; recorded traces are the canonical
+    # linearization and replay sequentially (tests/test_rounds.py pins
+    # ignored_absent == 0 through the replay kernel).
+    round_delivery: bool = False
+    # Trace-row capacity when record_trace is on (None = max_steps). The
+    # sequential kernels append at most one record per step, so max_steps
+    # rows always suffice; round_delivery appends up to num_actors records
+    # per step — size this to the expected delivery total there.
+    trace_capacity: Optional[int] = None
     # Message-payload storage dtype for the pool/timer-memory columns
     # ('int32' or 'int16'). The [P, W] pool_msg array dominates the
     # per-lane carry, so halving it halves the HBM traffic of the XLA
@@ -129,6 +148,15 @@ class DeviceConfig:
             raise ValueError(
                 f"msg_dtype must be 'int32' or 'int16', got {self.msg_dtype!r}"
             )
+        if self.round_delivery and self.record_trace and not self.trace_capacity:
+            # Round mode appends up to num_actors records per step; the
+            # max_steps fallback that suits the sequential kernels would
+            # silently truncate the lift (runtime overflow flags lanes,
+            # but an undersized default is a config error — fail fast).
+            raise ValueError(
+                "round_delivery with record_trace requires an explicit "
+                "trace_capacity (expected total deliveries + externals)"
+            )
 
     @property
     def msg_jnp_dtype(self):
@@ -139,6 +167,10 @@ class DeviceConfig:
         if self.index_mode == "auto":
             return jax.default_backend() == "tpu"
         return self.index_mode == "onehot"
+
+    @property
+    def trace_rows(self) -> int:
+        return self.trace_capacity if self.trace_capacity else self.max_steps
 
     @property
     def rec_width(self) -> int:
@@ -215,7 +247,7 @@ def init_state(app: DSLApp, cfg: DeviceConfig, key) -> ScheduleState:
     init_states = np.stack(
         [np.asarray(app.init_state(i), np.int32) for i in range(n)]
     )
-    trace_shape = (cfg.max_steps, cfg.rec_width) if cfg.record_trace else (0, 0)
+    trace_shape = (cfg.trace_rows, cfg.rec_width) if cfg.record_trace else (0, 0)
     return ScheduleState(
         actor_state=jnp.asarray(init_states),
         started=jnp.zeros(n, bool),
@@ -312,7 +344,7 @@ def insert_rows(
     row_timer: jnp.ndarray,  # [K] bool
     row_parked: jnp.ndarray,  # [K] bool
     row_msg: jnp.ndarray,  # [K, W] int32
-    crec=None,  # scalar int32: trace index of the creating event
+    crec=None,  # int32 trace index of the creating event: scalar or [K]
 ) -> ScheduleState:
     """Scatter up to K new entries into free pool slots. Overflow (more valid
     rows than free slots) flips the lane status to ST_OVERFLOW."""
@@ -354,9 +386,18 @@ def insert_rows(
             status=jnp.where(overflow, jnp.int32(ST_OVERFLOW), state.status),
         )
         if crec is not None:
-            new_state = new_state._replace(
-                pool_crec=jnp.where(hit, crec, state.pool_crec)
-            )
+            crec = jnp.asarray(crec, jnp.int32)
+            if crec.ndim == 0:
+                new_crec = jnp.where(hit, crec, state.pool_crec)
+            else:  # per-row creator links ([K], round-delivery inserts)
+                new_crec = jnp.where(
+                    hit,
+                    jnp.sum(
+                        jnp.where(oh_kp, crec[:, None], 0), axis=0
+                    ),
+                    state.pool_crec,
+                )
+            new_state = new_state._replace(pool_crec=new_crec)
         return new_state
     slots = jnp.where(ok, slots, cfg.pool_capacity)  # out-of-range => dropped
     new_state = state._replace(
@@ -373,6 +414,7 @@ def insert_rows(
     if crec is not None:
         # Creator links are only maintained when tracing (DPOR mode) —
         # untraced sweeps skip the extra scatter entirely.
+        crec = jnp.asarray(crec, jnp.int32)
         new_state = new_state._replace(
             pool_crec=state.pool_crec.at[slots].set(
                 jnp.broadcast_to(crec, (k,)), mode="drop"
@@ -546,7 +588,7 @@ def deliver_index(
 
 
 def _append_record(state: ScheduleState, cfg: DeviceConfig, rec, enabled) -> ScheduleState:
-    pos = jnp.minimum(state.trace_len, cfg.max_steps - 1)
+    pos = jnp.minimum(state.trace_len, cfg.trace_rows - 1)
     new_trace = ops.set_row(state.trace, pos, rec, enabled, cfg.use_onehot)
     return state._replace(
         trace=new_trace, trace_len=state.trace_len + enabled.astype(jnp.int32)

@@ -81,6 +81,115 @@ def _precomputed(app: DSLApp, cfg: DeviceConfig):
     return jnp.asarray(init_states), jnp.asarray(initial_rows)
 
 
+def _injection_phase(
+    state: ScheduleState,
+    cfg: DeviceConfig,
+    app: DSLApp,
+    prog: ExtProgram,
+    initial_rows,
+    init_states,
+    injecting,
+):
+    """The masked injection half of a fused step (inert unless `injecting`:
+    op -> OP_END): applies the current external op's effects and all segment
+    bookkeeping (budget/final/cond), returning the proposed pool rows for
+    the shared insert. Shared verbatim by the sequential step and the
+    round-delivery step (rounds.py) so the two kernels cannot drift."""
+    oh = cfg.use_onehot
+    e = prog.op.shape[0]
+    cur = jnp.clip(state.ext_cursor, 0, e - 1)
+    exhausted = state.ext_cursor >= e
+    cur_op = ops.get_scalar(prog.op, cur, oh)
+    op = jnp.where(injecting & ~exhausted, cur_op, OP_END)
+    state, inj_rows, inj_rec, inj_enabled = external_effects(
+        state, cfg, app, initial_rows, init_states,
+        op,
+        ops.get_scalar(prog.a, cur, oh),
+        ops.get_scalar(prog.b, cur, oh),
+        ops.get_row(prog.msg, cur, oh),
+    )
+    new_cursor = state.ext_cursor + (injecting & ~exhausted).astype(jnp.int32)
+    raw_op = jnp.where(exhausted, OP_END, cur_op)
+    is_wait_like = (raw_op == OP_WAIT) | (raw_op == OP_WAITCOND)
+    to_dispatch = injecting & (
+        is_wait_like | (raw_op == OP_END) | (new_cursor >= e)
+    )
+    # Bounded quiescence: a WAIT op carries its budget in field `a`, a
+    # WAITCOND in field `b` (`a` is its condition id); 0 = strict. A
+    # final drain — entered via OP_END *or* by running off the end of
+    # a full-length program — is unlimited (stale budgets must not cap
+    # it).
+    seg_budget = jnp.where(
+        injecting,
+        jnp.where(
+            raw_op == OP_WAIT,
+            ops.get_scalar(prog.a, cur, oh),
+            jnp.where(
+                raw_op == OP_WAITCOND,
+                ops.get_scalar(prog.b, cur, oh),
+                jnp.where(
+                    (raw_op == OP_END) | (new_cursor >= e),
+                    0,
+                    state.seg_budget,
+                ),
+            ),
+        ),
+        state.seg_budget,
+    ).astype(jnp.int32)
+    # Host-parity run-end semantics (reference: execution ends with the
+    # segment of the LAST external event): the segment we're entering is
+    # final if this op is OP_END / past-the-end, or a WAIT/WAITCOND with
+    # nothing but OP_END after it.
+    next_cur = jnp.clip(new_cursor, 0, e - 1)
+    next_op = jnp.where(
+        new_cursor >= e, OP_END, ops.get_scalar(prog.op, next_cur, oh)
+    )
+    final_seg = to_dispatch & (
+        (raw_op == OP_END)
+        | (new_cursor >= e)
+        | (is_wait_like & (next_op == OP_END))
+    )
+    state = state._replace(
+        ext_cursor=new_cursor,
+        seg_budget=seg_budget,
+        seg_start=jnp.where(
+            to_dispatch, state.deliveries, state.seg_start
+        ).astype(jnp.int32),
+        final_seg=jnp.where(to_dispatch, final_seg, state.final_seg),
+        seg_cond=jnp.where(
+            to_dispatch,
+            jnp.where(
+                raw_op == OP_WAITCOND,
+                ops.get_scalar(prog.a, cur, oh),
+                jnp.int32(-1),
+            ),
+            state.seg_cond,
+        ).astype(jnp.int32),
+    )
+    return state, inj_rows, inj_rec, inj_enabled, to_dispatch
+
+
+def _segment_cond_met(state: ScheduleState, app: DSLApp, dispatching):
+    """WaitCondition gating: True when this dispatch segment's condition
+    (seg_cond >= 0) currently holds. The host checks the condition BEFORE
+    each delivery and ends the segment without delivering once it holds;
+    masking every candidate reproduces that exactly (the quiescence test
+    sees no deliverable and flips the segment)."""
+    if not app.conditions:
+        return jnp.bool_(False)
+    branches = [
+        (lambda s, fn=fn: fn(s.actor_state, alive_mask(s))
+         .astype(jnp.bool_))
+        for fn in app.conditions
+    ]
+    cid = jnp.clip(state.seg_cond, 0, len(branches) - 1)
+    return (
+        (state.seg_cond >= 0)
+        & jax.lax.switch(cid, branches, state)
+        & dispatching
+    )
+
+
 def make_step_fn(app: DSLApp, cfg: DeviceConfig):
     """The fused, branchless step: injection and dispatch effects are both
     computed with masks (inert op / invalid index for the inactive side) and
@@ -104,97 +213,12 @@ def make_step_fn(app: DSLApp, cfg: DeviceConfig):
         dispatching = active & (state.status == ST_DISPATCH)
         rec_idx = state.trace_len  # creator link for this step's insert
 
-        # ----- injection side (inert unless `injecting`: op -> OP_END) ----
-        e = prog.op.shape[0]
-        cur = jnp.clip(state.ext_cursor, 0, e - 1)
-        exhausted = state.ext_cursor >= e
-        cur_op = ops.get_scalar(prog.op, cur, oh)
-        op = jnp.where(injecting & ~exhausted, cur_op, OP_END)
-        state, inj_rows, inj_rec, inj_enabled = external_effects(
-            state, cfg, app, initial_rows, init_states,
-            op,
-            ops.get_scalar(prog.a, cur, oh),
-            ops.get_scalar(prog.b, cur, oh),
-            ops.get_row(prog.msg, cur, oh),
-        )
-        new_cursor = state.ext_cursor + (injecting & ~exhausted).astype(jnp.int32)
-        raw_op = jnp.where(exhausted, OP_END, cur_op)
-        is_wait_like = (raw_op == OP_WAIT) | (raw_op == OP_WAITCOND)
-        to_dispatch = injecting & (
-            is_wait_like | (raw_op == OP_END) | (new_cursor >= e)
-        )
-        # Bounded quiescence: a WAIT op carries its budget in field `a`, a
-        # WAITCOND in field `b` (`a` is its condition id); 0 = strict. A
-        # final drain — entered via OP_END *or* by running off the end of
-        # a full-length program — is unlimited (stale budgets must not cap
-        # it).
-        seg_budget = jnp.where(
-            injecting,
-            jnp.where(
-                raw_op == OP_WAIT,
-                ops.get_scalar(prog.a, cur, oh),
-                jnp.where(
-                    raw_op == OP_WAITCOND,
-                    ops.get_scalar(prog.b, cur, oh),
-                    jnp.where(
-                        (raw_op == OP_END) | (new_cursor >= e),
-                        0,
-                        state.seg_budget,
-                    ),
-                ),
-            ),
-            state.seg_budget,
-        ).astype(jnp.int32)
-        # Host-parity run-end semantics (reference: execution ends with the
-        # segment of the LAST external event): the segment we're entering is
-        # final if this op is OP_END / past-the-end, or a WAIT/WAITCOND with
-        # nothing but OP_END after it.
-        next_cur = jnp.clip(new_cursor, 0, e - 1)
-        next_op = jnp.where(
-            new_cursor >= e, OP_END, ops.get_scalar(prog.op, next_cur, oh)
-        )
-        final_seg = to_dispatch & (
-            (raw_op == OP_END)
-            | (new_cursor >= e)
-            | (is_wait_like & (next_op == OP_END))
-        )
-        state = state._replace(
-            ext_cursor=new_cursor,
-            seg_budget=seg_budget,
-            seg_start=jnp.where(
-                to_dispatch, state.deliveries, state.seg_start
-            ).astype(jnp.int32),
-            final_seg=jnp.where(to_dispatch, final_seg, state.final_seg),
-            seg_cond=jnp.where(
-                to_dispatch,
-                jnp.where(
-                    raw_op == OP_WAITCOND,
-                    ops.get_scalar(prog.a, cur, oh),
-                    jnp.int32(-1),
-                ),
-                state.seg_cond,
-            ).astype(jnp.int32),
+        state, inj_rows, inj_rec, inj_enabled, to_dispatch = _injection_phase(
+            state, cfg, app, prog, initial_rows, init_states, injecting
         )
 
         # ----- dispatch side (inert unless `dispatching`: idx -> P) -------
-        # WaitCondition gating: the host checks the condition BEFORE each
-        # delivery and ends the segment without delivering once it holds;
-        # masking every candidate reproduces that exactly (the quiescence
-        # test below sees no deliverable and flips the segment).
-        if app.conditions:
-            branches = [
-                (lambda s, fn=fn: fn(s.actor_state, alive_mask(s))
-                 .astype(jnp.bool_))
-                for fn in app.conditions
-            ]
-            cid = jnp.clip(state.seg_cond, 0, len(branches) - 1)
-            cond_met = (
-                (state.seg_cond >= 0)
-                & jax.lax.switch(cid, branches, state)
-                & dispatching
-            )
-        else:
-            cond_met = jnp.bool_(False)
+        cond_met = _segment_cond_met(state, app, dispatching)
         mask = deliverable_mask(state, cfg) & dispatching & ~cond_met
         if cfg.srcdst_fifo:
             # TCP-ordered channels: only FIFO heads (and timers) compete.
@@ -302,6 +326,16 @@ def make_step_fn(app: DSLApp, cfg: DeviceConfig):
     return step
 
 
+def make_any_step_fn(app: DSLApp, cfg: DeviceConfig):
+    """The cfg-selected step function: round-delivery or sequential. The
+    single dispatch point for every driver (explore, continuous)."""
+    if cfg.round_delivery:
+        from .rounds import make_round_step_fn  # lazy: rounds imports us
+
+        return make_round_step_fn(app, cfg)
+    return make_step_fn(app, cfg)
+
+
 def _finalize(state: ScheduleState, app, cfg) -> ScheduleState:
     code = check_invariant(state, app)
     return state._replace(
@@ -314,7 +348,7 @@ def make_run_lane(app: DSLApp, cfg: DeviceConfig):
     """One lane, program to completion (or step cap): the single source of
     lane semantics shared by the batch explore kernel and the single-lane
     trace kernel (the pair whose agreement the device→host lift relies on)."""
-    step = make_step_fn(app, cfg)
+    step = make_any_step_fn(app, cfg)
 
     def run_lane(prog: ExtProgram, key) -> LaneResult:
         state = init_state(app, cfg, key)

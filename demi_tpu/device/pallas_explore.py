@@ -61,6 +61,13 @@ def _check_pallas_cfg(cfg: DeviceConfig, interpret: Optional[bool]):
             "pallas kernels require the one-hot index mode on TPU "
             "(DeviceConfig(index_mode='onehot' or 'auto'))"
         )
+    if not interpret and cfg.round_delivery:
+        # The round step's Mosaic lowering is unvalidated (gumbel/uniform
+        # sampling + 2-D record scatters); use the XLA backend for round
+        # mode — its win is step-count reduction, which XLA gets too.
+        raise ValueError(
+            "round_delivery is XLA-only; drop impl='pallas' for round mode"
+        )
     return interpret
 
 

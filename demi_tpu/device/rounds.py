@@ -1,0 +1,401 @@
+"""Synchronous-round dispatch: deliver one message per receiver per step.
+
+The sequential explore step (explore.py) delivers ONE pool entry per step
+but pays pool-linear mask/insert work every step — for flood workloads
+(BASELINE config 5: 64-actor reliable broadcast, ~4.6k deliveries/lane)
+that is ~4.6k pool-wide passes per lane. In this actor model deliveries at
+DISTINCT receivers commute: a handler reads/writes only its own state row
+and emits point-to-point sends, so any round that delivers at most one
+entry per receiver equals the sequential schedule that delivers them in
+ascending receiver order. This kernel exploits that: each dispatch step
+selects one uniformly-random deliverable entry PER RECEIVER and applies
+all of them with effects computed sequential-equivalently to the
+ascending-receiver-id linearization — up to num_actors deliveries for one
+round of pool-wide work.
+
+What stays exact w.r.t. that linearization (pinned by tests/test_rounds.py
+replaying recorded round traces through the sequential replay kernel with
+``ignored_absent == 0``):
+  - per-receiver handler effects, pool consumption, arrival seqs
+  - the sched_hash fold (closed form of the sequential FNV fold)
+  - the order-SENSITIVE timer-memory semantics (a non-timer delivery
+    clears every actor's remembered timer and unparks the pool): resolved
+    with prefix/suffix-or over the canonical order, including park checks
+    of each receiver's re-armed timers against the memory state *at its
+    position* in the linearization
+  - trace records (canonical order) and DPOR parent links
+
+What coarsens to round granularity (documented divergence from the
+sequential kernel, NOT from legal system behavior): segment WaitCondition
+checks and interval invariant checks run once per round, and quiescence
+budgets cap the round's delivery count rather than interleaving.
+
+This mode is a device-only exploration strategy with no reference
+counterpart (the reference's JVM scheduler is inherently one-message-at-
+a-time, Instrumenter.scala:913-1109); it widens the per-step parallelism
+axis the same way vmap widens the per-lane axis — SIMD over receivers
+inside SIMD over schedules.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..dsl import DSLApp
+from . import ops
+from .core import (
+    REC_DELIVERY,
+    REC_TIMER,
+    ST_DISPATCH,
+    ST_DONE,
+    ST_INJECT,
+    ST_OVERFLOW,
+    ST_VIOLATION,
+    DeviceConfig,
+    RowProposal,
+    ScheduleState,
+    _append_record,
+    check_invariant,
+    deliverable_mask,
+    fifo_head_mask,
+    insert_rows,
+)
+
+_FNV_PRIME = 0x01000193
+_BIG = jnp.int32(1 << 30)
+
+
+def _per_dst_reduce(vals, dstv, cand, n, oh, reduce, fill):
+    """Per-destination reduce of ``vals[i]`` over entries with
+    ``dstv[i] == d`` and ``cand[i]`` -> [N]. Dual-mode like device/ops."""
+    if oh:
+        dst_oh = dstv[:, None] == jnp.arange(n)[None, :]
+        table = jnp.where(dst_oh & cand[:, None], vals[:, None], fill)
+        return reduce(table, axis=0)
+    masked = jnp.where(cand, vals, fill)
+    init = jnp.full((n,), fill, masked.dtype)
+    if reduce is jnp.max:
+        return init.at[dstv].max(masked)
+    return init.at[dstv].min(masked)
+
+
+def _gather_entry(vec, e_safe, oh, is_row=False):
+    """vec[e_safe] for per-receiver entry indices e_safe[N] into pool
+    arrays [P] / [P, W]."""
+    if not oh:
+        return vec[e_safe]
+    eoh = e_safe[:, None] == jnp.arange(vec.shape[0])[None, :]
+    if is_row:
+        return jnp.einsum(
+            "np,pw->nw", eoh.astype(jnp.int32), vec.astype(jnp.int32)
+        )
+    if vec.dtype == jnp.bool_:
+        return jnp.any(eoh & vec[None, :], axis=1)
+    return jnp.sum(jnp.where(eoh, vec[None, :], 0), axis=1)
+
+
+def make_round_step_fn(app: DSLApp, cfg: DeviceConfig):
+    """The round-delivery twin of explore.make_step_fn: identical injection
+    phase (shared code), dispatch delivers one entry per receiver."""
+    from .explore import (  # local: rounds is imported by explore
+        _injection_phase,
+        _precomputed,
+        _segment_cond_met,
+    )
+
+    init_states, initial_rows = _precomputed(app, cfg)
+    oh = cfg.use_onehot
+    n, p, w = cfg.num_actors, cfg.pool_capacity, cfg.msg_width
+    k_out = cfg.max_outbox
+    actor_ids = jnp.arange(n, dtype=jnp.int32)
+    idxv = jnp.arange(p, dtype=jnp.int32)
+    # FNV prime powers c^j for j in [0, n]: the closed-form fold
+    # h' = h*c^r + sum_i mix_i * c^(r-1-i) of r sequential fold steps.
+    cpow = jnp.asarray(
+        [pow(_FNV_PRIME, j, 1 << 32) for j in range(n + 1)], jnp.uint32
+    )
+    pw31 = jnp.asarray([pow(31, j, 1 << 32) for j in range(w)], jnp.uint32)
+    if app.timer_tags:
+        ttags = jnp.asarray(list(app.timer_tags), jnp.int32)
+    else:
+        ttags = None
+
+    def step(state: ScheduleState, prog) -> ScheduleState:
+        active = state.status < ST_DONE
+        injecting = active & (state.status == ST_INJECT)
+        dispatching = active & (state.status == ST_DISPATCH)
+        inj_rec_idx = state.trace_len
+
+        state, inj_rows, inj_rec, inj_enabled, to_dispatch = _injection_phase(
+            state, cfg, app, prog, initial_rows, init_states, injecting
+        )
+
+        # ----- dispatch round ---------------------------------------------
+        cond_met = _segment_cond_met(state, app, dispatching)
+        cand = deliverable_mask(state, cfg) & dispatching & ~cond_met
+        if cfg.srcdst_fifo:
+            cand = cand & fifo_head_mask(state)
+        any_deliverable = jnp.any(cand)
+
+        # Per-receiver uniform choice: argmax of iid priorities over each
+        # receiver's candidates is uniform among them; with timer_weight,
+        # Gumbel-max gives the per-entry weighted analog of the sequential
+        # kernel's class-weighted choice.
+        key, sub = ops.rng_split(state.rng)
+        if cfg.timer_weight != 1.0:
+            u = jax.random.uniform(
+                sub, (p,), minval=1e-20, maxval=1.0
+            )
+            pri = -jnp.log(-jnp.log(u)) + jnp.log(
+                jnp.where(state.pool_timer, cfg.timer_weight, 1.0)
+            )
+        else:
+            pri = jax.random.uniform(sub, (p,))
+        state = state._replace(rng=jnp.where(dispatching, key, state.rng))
+
+        dstv = state.pool_dst
+        best = _per_dst_reduce(pri, dstv, cand, n, oh, jnp.max, -jnp.inf)
+        delivered0 = _per_dst_reduce(
+            cand, dstv, cand, n, oh, jnp.max, False
+        )
+        is_best = cand & (pri >= ops.gather_vec(best, dstv, oh))
+        min_idx = _per_dst_reduce(
+            idxv, dstv, is_best, n, oh, jnp.min, jnp.int32(p)
+        )
+        chosen = is_best & (idxv == ops.gather_vec(min_idx, dstv, oh))
+
+        # Quiescence-budget cap: deliver only the first `remaining`
+        # receivers of the canonical order (sequential kernel delivers
+        # exactly seg_budget entries then flips the segment).
+        remaining = jnp.where(
+            state.seg_budget > 0,
+            state.seg_budget - (state.deliveries - state.seg_start),
+            _BIG,
+        )
+        incl0 = ops.prefix_sum(delivered0.astype(jnp.int32), oh)
+        rank0 = incl0 - delivered0.astype(jnp.int32)  # exclusive
+        delivered = delivered0 & (rank0 < remaining)
+        chosen = chosen & ops.gather_vec(delivered, dstv, oh)
+        incl = ops.prefix_sum(delivered.astype(jnp.int32), oh)
+        rank = incl - delivered.astype(jnp.int32)
+        r_total = jnp.sum(delivered.astype(jnp.int32))
+
+        # Per-receiver chosen entry (p = none).
+        e_idx = _per_dst_reduce(
+            idxv, dstv, chosen, n, oh, jnp.min, jnp.int32(p)
+        )
+        e_safe = jnp.minimum(e_idx, p - 1)
+        src_d = _gather_entry(state.pool_src, e_safe, oh)
+        msg_d = _gather_entry(state.pool_msg, e_safe, oh, is_row=True).astype(
+            jnp.int32
+        )
+        is_t = _gather_entry(state.pool_timer, e_safe, oh) & delivered
+        crec_d = _gather_entry(state.pool_crec, e_safe, oh)
+
+        # Handlers, vmapped over receivers; effects masked by `delivered`.
+        new_rows, outbox = jax.vmap(app.handler)(
+            actor_ids, state.actor_state, src_d, msg_d
+        )
+        actor_state = jnp.where(
+            delivered[:, None], new_rows, state.actor_state
+        )
+
+        # Canonical-order timer-memory semantics. Sequential rules
+        # (core.delivery_effects): a timer delivery at d remembers msg in
+        # row d; a non-timer delivery zeroes the WHOLE table and unparks
+        # the pool. Resolved over ascending-d order with prefix/suffix-or.
+        dnt = delivered & ~is_t
+        nt_incl = ops.prefix_sum(dnt.astype(jnp.int32), oh)
+        nt_total = jnp.sum(dnt.astype(jnp.int32))
+        nt_before = (nt_incl - dnt.astype(jnp.int32)) > 0  # strictly earlier
+        nt_after = (nt_total - nt_incl) > 0  # strictly later
+        any_nt = nt_total > 0
+        set_row = is_t & ~nt_after  # timer survives: no later clear
+        zero_row = ~set_row & any_nt
+        timer_mem = jnp.where(
+            set_row[:, None],
+            msg_d.astype(state.timer_mem.dtype),
+            jnp.where(zero_row[:, None], 0, state.timer_mem),
+        )
+        timer_mem_valid = set_row | (~any_nt & state.timer_mem_valid)
+
+        # Outboxes -> proposed rows ([N, K] grid), with park checks against
+        # the memory state at each receiver's position: row d is visible
+        # unless an earlier receiver delivered a non-timer (only d itself
+        # ever writes row d, and d's own update lands after its check).
+        ob_valid = (outbox[:, :, 0] != 0) & delivered[:, None]
+        ob_dst = jnp.clip(outbox[:, :, 1], 0, n - 1)
+        ob_msg = outbox[:, :, 2:]
+        ob_src = jnp.broadcast_to(actor_ids[:, None], (n, k_out))
+        if ttags is not None:
+            tag_hit = jnp.any(
+                ob_msg[:, :, 0:1] == ttags[None, None, :], axis=2
+            )
+        else:
+            tag_hit = jnp.zeros((n, k_out), bool)
+        ob_timer = tag_hit & (ob_dst == actor_ids[:, None])
+        check_valid = state.timer_mem_valid & ~nt_before
+        mem_match = (
+            jnp.all(
+                ob_msg
+                == state.timer_mem.astype(jnp.int32)[:, None, :],
+                axis=2,
+            )
+            & check_valid[:, None]
+        )
+        ob_parked = ob_timer & mem_match & ~nt_after[:, None]
+
+        # Consume + count + old-entry unparking.
+        state = state._replace(
+            actor_state=actor_state,
+            pool_valid=state.pool_valid & ~chosen,
+            pool_parked=jnp.where(
+                any_nt, jnp.zeros_like(state.pool_parked), state.pool_parked
+            ),
+            timer_mem=timer_mem,
+            timer_mem_valid=timer_mem_valid,
+            deliveries=state.deliveries + r_total,
+        )
+
+        # Closed-form sched_hash fold of the linearization.
+        mix = (
+            jnp.sum(msg_d.astype(jnp.uint32) * pw31[None, :], axis=1)
+            + src_d.astype(jnp.uint32) * jnp.uint32(0x9E3779B1)
+            + actor_ids.astype(jnp.uint32) * jnp.uint32(0x85EBCA77)
+            + is_t.astype(jnp.uint32) * jnp.uint32(0xC2B2AE35)
+        )
+        expo = jnp.clip(r_total - 1 - rank, 0, n)
+        coeff = ops.gather_vec(cpow, expo, oh)
+        fold = state.sched_hash * ops.get_scalar(cpow, r_total, oh) + jnp.sum(
+            jnp.where(delivered, mix * coeff, jnp.uint32(0))
+        )
+        state = state._replace(
+            sched_hash=jnp.where(r_total > 0, fold, state.sched_hash)
+        )
+
+        # Trace records in canonical order.
+        if cfg.record_trace:
+            t_rows = state.trace.shape[0]
+            pos = state.trace_len + rank
+            kind = jnp.where(is_t, REC_TIMER, REC_DELIVERY)
+            parts = [jnp.stack([kind, src_d, actor_ids], axis=1), msg_d]
+            if cfg.record_parents:
+                prev = state.last_rec
+                parts.append(crec_d[:, None])
+                parts.append(prev[:, None])
+                state = state._replace(
+                    last_rec=jnp.where(delivered, pos, state.last_rec)
+                )
+            rec = jnp.concatenate(parts, axis=1)  # [N, rec_width]
+            if oh:
+                pos_oh = (
+                    pos[:, None] == jnp.arange(t_rows)[None, :]
+                ) & delivered[:, None]
+                hit = jnp.any(pos_oh, axis=0)
+                contrib = jnp.einsum(
+                    "nt,nr->tr", pos_oh.astype(jnp.int32), rec
+                )
+                trace = jnp.where(hit[:, None], contrib, state.trace)
+            else:
+                pos_sc = jnp.where(delivered, pos, t_rows)
+                trace = state.trace.at[pos_sc].set(rec, mode="drop")
+            # A round that would overrun the trace array corrupts the
+            # device->host lift (trace_len past the stored rows) — flag
+            # the lane as aborted instead of silently dropping records.
+            state = state._replace(
+                trace=trace,
+                trace_len=state.trace_len + r_total,
+                status=jnp.where(
+                    state.trace_len + r_total > t_rows,
+                    jnp.int32(ST_OVERFLOW),
+                    state.status,
+                ),
+            )
+            crec_round = jnp.broadcast_to(pos[:, None], (n, k_out)).reshape(-1)
+        else:
+            crec_round = jnp.zeros((n * k_out,), jnp.int32)
+
+        # ----- the ONE pool insert for both sides -------------------------
+        round_rows = RowProposal(
+            valid=ob_valid.reshape(-1),
+            src=ob_src.reshape(-1),
+            dst=ob_dst.reshape(-1),
+            timer=ob_timer.reshape(-1),
+            parked=ob_parked.reshape(-1),
+            msg=ob_msg.reshape(n * k_out, w),
+        )
+        rows = RowProposal.concat(inj_rows, round_rows)
+        if cfg.record_parents:
+            k_inj = inj_rows.valid.shape[0]
+            crec = jnp.concatenate(
+                [jnp.full((k_inj,), inj_rec_idx, jnp.int32), crec_round]
+            )
+        else:
+            crec = None
+        state = insert_rows(
+            state, cfg, rows.valid, rows.src, rows.dst, rows.timer,
+            rows.parked, rows.msg, crec=crec,
+        )
+        if cfg.record_trace:
+            # Injection record (mutually exclusive with round records).
+            state = _append_record(
+                state, cfg, inj_rec, injecting & inj_enabled
+            )
+
+        inv_code = check_invariant(state, app)
+
+        # Interval invariant check at round granularity: fire when the
+        # round crossed an interval boundary.
+        if cfg.invariant_interval:
+            iv = cfg.invariant_interval
+            due = (
+                (state.deliveries // iv)
+                > ((state.deliveries - r_total) // iv)
+            ) & (r_total > 0)
+            code = jnp.where(due, inv_code, jnp.int32(0))
+            state = state._replace(
+                status=jnp.where(
+                    code != 0, jnp.int32(ST_VIOLATION), state.status
+                ),
+                violation=jnp.where(
+                    code != 0, code.astype(jnp.int32), state.violation
+                ),
+            )
+
+        # ----- status resolution (identical to the sequential step) ------
+        status = jnp.where(
+            injecting & (state.status == ST_INJECT) & to_dispatch,
+            jnp.int32(ST_DISPATCH),
+            state.status,
+        )
+        budget_spent = (state.seg_budget > 0) & (
+            state.deliveries - state.seg_start >= state.seg_budget
+        )
+        quiescent = (
+            dispatching
+            & (~any_deliverable | budget_spent)
+            & (status == ST_DISPATCH)
+        )
+        fin_code = inv_code
+        status = jnp.where(
+            quiescent,
+            jnp.where(
+                state.final_seg,
+                jnp.where(
+                    fin_code != 0, jnp.int32(ST_VIOLATION), jnp.int32(ST_DONE)
+                ),
+                jnp.int32(ST_INJECT),
+            ),
+            status,
+        )
+        violation = jnp.where(
+            quiescent & state.final_seg,
+            fin_code.astype(jnp.int32),
+            state.violation,
+        )
+        return state._replace(status=status, violation=violation)
+
+    return step
