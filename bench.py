@@ -16,7 +16,11 @@ Extra keys reported for the record:
     (schedules/sec + violations found).
   - config5: BASELINE config 5 — 64-actor reliable broadcast sweep
     (schedules/sec + lanes swept; 1M lanes on TPU, smaller on CPU
-    fallback; override with DEMI_BENCH_CONFIG5_LANES).
+    fallback; override with DEMI_BENCH_CONFIG5_LANES). Runs in
+    round-delivery mode by default (identical invariant semantics for
+    this workload — checks only at quiescence; ~6x on CPU);
+    DEMI_BENCH_CONFIG5_MODE=seq forces the sequential kernel for
+    comparison with pre-round-5 numbers.
   - platform: the JAX platform the numbers were measured on.
 
 Modes: `python bench.py` runs everything; `--config 4` / `--config 5`
@@ -64,8 +68,12 @@ def bench_device_raft(jax):
     can tell signal from noise (VERDICT r3 weak #7).
 
     DEMI_BENCH_IMPL forces a single variant: xla | xla-trailing |
-    xla-trailing-ee | pallas | pallas-trailing | pallas-trailing-ee
-    ('-ee' = early-exit while_loop instead of the fixed-length scan).
+    xla-trailing-ee | pallas | pallas-trailing | pallas-trailing-ee |
+    xla-round-ee | xla-trailing-round-ee ('-ee' = early-exit while_loop
+    instead of the fixed-length scan; '-round' = round-delivery mode,
+    whose invariant checks are round-granularity — such variants are
+    excluded from the per-delivery headline and summarized under
+    "round", unless forced alone, which relabels the metric).
     DEMI_BENCH_BLOCK_LANES sets the pallas block size."""
     import dataclasses
 
@@ -102,18 +110,25 @@ def bench_device_raft(jax):
         [
             "xla", "xla-trailing", "xla-trailing-ee",
             "pallas", "pallas-trailing", "pallas-trailing-ee",
+            "xla-round-ee", "xla-trailing-round-ee",
         ]
         if platform not in ("cpu",)
-        else ["xla", "xla-trailing", "xla-trailing-ee"]
+        else [
+            "xla", "xla-trailing", "xla-trailing-ee",
+            "xla-round-ee", "xla-trailing-round-ee",
+        ]
     )
 
     def build(name):
         lane_axis = "trailing" if "-trailing" in name else "leading"
-        k_cfg = (
-            dataclasses.replace(cfg, early_exit=True)
-            if name.endswith("-ee")
-            else cfg
-        )
+        k_cfg = cfg
+        if name.endswith("-ee"):
+            k_cfg = dataclasses.replace(k_cfg, early_exit=True)
+        if "-round" in name:
+            # Round-delivery variants check the invariant at round (not
+            # delivery) granularity — reported separately, never as the
+            # per-delivery headline (see `round` in the output).
+            k_cfg = dataclasses.replace(k_cfg, round_delivery=True)
         if name.startswith("pallas"):
             return make_explore_kernel_pallas(
                 app, k_cfg, block_lanes=block_lanes, lane_axis=lane_axis
@@ -187,13 +202,24 @@ def bench_device_raft(jax):
         rs = sorted(rates[name])
         per_impl_raw[name] = round(rs[len(rs) // 2], 1)  # median
         spread[name] = [round(rs[0], 1), round(rs[-1], 1)]
-    best = max(uniq_rate_exact, key=uniq_rate_exact.get)
+    # Headline = best variant with per-delivery invariant checks; the
+    # round-delivery variants (coarser, round-granularity checks) are
+    # summarized separately so the metric name stays truthful.
+    seq_rates = {
+        n: r for n, r in uniq_rate_exact.items() if "-round" not in n
+    }
+    rnd_rates = {n: r for n, r in uniq_rate_exact.items() if "-round" in n}
+    headline_granularity = "per-delivery"
+    if not seq_rates:  # every per-delivery variant failed on this backend
+        seq_rates = rnd_rates
+        headline_granularity = "round"
+    best = max(seq_rates, key=seq_rates.get)
     uniq_rate = per_impl[best]
     # Exact duplicate fraction over the best variant's measured lanes
     # (per-rep rate variance must not leak into this metric).
     best_uniq = int(np.unique(np.concatenate(hashes[best])).size)
     best_lanes = len(rates[best]) * batch
-    return uniq_rate, {
+    extra = {
         "per_impl": per_impl,
         "per_impl_raw_lanes_per_sec": per_impl_raw,
         "per_impl_rep_spread": spread,
@@ -201,7 +227,19 @@ def bench_device_raft(jax):
         "raw_lanes_per_sec": per_impl_raw[best],
         "unique_fraction": round(best_uniq / best_lanes, 4),
         "impl": best,
+        # "round" here = the headline number itself came from a
+        # round-granularity variant (only when no per-delivery variant
+        # produced a result) — main() relabels the metric string then.
+        "headline_invariant_granularity": headline_granularity,
     }
+    if rnd_rates:
+        rbest = max(rnd_rates, key=rnd_rates.get)
+        extra["round"] = {
+            "value": per_impl[rbest],
+            "impl": rbest,
+            "invariant_granularity": "round",
+        }
+    return uniq_rate, extra
 
 
 def bench_host_raft(budget_s: float = 6.0):
@@ -327,14 +365,22 @@ def bench_config5(jax, total_lanes=None):
 
     n = 64
     app = make_broadcast_app(n, reliable=True)
+    # Round-delivery mode by default (DEMI_BENCH_CONFIG5_MODE=seq forces
+    # the sequential kernel): with invariant_interval=0 the agreement
+    # check runs only at quiescence in BOTH modes, so round mode is
+    # apples-to-apples here — same programs, same verdicts, same unique-
+    # schedule accounting — at ~1/30th the steps (one round delivers up
+    # to one message per receiver; the flood is ~4.5k deliveries/lane).
+    mode = os.environ.get("DEMI_BENCH_CONFIG5_MODE", "round")
     # Reliable broadcast floods n*(n-1) relays; pool must hold the peak.
     cfg = DeviceConfig.for_app(
         app,
         pool_capacity=4608,
-        max_steps=4608,
+        max_steps=4608 if mode == "seq" else 224,
         max_external_ops=80,
         invariant_interval=0,  # agreement holds only at quiescence
         early_exit=True,  # the flood quiesces below the step cap
+        round_delivery=(mode != "seq"),
     )
     starts = dsl_start_events(app)
 
@@ -352,10 +398,13 @@ def bench_config5(jax, total_lanes=None):
 
     platform = jax.devices()[0].platform
     if total_lanes is None:
-        # CPU fallback: the 64-actor flood runs ~1 lane/sec on CPU (4608
-        # steps x 4608-slot pool per lane), so keep the soak tiny; the
-        # 1M-lane sweep is a TPU workload.
-        default = 1_000_000 if platform not in ("cpu",) else 64
+        # CPU fallback sizing: sequential runs ~2-3 lanes/sec (4608 steps
+        # x 4608-slot pool per lane); round mode ~25-30/sec. The 1M-lane
+        # sweep is a TPU workload either way.
+        if platform not in ("cpu",):
+            default = 1_000_000
+        else:
+            default = 256 if mode != "seq" else 64
         total_lanes = int(os.environ.get("DEMI_BENCH_CONFIG5_LANES", default))
     chunk = min(2048 if platform not in ("cpu",) else 32, total_lanes)
     driver = SweepDriver(app, cfg, program_gen)
@@ -366,6 +415,7 @@ def bench_config5(jax, total_lanes=None):
     overflow_lanes = sum(c.overflow_lanes for c in result.chunks)
     return {
         "actors": n,
+        "mode": mode,
         "lanes": result.lanes,
         "schedules_per_sec": round(result.lanes / secs, 1),
         "unique_schedules": result.unique_schedules,
@@ -533,6 +583,11 @@ def main():
         return
 
     value, impl_info = bench_device_raft(jax)
+    if impl_info.get("headline_invariant_granularity") == "round":
+        out["metric"] = (
+            "unique schedules explored/sec/chip (5-node raft fuzz, "
+            "round-granularity invariant checks)"
+        )
     host = bench_host_raft()
     ttfv = bench_time_to_first_violation(jax)
     config4 = bench_config4(jax)
