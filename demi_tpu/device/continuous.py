@@ -325,6 +325,17 @@ class ContinuousSweepDriver:
 
             self._lower = _lower_memo
         self._stack = stack_programs
+        if impl == "pallas" and cfg.round_delivery:
+            # Round mode is XLA-only; degrade rather than abort (matches
+            # SweepDriver's env-forced-pallas fallback).
+            import sys
+
+            print(
+                "ContinuousSweepDriver: round_delivery is XLA-only; "
+                "using the XLA segment kernel",
+                file=sys.stderr,
+            )
+            impl = "xla"
         if impl == "pallas":
             self.segment = make_segment_kernel_pallas(
                 app, cfg, seg_steps, block_lanes=block_lanes, mesh=mesh

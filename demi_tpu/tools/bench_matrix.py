@@ -48,8 +48,8 @@ def main(argv=None):
     platform = jax.devices()[0].platform
     prog1 = lower_program(app, cfg, program)
 
-    def measure(kernel, batch):
-        progs = stack_programs([prog1] * batch)
+    def measure(kernel, batch, prog_override=None):
+        progs = stack_programs([prog_override or prog1] * batch)
         keys = jax.random.split(jax.random.PRNGKey(0), batch)
         t0 = time.perf_counter()
         jax.block_until_ready(kernel(progs, keys))
@@ -69,6 +69,28 @@ def main(argv=None):
             try:
                 sps, comp = measure(
                     make_explore_kernel(app, cfg, lane_axis=lane_axis), batch
+                )
+                print(json.dumps({
+                    "impl": tag, "platform": platform, "batch": batch,
+                    "schedules_per_sec": round(sps, 1),
+                    "compile_s": round(comp, 1),
+                }), flush=True)
+            except Exception as e:
+                print(json.dumps({
+                    "impl": tag, "batch": batch, "error": repr(e)[:300]
+                }), flush=True)
+    # Round-delivery variants (round-granularity invariant checks; see
+    # DESIGN.md §3b) — the per-step-parallelism lever on this hardware.
+    import dataclasses
+
+    rcfg = dataclasses.replace(cfg, round_delivery=True, early_exit=True)
+    for lane_axis in ("leading", "trailing"):
+        for batch in batches:
+            tag = f"xla-round-{lane_axis}-ee"  # -ee: rcfg sets early_exit
+            try:
+                sps, comp = measure(
+                    make_explore_kernel(app, rcfg, lane_axis=lane_axis),
+                    batch,
                 )
                 print(json.dumps({
                     "impl": tag, "platform": platform, "batch": batch,
@@ -165,6 +187,49 @@ def main(argv=None):
                 print(json.dumps({
                     "impl": tag, "batch": batch, "error": repr(e)[:300],
                 }), flush=True)
+
+    # Config-5 fixture pair (64-actor reliable flood, P=4608): the
+    # per-delivery step cost is pool-linear, so this is where round
+    # mode's step-count collapse shows — sequential vs round on the SAME
+    # programs/seeds (VERDICT r4 #2's measured cell). Lane counts stay
+    # tiny: the cell measures per-lane step cost, not sweep scale.
+    from demi_tpu.apps.broadcast import make_broadcast_app
+    from demi_tpu.apps.common import dsl_start_events
+    from demi_tpu.external_events import (
+        Kill, MessageConstructor, Send, WaitQuiescence,
+    )
+
+    bapp = make_broadcast_app(64, reliable=True)
+    bstarts = dsl_start_events(bapp)
+    bprogram = list(bstarts) + [
+        Send(bapp.actor_name(0), MessageConstructor(lambda: (1, 0))),
+        Kill(bapp.actor_name(1)),
+        WaitQuiescence(),
+    ]
+    b_lanes = 8 if platform in ("cpu",) else 256
+    for tag, steps, rnd in (
+        ("config5-seq", 4608, False),
+        ("config5-round", 224, True),
+    ):
+        bcfg = DeviceConfig.for_app(
+            bapp, pool_capacity=4608, max_steps=steps,
+            max_external_ops=80, early_exit=True, round_delivery=rnd,
+        )
+        try:
+            sps, comp = measure(
+                make_explore_kernel(bapp, bcfg),
+                b_lanes,
+                prog_override=lower_program(bapp, bcfg, bprogram),
+            )
+            print(json.dumps({
+                "impl": tag, "platform": platform, "batch": b_lanes,
+                "schedules_per_sec": round(sps, 2),
+                "compile_s": round(comp, 1),
+            }), flush=True)
+        except Exception as e:
+            print(json.dumps({
+                "impl": tag, "batch": b_lanes, "error": repr(e)[:300],
+            }), flush=True)
 
 
 if __name__ == "__main__":
