@@ -369,10 +369,20 @@ def cmd_bridge_fuzz(args) -> int:
 
     from .bridge import BridgeSession, bridge_invariant
     from .bridge.session import _normalize
-    from .external_events import MessageConstructor, Send, Start
+    from .external_events import (
+        MessageConstructor,
+        Send,
+        Start,
+        atomic_block,
+    )
     from .runner import sts_sched_ddmin
     from .schedulers import RandomScheduler
 
+    if args.atomic_batch < 0 or args.atomic_batch > args.num_sends:
+        raise SystemExit(
+            f"--atomic-batch must be in [0, --num-sends]; got "
+            f"{args.atomic_batch} with --num-sends {args.num_sends}"
+        )
     payloads = [_normalize(json.loads(s)) for s in args.send]
     if not payloads and args.num_sends > 0:
         raise SystemExit(
@@ -398,15 +408,22 @@ def cmd_bridge_fuzz(args) -> int:
         )
         for i in range(args.max_executions):
             rng = _random.Random(args.seed + i)
-            program = [
-                Start(n, ctor=session.actor_factory(n)) for n in names
-            ] + [
+            sends = [
                 Send(
                     rng.choice(targets),
                     MessageConstructor(lambda p=rng.choice(payloads): p),
                 )
                 for _ in range(args.num_sends)
-            ] + [WaitQuiescence(budget=args.wait_budget)]
+            ]
+            if args.atomic_batch and len(sends) >= args.atomic_batch:
+                # Mark a random contiguous run of sends as one external
+                # atomic block (minimizes all-or-nothing, unignorable).
+                k = args.atomic_batch
+                j = rng.randrange(len(sends) - k + 1)
+                atomic_block(sends[j:j + k])
+            program = [
+                Start(n, ctor=session.actor_factory(n)) for n in names
+            ] + sends + [WaitQuiescence(budget=args.wait_budget)]
             result = RandomScheduler(
                 config, seed=args.seed + i, max_messages=args.max_messages,
                 invariant_check_interval=1, timer_weight=args.timer_weight,
@@ -576,6 +593,12 @@ def main(argv: Optional[list] = None) -> int:
                    dest="max_messages")
     p.add_argument("--timer-weight", type=float, default=0.3,
                    dest="timer_weight")
+    p.add_argument(
+        "--atomic-batch", type=int, default=0, dest="atomic_batch",
+        metavar="K",
+        help="mark a random K-run of the generated sends as one external "
+             "atomic block (all-or-nothing under minimization)",
+    )
     p.add_argument(
         "--invariant", default=None, metavar="MODULE:FUNCTION",
         help="app-specific safety predicate (states dict -> violation "

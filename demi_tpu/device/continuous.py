@@ -26,7 +26,13 @@ import jax.numpy as jnp
 
 from ..dsl import DSLApp
 from .core import ST_DONE, ST_VIOLATION, DeviceConfig, ScheduleState
-from .explore import ExtProgram, _finalize, init_state, make_any_step_fn
+from .explore import (
+    ExtProgram,
+    _finalize,
+    init_state,
+    make_any_step_fn,
+    resolve_impl,
+)
 
 LANES = "lanes"
 
@@ -325,17 +331,7 @@ class ContinuousSweepDriver:
 
             self._lower = _lower_memo
         self._stack = stack_programs
-        if impl == "pallas" and cfg.round_delivery:
-            # Round mode is XLA-only; degrade rather than abort (matches
-            # SweepDriver's env-forced-pallas fallback).
-            import sys
-
-            print(
-                "ContinuousSweepDriver: round_delivery is XLA-only; "
-                "using the XLA segment kernel",
-                file=sys.stderr,
-            )
-            impl = "xla"
+        impl = resolve_impl(impl, cfg, "ContinuousSweepDriver")
         if impl == "pallas":
             self.segment = make_segment_kernel_pallas(
                 app, cfg, seg_steps, block_lanes=block_lanes, mesh=mesh

@@ -336,6 +336,21 @@ def make_any_step_fn(app: DSLApp, cfg: DeviceConfig):
     return make_step_fn(app, cfg)
 
 
+def resolve_impl(impl: str, cfg: DeviceConfig, driver: str) -> str:
+    """Backend selection rule shared by the sweep drivers: round mode is
+    XLA-only (pallas_explore guard), and an env/arg-forced pallas must
+    degrade rather than abort — TPU bench windows are scarce."""
+    if impl == "pallas" and cfg.round_delivery:
+        import sys
+
+        print(
+            f"{driver}: round_delivery is XLA-only; using the XLA kernels",
+            file=sys.stderr,
+        )
+        return "xla"
+    return impl
+
+
 def _finalize(state: ScheduleState, app, cfg) -> ScheduleState:
     code = check_invariant(state, app)
     return state._replace(

@@ -102,6 +102,13 @@ class ExternalEvent:
     equal-looking events at different positions."""
 
     eid: int = field(default_factory=_next_eid, init=False)
+    # External atomic block membership (reference:
+    # ExternalEventInjector.scala:179-216 begin/endExternalAtomicBlock):
+    # consecutive events sharing a block id inject as one atomic batch
+    # (Begin/End markers recorded around them), minimize as ONE atom
+    # (all-or-nothing, never interleaved), and replay unignorably. Assign
+    # via ``atomic_block(...)``.
+    block: Optional[int] = field(default=None, init=False, compare=False)
 
     # Identity semantics but stable hashing across pickling.
     def __eq__(self, other):
@@ -234,11 +241,52 @@ def externals_summary(events: Sequence[ExternalEvent]) -> str:
     return " ".join(parts)
 
 
+def atomic_block(
+    events: Sequence[ExternalEvent], block_id: Optional[int] = None
+) -> List[ExternalEvent]:
+    """Mark ``events`` as one external atomic block (reference:
+    beginExternalAtomicBlock / endExternalAtomicBlock,
+    ExternalEventInjector.scala:179-216 — the mechanism a nondeterministic
+    external client uses to mark 'this batch is one logical input'):
+
+      - injection applies the members back-to-back with Begin/End markers
+        recorded around them (schedulers/base.py);
+      - DDMin removes the block all-or-nothing and never interleaves
+        other events into it (minimization/event_dag.py atomize);
+      - STS replay treats the block's recorded consequences as
+        unignorable — absences inside it raise instead of being skipped
+        (schedulers/replay.py), the sequential-world rendering of the
+        reference's 'wait for block end before deciding whether its
+        messages show up' (STSScheduler.scala:414-444).
+
+    Returns the same event objects (mutated in place: block ids ride the
+    eid counter so deserialization can floor past them). Members must be
+    used contiguously and must not contain Wait* events."""
+    events = list(events)
+    bid = block_id if block_id is not None else _next_eid()
+    for e in events:
+        if isinstance(e, (WaitQuiescence, WaitCondition)):
+            raise ValueError(f"atomic blocks cannot contain waits: {e!r}")
+        object.__setattr__(e, "block", bid)
+    return events
+
+
 def sanity_check_externals(events: Sequence[ExternalEvent]) -> None:
     """Reject trivially malformed fuzz tests: sends/kills of never-started
-    actors (reference: Fuzzer.validateFuzzTest, Fuzzer.scala:126-133)."""
+    actors (reference: Fuzzer.validateFuzzTest, Fuzzer.scala:126-133) and
+    non-contiguous atomic blocks."""
     started = set()
+    closed_blocks = set()
+    open_block: Optional[int] = None
     for e in events:
+        if e.block != open_block:
+            if open_block is not None:
+                closed_blocks.add(open_block)
+            if e.block in closed_blocks:
+                raise ValueError(
+                    f"atomic block {e.block} is not contiguous at {e}"
+                )
+            open_block = e.block
         if isinstance(e, Start):
             started.add(e.name)
         elif isinstance(e, (Kill, HardKill)):
@@ -247,3 +295,6 @@ def sanity_check_externals(events: Sequence[ExternalEvent]) -> None:
         elif isinstance(e, Send):
             if e.name not in started:
                 raise ValueError(f"{e} targets never-started actor {e.name}")
+        elif isinstance(e, (WaitQuiescence, WaitCondition)):
+            if e.block is not None:
+                raise ValueError(f"atomic blocks cannot contain waits: {e!r}")

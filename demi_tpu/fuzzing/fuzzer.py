@@ -24,6 +24,7 @@ from ..external_events import (
     UnPartition,
     WaitCondition,
     WaitQuiescence,
+    atomic_block,
     sanity_check_externals,
 )
 
@@ -59,6 +60,10 @@ class FuzzerWeights:
     # with a DSLApp.conditions table (Fuzzer(num_conditions=...)); always
     # budgeted so an unsatisfiable predicate can't wedge a lane.
     wait_condition: float = 0.0
+    # External atomic blocks: a batch of 2-4 sends marked as one logical
+    # input (external_events.atomic_block) — injected atomically,
+    # minimized all-or-nothing, unignorable under STS replay.
+    atomic_block: float = 0.0
 
 
 class Fuzzer:
@@ -111,6 +116,7 @@ class Fuzzer:
             ("hard_kill", self.weights.hard_kill),
             ("restart", self.weights.restart),
             ("wait_condition", self.weights.wait_condition),
+            ("atomic_block", self.weights.atomic_block),
         ]
         total = sum(w for _, w in choices)
         generated = 0
@@ -151,6 +157,19 @@ class Fuzzer:
                 send = self.message_gen.generate(rng, alive)
                 if send is not None:
                     events.append(send)
+                    generated += 1
+            elif kind == "atomic_block":
+                batch = []
+                for _ in range(rng.randint(2, 4)):
+                    send = self.message_gen.generate(rng, alive)
+                    if send is None:
+                        break
+                    batch.append(send)
+                if len(batch) >= 2:
+                    events.extend(atomic_block(batch))
+                    generated += len(batch)
+                elif batch:  # generator ran dry mid-batch: plain send
+                    events.extend(batch)
                     generated += 1
             elif kind == "wait_condition":
                 if self.num_conditions > 0 and events and not isinstance(

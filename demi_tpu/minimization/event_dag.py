@@ -85,6 +85,26 @@ class UnmodifiedEventDag(EventDag):
     def atomize(self, given_events: Sequence[ExternalEvent]) -> List[AtomicEvent]:
         by_eid = {e.eid: e for e in self.events}
         atoms: List[AtomicEvent] = []
+        # External atomic blocks (ExternalEvent.block): members form ONE
+        # atom — DDMin removes them all-or-nothing, exactly the
+        # reference's treatment of a task's begin/endExternalAtomicBlock
+        # extent. Pairing is transitive: a Start..Kill or conjoined pair
+        # with one foot in a block pulls the other foot in.
+        block_of = {
+            e.eid: e.block for e in given_events if e.block is not None
+        }
+        block_groups: Dict[int, List[ExternalEvent]] = {}
+
+        def place(*events: ExternalEvent) -> None:
+            bids = {block_of.get(e.eid) for e in events} - {None}
+            if len(bids) > 1:
+                raise ValueError(
+                    f"events pair across atomic blocks: {events!r}"
+                )
+            if bids:
+                block_groups.setdefault(bids.pop(), []).extend(events)
+            else:
+                atoms.append(AtomicEvent(*events))
 
         # Explicitly conjoined pairs first.
         conjoined = [e for e in given_events if e.eid in self._conjoined]
@@ -95,7 +115,7 @@ class UnmodifiedEventDag(EventDag):
             partner = by_eid[self._conjoined[e.eid]]
             seen.add(e.eid)
             seen.add(partner.eid)
-            atoms.append(AtomicEvent(e, partner))
+            place(e, partner)
 
         # Domain knowledge: Start..Kill and Partition..UnPartition pair up.
         open_dual: Dict[str, ExternalEvent] = {}
@@ -106,7 +126,7 @@ class UnmodifiedEventDag(EventDag):
                 start = open_dual.pop(("start", e.name), None)
                 if start is None:
                     raise ValueError(f"Kill({e.name}) without preceding Start")
-                atoms.append(AtomicEvent(start, e))
+                place(start, e)
             elif isinstance(e, Start):
                 open_dual[("start", e.name)] = e
             elif isinstance(e, Partition):
@@ -115,13 +135,17 @@ class UnmodifiedEventDag(EventDag):
                 part = open_dual.pop(("part", e.a, e.b), None)
                 if part is None:
                     raise ValueError(f"UnPartition({e.a},{e.b}) without Partition")
-                atoms.append(AtomicEvent(part, e))
+                place(part, e)
             else:
-                atoms.append(AtomicEvent(e))
+                place(e)
 
         # Unpaired Starts/Partitions stand alone.
         for e in open_dual.values():
-            atoms.append(AtomicEvent(e))
+            place(e)
+
+        for members in block_groups.values():
+            members.sort(key=lambda e: self.event_to_idx[e.eid])
+            atoms.append(AtomicEvent(*members))
 
         total = sum(len(a.events) for a in atoms)
         assert total == len(given_events), (total, len(given_events))

@@ -15,7 +15,6 @@ headline metric against the JVM reference.
 from __future__ import annotations
 
 import os
-import sys
 import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, List, Optional, Sequence, Tuple
@@ -116,17 +115,11 @@ class SweepDriver:
         self.app = app
         self.cfg = cfg
         self.program_gen = program_gen
-        impl = os.environ.get("DEMI_DEVICE_IMPL", "xla")
-        if impl == "pallas" and cfg.round_delivery:
-            # Round mode is XLA-only (pallas_explore guard); a forced
-            # pallas env must degrade, not kill the sweep (TPU bench
-            # windows are scarce).
-            print(
-                "SweepDriver: round_delivery is XLA-only; ignoring "
-                "DEMI_DEVICE_IMPL=pallas",
-                file=sys.stderr,
-            )
-            impl = "xla"
+        from ..device.explore import resolve_impl
+
+        impl = resolve_impl(
+            os.environ.get("DEMI_DEVICE_IMPL", "xla"), cfg, "SweepDriver"
+        )
         self.impl = impl
         if use_mesh:
             self.mesh = mesh or make_mesh()

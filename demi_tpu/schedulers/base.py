@@ -26,9 +26,11 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 from ..config import SchedulerConfig
 from ..events import (
     EXTERNAL,
+    BeginExternalAtomicBlock,
     BeginWaitCondition,
     BeginWaitQuiescence,
     CodeBlockEvent,
+    EndExternalAtomicBlock,
     HardKillEvent,
     KillEvent,
     MsgEvent,
@@ -189,17 +191,42 @@ class BaseScheduler:
 
         Reference: EventOrchestrator.inject_until_quiescence
         (EventOrchestrator.scala:132-189)."""
+        open_block: Optional[int] = None
+
+        def _close_block() -> None:
+            nonlocal open_block
+            if open_block is not None:
+                self.trace.append(
+                    self._unique(EndExternalAtomicBlock(open_block))
+                )
+                open_block = None
+
         while cursor < len(program):
             event = program[cursor]
             cursor += 1
             if isinstance(event, WaitQuiescence):
+                _close_block()
                 self.trace.append(self._unique(BeginWaitQuiescence()))
                 return cursor, None, event.budget
             if isinstance(event, WaitCondition):
+                _close_block()
                 self.trace.append(self._unique(BeginWaitCondition()))
                 cond = event.cond or self._dsl_condition(event.cond_id)
                 return cursor, cond, event.budget
+            # External atomic blocks (reference:
+            # ExternalEventInjector.scala:179-216): members inject
+            # back-to-back inside Begin/End markers. Injection is already
+            # atomic w.r.t. dispatch here; the markers make the block
+            # boundary visible to STS replay and trace surgeries.
+            if event.block != open_block:
+                _close_block()
+                if event.block is not None:
+                    self.trace.append(
+                        self._unique(BeginExternalAtomicBlock(event.block))
+                    )
+                    open_block = event.block
             self._inject_one(event)
+        _close_block()
         return cursor, None, None
 
     def _dsl_condition(self, cond_id: Optional[int]) -> Callable[[], bool]:

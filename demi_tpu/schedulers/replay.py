@@ -25,10 +25,12 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 from ..config import SchedulerConfig
 from ..events import (
     EXTERNAL,
+    BeginExternalAtomicBlock,
     BeginUnignorableEvents,
     BeginWaitCondition,
     BeginWaitQuiescence,
     CodeBlockEvent,
+    EndExternalAtomicBlock,
     EndUnignorableEvents,
     Event,
     HardKillEvent,
@@ -290,6 +292,18 @@ class TraceFollowingScheduler(BaseScheduler):
             self._unignorable_depth += 1
             self.trace.append(self._unique(event))
         elif isinstance(event, EndUnignorableEvents):
+            self._unignorable_depth = max(0, self._unignorable_depth - 1)
+            self.trace.append(self._unique(event))
+        elif isinstance(event, BeginExternalAtomicBlock):
+            # An external atomic block's recorded consequences are
+            # unignorable during its extent: the reference defers
+            # ignore-absent decisions until the live block ends
+            # (STSScheduler.scala:414-444) — in this synchronous engine
+            # the block's injections are deterministic, so the faithful
+            # rendering is 'absences inside the block raise'.
+            self._unignorable_depth += 1
+            self.trace.append(self._unique(event))
+        elif isinstance(event, EndExternalAtomicBlock):
             self._unignorable_depth = max(0, self._unignorable_depth - 1)
             self.trace.append(self._unique(event))
         # other meta events: ignore

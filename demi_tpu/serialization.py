@@ -29,10 +29,12 @@ from typing import Any, Dict, List, Optional, Sequence
 from .dsl import DSLApp
 from .events import (
     WildCardMatch,
+    BeginExternalAtomicBlock,
     BeginUnignorableEvents,
     BeginWaitCondition,
     BeginWaitQuiescence,
     CodeBlockEvent,
+    EndExternalAtomicBlock,
     EndUnignorableEvents,
     Event,
     HardKillEvent,
@@ -148,6 +150,10 @@ def _event_to_json(u: Unique) -> Dict[str, Any]:
         rec.update(type="begin_unignorable")
     elif isinstance(e, EndUnignorableEvents):
         rec.update(type="end_unignorable")
+    elif isinstance(e, BeginExternalAtomicBlock):
+        rec.update(type="begin_atomic", block=e.block_id)
+    elif isinstance(e, EndExternalAtomicBlock):
+        rec.update(type="end_atomic", block=e.block_id)
     else:
         raise TypeError(f"unserializable event {e!r}")
     return rec
@@ -176,6 +182,10 @@ def _event_from_json(rec: Dict[str, Any], app: Optional[DSLApp]) -> Unique:
         e = UnPartitionEvent(rec["a"], rec["b"])
     elif t == "code_block":
         e = CodeBlockEvent(rec.get("label", ""))
+    elif t == "begin_atomic":
+        e = BeginExternalAtomicBlock(rec["block"])
+    elif t == "end_atomic":
+        e = EndExternalAtomicBlock(rec["block"])
     else:
         e = _EVENT_TYPES[t]()
     return Unique(e, rec["id"])
@@ -183,6 +193,8 @@ def _event_from_json(rec: Dict[str, Any], app: Optional[DSLApp]) -> Unique:
 
 def _external_to_json(e: ExternalEvent) -> Dict[str, Any]:
     rec: Dict[str, Any] = {"eid": e.eid}
+    if e.block is not None:
+        rec["block"] = e.block
     if isinstance(e, Start):
         rec.update(type="start", name=e.name)
     elif isinstance(e, Kill):
@@ -240,6 +252,11 @@ def _external_from_json(rec: Dict[str, Any], app: Optional[DSLApp]) -> ExternalE
     # counter so fresh events never alias restored ones.
     object.__setattr__(e, "eid", rec["eid"])
     ensure_eid_floor(rec["eid"])
+    if rec.get("block") is not None:
+        # Block ids ride the eid counter; floor past them too so fresh
+        # blocks never alias restored ones.
+        object.__setattr__(e, "block", rec["block"])
+        ensure_eid_floor(rec["block"])
     return e
 
 

@@ -27,10 +27,12 @@ from typing import Any, Callable, Dict, Iterable, Iterator, List, Optional, Sequ
 
 from .events import (
     EXTERNAL,
+    BeginExternalAtomicBlock,
     BeginUnignorableEvents,
     BeginWaitCondition,
     BeginWaitQuiescence,
     CodeBlockEvent,
+    EndExternalAtomicBlock,
     EndUnignorableEvents,
     Event,
     HardKillEvent,
@@ -155,9 +157,18 @@ class EventTrace:
         happen. Reference: EventTrace.scala:290-380."""
         remaining: List[ExternalEvent] = [e for e in subseq if not isinstance(e, Send)]
         result: List[Unique] = []
+        # Atomic-block markers survive iff any member survives in the
+        # subsequence (atomize keeps blocks whole, so it's all-or-none).
+        kept_blocks = {e.block for e in subseq if e.block is not None}
 
         for u in self.events:
             event = u.event
+            if isinstance(
+                event, (BeginExternalAtomicBlock, EndExternalAtomicBlock)
+            ):
+                if event.block_id in kept_blocks:
+                    result.append(u)
+                continue
             if not remaining:
                 # All non-Send externals matched; keep message events and
                 # internal events only. Wait markers seen here belong to

@@ -1,0 +1,79 @@
+"""Bridge fixture: an external app whose violation needs an ATOMIC pair.
+
+Actor "unit" arms on ("arm",) and detonates on ("fire",) only while
+armed; ANY other delivery in between disarms it. Actor "noise" absorbs
+("n", k) messages and pokes the unit (the disarm hazard). The violating
+input is therefore the arm+fire batch delivered as one logical unit —
+exactly what external atomic blocks (external_events.atomic_block)
+express. Used by tests/test_atomic_blocks.py to prove minimization keeps
+the block whole while pruning the noise.
+
+Runs standalone over the bridge pipe protocol:
+    python tests/fixtures/combo_app.py
+"""
+
+import json
+import sys
+
+
+STATE = {}
+
+
+def reset(actor):
+    STATE[actor] = {"armed": 0, "boom": 0} if actor == "unit" else {"seen": 0}
+
+
+def handle(actor, src, msg):
+    effects = {"op": "effects", "sends": [], "timers": [], "logs": [],
+               "blocked": None}
+    st = STATE[actor]
+    tag = msg[0] if isinstance(msg, list) else msg
+    if actor == "unit":
+        if tag == "arm":
+            st["armed"] = 1
+        elif tag == "fire":
+            if st["armed"]:
+                st["boom"] = 1
+            st["armed"] = 0
+        else:  # any other delivery disarms (the atomicity hazard)
+            st["armed"] = 0
+    elif actor == "noise":
+        st["seen"] += 1
+        effects["sends"].append({"dst": "unit", "msg": ["poke"]})
+    return effects
+
+
+def main():
+    def recv():
+        line = sys.stdin.readline()
+        return json.loads(line) if line else None
+
+    def send(obj):
+        sys.stdout.write(json.dumps(obj) + "\n")
+        sys.stdout.flush()
+
+    send({"op": "register", "actors": ["unit", "noise"],
+          "features": ["snapshot"]})
+    while True:
+        cmd = recv()
+        if cmd is None or cmd.get("op") == "shutdown":
+            return
+        op = cmd["op"]
+        if op == "start":
+            reset(cmd["actor"])
+            send({"op": "effects"})
+        elif op == "deliver":
+            send(handle(cmd["actor"], cmd["src"], cmd["msg"]))
+        elif op in ("checkpoint", "snapshot"):
+            send({"op": "state", "state": dict(STATE[cmd["actor"]])})
+        elif op == "restore":
+            STATE[cmd["actor"]] = dict(cmd["state"])
+            send({"op": "effects"})
+        elif op == "stop":
+            STATE.pop(cmd["actor"], None)
+        else:
+            raise SystemExit(f"unknown op {cmd!r}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,8 @@
+"""App-specific invariant for combo_app (bridge-fuzz --invariant)."""
+
+
+def boom(states):
+    unit = states.get("unit")
+    if isinstance(unit, dict) and unit.get("boom"):
+        return 2
+    return None

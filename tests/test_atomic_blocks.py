@@ -1,0 +1,274 @@
+"""External atomic blocks end-to-end (VERDICT r4 missing #1).
+
+Reference: beginExternalAtomicBlock / endExternalAtomicBlock
+(ExternalEventInjector.scala:179-216) and STS's atomic-block handling
+(STSScheduler.scala:414-444). Here: ``atomic_block(...)`` marks a batch
+of externals as one logical input — injection records Begin/End markers
+around it, DDMin removes it all-or-nothing (never interleaving), STS
+replay treats its extent as unignorable, and the bridge regression
+proves a real external process's arm+fire batch survives minimization
+as one unit while surrounding noise is pruned.
+"""
+
+import sys
+
+import pytest
+
+from demi_tpu.apps.broadcast import make_broadcast_app
+from demi_tpu.apps.common import dsl_start_events, make_host_invariant
+from demi_tpu.config import SchedulerConfig
+from demi_tpu.events import (
+    BeginExternalAtomicBlock,
+    EndExternalAtomicBlock,
+    MsgSend,
+)
+from demi_tpu.external_events import (
+    Kill,
+    MessageConstructor,
+    Send,
+    Start,
+    WaitQuiescence,
+    atomic_block,
+    sanity_check_externals,
+)
+from demi_tpu.minimization.event_dag import UnmodifiedEventDag
+from demi_tpu.schedulers import BasicScheduler, RandomScheduler
+
+
+def _send(app, i, v=0):
+    return Send(app.actor_name(i), MessageConstructor(lambda vv=v: (1, vv)))
+
+
+def test_atomize_groups_block_members():
+    app = make_broadcast_app(4, reliable=False)
+    starts = dsl_start_events(app)
+    blk = atomic_block([_send(app, 0), _send(app, 1), _send(app, 2)])
+    prog = list(starts) + [_send(app, 3)] + blk
+    dag = UnmodifiedEventDag(prog)
+    atoms = dag.get_atomic_events()
+    sizes = sorted(len(a.events) for a in atoms)
+    # 4 start singletons + 1 plain send + ONE 3-member block atom.
+    assert sizes == [1, 1, 1, 1, 1, 3]
+    block_atom = next(a for a in atoms if len(a.events) == 3)
+    assert {e.eid for e in block_atom.events} == {e.eid for e in blk}
+
+
+def test_atomize_pairing_pulls_partner_into_block():
+    """A Kill whose Start sits inside a block joins the block's atom
+    (atomicity is transitive), never straddles it."""
+    app = make_broadcast_app(4, reliable=False)
+    starts = dsl_start_events(app)
+    extra = Start("x9", ctor=lambda: None)
+    blk = atomic_block([extra, _send(app, 0)])
+    kill = Kill("x9")
+    prog = list(starts) + blk + [kill]
+    dag = UnmodifiedEventDag(prog)
+    atoms = dag.get_atomic_events()
+    block_atom = next(a for a in atoms if len(a.events) >= 2)
+    assert {e.eid for e in block_atom.events} == {
+        extra.eid, blk[1].eid, kill.eid
+    }
+
+
+def test_sanity_check_rejects_split_blocks_and_waits():
+    app = make_broadcast_app(2, reliable=False)
+    starts = dsl_start_events(app)
+    a, b = _send(app, 0), _send(app, 1)
+    atomic_block([a, b])
+    with pytest.raises(ValueError, match="not contiguous"):
+        sanity_check_externals(
+            list(starts) + [a, _send(app, 0), b]
+        )
+    with pytest.raises(ValueError, match="waits"):
+        atomic_block([_send(app, 0), WaitQuiescence()])
+
+
+def test_injection_records_markers_once_per_block():
+    app = make_broadcast_app(4, reliable=False)
+    starts = dsl_start_events(app)
+    blk = atomic_block([_send(app, 0), _send(app, 1)])
+    prog = list(starts) + [_send(app, 2)] + blk + [WaitQuiescence()]
+    config = SchedulerConfig(invariant_check=make_host_invariant(app))
+    result = BasicScheduler(config).execute(prog)
+    events = result.trace.get_events()
+    begins = [e for e in events if isinstance(e, BeginExternalAtomicBlock)]
+    ends = [e for e in events if isinstance(e, EndExternalAtomicBlock)]
+    assert len(begins) == 1 and len(ends) == 1
+    assert begins[0].block_id == blk[0].block == ends[0].block_id
+    bi = events.index(begins[0])
+    ei = events.index(ends[0])
+    # The two member sends are recorded inside the marker extent.
+    inside = [
+        e for e in events[bi:ei]
+        if isinstance(e, MsgSend) and e.is_external
+    ]
+    assert len(inside) == 2
+
+
+def test_subsequence_intersection_keeps_or_drops_markers_with_block():
+    app = make_broadcast_app(4, reliable=False)
+    starts = dsl_start_events(app)
+    blk = atomic_block([_send(app, 0), _send(app, 1)])
+    plain = _send(app, 2)
+    prog = list(starts) + [plain] + blk + [WaitQuiescence()]
+    config = SchedulerConfig(invariant_check=make_host_invariant(app))
+    result = BasicScheduler(config).execute(prog)
+    trace = result.trace
+    trace.original_externals = prog
+
+    with_block = trace.subsequence_intersection(list(starts) + blk)
+    kinds = [type(e).__name__ for e in with_block.get_events()]
+    assert "BeginExternalAtomicBlock" in kinds
+    assert "EndExternalAtomicBlock" in kinds
+
+    without_block = trace.subsequence_intersection(list(starts) + [plain])
+    kinds = [type(e).__name__ for e in without_block.get_events()]
+    assert "BeginExternalAtomicBlock" not in kinds
+    assert "EndExternalAtomicBlock" not in kinds
+
+
+def test_sts_replay_block_extent_is_unignorable():
+    """Inside a block's marker extent, an absent expected delivery must
+    raise (the reference defers ignore-absent past the block end; a
+    doctored trace whose block-internal delivery can't exist is a real
+    divergence, not skippable noise)."""
+    from demi_tpu.events import MsgEvent, Unique
+    from demi_tpu.schedulers.replay import ReplayException, STSScheduler
+
+    app = make_broadcast_app(4, reliable=False)
+    starts = dsl_start_events(app)
+    blk = atomic_block([_send(app, 0)])
+    prog = list(starts) + blk + [WaitQuiescence()]
+    config = SchedulerConfig(invariant_check=make_host_invariant(app))
+    result = BasicScheduler(config).execute(prog)
+    trace = result.trace
+    trace.original_externals = prog
+
+    def doctor(events, inside):
+        """Insert a never-sent expected delivery before/after End."""
+        out = []
+        for u in events:
+            if isinstance(u.event, EndExternalAtomicBlock) and inside:
+                out.append(Unique(MsgEvent("n0", "n3", (9, 9)), 99_999))
+            out.append(u)
+            if isinstance(u.event, EndExternalAtomicBlock) and not inside:
+                out.append(Unique(MsgEvent("n0", "n3", (9, 9)), 99_999))
+        from demi_tpu.trace import EventTrace
+
+        t = EventTrace(out, prog)
+        return t
+
+    t_in = doctor(trace.events, inside=True)
+    sts_in = STSScheduler(config, t_in)
+    with pytest.raises(ReplayException):
+        sts_in.replay(t_in, prog)
+
+    t_out = doctor(trace.events, inside=False)
+    sts_out = STSScheduler(config, t_out)
+    sts_out.replay(t_out, prog)  # outside the extent: ignored as usual
+    assert len(sts_out.ignored_absent) == 1
+
+
+def test_serialization_roundtrips_block_ids(tmp_path):
+    """Stage save/load (and the recorded Begin/End trace markers) keep
+    block identity intact."""
+    from demi_tpu.serialization import load_stage, save_stage
+
+    app = make_broadcast_app(4, reliable=False)
+    starts = dsl_start_events(app)
+    blk = atomic_block([_send(app, 0), _send(app, 1)])
+    prog = list(starts) + blk + [WaitQuiescence()]
+    config = SchedulerConfig(invariant_check=make_host_invariant(app))
+    result = BasicScheduler(config).execute(prog)
+    save_stage(str(tmp_path), "orig", prog, result.trace)
+    restored, rtrace = load_stage(str(tmp_path), "orig", app=app)
+    rblk = [e for e in restored if e.block is not None]
+    assert len(rblk) == 2
+    assert rblk[0].block == rblk[1].block == blk[0].block
+    assert [e.eid for e in restored] == [e.eid for e in prog]
+    marker_ids = [
+        e.block_id
+        for e in rtrace.get_events()
+        if isinstance(e, (BeginExternalAtomicBlock, EndExternalAtomicBlock))
+    ]
+    assert marker_ids == [blk[0].block, blk[0].block]
+
+
+def test_fuzzer_generates_contiguous_blocks():
+    from demi_tpu.apps.broadcast import broadcast_send_generator
+    from demi_tpu.fuzzing import Fuzzer, FuzzerWeights
+
+    app = make_broadcast_app(4, reliable=False)
+    fuzzer = Fuzzer(
+        num_events=12,
+        weights=FuzzerWeights(send=0.3, atomic_block=0.3,
+                              wait_quiescence=0.1),
+        message_gen=broadcast_send_generator(app),
+        prefix=dsl_start_events(app),
+    )
+    saw_block = False
+    for seed in range(10):
+        prog = fuzzer.generate_fuzz_test(seed)
+        sanity_check_externals(prog)  # contiguity validated here
+        if any(e.block is not None for e in prog):
+            saw_block = True
+    assert saw_block
+
+
+def test_bridge_minimization_preserves_block_atomically():
+    """The VERDICT's done-criterion: a real external process whose
+    violation needs the arm+fire batch delivered as one unit. DDMin over
+    the fuzzed program prunes the noise but must keep the atomic block
+    whole — and the minimized trace must still reproduce."""
+    from demi_tpu.bridge import BridgeSession, bridge_invariant
+    from demi_tpu.runner import sts_sched_ddmin
+
+    argv = [sys.executable, "tests/fixtures/combo_app.py"]
+
+    def boom_predicate(states):
+        unit = states.get("unit")
+        if isinstance(unit, dict) and unit.get("boom"):
+            return 2
+        return None
+
+    with BridgeSession(argv) as session:
+        config = SchedulerConfig(
+            invariant_check=bridge_invariant(predicate=boom_predicate)
+        )
+        starts = [
+            Start(n, ctor=session.actor_factory(n))
+            for n in ("unit", "noise")
+        ]
+
+        def noise(k):
+            return Send("noise", MessageConstructor(lambda kk=k: ("n", kk)))
+
+        blk = atomic_block([
+            Send("unit", MessageConstructor(lambda: ("arm",))),
+            Send("unit", MessageConstructor(lambda: ("fire",))),
+        ])
+        program = (
+            starts
+            + [noise(0), noise(1)]
+            + blk
+            + [noise(2)]
+            + [WaitQuiescence()]
+        )
+        result = BasicScheduler(config).execute(program)
+        assert result.violation is not None and result.violation.code == 2
+
+        mcs, verified = sts_sched_ddmin(
+            config, result.trace, program, result.violation
+        )
+        assert verified is not None, "minimized program must reproduce"
+        kept = mcs.get_all_events()
+        kept_blocks = [e for e in kept if e.block is not None]
+        # The block survived WHOLE: both members, same id.
+        assert len(kept_blocks) == 2
+        assert kept_blocks[0].block == kept_blocks[1].block
+        msgs = sorted(e.message()[0] for e in kept_blocks)
+        assert msgs == ["arm", "fire"]
+        # Noise sends were pruned.
+        assert not any(
+            isinstance(e, Send) and e.name == "noise" for e in kept
+        )
