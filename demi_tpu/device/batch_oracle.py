@@ -70,16 +70,24 @@ class DeviceReplayChecker:
         cfg: DeviceConfig,
         config: SchedulerConfig,
         impl: Optional[str] = None,
+        mesh=None,
     ):
         self.app = app
         self.cfg = cfg
         self.config = config
+        self.mesh = mesh
         # Kernel backend: 'xla' (default) or 'pallas' (VMEM-resident lane
         # blocks, device/pallas_explore.py). DEMI_DEVICE_IMPL sets the
         # default so a whole minimize pipeline can be flipped from the
-        # environment for TPU experiments.
+        # environment for TPU experiments. A mesh shards each candidate
+        # batch over its lane axis instead (one DDMin level spread across
+        # chips, SURVEY.md §2.8).
         impl = impl or os.environ.get("DEMI_DEVICE_IMPL", "xla")
-        if impl == "pallas":
+        if mesh is not None:
+            from ..parallel.mesh import shard_replay_kernel
+
+            self.kernel = shard_replay_kernel(app, cfg, mesh)
+        elif impl == "pallas":
             from .pallas_explore import make_replay_kernel_pallas
 
             self.kernel = make_replay_kernel_pallas(app, cfg)
@@ -111,6 +119,10 @@ class DeviceReplayChecker:
         # their verdicts are sliced off.
         n = len(candidates)
         bucket = max(8, 1 << (n - 1).bit_length())
+        if self.mesh is not None:
+            from ..parallel.mesh import pad_batch_to_devices
+
+            bucket = pad_batch_to_devices(bucket, self.mesh)
         if bucket > n:
             records = np.concatenate(
                 [records, np.repeat(records[:1], bucket - n, axis=0)]

@@ -358,12 +358,26 @@ class DeviceDPOR:
         program: Sequence[ExternalEvent],
         batch_size: int = 64,
         impl: Optional[str] = None,
+        mesh=None,
     ):
         assert cfg.record_trace and cfg.record_parents
         self.app = app
         self.cfg = cfg
         impl = impl or os.environ.get("DEMI_DEVICE_IMPL", "xla")
-        if impl == "pallas":
+        if mesh is not None:
+            # Frontier rounds sharded over the device mesh (SURVEY.md
+            # §2.8: the batch axis covers EVERY batched workload, the
+            # search kernels included). Rounds are padded to batch_size,
+            # which must divide over the mesh axis.
+            from ..parallel.mesh import LANES, shard_dpor_kernel
+
+            if batch_size % mesh.shape[LANES]:
+                raise ValueError(
+                    f"batch_size {batch_size} must be a multiple of the "
+                    f"mesh axis {mesh.shape[LANES]}"
+                )
+            self.kernel = shard_dpor_kernel(app, cfg, mesh)
+        elif impl == "pallas":
             from .pallas_explore import make_dpor_kernel_pallas
 
             self.kernel = make_dpor_kernel_pallas(

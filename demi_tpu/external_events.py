@@ -108,7 +108,7 @@ class ExternalEvent:
     # (Begin/End markers recorded around them), minimize as ONE atom
     # (all-or-nothing, never interleaved), and replay unignorably. Assign
     # via ``atomic_block(...)``.
-    block: Optional[int] = field(default=None, init=False, compare=False)
+    block_id: Optional[int] = field(default=None, init=False, compare=False)
 
     # Identity semantics but stable hashing across pickling.
     def __eq__(self, other):
@@ -267,7 +267,7 @@ def atomic_block(
     for e in events:
         if isinstance(e, (WaitQuiescence, WaitCondition)):
             raise ValueError(f"atomic blocks cannot contain waits: {e!r}")
-        object.__setattr__(e, "block", bid)
+        object.__setattr__(e, "block_id", bid)
     return events
 
 
@@ -279,14 +279,14 @@ def sanity_check_externals(events: Sequence[ExternalEvent]) -> None:
     closed_blocks = set()
     open_block: Optional[int] = None
     for e in events:
-        if e.block != open_block:
+        if e.block_id != open_block:
             if open_block is not None:
                 closed_blocks.add(open_block)
-            if e.block in closed_blocks:
+            if e.block_id in closed_blocks:
                 raise ValueError(
-                    f"atomic block {e.block} is not contiguous at {e}"
+                    f"atomic block {e.block_id} is not contiguous at {e}"
                 )
-            open_block = e.block
+            open_block = e.block_id
         if isinstance(e, Start):
             started.add(e.name)
         elif isinstance(e, (Kill, HardKill)):
@@ -296,5 +296,5 @@ def sanity_check_externals(events: Sequence[ExternalEvent]) -> None:
             if e.name not in started:
                 raise ValueError(f"{e} targets never-started actor {e.name}")
         elif isinstance(e, (WaitQuiescence, WaitCondition)):
-            if e.block is not None:
+            if e.block_id is not None:
                 raise ValueError(f"atomic blocks cannot contain waits: {e!r}")

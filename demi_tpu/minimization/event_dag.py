@@ -85,13 +85,13 @@ class UnmodifiedEventDag(EventDag):
     def atomize(self, given_events: Sequence[ExternalEvent]) -> List[AtomicEvent]:
         by_eid = {e.eid: e for e in self.events}
         atoms: List[AtomicEvent] = []
-        # External atomic blocks (ExternalEvent.block): members form ONE
+        # External atomic blocks (ExternalEvent.block_id): members form ONE
         # atom — DDMin removes them all-or-nothing, exactly the
         # reference's treatment of a task's begin/endExternalAtomicBlock
         # extent. Pairing is transitive: a Start..Kill or conjoined pair
         # with one foot in a block pulls the other foot in.
         block_of = {
-            e.eid: e.block for e in given_events if e.block is not None
+            e.eid: e.block_id for e in given_events if e.block_id is not None
         }
         block_groups: Dict[int, List[ExternalEvent]] = {}
 

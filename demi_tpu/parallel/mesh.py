@@ -45,14 +45,15 @@ def sweep_sharding(mesh: Mesh, axis: str = LANES) -> Tuple[NamedSharding, NamedS
     return NamedSharding(mesh, P(axis)), NamedSharding(mesh, P())
 
 
-def _shard_lane_kernel(run_lane, mesh: Mesh, axis: str):
-    """vmap a single-lane fn and shard its lane batch over the mesh: inputs
-    and outputs are sharded on their leading (lane) dimension; each device
-    advances its lane shard independently — the pjit/ICI scale-out."""
+def _shard_lane_kernel(run_lane, mesh: Mesh, axis: str, n_in: int = 2):
+    """vmap a single-lane fn and shard its lane batch over the mesh: all
+    ``n_in`` inputs and the outputs are sharded on their leading (lane)
+    dimension; each device advances its lane shard independently — the
+    pjit/ICI scale-out."""
     batch_sharding = NamedSharding(mesh, P(axis))
     return jax.jit(
         jax.vmap(run_lane),
-        in_shardings=(batch_sharding, batch_sharding),
+        in_shardings=(batch_sharding,) * n_in,
         out_shardings=batch_sharding,
     )
 
@@ -68,6 +69,16 @@ def shard_replay_kernel(app: DSLApp, cfg: DeviceConfig, mesh: Mesh, axis: str = 
     from ..device.replay import make_replay_run_lane
 
     return _shard_lane_kernel(make_replay_run_lane(app, cfg), mesh, axis)
+
+
+def shard_dpor_kernel(app: DSLApp, cfg: DeviceConfig, mesh: Mesh, axis: str = LANES):
+    """DPOR frontier batches sharded over the mesh: each device replays
+    its shard of the round's prescriptions (prescription-guided explore
+    lanes are independent, so no collectives inside a round — the
+    frontier/backtrack analysis stays on the host)."""
+    from ..device.dpor_sweep import make_dpor_run_lane
+
+    return _shard_lane_kernel(make_dpor_run_lane(app, cfg), mesh, axis, n_in=3)
 
 
 def shard_explore_kernel_pallas(

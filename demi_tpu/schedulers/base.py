@@ -218,13 +218,13 @@ class BaseScheduler:
             # back-to-back inside Begin/End markers. Injection is already
             # atomic w.r.t. dispatch here; the markers make the block
             # boundary visible to STS replay and trace surgeries.
-            if event.block != open_block:
+            if event.block_id != open_block:
                 _close_block()
-                if event.block is not None:
+                if event.block_id is not None:
                     self.trace.append(
-                        self._unique(BeginExternalAtomicBlock(event.block))
+                        self._unique(BeginExternalAtomicBlock(event.block_id))
                     )
-                    open_block = event.block
+                    open_block = event.block_id
             self._inject_one(event)
         _close_block()
         return cursor, None, None

@@ -193,8 +193,8 @@ def _event_from_json(rec: Dict[str, Any], app: Optional[DSLApp]) -> Unique:
 
 def _external_to_json(e: ExternalEvent) -> Dict[str, Any]:
     rec: Dict[str, Any] = {"eid": e.eid}
-    if e.block is not None:
-        rec["block"] = e.block
+    if e.block_id is not None:
+        rec["block"] = e.block_id
     if isinstance(e, Start):
         rec.update(type="start", name=e.name)
     elif isinstance(e, Kill):
@@ -255,7 +255,7 @@ def _external_from_json(rec: Dict[str, Any], app: Optional[DSLApp]) -> ExternalE
     if rec.get("block") is not None:
         # Block ids ride the eid counter; floor past them too so fresh
         # blocks never alias restored ones.
-        object.__setattr__(e, "block", rec["block"])
+        object.__setattr__(e, "block_id", rec["block"])
         ensure_eid_floor(rec["block"])
     return e
 

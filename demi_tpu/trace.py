@@ -159,7 +159,7 @@ class EventTrace:
         result: List[Unique] = []
         # Atomic-block markers survive iff any member survives in the
         # subsequence (atomize keeps blocks whole, so it's all-or-none).
-        kept_blocks = {e.block for e in subseq if e.block is not None}
+        kept_blocks = {e.block_id for e in subseq if e.block_id is not None}
 
         for u in self.events:
             event = u.event

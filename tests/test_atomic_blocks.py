@@ -94,7 +94,7 @@ def test_injection_records_markers_once_per_block():
     begins = [e for e in events if isinstance(e, BeginExternalAtomicBlock)]
     ends = [e for e in events if isinstance(e, EndExternalAtomicBlock)]
     assert len(begins) == 1 and len(ends) == 1
-    assert begins[0].block_id == blk[0].block == ends[0].block_id
+    assert begins[0].block_id == blk[0].block_id == ends[0].block_id
     bi = events.index(begins[0])
     ei = events.index(ends[0])
     # The two member sends are recorded inside the marker extent.
@@ -169,6 +169,29 @@ def test_sts_replay_block_extent_is_unignorable():
     assert len(sts_out.ignored_absent) == 1
 
 
+def test_code_block_events_are_not_atomic_blocks():
+    """CodeBlock's pre-existing ``block`` closure field must not collide
+    with atomic-block ids (ExternalEvent.block_id): two CodeBlocks
+    sharing a closure are NOT a block, inject without markers, and stay
+    separate DDMin atoms."""
+    from demi_tpu.external_events import CodeBlock
+
+    app = make_broadcast_app(2, reliable=False)
+    starts = dsl_start_events(app)
+    fn = lambda: None  # noqa: E731 - shared closure is the point
+    cb1, cb2 = CodeBlock(block=fn), CodeBlock(block=fn)
+    prog = list(starts) + [cb1, _send(app, 0), cb2, WaitQuiescence()]
+    sanity_check_externals(prog)  # must not flag a 'split block'
+    config = SchedulerConfig(invariant_check=make_host_invariant(app))
+    result = BasicScheduler(config).execute(prog)
+    assert not any(
+        isinstance(e, (BeginExternalAtomicBlock, EndExternalAtomicBlock))
+        for e in result.trace.get_events()
+    )
+    atoms = UnmodifiedEventDag(prog[:-1]).get_atomic_events()
+    assert all(len(a.events) == 1 for a in atoms)
+
+
 def test_serialization_roundtrips_block_ids(tmp_path):
     """Stage save/load (and the recorded Begin/End trace markers) keep
     block identity intact."""
@@ -182,16 +205,16 @@ def test_serialization_roundtrips_block_ids(tmp_path):
     result = BasicScheduler(config).execute(prog)
     save_stage(str(tmp_path), "orig", prog, result.trace)
     restored, rtrace = load_stage(str(tmp_path), "orig", app=app)
-    rblk = [e for e in restored if e.block is not None]
+    rblk = [e for e in restored if e.block_id is not None]
     assert len(rblk) == 2
-    assert rblk[0].block == rblk[1].block == blk[0].block
+    assert rblk[0].block_id == rblk[1].block_id == blk[0].block_id
     assert [e.eid for e in restored] == [e.eid for e in prog]
     marker_ids = [
         e.block_id
         for e in rtrace.get_events()
         if isinstance(e, (BeginExternalAtomicBlock, EndExternalAtomicBlock))
     ]
-    assert marker_ids == [blk[0].block, blk[0].block]
+    assert marker_ids == [blk[0].block_id, blk[0].block_id]
 
 
 def test_fuzzer_generates_contiguous_blocks():
@@ -210,7 +233,7 @@ def test_fuzzer_generates_contiguous_blocks():
     for seed in range(10):
         prog = fuzzer.generate_fuzz_test(seed)
         sanity_check_externals(prog)  # contiguity validated here
-        if any(e.block is not None for e in prog):
+        if any(e.block_id is not None for e in prog):
             saw_block = True
     assert saw_block
 
@@ -262,10 +285,10 @@ def test_bridge_minimization_preserves_block_atomically():
         )
         assert verified is not None, "minimized program must reproduce"
         kept = mcs.get_all_events()
-        kept_blocks = [e for e in kept if e.block is not None]
+        kept_blocks = [e for e in kept if e.block_id is not None]
         # The block survived WHOLE: both members, same id.
         assert len(kept_blocks) == 2
-        assert kept_blocks[0].block == kept_blocks[1].block
+        assert kept_blocks[0].block_id == kept_blocks[1].block_id
         msgs = sorted(e.message()[0] for e in kept_blocks)
         assert msgs == ["arm", "fire"]
         # Noise sends were pruned.
