@@ -178,6 +178,7 @@ def cmd_minimize(args) -> int:
         app=None if args.host else app,
         device_cfg=device_cfg,
         checkpoint_dir=args.experiment, resume=args.resume,
+        stage_budget_seconds=args.stage_budget,
     )
     print_minimization_stats(result)
     ExperimentSerializer.save(
@@ -506,6 +507,13 @@ def main(argv: Optional[list] = None) -> int:
         "--resume", action="store_true",
         help="restart after the last completed pipeline stage "
              "(stage checkpoints live in the experiment dir)",
+    )
+    p.add_argument(
+        "--stage-budget", type=float, default=None, dest="stage_budget",
+        metavar="SECONDS",
+        help="wall-clock cap per minimizer stage (best-so-far kept, "
+             "exhaustion recorded in stats; reference caps each gamut "
+             "minimizer the same way)",
     )
     p.add_argument(
         "--peek", type=int, default=0, metavar="K",

@@ -86,6 +86,14 @@ class DeviceReplayChecker:
         if mesh is not None:
             from ..parallel.mesh import shard_replay_kernel
 
+            if impl == "pallas":
+                import sys
+
+                print(
+                    "DeviceReplayChecker: mesh sharding uses the XLA "
+                    "replay kernel; ignoring impl=pallas",
+                    file=sys.stderr,
+                )
             self.kernel = shard_replay_kernel(app, cfg, mesh)
         elif impl == "pallas":
             from .pallas_explore import make_replay_kernel_pallas

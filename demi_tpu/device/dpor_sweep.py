@@ -371,6 +371,15 @@ class DeviceDPOR:
             # which must divide over the mesh axis.
             from ..parallel.mesh import LANES, shard_dpor_kernel
 
+            if impl == "pallas":
+                import sys
+
+                print(
+                    "DeviceDPOR: mesh sharding uses the XLA DPOR kernel; "
+                    "ignoring impl=pallas",
+                    file=sys.stderr,
+                )
+
             if batch_size % mesh.shape[LANES]:
                 raise ValueError(
                     f"batch_size {batch_size} must be a multiple of the "

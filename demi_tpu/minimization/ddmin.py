@@ -15,7 +15,7 @@ from typing import Any, List, Optional, Sequence
 
 from ..trace import EventTrace
 from .event_dag import AtomicEvent, EventDag, UnmodifiedEventDag
-from .stats import MinimizationStats
+from .stats import MinimizationStats, StageBudget
 from .test_oracle import TestOracle
 
 
@@ -28,9 +28,11 @@ class Minimizer:
 
 class DDMin(Minimizer):
     def __init__(self, oracle: TestOracle, check_unmodified: bool = False,
-                 stats: Optional[MinimizationStats] = None):
+                 stats: Optional[MinimizationStats] = None,
+                 budget: Optional[StageBudget] = None):
         self.oracle = oracle
         self.check_unmodified = check_unmodified
+        self.budget = budget or StageBudget()
         self.stats = stats or MinimizationStats()
         self.original_traces: List[EventTrace] = []  # violating traces seen
         self._violation = None
@@ -69,6 +71,11 @@ class DDMin(Minimizer):
         than needing a post-hoc verify_mcs warning."""
         atoms = dag.get_atomic_events()
         if len(atoms) <= 1:
+            return dag
+        if self.budget.exhausted():
+            # Budget cutoff keeps the invariant: `dag` reproduces with
+            # this remainder, so returning it is valid, just non-minimal.
+            self.stats.record_budget_exhausted()
             return dag
         mid = len(atoms) // 2
         left_dag = dag.remove_events(atoms[mid:])
@@ -112,10 +119,12 @@ class BatchedDDMin(Minimizer):
     DDMin above is oracle-compatible with it; this variant trades a few
     redundant trials for one kernel launch per level."""
 
-    def __init__(self, oracle, stats: Optional[MinimizationStats] = None):
+    def __init__(self, oracle, stats: Optional[MinimizationStats] = None,
+                 budget: Optional[StageBudget] = None):
         # oracle must provide test_batch(list_of_externals, fp) -> [bool];
         # test(...) is used once at the end to host-verify the MCS.
         self.oracle = oracle
+        self.budget = budget or StageBudget()
         self.stats = stats or MinimizationStats()
         self.levels = 0
         self.verified_trace = None  # host-verified MCS execution (or None)
@@ -132,6 +141,9 @@ class BatchedDDMin(Minimizer):
         while True:
             atoms = current.get_atomic_events()
             if len(atoms) <= 1:
+                break
+            if self.budget.exhausted():
+                self.stats.record_budget_exhausted()
                 break
             n = min(n, len(atoms))
             size = (len(atoms) + n - 1) // n

@@ -24,7 +24,7 @@ from ..events import (
     Unique,
 )
 from ..trace import EventTrace
-from .stats import MinimizationStats
+from .stats import MinimizationStats, StageBudget
 
 
 def removable_delivery_indices(trace: EventTrace) -> List[int]:
@@ -149,10 +149,12 @@ class STSSchedMinimizer:
         check: Callable[[EventTrace], Optional[EventTrace]],
         strategy: RemovalStrategy,
         stats: Optional[MinimizationStats] = None,
+        budget: Optional[StageBudget] = None,
     ):
         # check(candidate_expected_trace) -> executed violating trace | None
         self.check = check
         self.strategy = strategy
+        self.budget = budget or StageBudget()
         self.stats = stats or MinimizationStats()
 
     def minimize(self, initial_failing: EventTrace) -> EventTrace:
@@ -162,6 +164,9 @@ class STSSchedMinimizer:
         self.stats.record_prune_start()
         last_failing = initial_failing
         while True:
+            if self.budget.exhausted():
+                self.stats.record_budget_exhausted()
+                break
             candidate = self.strategy.next_candidate(last_failing)
             if candidate is None:
                 break
@@ -194,9 +199,11 @@ class BatchedInternalMinimizer:
         batch_check: Callable[[List[EventTrace]], List[Optional[EventTrace]]],
         stats: Optional[MinimizationStats] = None,
         max_rounds: int = 10_000,
+        budget: Optional[StageBudget] = None,
     ):
         # batch_check(candidates) -> per-candidate executed trace | None
         self.batch_check = batch_check
+        self.budget = budget or StageBudget()
         self.stats = stats or MinimizationStats()
         self.max_rounds = max_rounds
 
@@ -205,6 +212,9 @@ class BatchedInternalMinimizer:
         self.stats.record_prune_start()
         last_failing = initial_failing
         for _ in range(self.max_rounds):
+            if self.budget.exhausted():
+                self.stats.record_budget_exhausted()
+                break
             indices = removable_delivery_indices(last_failing)
             if not indices:
                 break

@@ -14,6 +14,22 @@ import time
 from typing import Any, Dict, List, Optional
 
 
+class StageBudget:
+    """Wall-clock budget for one minimization stage (reference: each
+    gamut minimizer capped, RunnerUtils.scala:180). Minimizers poll
+    ``exhausted()`` at loop boundaries and return their current best —
+    progress so far is always kept, never discarded."""
+
+    def __init__(self, seconds: Optional[float] = None):
+        self.seconds = seconds
+        self.deadline = (
+            None if seconds is None else time.monotonic() + seconds
+        )
+
+    def exhausted(self) -> bool:
+        return self.deadline is not None and time.monotonic() >= self.deadline
+
+
 class _Stage:
     def __init__(self, strategy: str, oracle: str):
         self.strategy = strategy
@@ -28,6 +44,9 @@ class _Stage:
         self.minimized_deliveries = 0
         self.minimized_externals = 0
         self.minimized_timers = 0
+        # True when the stage stopped on its wall-clock budget rather
+        # than converging (the result is valid but possibly non-minimal).
+        self.budget_exhausted = False
 
     def to_json(self) -> Dict[str, Any]:
         return {
@@ -43,6 +62,7 @@ class _Stage:
             "minimized_deliveries": self.minimized_deliveries,
             "minimized_externals": self.minimized_externals,
             "minimized_timers": self.minimized_timers,
+            "budget_exhausted": self.budget_exhausted,
         }
 
     @classmethod
@@ -60,6 +80,7 @@ class _Stage:
         stage.minimized_deliveries = obj.get("minimized_deliveries", 0)
         stage.minimized_externals = obj.get("minimized_externals", 0)
         stage.minimized_timers = obj.get("minimized_timers", 0)
+        stage.budget_exhausted = obj.get("budget_exhausted", False)
         return stage
 
 
@@ -114,6 +135,9 @@ class MinimizationStats:
         stage.minimized_deliveries = deliveries
         stage.minimized_externals = externals
         stage.minimized_timers = timers
+
+    def record_budget_exhausted(self) -> None:
+        self.current.budget_exhausted = True
 
     # -- persistence -------------------------------------------------------
     def to_json(self) -> str:

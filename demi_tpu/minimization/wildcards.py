@@ -26,7 +26,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Set, Tuple
 from ..events import MsgEvent, TimerDelivery, Unique, WildCardMatch
 from ..fingerprints import FingerprintFactory
 from ..trace import EventTrace
-from .stats import MinimizationStats
+from .stats import MinimizationStats, StageBudget
 from .test_oracle import TestOracle
 
 
@@ -332,8 +332,10 @@ class WildcardMinimizer:
         stats: Optional[MinimizationStats] = None,
         aggressiveness: str = "singletons_after",
         policy: str = "first",
+        budget: Optional[StageBudget] = None,
     ):
         self.check = check
+        self.budget = budget or StageBudget()
         self.stats = stats or MinimizationStats()
         self.aggressiveness = aggressiveness
         self.policy = policy
@@ -356,6 +358,9 @@ class WildcardMinimizer:
     def _drive(self, clusterizer: Clusterizer, best: EventTrace) -> EventTrace:
         reproduced = False
         while True:
+            if self.budget.exhausted():
+                self.stats.record_budget_exhausted()
+                break
             candidate = clusterizer.next_trace(reproduced, set())
             if candidate is None:
                 break
@@ -385,6 +390,7 @@ class BatchedWildcardMinimizer:
         stats: Optional[MinimizationStats] = None,
         policy: str = "first",
         first_and_last: bool = False,
+        budget: Optional[StageBudget] = None,
     ):
         # batch_verdicts(candidates) -> [reproduced?]; host_check produces
         # the executed trace for the adopted schedule. With first_and_last,
@@ -395,6 +401,7 @@ class BatchedWildcardMinimizer:
         # DPOR backtracks.
         self.batch_verdicts = batch_verdicts
         self.host_check = host_check
+        self.budget = budget or StageBudget()
         self.stats = stats or MinimizationStats()
         self.policy = policy
         self.first_and_last = first_and_last
@@ -413,6 +420,9 @@ class BatchedWildcardMinimizer:
         )
         best = trace  # last host-confirmed violating execution
         while True:
+            if self.budget.exhausted():
+                self.stats.record_budget_exhausted()
+                break
             remaining = [
                 [i for i in c if i not in removed] for c in cluster_list
             ]

@@ -134,6 +134,7 @@ def sts_sched_ddmin(
     violation: Any,
     stats: Optional[MinimizationStats] = None,
     oracle=None,
+    budget=None,
 ):
     """External-event DDMin over the STS oracle
     (reference: RunnerUtils.stsSchedDDMin, RunnerUtils.scala:642-707).
@@ -152,7 +153,10 @@ def sts_sched_ddmin(
                 "externals=None requires trace.original_externals to be set"
             )
     oracle = oracle or sts_oracle(config, trace)
-    ddmin = DDMin(oracle, check_unmodified=True, stats=stats or MinimizationStats())
+    ddmin = DDMin(
+        oracle, check_unmodified=True, stats=stats or MinimizationStats(),
+        budget=budget,
+    )
     mcs = ddmin.minimize(make_dag(list(externals)), violation)
     verified = ddmin.verify_mcs(mcs, violation)
     return mcs, verified
@@ -165,6 +169,7 @@ def minimize_internals(
     violation: Any,
     strategy: Optional[RemovalStrategy] = None,
     stats: Optional[MinimizationStats] = None,
+    budget=None,
 ) -> EventTrace:
     """Reference: RunnerUtils.minimizeInternals (RunnerUtils.scala:980-1003)."""
 
@@ -173,7 +178,8 @@ def minimize_internals(
         return sts.test_with_trace(candidate, list(externals), violation)
 
     minimizer = STSSchedMinimizer(
-        check, strategy or OneAtATimeStrategy(), stats=stats or MinimizationStats()
+        check, strategy or OneAtATimeStrategy(),
+        stats=stats or MinimizationStats(), budget=budget,
     )
     return minimizer.minimize(failing_trace)
 
@@ -372,11 +378,18 @@ def run_the_gamut(
     device_cfg=None,
     checkpoint_dir: Optional[str] = None,
     resume: bool = False,
+    stage_budget_seconds: Optional[float] = None,
 ) -> GamutResult:
     """The full minimization pipeline (reference: RunnerUtils.runTheGamut,
     RunnerUtils.scala:171-500): provenance pruning → external DDMin →
     internal minimization → wildcard (clock-cluster) minimization → final
     internal minimization.
+
+    ``stage_budget_seconds`` caps each minimizer stage's wall clock
+    (reference: RunnerUtils.scala:180 caps every gamut minimizer): on
+    exhaustion the stage keeps its best-so-far result, marks
+    ``budget_exhausted`` in its MinimizationStats stage, and the pipeline
+    moves on — a pathological wildcard stage can no longer run unbounded.
 
     With ``app`` (a DSLApp), every stage's candidate trials run as
     device-batched replay kernels — BatchedDDMin levels, batched
@@ -391,6 +404,10 @@ def run_the_gamut(
     serialization + deserializeExperiment, Serialization.scala /
     RunnerUtils.scala:502-552)."""
     from .serialization import load_stage, save_stage
+    from .minimization.stats import StageBudget
+
+    def stage_budget() -> StageBudget:
+        return StageBudget(stage_budget_seconds)
 
     stats = MinimizationStats()
     trace, externals, violation = (
@@ -467,12 +484,13 @@ def run_the_gamut(
     else:
         if checker is not None:
             oracle = DeviceSTSOracle(app, device_cfg, config, trace, checker=checker)
-            ddmin = BatchedDDMin(oracle, stats=stats)
+            ddmin = BatchedDDMin(oracle, stats=stats, budget=stage_budget())
             mcs_dag = ddmin.minimize(make_dag(list(externals)), violation)
             verified = ddmin.verified_trace
         else:
             mcs_dag, verified = sts_sched_ddmin(
-                config, trace, externals, violation, stats=stats
+                config, trace, externals, violation, stats=stats,
+                budget=stage_budget(),
             )
         externals = mcs_dag.get_all_events()
         if verified is not None:
@@ -484,6 +502,7 @@ def run_the_gamut(
         minimizer = BatchedInternalMinimizer(
             make_batched_internal_check(checker, list(externals), violation),
             stats=stats,
+            budget=stage_budget(),
         )
         return minimizer.minimize(tr)
 
@@ -498,6 +517,7 @@ def run_the_gamut(
             trace = minimize_internals(
                 config, trace, externals, violation,
                 strategy=internal_strategy or OneAtATimeStrategy(), stats=stats,
+                budget=stage_budget(),
             )
         checkpoint("int_min", externals, trace)
     record("int_min", externals, trace)
@@ -522,10 +542,11 @@ def run_the_gamut(
                 # FirstAndLastBacktrack — alternative picks are extra lanes,
                 # not sequential backtracks).
                 wc = BatchedWildcardMinimizer(
-                    batch_verdicts, check, stats=stats, first_and_last=True
+                    batch_verdicts, check, stats=stats, first_and_last=True,
+                    budget=stage_budget(),
                 )
             else:
-                wc = WildcardMinimizer(check, stats=stats)
+                wc = WildcardMinimizer(check, stats=stats, budget=stage_budget())
             trace = wc.minimize(trace, config.fingerprinter)
             checkpoint("wildcard", externals, trace)
         record("wildcard", externals, trace)
@@ -540,6 +561,7 @@ def run_the_gamut(
                 trace = minimize_internals(
                     config, trace, externals, violation,
                     strategy=SrcDstFIFORemoval(), stats=stats,
+                    budget=stage_budget(),
                 )
             checkpoint("int_min2", externals, trace)
         record("int_min2", externals, trace)

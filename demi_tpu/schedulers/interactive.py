@@ -11,15 +11,34 @@ Commands:
   ext                inject external events up to the next wait
   inv                run the invariant check now
   run <n>            deliver n events FIFO
+  fail <actor>       Kill (isolate) an actor mid-run
+  hardfail <actor>   HardKill (stop + scrub) an actor mid-run
+  start <actor>      (re)start an actor — recovery for a failed name
+  partition <a> <b>  cut the link a <-> b
+  unpartition <a> <b>  heal the link
+  code <name>        run a registered host code block at this point
   quit               end the session
+
+The mid-run fault commands (reference: InteractiveScheduler.scala:26-113
+command framework) reuse the ordinary external-event injection path, so
+they record the same trace events (KillEvent/SpawnEvent/...) a scripted
+program would — the session's EventTrace replays like any other.
 """
 
 from __future__ import annotations
 
-from typing import Any, Callable, Iterable, Iterator, List, Optional, Sequence
+from typing import Any, Callable, Dict, Iterable, Iterator, List, Optional, Sequence
 
 from ..config import SchedulerConfig
-from ..external_events import ExternalEvent
+from ..external_events import (
+    CodeBlock,
+    ExternalEvent,
+    HardKill,
+    Kill,
+    Partition,
+    Start,
+    UnPartition,
+)
 from ..runtime.system import PendingEntry
 from .base import BaseScheduler, ExecutionResult
 
@@ -30,12 +49,16 @@ class InteractiveScheduler(BaseScheduler):
         config: SchedulerConfig,
         commands: Optional[Iterable[str]] = None,
         out: Callable[[str], None] = print,
+        code_blocks: Optional[Dict[str, Callable[[], None]]] = None,
     ):
         super().__init__(config)
         self._commands: Optional[Iterator[str]] = (
             iter(commands) if commands is not None else None
         )
         self.out = out
+        # Named host blocks runnable mid-session via `code <name>`
+        # (the scriptable stand-in for the reference REPL's inline code).
+        self.code_blocks = dict(code_blocks or {})
 
     # -- policy hooks ------------------------------------------------------
     def reset_pending(self) -> None:
@@ -101,6 +124,39 @@ class InteractiveScheduler(BaseScheduler):
                 self.out(f"violation: {violation!r}")
                 if violation is not None:
                     break
+            elif name == "fail" and len(parts) == 2:
+                if not self._known(parts[1]):
+                    continue
+                self._inject_one(Kill(parts[1]))
+                self.out(f"failed (isolated) {parts[1]}")
+            elif name == "hardfail" and len(parts) == 2:
+                if not self._known(parts[1]):
+                    continue
+                self._inject_one(HardKill(parts[1]))
+                self.out(f"hard-failed {parts[1]}")
+            elif name == "start" and len(parts) == 2:
+                if parts[1] not in self.actor_factories:
+                    self.out(f"no factory known for {parts[1]!r}")
+                else:
+                    self._inject_one(Start(parts[1]))
+                    self.out(f"started {parts[1]}")
+            elif name == "partition" and len(parts) == 3:
+                if not (self._known(parts[1]) and self._known(parts[2])):
+                    continue
+                self._inject_one(Partition(parts[1], parts[2]))
+                self.out(f"partitioned {parts[1]} | {parts[2]}")
+            elif name == "unpartition" and len(parts) == 3:
+                if not (self._known(parts[1]) and self._known(parts[2])):
+                    continue
+                self._inject_one(UnPartition(parts[1], parts[2]))
+                self.out(f"unpartitioned {parts[1]} | {parts[2]}")
+            elif name == "code" and len(parts) == 2:
+                block = self.code_blocks.get(parts[1])
+                if block is None:
+                    self.out(f"no code block registered as {parts[1]!r}")
+                else:
+                    self._inject_one(CodeBlock(block=block, label=parts[1]))
+                    self.out(f"ran code block {parts[1]}")
             else:
                 self.out(f"unknown command: {cmd!r}")
         if violation is None:
@@ -111,6 +167,15 @@ class InteractiveScheduler(BaseScheduler):
             deliveries=self.deliveries,
             quiescent=False,
         )
+
+    def _known(self, actor: str) -> bool:
+        """Fault targets must be actors this session has seen a factory
+        for — a typo'd name would otherwise record a phantom fault and
+        silently skew every later invariant conclusion."""
+        if actor in self.actor_factories or actor in self.system.actors:
+            return True
+        self.out(f"unknown actor {actor!r}")
+        return False
 
     def _deliverable(self) -> List[PendingEntry]:
         return [e for e in self._pending if self.system.deliverable(e)]

@@ -146,6 +146,60 @@ def test_interactive_scripted_session():
     assert any("->" in line for line in out)
 
 
+def test_interactive_mid_run_fault_commands():
+    """Reference parity (InteractiveScheduler.scala:26-113): a scripted
+    session kills a node mid-flood, recovers it, and lands in a violating
+    EventTrace — the fail/start commands record the same KillEvent/
+    SpawnEvent records a programmed Kill/Start would."""
+    from demi_tpu.events import KillEvent, SpawnEvent
+
+    app, config = _app_and_config(reliable=True)
+    out = []
+    ran = []
+    sched = InteractiveScheduler(
+        config,
+        commands=[
+            "ext",             # starts + the broadcast send
+            "run 1",           # n0 delivers, relays to n1/n2 pending
+            "fail n1",         # kill mid-run: n1 isolated, relay blocked
+            "pending",
+            "run 2",           # n2 (and n0's dup) deliver; n1 stays dark
+            "code note",       # host code block mid-session
+            "start n1",        # recovery: n1 alive again, still empty
+            "inv",             # n1 (empty) vs n0/n2 (bit): violation
+            "quit",
+        ],
+        out=out.append,
+        code_blocks={"note": lambda: ran.append("note")},
+    )
+    program = _program(app, _send(app, 0, 0))
+    result = sched.run_session(program)
+    assert result.violation is not None
+    assert ran == ["note"]
+    events = result.trace.get_events()
+    kills = [e for e in events if isinstance(e, KillEvent)]
+    assert [e.name for e in kills] == ["n1"]
+    # The recovery start is recorded after the kill.
+    spawns = [i for i, e in enumerate(events)
+              if isinstance(e, SpawnEvent) and e.name == "n1"]
+    kill_idx = next(i for i, e in enumerate(events)
+                    if isinstance(e, KillEvent))
+    assert spawns and spawns[-1] > kill_idx
+
+
+def test_interactive_unknown_fault_targets_report():
+    app, config = _app_and_config(reliable=False)
+    out = []
+    sched = InteractiveScheduler(
+        config,
+        commands=["start ghost", "code nope", "quit"],
+        out=out.append,
+    )
+    sched.run_session(_program(app))
+    assert any("no factory known" in line for line in out)
+    assert any("no code block" in line for line in out)
+
+
 def test_serialization_round_trip(tmp_path):
     app, config = _app_and_config(reliable=False)
     program = _program(app, _send(app, 0, 0), _send(app, 1, 1))
