@@ -143,10 +143,41 @@ def axon_wedged() -> bool:
             _verdict = True
             return True
         if _pid_alive(pid, start_time):
-            # Still hanging in backend init: wedged. Do NOT kill it and
-            # do NOT add another probe to the single-tenant tunnel.
-            _verdict = True
-            return True
+            # Another process's probe is still in backend init. A YOUNG
+            # probe (spawned seconds ago by a concurrent caller) is not
+            # evidence of a wedge — init takes a few seconds even when
+            # healthy, and the pre-shared-state guard always waited up
+            # to _PROBE_WAIT. Poll for its verdict file for the
+            # REMAINDER of that window (spawn time = the pid file's
+            # mtime); only park-and-report once the window elapses. No
+            # new probe either way (single-tenant tunnel).
+            try:
+                spawned = os.path.getmtime(
+                    os.path.join(STATE_DIR, "probe.pid")
+                )
+            except OSError:
+                spawned = 0.0
+            deadline = spawned + _PROBE_WAIT
+            while time.time() < deadline:
+                if _verdict_file() or not _pid_alive(pid, start_time):
+                    break
+                time.sleep(0.5)
+            verdict = _verdict_file()
+            if verdict == "probe.ok":
+                _clear_state()
+                _verdict = False
+                return False
+            if verdict == "probe.err":
+                _clear_state()
+                _verdict = True
+                return True
+            if _pid_alive(pid, start_time):
+                # Outlived the full window with no verdict: wedged.
+                # Do NOT kill it (killing mid-grant re-wedges).
+                _verdict = True
+                return True
+            # Died mid-poll without a verdict: fall through to a fresh
+            # probe below.
         # Died without a verdict file (OOM-killed, machine reboot):
         # forget it and fall through to a fresh probe.
         _clear_state()

@@ -43,6 +43,8 @@ from dataclasses import dataclass
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 TIMER_TAG = "__timer__"
+# Bounded per-node snapshot-token window (STS peek rollback depth).
+_SNAPSHOT_CAP = 64
 UDP_TAG = "__udp__"
 EXTERNAL_ADDR = ("0.0.0.0", 0)
 
@@ -213,6 +215,7 @@ class _Node:
         self.arm_counts: Dict[str, int] = {}
         self.effects = _Effects()
         self._snapshots: Dict[int, tuple] = {}
+        self._next_snapshot_token = 0
 
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> None:
@@ -300,21 +303,38 @@ class _Node:
         identity-shared across copies; only app state forks."""
         import copy
 
-        token = len(self._snapshots)
+        token = self._next_snapshot_token
+        self._next_snapshot_token += 1
+        # The virtual clock rides along: a rolled-back peek probe that
+        # delivered timers must not leave loop.time() advanced (replay
+        # determinism for time-reading apps).
         self._snapshots[token] = copy.deepcopy(
-            (self.protocol, dict(self.armed), dict(self.arm_counts))
+            (self.protocol, dict(self.armed), dict(self.arm_counts),
+             self.loop._now)
         )
+        # Tokens from abandoned probes would otherwise accumulate for the
+        # process lifetime; peek rollback only ever reaches back a bounded
+        # distance, so keep a bounded window and fail LOUDLY on a miss.
+        while len(self._snapshots) > _SNAPSHOT_CAP:
+            self._snapshots.pop(next(iter(self._snapshots)))
         return token
 
     def restore(self, token: int) -> None:
         import copy
 
+        if token not in self._snapshots:
+            raise KeyError(
+                f"snapshot token {token} expired (cap {_SNAPSHOT_CAP}); "
+                "deepen _SNAPSHOT_CAP if probes legitimately reach back "
+                "this far"
+            )
         # Deepcopy AGAIN so the stored snapshot stays pristine if this
         # state gets mutated and re-restored (peek may roll back twice).
-        proto, armed, counts = copy.deepcopy(self._snapshots[token])
+        proto, armed, counts, now = copy.deepcopy(self._snapshots[token])
         self.protocol = proto
         self.armed = armed
         self.arm_counts = counts
+        self.loop._now = now
         self.transport = _Transport(self)
         if hasattr(self.protocol, "transport"):
             self.protocol.transport = self.transport

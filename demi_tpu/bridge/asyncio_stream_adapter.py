@@ -15,20 +15,28 @@ byte-for-byte unchanged. Topology comes from the integration surface
 
 Determinism model: one established connection = one pair of protocol
 instances; every ``write`` becomes a bridge send carrying
-``("__tcp__", conn_id, seq, chunk)``. The SCHEDULER reorders these like
-any network packets — and the adapter reassembles them per connection in
-sequence order before invoking ``data_received``, which is exactly TCP's
-contract (ordered byte stream over an unordered packet substrate). So
-schedule exploration perturbs *cross-connection* interleavings at each
-node — the nondeterminism real TCP apps actually face — while each
-stream stays internally ordered. seq 0 is the SYN (server side
-instantiates its protocol on arrival = accept); a ``FIN`` sentinel chunk
-closes (``connection_lost(None)``).
+``("__tcp__", conn_id, seq, chunk, fin)``. The SCHEDULER reorders these
+like any network packets — and the adapter reassembles them per
+connection in sequence order before invoking ``data_received``, which is
+exactly TCP's contract (ordered byte stream over an unordered packet
+substrate). So schedule exploration perturbs *cross-connection*
+interleavings at each node — the nondeterminism real TCP apps actually
+face — while each stream stays internally ordered. seq 0 is the SYN
+(server side instantiates its protocol on arrival = accept); close is
+the out-of-band ``fin`` flag (fifth message field — payload bytes can
+never collide with it), delivering ``connection_lost(None)`` in order.
 
-Scope (v1): server protocols are per-connection instances from the
-app's own factory (exactly what ``loop.create_server`` takes); node
-checkpoints expose the JSON subset of a spec-designated app-state
-object; the snapshot feature is not implemented for stream nodes.
+Server protocols are per-connection instances from the app's own
+factory (exactly what ``loop.create_server`` takes); node checkpoints
+expose the JSON subset of a spec-designated app-state object. Round 5:
+stream nodes serve the "snapshot" bridge feature — opaque rollback
+tokens capturing the whole connection table (protocol instances,
+reassembly buffers, send-side seq counters), armed timers, the
+app-state object's vars, and the virtual clock — so STS peek and system
+snapshots work over live TCP apps exactly as over datagram apps. The
+app-state object keeps its IDENTITY across restores (its vars are
+rolled back in place), so protocol factories closing over it stay
+consistent.
 """
 
 from __future__ import annotations
@@ -41,7 +49,6 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 from .asyncio_adapter import _Effects, _Loop
 
 TCP_TAG = "__tcp__"
-FIN = "__FIN__"
 
 
 @dataclass
@@ -85,6 +92,14 @@ class _StreamTransport:
         )
         self._next_seq += 1
 
+    def _restore_state(self, next_seq: int, closing: bool) -> None:
+        # Snapshot rollback: transports are identity-shared across
+        # snapshots (protocol instances hold references under arbitrary
+        # attribute names), so their send-side stream state is restored
+        # IN PLACE.
+        self._next_seq = next_seq
+        self._closing = closing
+
     def writelines(self, chunks) -> None:
         for c in chunks:
             self.write(c)
@@ -93,7 +108,7 @@ class _StreamTransport:
         if not self._closing:
             self._closing = True
             self._node.capture_chunk(
-                self._peer, self._conn_id, self._next_seq, FIN
+                self._peer, self._conn_id, self._next_seq, "", fin=True
             )
             self._next_seq += 1
 
@@ -138,6 +153,8 @@ class _StreamNode:
         # Timer plumbing shared with the datagram adapter's loop.
         self.armed: Dict[tuple, Tuple[Callable, tuple, float]] = {}
         self.arm_counts: Dict[str, int] = {}
+        self._snapshots: Dict[int, tuple] = {}
+        self._next_snapshot_token = 0
 
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> None:
@@ -172,12 +189,14 @@ class _StreamNode:
         self.conns.clear()
 
     # -- effects capture ----------------------------------------------------
-    def capture_chunk(self, peer: str, conn_id: str, seq: int, data: str) -> None:
+    def capture_chunk(
+        self, peer: str, conn_id: str, seq: int, data: str, fin: bool = False
+    ) -> None:
         if peer not in self.adapter.nodes:
             self.effects.logs.append(f"write to unknown node {peer!r} dropped")
             return
         self.effects.sends.append(
-            {"dst": peer, "msg": [TCP_TAG, conn_id, seq, data]}
+            {"dst": peer, "msg": [TCP_TAG, conn_id, seq, data, int(fin)]}
         )
 
     def arm_timer(self, delay: float, callback, args):
@@ -209,11 +228,11 @@ class _StreamNode:
             self.loop._now = max(self.loop._now, when)
             callback(*args)
             return
-        if not (isinstance(msg, (list, tuple)) and len(msg) == 4
+        if not (isinstance(msg, (list, tuple)) and len(msg) == 5
                 and msg[0] == TCP_TAG):
             self.effects.logs.append(f"undecodable message {msg!r} dropped")
             return
-        _, conn_id, seq, data = msg
+        _, conn_id, seq, data, fin = msg
         conn = self.conns.get(conn_id)
         if conn is None:
             # First packet of an inbound connection (any seq: the SYN may
@@ -226,13 +245,13 @@ class _StreamNode:
             conn = _Conn(conn_id, src)
             conn.next_seq = 0  # server side starts at the SYN
             self.conns[conn_id] = conn
-        conn.buffer[int(seq)] = data
+        conn.buffer[int(seq)] = (data, bool(fin))
         self._drain(conn)
 
     def _drain(self, conn: _Conn) -> None:
         """TCP reassembly: apply buffered chunks in sequence order."""
         while not conn.closed and conn.next_seq in conn.buffer:
-            data = conn.buffer.pop(conn.next_seq)
+            data, fin = conn.buffer.pop(conn.next_seq)
             is_syn = conn.next_seq == 0
             conn.next_seq += 1
             if is_syn:
@@ -242,11 +261,108 @@ class _StreamNode:
                     self, conn.conn_id, conn.peer
                 )
                 conn.protocol.connection_made(conn.transport)
-            elif data == FIN:
+            elif fin:
                 conn.closed = True
                 conn.protocol.connection_lost(None)
             else:
                 conn.protocol.data_received(data.encode("latin-1"))
+
+    # -- snapshot/restore (STS peek support) --------------------------------
+    def snapshot(self) -> int:
+        """Opaque rollback token for the whole node: connection table
+        (protocol instances + reassembly buffers), send-side transport
+        seq state, armed timers, the app-state object's vars, and the
+        virtual clock — one deepcopy so cross-references stay bound.
+
+        Two identity rules make arbitrary app references survive
+        rollback: transports restore their stream state IN PLACE
+        (protocols keep them under arbitrary attribute names), and the
+        spec's app-state object is memo-pinned so copied protocols keep
+        pointing at the ORIGINAL object, whose vars are rolled back in
+        place on restore — factories closing over it stay consistent."""
+        import copy
+
+        from .asyncio_adapter import _SNAPSHOT_CAP
+
+        # ONE deepcopy with ONE memo: timer callbacks stay bound to the
+        # copied protocols, and mutable objects shared between app_state
+        # and protocol instances (e.g. a protocol caching
+        # ``self.store = kv.store``) dedupe to the same copy. app_state
+        # ITSELF is memo-pinned so references to it keep pointing at the
+        # original object (whose vars roll back in place on restore).
+        memo: Dict[int, Any] = {}
+        if self.spec.app_state is not None:
+            memo[id(self.spec.app_state)] = self.spec.app_state
+        conn_copy, armed_copy, app_vars = copy.deepcopy(
+            (
+                {
+                    cid: (c.protocol, c.peer, c.next_seq, dict(c.buffer),
+                          c.closed)
+                    for cid, c in self.conns.items()
+                },
+                dict(self.armed),
+                (
+                    dict(vars(self.spec.app_state))
+                    if self.spec.app_state is not None
+                    else None
+                ),
+            ),
+            memo,
+        )
+        transports = {
+            cid: (c.transport, c.transport._next_seq, c.transport._closing)
+            for cid, c in self.conns.items()
+            if c.transport is not None
+        }
+        token = self._next_snapshot_token
+        self._next_snapshot_token += 1
+        self._snapshots[token] = (
+            conn_copy, armed_copy, dict(self.arm_counts), app_vars,
+            transports, self.loop._now,
+        )
+        while len(self._snapshots) > _SNAPSHOT_CAP:
+            self._snapshots.pop(next(iter(self._snapshots)))
+        return token
+
+    def restore(self, token: int) -> None:
+        import copy
+
+        from .asyncio_adapter import _SNAPSHOT_CAP
+
+        if token not in self._snapshots:
+            raise KeyError(
+                f"snapshot token {token} expired (cap {_SNAPSHOT_CAP})"
+            )
+        memo: Dict[int, Any] = {}
+        if self.spec.app_state is not None:
+            memo[id(self.spec.app_state)] = self.spec.app_state
+        (conn_copy, armed_copy, counts, app_vars, transports, now) = (
+            self._snapshots[token]
+        )
+        # Deepcopy AGAIN (stored snapshot must survive re-restores) —
+        # again with ONE memo, so restored timer callbacks bind to the
+        # restored protocols and shared app-state internals stay shared.
+        conn_copy, armed_copy, app_vars = copy.deepcopy(
+            (conn_copy, armed_copy, app_vars), memo
+        )
+        self.armed = armed_copy
+        self.arm_counts = dict(counts)
+        if app_vars is not None:
+            vars(self.spec.app_state).clear()
+            vars(self.spec.app_state).update(app_vars)
+        self.conns = {}
+        for cid, (proto, peer, next_seq, buffer, closed) in conn_copy.items():
+            conn = _Conn(cid, peer)
+            conn.protocol = proto
+            conn.next_seq = next_seq
+            conn.buffer = dict(buffer)
+            conn.closed = closed
+            if cid in transports:
+                transport, t_seq, t_closing = transports[cid]
+                transport._restore_state(t_seq, t_closing)
+                conn.transport = transport
+            self.conns[cid] = conn
+        self.loop._now = now
 
     # -- checkpoint ---------------------------------------------------------
     def checkpoint(self) -> dict:
@@ -299,7 +415,11 @@ class AsyncioStreamAdapter:
         return node.effects.as_reply()
 
     def serve(self, recv, send) -> None:
-        send({"op": "register", "actors": list(self.nodes)})
+        send({
+            "op": "register",
+            "actors": list(self.nodes),
+            "features": ["snapshot"],
+        })
         while True:
             cmd = recv()
             if cmd is None or cmd.get("op") == "shutdown":
@@ -313,6 +433,11 @@ class AsyncioStreamAdapter:
                 send(self._run(node, lambda: node.deliver(src, msg)))
             elif op == "checkpoint":
                 send({"op": "state", "state": node.checkpoint()})
+            elif op == "snapshot":
+                send({"op": "state", "state": node.snapshot()})
+            elif op == "restore":
+                node.restore(cmd["state"])
+                send({"op": "effects"})
             elif op == "stop":
                 node.stop()  # no reply
             else:

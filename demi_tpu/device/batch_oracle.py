@@ -148,11 +148,13 @@ class DeviceReplayChecker:
     ) -> Optional[EventTrace]:
         # Keep the tiers' replay power matched: when the device kernel
         # peeks (cfg.replay_peek), the host bookkeeping replay must too,
-        # or device-positive candidates would fail host re-execution.
+        # with the SAME prefix budget — a larger host budget would let a
+        # candidate host-verify via a longer peek than the device oracle
+        # that selected it allows (and vice versa on re-runs).
         sts = STSScheduler(
             self.config, candidate,
             allow_peek=self.cfg.replay_peek > 0,
-            max_peek_messages=max(self.cfg.replay_peek, 10),
+            max_peek_messages=self.cfg.replay_peek,
         )
         return sts.test_with_trace(candidate, list(externals), violation)
 

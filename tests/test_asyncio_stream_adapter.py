@@ -47,8 +47,8 @@ def test_stream_dial_emits_syn_then_data():
     msgs = [tuple(s["msg"]) for s in reply["sends"]]
     conn = msgs[0][1]
     assert msgs == [
-        (TCP_TAG, conn, 0, ""),           # SYN
-        (TCP_TAG, conn, 1, "GET x\n"),    # connection_made's write
+        (TCP_TAG, conn, 0, "", 0),        # SYN
+        (TCP_TAG, conn, 1, "GET x\n", 0),  # connection_made's write
     ]
     assert not reply["crashed"]
 
@@ -63,15 +63,15 @@ def test_stream_reassembly_holds_out_of_order_chunks():
     conn = "alice->server#0"
     early = ad._run(
         server,
-        lambda: server.deliver("alice", (TCP_TAG, conn, 1, "GET x\n")),
+        lambda: server.deliver("alice", (TCP_TAG, conn, 1, "GET x\n", 0)),
     )
     assert early["sends"] == []  # held: no accept yet
     landed = ad._run(
-        server, lambda: server.deliver("alice", (TCP_TAG, conn, 0, ""))
+        server, lambda: server.deliver("alice", (TCP_TAG, conn, 0, "", 0))
     )
     # SYN drained the buffer: accept, then GET -> VAL reply.
     assert [tuple(s["msg"]) for s in landed["sends"]] == [
-        (TCP_TAG, conn, 1, "VAL 0\n")
+        (TCP_TAG, conn, 1, "VAL 0\n", 0)
     ]
     assert server.checkpoint()["open_conns"] == [conn]
 
@@ -81,10 +81,10 @@ def test_stream_fin_closes_connection():
     server = ad.nodes["server"]
     ad._run(server, server.start)
     conn = "alice->server#0"
-    ad._run(server, lambda: server.deliver("alice", (TCP_TAG, conn, 0, "")))
+    ad._run(server, lambda: server.deliver("alice", (TCP_TAG, conn, 0, "", 0)))
     ad._run(
         server,
-        lambda: server.deliver("alice", (TCP_TAG, conn, 1, "__FIN__")),
+        lambda: server.deliver("alice", (TCP_TAG, conn, 1, "", 1)),
     )
     assert server.checkpoint()["open_conns"] == []
 
@@ -154,3 +154,172 @@ def test_tcp_lost_update_soak_minimize_replay_every_hit():
         assert found > 10  # the race is common under random schedules
         assert minimized == found
         assert replayed == found
+
+
+def test_stream_snapshot_restore_roundtrip():
+    """Round 5 (VERDICT r4 weak #4): stream nodes serve rollback tokens.
+    A probe that delivers chunks (mutating protocols, reassembly
+    buffers, send-side seqs, the shared KV object, and the virtual
+    clock) must roll back bit-for-bit — including app-state IDENTITY
+    (factories close over the KV object; its vars restore in place)."""
+    ad = AsyncioStreamAdapter(NODE_SPECS)
+    server, alice = ad.nodes["server"], ad.nodes["alice"]
+    ad._run(server, server.start)
+    ad._run(alice, alice.start)
+    conn = "alice->server#0"
+    ad._run(server, lambda: server.deliver("alice", (TCP_TAG, conn, 0, "", 0)))
+    ad._run(
+        server,
+        lambda: server.deliver("alice", (TCP_TAG, conn, 1, "GET x\n", 0)),
+    )
+    import copy
+
+    kv_obj = server.spec.app_state
+    # checkpoint() values alias live app state in-process (the bridge
+    # JSON-serializes them at the wire, where it can't alias) — copy.
+    before = copy.deepcopy(server.checkpoint())
+    before_now = ad.loop._now
+    token = server.snapshot()
+
+    # Probe: a SET mutates the KV store and advances transport seqs.
+    ad._run(
+        server,
+        lambda: server.deliver("alice", (TCP_TAG, conn, 2, "SET x 7\n", 0)),
+    )
+    ad.loop._now += 11.0
+    assert server.checkpoint() != before
+
+    server.restore(token)
+    assert server.checkpoint() == before
+    assert server.spec.app_state is kv_obj  # identity preserved
+    assert ad.loop._now == before_now
+    # The restored connection still works: re-delivering the SET
+    # reproduces the same effects as the probe did.
+    reply = ad._run(
+        server,
+        lambda: server.deliver("alice", (TCP_TAG, conn, 2, "SET x 7\n", 0)),
+    )
+    assert any("OK" in s["msg"][3] for s in reply["sends"])
+    assert server.checkpoint()["sets"] == 1
+
+
+def test_stream_sts_peek_enables_absent_event():
+    """The stream twin of test_bridge_sts_peek_enables_absent_event:
+    STS peek over a LIVE external TCP process — the doctored schedule is
+    missing the enabling VAL reply, peek re-delivers pending chunks
+    under a system snapshot (bridge rollback tokens), and the replay
+    completes."""
+    from demi_tpu.events import MsgEvent
+    from demi_tpu.schedulers.replay import STSScheduler
+    from demi_tpu.trace import EventTrace
+
+    with BridgeSession(LAUNCHER, env=ENV) as session:
+        config = _config()
+        program = make_program(session)
+        recorded = BasicScheduler(config).execute(program)
+
+        def is_val_to_alice(u):
+            e = u.event
+            return (
+                isinstance(e, MsgEvent)
+                and e.rcv == "alice"
+                and isinstance(e.msg, tuple)
+                and len(e.msg) == 5
+                and isinstance(e.msg[3], str)
+                and e.msg[3].startswith("VAL")
+            )
+
+        cut = [u for u in recorded.trace.events if is_val_to_alice(u)]
+        assert cut, "no VAL delivery to alice recorded"
+        doctored = EventTrace(
+            [u for u in recorded.trace.events if not is_val_to_alice(u)],
+            list(recorded.trace.original_externals or program),
+        )
+        sts = STSScheduler(config, doctored, allow_peek=True)
+        filtered = (
+            doctored.filter_failure_detector_messages()
+            .filter_checkpoint_messages()
+            .subsequence_intersection(program)
+        )
+        result = sts.replay(filtered, program)
+        assert sts.peeked_prefixes >= 1
+        # Alice's SET (enabled only by the peeked VAL) happened.
+        sets = [
+            e for e in result.trace.get_events()
+            if isinstance(e, MsgEvent) and e.rcv == "server"
+            and isinstance(e.msg, tuple) and len(e.msg) == 5
+            and isinstance(e.msg[3], str) and e.msg[3].startswith("SET")
+        ]
+        assert sets
+
+
+def test_stream_snapshot_keeps_shared_state_bound():
+    """Review regression: a protocol caching an INNER mutable of the
+    app-state object (self.store = kv.store) and a timer bound to a
+    protocol must both stay consistent across restore — one memo per
+    deepcopy, or writes after rollback land in a divorced copy."""
+    import asyncio
+
+    class Store:
+        def __init__(self):
+            self.store = {"x": 0}
+
+    class CachingProto(asyncio.Protocol):
+        def __init__(self, st):
+            self.store = st.store  # shared inner mutable
+
+        def connection_made(self, transport):
+            self.transport = transport
+
+        def data_received(self, data):
+            self.store["x"] += 1
+            loop = asyncio.get_event_loop()
+            loop.call_later(5, self._tick)
+
+        def _tick(self):
+            self.store["x"] += 100
+
+    st = Store()
+    from demi_tpu.bridge.asyncio_stream_adapter import StreamNodeSpec
+
+    specs = {
+        "srv": StreamNodeSpec(
+            server_factory=lambda: CachingProto(st), app_state=st
+        ),
+        "cli": StreamNodeSpec(dials=[Dial_("srv")]),
+    }
+    ad = AsyncioStreamAdapter(specs)
+    srv = ad.nodes["srv"]
+    ad._run(srv, srv.start)
+    conn = "c0"
+    ad._run(srv, lambda: srv.deliver("cli", (TCP_TAG, conn, 0, "", 0)))
+    reply = ad._run(
+        srv, lambda: srv.deliver("cli", (TCP_TAG, conn, 1, "hit\n", 0))
+    )
+    timer_msg = reply["timers"][0]
+    assert st.store["x"] == 1
+    token = srv.snapshot()
+    # Probe mutates, then rolls back.
+    ad._run(srv, lambda: srv.deliver("cli", (TCP_TAG, conn, 2, "hit\n", 0)))
+    assert st.store["x"] == 2
+    srv.restore(token)
+    assert st.store["x"] == 1
+    # Shared-binding checks: a post-restore delivery AND the restored
+    # timer must both write through to the app-state object the
+    # invariant reads.
+    ad._run(srv, lambda: srv.deliver("cli", (TCP_TAG, conn, 2, "hit\n", 0)))
+    assert st.store["x"] == 2, "protocol writes diverged from app_state"
+    ad._run(srv, lambda: srv.deliver("cli", list(timer_msg)))
+    assert st.store["x"] == 102, "restored timer bound to orphan protocol"
+
+
+def Dial_(peer):
+    from demi_tpu.bridge.asyncio_stream_adapter import Dial
+
+    import asyncio
+
+    class Nop(asyncio.Protocol):
+        def connection_made(self, transport):
+            pass
+
+    return Dial(peer, Nop, conn_id="c0")
