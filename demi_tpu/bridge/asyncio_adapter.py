@@ -176,6 +176,12 @@ class _Loop:
         return False
 
     def create_task(self, coro, **kwargs):
+        # Coroutine-adapter nodes run tasks deterministically — route
+        # the common loop.create_task idiom there; datagram/stream nodes
+        # keep the loud v1 refusal.
+        node = self._adapter.current_node
+        if node is not None and hasattr(node, "api_create_task"):
+            return node.api_create_task(coro)
         raise NotImplementedError(
             "demi_tpu asyncio adapter v1 interposes callback-style "
             "protocols only (see bridge/asyncio_adapter.py docstring); "

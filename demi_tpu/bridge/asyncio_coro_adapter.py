@@ -1,0 +1,464 @@
+"""Adapter: run UNMODIFIED coroutine-style asyncio apps under the bridge.
+
+The stream adapter (asyncio_stream_adapter.py) interposes the
+callback-style ``asyncio.Protocol`` surface; this module covers the
+DOMINANT modern style — ``async def`` apps written against
+
+  - ``asyncio.start_server(handler, host, port)`` with
+    ``async def handler(reader, writer)``,
+  - ``asyncio.open_connection(host, port)`` -> (reader, writer),
+  - ``reader.read/readline/readexactly``, ``writer.write/drain/close``,
+  - ``asyncio.sleep``, ``asyncio.create_task`` / ``ensure_future``,
+  - ``server.serve_forever()`` / ``async with server:``
+
+byte-for-byte unchanged. The role WeaveActor.aj plays for Akka
+(SURVEY.md §2.1) applied to the foreign runtime's primary programming
+surface.
+
+Execution model: a per-node cooperative task runtime drives coroutines
+with ``coro.send`` until every task is SUSPENDED on an adapter awaitable
+— a stream read, a sleep, a task join, or ``serve_forever``. Suspension
+points are exactly the asyncio ones, so an app's await graph runs
+unmodified; everything between two suspensions executes atomically
+inside one bridge ``deliver`` (the same atomicity a real single-threaded
+event loop provides). Chunk delivery feeds the matching reader and
+resumes its waiter; timer delivery resumes the matching sleeper; the
+ready queue is FIFO — replay determinism is structural.
+
+Transport/wire layer is the stream adapter's, unchanged: writes become
+sequenced ``(__tcp__, conn, seq, chunk, fin)`` sends the scheduler
+reorders, with per-connection reassembly (TCP's contract). A server
+handler task is spawned per accepted connection (SYN), exactly like
+``asyncio.start_server``.
+
+Scope (v1): read/readline/readexactly, write/drain/close/wait_closed,
+sleep, create_task/ensure_future + awaiting tasks, serve_forever.
+No task cancellation/wait_for timeouts. Coroutine frames are not
+deep-copyable, so coro nodes do NOT serve the "snapshot" bridge feature
+(STS peek falls back to ignore-absent); checkpoints still expose the
+app-state object like stream nodes.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Optional
+
+from .asyncio_stream_adapter import (
+    AsyncioStreamAdapter,
+    StreamNodeSpec,
+    _Conn,
+    _StreamNode,
+    _StreamTransport,
+)
+
+
+@dataclass
+class CoroNodeSpec:
+    """One coroutine-style app node.
+
+    ``main``: async callable run at node start (clients; or a server app
+    that calls asyncio.start_server itself). ``server``: an
+    ``async def handler(reader, writer)`` registered directly (for apps
+    whose integration surface hands the handler over instead of a
+    main()). ``app_state`` as in StreamNodeSpec."""
+
+    main: Optional[Callable] = None
+    server: Optional[Callable] = None
+    app_state: Any = None
+
+
+class _Task:
+    _ids = 0
+
+    def __init__(self, coro, runtime: "_CoroRuntime"):
+        self.coro = coro
+        self.runtime = runtime
+        self.done = False
+        self.result = None
+        self.exception: Optional[BaseException] = None
+        self.joiners: list = []
+        _Task._ids += 1
+        self.name = f"task{_Task._ids}"
+
+    # asyncio.Task-alike surface
+    def __await__(self):
+        if not self.done:
+            yield ("join", self)
+        if self.exception is not None:
+            raise self.exception
+        return self.result
+
+    def add_done_callback(self, cb):  # minimal parity
+        if self.done:
+            cb(self)
+        else:
+            self.joiners.append(("cb", cb))
+
+
+class _Reader:
+    """StreamReader-alike fed by the reassembled connection bytes."""
+
+    def __init__(self, runtime: "_CoroRuntime"):
+        self.runtime = runtime
+        self.buffer = bytearray()
+        self.eof = False
+
+    def feed_data(self, data: bytes) -> None:
+        self.buffer.extend(data)
+        self.runtime.wake(("read", id(self)))
+
+    def feed_eof(self) -> None:
+        self.eof = True
+        self.runtime.wake(("read", id(self)))
+
+    def at_eof(self) -> bool:
+        return self.eof and not self.buffer
+
+    # -- awaitables ---------------------------------------------------------
+    def _take_line(self):
+        i = self.buffer.find(b"\n")
+        if i < 0:
+            return None
+        out = bytes(self.buffer[: i + 1])
+        del self.buffer[: i + 1]
+        return out
+
+    async def readline(self) -> bytes:
+        while True:
+            line = self._take_line()
+            if line is not None:
+                return line
+            if self.eof:
+                out = bytes(self.buffer)
+                self.buffer.clear()
+                return out
+            await _Suspend(("read", id(self)))
+
+    async def read(self, n: int = -1) -> bytes:
+        while True:
+            if n < 0:
+                # asyncio semantics: read() with no size blocks until
+                # EOF and returns the entire remaining stream.
+                if self.eof:
+                    out = bytes(self.buffer)
+                    self.buffer.clear()
+                    return out
+            elif self.buffer and n != 0:
+                take = min(n, len(self.buffer))
+                out = bytes(self.buffer[:take])
+                del self.buffer[:take]
+                return out
+            elif self.eof or n == 0:
+                return b""
+            await _Suspend(("read", id(self)))
+
+    async def readexactly(self, n: int) -> bytes:
+        while True:
+            if len(self.buffer) >= n:
+                out = bytes(self.buffer[:n])
+                del self.buffer[:n]
+                return out
+            if self.eof:
+                import asyncio
+
+                partial = bytes(self.buffer)
+                self.buffer.clear()
+                raise asyncio.IncompleteReadError(partial, n)
+            await _Suspend(("read", id(self)))
+
+
+class _Suspend:
+    """Awaitable yielding one suspension key to the task runtime."""
+
+    def __init__(self, key):
+        self.key = key
+
+    def __await__(self):
+        yield self.key
+
+
+class _Writer:
+    """StreamWriter-alike over the stream transport."""
+
+    def __init__(self, transport: _StreamTransport):
+        self.transport = transport
+
+    def write(self, data: bytes) -> None:
+        self.transport.write(data)
+
+    def writelines(self, chunks) -> None:
+        self.transport.writelines(chunks)
+
+    async def drain(self) -> None:
+        return None  # the virtual network never backpressures
+
+    def close(self) -> None:
+        self.transport.close()
+
+    def is_closing(self) -> bool:
+        return self.transport.is_closing()
+
+    async def wait_closed(self) -> None:
+        return None
+
+    def get_extra_info(self, name: str, default=None):
+        return self.transport.get_extra_info(name, default)
+
+
+class _Server:
+    """asyncio.Server-alike returned by the patched start_server."""
+
+    def __init__(self):
+        self.closed = False
+
+    async def __aenter__(self):
+        return self
+
+    async def __aexit__(self, *exc):
+        self.close()
+
+    async def serve_forever(self):
+        await _Suspend(("forever", id(self)))
+
+    def close(self) -> None:
+        self.closed = True
+
+    async def wait_closed(self) -> None:
+        return None
+
+    def is_serving(self) -> bool:
+        return not self.closed
+
+
+class _CoroRuntime:
+    """Per-node cooperative scheduler: FIFO ready queue + suspension map."""
+
+    def __init__(self, node: "_CoroNode"):
+        self.node = node
+        self.ready: deque = deque()
+        self.blocked: Dict[Any, list] = {}  # key -> [tasks]
+
+    def spawn(self, coro) -> _Task:
+        task = _Task(coro, self)
+        self.ready.append(task)
+        return task
+
+    def wake(self, key) -> None:
+        for task in self.blocked.pop(key, []):
+            self.ready.append(task)
+
+    def run(self) -> None:
+        """Drive every ready task to its next suspension (or completion).
+        Deterministic: FIFO order, new tasks/wakes append."""
+        steps = 0
+        while self.ready:
+            steps += 1
+            if steps > 100_000:
+                raise RuntimeError(
+                    "coroutine runtime livelock: 100k task steps without "
+                    "quiescing (an await-free spin loop in the app?)"
+                )
+            task = self.ready.popleft()
+            try:
+                key = task.coro.send(None)
+            except StopIteration as stop:
+                self._finish(task, stop.value, None)
+                continue
+            except Exception as e:  # handler crashed
+                self._finish(task, None, e)
+                # Crash surfaces like a protocol handler raise would.
+                raise
+            if key == ("ready",):  # sleep(0)-style yield
+                self.ready.append(task)
+            else:
+                self.blocked.setdefault(key, []).append(task)
+
+    def _finish(self, task: _Task, result, exc) -> None:
+        task.done = True
+        task.result = result
+        task.exception = exc
+        for kind, j in task.joiners:
+            if kind == "cb":
+                j(task)
+        task.joiners.clear()
+        self.wake(("join", task))
+
+
+class _CoroServerProtocol:
+    """Internal per-connection protocol: bridges the stream layer to a
+    spawned ``handler(reader, writer)`` task (asyncio's
+    StreamReaderProtocol, re-derived)."""
+
+    def __init__(self, node: "_CoroNode", handler):
+        self.node = node
+        self.handler = handler
+        self.reader: Optional[_Reader] = None
+
+    def connection_made(self, transport) -> None:
+        self.reader = _Reader(self.node.runtime)
+        writer = _Writer(transport)
+        self.node.runtime.spawn(self.handler(self.reader, writer))
+        self.node.runtime.run()
+
+    def data_received(self, data: bytes) -> None:
+        self.reader.feed_data(data)
+        self.node.runtime.run()
+
+    def connection_lost(self, exc) -> None:
+        self.reader.feed_eof()
+        self.node.runtime.run()
+
+
+class _CoroClientProtocol:
+    """Internal protocol for open_connection's client side."""
+
+    def __init__(self, node: "_CoroNode"):
+        self.node = node
+        self.reader = _Reader(node.runtime)
+
+    def connection_made(self, transport) -> None:
+        pass
+
+    def data_received(self, data: bytes) -> None:
+        self.reader.feed_data(data)
+        self.node.runtime.run()
+
+    def connection_lost(self, exc) -> None:
+        self.reader.feed_eof()
+        self.node.runtime.run()
+
+
+class _CoroNode(_StreamNode):
+    def __init__(self, adapter, name, spec: CoroNodeSpec):
+        # The underlying machinery speaks StreamNodeSpec; server_factory
+        # reads the handler registered at runtime (start_server) or
+        # supplied directly.
+        self._coro_spec = spec
+        stream_spec = StreamNodeSpec(
+            server_factory=(lambda: _CoroServerProtocol(
+                self, self.server_handler
+            )),
+            dials=[],
+            app_state=spec.app_state,
+        )
+        super().__init__(adapter, name, stream_spec)
+        self.server_handler: Optional[Callable] = spec.server
+        self.runtime = _CoroRuntime(self)
+        self._dial_count = 0
+
+    def start(self) -> None:
+        self.runtime = _CoroRuntime(self)
+        self.server_handler = self._coro_spec.server
+        self._dial_count = 0
+        super().start()  # clears conns/timers, resets app_state; no dials
+        if self._coro_spec.main is not None:
+            self.runtime.spawn(self._coro_spec.main())
+            self.runtime.run()
+
+    # start_server with no registered handler yet: SYN gets dropped by
+    # the base drain only if server_factory is None — ours isn't, so
+    # guard here instead.
+    def _drain(self, conn) -> None:
+        if conn.next_seq == 0 and self.server_handler is None:
+            self.effects.logs.append(
+                f"no server handler for inbound conn {conn.conn_id!r}"
+            )
+            return
+        super()._drain(conn)
+
+    # -- patched-asyncio entry points ---------------------------------------
+    def api_start_server(self, client_connected_cb, host=None, port=None,
+                         **kw):
+        self.server_handler = client_connected_cb
+        return _completed(_Server())
+
+    def api_open_connection(self, host=None, port=None, **kw):
+        peer = str(host)
+        conn_id = f"{self.name}->{peer}#d{self._dial_count}"
+        self._dial_count += 1
+        conn = _Conn(conn_id, peer)
+        proto = _CoroClientProtocol(self)
+        conn.protocol = proto
+        conn.transport = _StreamTransport(self, conn_id, peer)
+        conn.next_seq = 1  # client side never receives a SYN
+        self.conns[conn_id] = conn
+        self.capture_chunk(peer, conn_id, 0, "")  # SYN
+        return _completed((proto.reader, _Writer(conn.transport)))
+
+    def api_sleep(self, delay, result=None):
+        if delay <= 0:
+            return _yield_once(result)
+        key = object()  # unique suspension key for this sleep
+
+        def resume():
+            self.runtime.wake(("sleep", id(key)))
+            self.runtime.run()
+
+        self.arm_timer(float(delay), resume, ())
+        return _sleep_await(("sleep", id(key)), result)
+
+    def api_create_task(self, coro, **kw):
+        return self.runtime.spawn(coro)
+
+    # Coroutine frames can't deepcopy: no snapshot feature.
+    def snapshot(self) -> int:
+        raise RuntimeError(
+            "coroutine-style nodes cannot serve snapshot tokens "
+            "(running coroutine frames are not copyable)"
+        )
+
+    def restore(self, token: int) -> None:
+        raise RuntimeError("coroutine-style nodes cannot restore snapshots")
+
+
+async def _completed(value):
+    return value
+
+
+async def _yield_once(result):
+    await _Suspend(("ready",))
+    return result
+
+
+async def _sleep_await(key, result):
+    await _Suspend(key)
+    return result
+
+
+class AsyncioCoroAdapter(AsyncioStreamAdapter):
+    """Hosts coroutine-style nodes; wire format and bridge protocol are
+    the stream adapter's."""
+
+    node_cls = _CoroNode
+    features = ()  # no snapshot: coroutine frames aren't copyable
+
+    def _patches(self) -> Dict[str, Callable]:
+        patches = super()._patches()
+
+        def via_node(method_name):
+            def call(*args, **kw):
+                node = self.current_node
+                if node is None:
+                    raise RuntimeError(
+                        "adapter asyncio API used outside a delivery"
+                    )
+                return getattr(node, method_name)(*args, **kw)
+
+            return call
+
+        patches.update(
+            start_server=via_node("api_start_server"),
+            open_connection=via_node("api_open_connection"),
+            sleep=via_node("api_sleep"),
+            create_task=via_node("api_create_task"),
+            ensure_future=via_node("api_create_task"),
+        )
+        return patches
+
+
+def serve_stdio(nodes: Dict[str, CoroNodeSpec]) -> None:
+    from .asyncio_stream_adapter import serve_stdio as _serve
+
+    _serve(nodes, adapter_cls=AsyncioCoroAdapter)

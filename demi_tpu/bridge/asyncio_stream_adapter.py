@@ -387,22 +387,35 @@ class AsyncioStreamAdapter:
     """Hosts stream nodes and speaks the bridge protocol on (recv, send)
     callables; structure mirrors AsyncioAdapter."""
 
+    node_cls = _StreamNode
+    features = ("snapshot",)
+
     def __init__(self, nodes: Dict[str, StreamNodeSpec]):
         self.loop = _Loop(self)
         self.nodes = {
-            name: _StreamNode(self, name, spec)
+            name: self.node_cls(self, name, spec)
             for name, spec in nodes.items()
         }
         self.current_node: Optional[_StreamNode] = None
+        self._patch_table = self._patches()  # built once: _run is hot
+
+    def _patches(self) -> Dict[str, Callable]:
+        """asyncio module attributes to swap during _run (subclasses add
+        the coroutine-surface functions)."""
+        return {
+            "get_running_loop": lambda: self.loop,
+            "get_event_loop": lambda: self.loop,
+        }
 
     def _run(self, node: _StreamNode, fn: Callable[[], None]) -> dict:
         import asyncio
 
         node.effects = _Effects()
         self.current_node = node
-        saved = (asyncio.get_running_loop, asyncio.get_event_loop)
-        asyncio.get_running_loop = lambda: self.loop  # type: ignore
-        asyncio.get_event_loop = lambda: self.loop  # type: ignore
+        patches = self._patch_table
+        saved = {k: getattr(asyncio, k) for k in patches}
+        for k, v in patches.items():
+            setattr(asyncio, k, v)
         try:
             fn()
             self.loop.drain()
@@ -410,7 +423,8 @@ class AsyncioStreamAdapter:
             node.effects.crashed = True
             node.effects.logs.append(f"crashed: {e!r}")
         finally:
-            asyncio.get_running_loop, asyncio.get_event_loop = saved
+            for k, v in saved.items():
+                setattr(asyncio, k, v)
             self.current_node = None
         return node.effects.as_reply()
 
@@ -418,7 +432,7 @@ class AsyncioStreamAdapter:
         send({
             "op": "register",
             "actors": list(self.nodes),
-            "features": ["snapshot"],
+            "features": list(self.features),
         })
         while True:
             cmd = recv()
@@ -434,17 +448,26 @@ class AsyncioStreamAdapter:
             elif op == "checkpoint":
                 send({"op": "state", "state": node.checkpoint()})
             elif op == "snapshot":
-                send({"op": "state", "state": node.snapshot()})
+                # An expired/unsupported token must surface as an error
+                # reply the scheduler can raise on — not kill the whole
+                # external process and lose the diagnostic.
+                try:
+                    send({"op": "state", "state": node.snapshot()})
+                except Exception as e:
+                    send({"op": "state", "state": None, "error": repr(e)})
             elif op == "restore":
-                node.restore(cmd["state"])
-                send({"op": "effects"})
+                try:
+                    node.restore(cmd["state"])
+                    send({"op": "effects"})
+                except Exception as e:
+                    send({"op": "effects", "error": repr(e)})
             elif op == "stop":
                 node.stop()  # no reply
             else:
                 raise SystemExit(f"unknown op {cmd!r}")
 
 
-def serve_stdio(nodes: Dict[str, StreamNodeSpec]) -> None:
+def serve_stdio(nodes: Dict[str, StreamNodeSpec], adapter_cls=None) -> None:
     def recv():
         line = sys.stdin.readline()
         return json.loads(line) if line else None
@@ -453,4 +476,4 @@ def serve_stdio(nodes: Dict[str, StreamNodeSpec]) -> None:
         sys.stdout.write(json.dumps(obj) + "\n")
         sys.stdout.flush()
 
-    AsyncioStreamAdapter(nodes).serve(recv, send)
+    (adapter_cls or AsyncioStreamAdapter)(nodes).serve(recv, send)

@@ -206,6 +206,12 @@ class BridgeSession:
         reply = self.transport.recv()
         if reply.get("op") not in ("effects", "state"):
             raise BridgeDown(f"unexpected reply {reply!r}")
+        if reply.get("error"):
+            # App-side op failure (e.g. an expired snapshot token): the
+            # process stays alive; the failure surfaces HERE, loudly.
+            raise HarnessError(
+                f"bridge app error for {obj.get('op')!r}: {reply['error']}"
+            )
         return reply
 
     def notify(self, obj: dict) -> None:

@@ -1,0 +1,83 @@
+"""An UNMODIFIED coroutine-style asyncio KV app (no demi_tpu knowledge).
+
+The modern-idiom twin of tcp_counter.py: ``asyncio.start_server`` with an
+``async def`` handler, ``asyncio.open_connection`` clients, awaits on
+readline/drain/sleep. Runnable standalone over real sockets:
+
+    python async_kv.py           # serialized demo on 127.0.0.1
+
+Two increment clients perform GET x -> SET x+1 read-modify-write cycles;
+interleaving both cycles loses an update (x < sets) — the same inherent
+race tcp_counter has, written the async/await way.
+"""
+
+import asyncio
+
+
+class KV:
+    def __init__(self):
+        self.reset()
+
+    def reset(self):
+        self.store = {"x": 0}
+        self.sets = 0
+
+
+async def kv_server(kv: KV, reader, writer):
+    while True:
+        line = await reader.readline()
+        if not line:
+            break
+        parts = line.decode().split()
+        if not parts:
+            continue
+        if parts[0] == "GET":
+            writer.write(
+                f"VAL {kv.store.get(parts[1], 0)}\n".encode()
+            )
+        elif parts[0] == "SET":
+            kv.store[parts[1]] = int(parts[2])
+            kv.sets += 1
+            writer.write(b"OK\n")
+        else:
+            writer.write(b"ERR\n")
+        await writer.drain()
+    writer.close()
+
+
+async def serve(kv: KV, host="0.0.0.0", port=9000):
+    server = await asyncio.start_server(
+        lambda r, w: kv_server(kv, r, w), host, port
+    )
+    async with server:
+        await server.serve_forever()
+
+
+async def increment_client(host="server", port=9000, think=0.05):
+    reader, writer = await asyncio.open_connection(host, port)
+    writer.write(b"GET x\n")
+    await writer.drain()
+    line = await reader.readline()
+    value = int(line.split()[1])
+    await asyncio.sleep(think)  # think time between read and write
+    writer.write(f"SET x {value + 1}\n".encode())
+    await writer.drain()
+    await reader.readline()  # OK
+    writer.close()
+
+
+async def _demo():
+    kv = KV()
+    server = await asyncio.start_server(
+        lambda r, w: kv_server(kv, r, w), "127.0.0.1", 0
+    )
+    port = server.sockets[0].getsockname()[1]
+    async with server:
+        await increment_client("127.0.0.1", port, think=0.0)
+        await increment_client("127.0.0.1", port, think=0.0)
+    print(f"x={kv.store['x']} sets={kv.sets}")
+    return kv
+
+
+if __name__ == "__main__":
+    asyncio.run(_demo())

@@ -1,0 +1,42 @@
+"""Bridge launcher + integration surface for the (unmodified) async_kv
+coroutine-style app: one KV server node, two increment-client nodes."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from async_kv import KV, increment_client, serve  # untouched
+
+from demi_tpu.bridge.asyncio_coro_adapter import CoroNodeSpec, serve_stdio
+
+KV_STATE = KV()
+
+NODE_SPECS = {
+    "server": CoroNodeSpec(
+        main=lambda: serve(KV_STATE), app_state=KV_STATE
+    ),
+    "alice": CoroNodeSpec(main=lambda: increment_client("server")),
+    "bob": CoroNodeSpec(main=lambda: increment_client("server")),
+}
+
+
+def lost_update(states):
+    """Safety: x must reflect every completed SET (same invariant as the
+    tcp_counter fixture)."""
+    server = states.get("server")
+    if server and server.get("sets", 0) > server.get("store", {}).get("x", 0):
+        return 1
+    return None
+
+
+def make_program(session, wait_budget: int = 60):
+    from demi_tpu.external_events import Start, WaitQuiescence
+
+    return [
+        Start(name, ctor=session.actor_factory(name)) for name in NODE_SPECS
+    ] + [WaitQuiescence(budget=wait_budget)]
+
+
+if __name__ == "__main__":
+    serve_stdio(NODE_SPECS)
