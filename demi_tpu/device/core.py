@@ -137,6 +137,10 @@ class DeviceConfig:
     # requires every app message field to fit the narrow range — the
     # app's contract, unchecked on device.
     msg_dtype: str = "int32"
+    # Testing-only escape hatch: force the O(P^2) head recompute even in
+    # sequential srcdst_fifo kernels (parity pin for the incremental
+    # maintenance; tests/test_device_srcdst.py).
+    head_recompute: bool = False
 
     def __post_init__(self):
         if self.index_mode not in ("auto", "onehot", "scatter"):
@@ -167,6 +171,17 @@ class DeviceConfig:
         if self.index_mode == "auto":
             return jax.default_backend() == "tpu"
         return self.index_mode == "onehot"
+
+    @property
+    def track_fifo_heads(self) -> bool:
+        """Incremental per-channel FIFO-head maintenance: srcdst_fifo's
+        head test drops from an O(P^2) same-channel compare per step to
+        O(K*P) at insert + O(P) at consume. The round kernel recomputes
+        per ROUND instead (amortized over up to N deliveries), so only
+        the sequential kernels carry the extra state."""
+        return self.srcdst_fifo and not self.round_delivery and not (
+            self.head_recompute
+        )
 
     @property
     def trace_rows(self) -> int:
@@ -209,6 +224,11 @@ class ScheduleState(NamedTuple):
     pool_msg: jnp.ndarray  # [P, W] int32
     pool_seq: jnp.ndarray  # [P] int32 arrival order (FIFO matching)
     pool_crec: jnp.ndarray  # [P] int32 trace index of the creating event (-1 none)
+    # Per-channel FIFO-head bits ([0] unless cfg.track_fifo_heads):
+    # True iff this entry is its (src,dst) channel's earliest-arrival
+    # valid non-timer entry. Maintained incrementally by
+    # insert_rows/delivery_effects/purges.
+    pool_head: jnp.ndarray  # [P] bool (or [0])
     # Timer-parking memory (host: justScheduledTimers keyed (rcv, fp);
     # device: one remembered timer per actor).
     timer_mem: jnp.ndarray  # [N, W] int32
@@ -262,6 +282,7 @@ def init_state(app: DSLApp, cfg: DeviceConfig, key) -> ScheduleState:
         pool_msg=jnp.zeros((p, w), cfg.msg_jnp_dtype),
         pool_seq=jnp.zeros(p, jnp.int32),
         pool_crec=jnp.full(p, -1, jnp.int32),
+        pool_head=jnp.zeros(p if cfg.track_fifo_heads else 0, bool),
         timer_mem=jnp.zeros((n, w), cfg.msg_jnp_dtype),
         timer_mem_valid=jnp.zeros(n, bool),
         last_rec=jnp.full(n, -1, jnp.int32),
@@ -309,10 +330,21 @@ def deliverable_mask(state: ScheduleState, cfg: DeviceConfig) -> jnp.ndarray:
     return state.pool_valid & ~state.pool_parked & dst_ok & passes_network
 
 
-def fifo_head_mask(state: ScheduleState) -> jnp.ndarray:
+def fifo_head_mask(state: ScheduleState, cfg: "DeviceConfig") -> jnp.ndarray:
     """Entries that are their (src,dst) channel's FIFO head (earliest
     arrival seq among valid non-timer entries of the same pair). Timers are
-    not channelized and pass through unconditionally."""
+    not channelized and pass through unconditionally.
+
+    With cfg.track_fifo_heads the bits are maintained incrementally
+    (insert_rows/delivery_effects/purges) and this is O(P); otherwise
+    (round kernel, parity pin) the O(P^2) same-channel recompute runs."""
+    if cfg.track_fifo_heads:
+        return state.pool_timer | state.pool_head
+    return state.pool_timer | recompute_fifo_heads(state)
+
+
+def recompute_fifo_heads(state: ScheduleState) -> jnp.ndarray:
+    """[P] bool: non-timer channel heads, recomputed from scratch."""
     chan = state.pool_valid & ~state.pool_timer
     same_pair = (
         (state.pool_src[:, None] == state.pool_src[None, :])
@@ -321,8 +353,7 @@ def fifo_head_mask(state: ScheduleState) -> jnp.ndarray:
         & chan[None, :]
     )
     earlier = same_pair & (state.pool_seq[None, :] < state.pool_seq[:, None])
-    is_head = chan & ~jnp.any(earlier, axis=1)
-    return state.pool_timer | is_head
+    return chan & ~jnp.any(earlier, axis=1)
 
 
 def alive_mask(state: ScheduleState) -> jnp.ndarray:
@@ -367,12 +398,38 @@ def insert_rows(
 
     seqs = state.seq_counter + want  # arrival order follows row order
     k = row_valid.shape[0]
+    if cfg.track_fifo_heads:
+        # A new row heads its channel iff the pool holds no valid
+        # non-timer same-channel entry and no EARLIER row of this batch
+        # opens the channel first (batch order = arrival order).
+        chan_pool = state.pool_valid & ~state.pool_timer
+        exists_pool = jnp.any(
+            (row_src[:, None] == state.pool_src[None, :])
+            & (row_dst[:, None] == state.pool_dst[None, :])
+            & chan_pool[None, :],
+            axis=1,
+        )
+        kidx = jnp.arange(k)
+        prior_batch = jnp.any(
+            (row_src[:, None] == row_src[None, :])
+            & (row_dst[:, None] == row_dst[None, :])
+            & (kidx[None, :] < kidx[:, None])
+            & (ok & ~row_timer)[None, :],
+            axis=1,
+        )
+        row_head = ok & ~row_timer & ~exists_pool & ~prior_batch
     if cfg.use_onehot:
         oh_kp = ok[:, None] & (
             slots[:, None] == jnp.arange(cfg.pool_capacity)[None, :]
         )  # [K, P] — at most one True per column (slots strictly increase)
         hit = jnp.any(oh_kp, axis=0)
+        new_head = (
+            ops.scatter_vec_bool(state.pool_head, oh_kp, row_head)
+            if cfg.track_fifo_heads
+            else state.pool_head
+        )
         new_state = state._replace(
+            pool_head=new_head,
             pool_valid=state.pool_valid | hit,
             pool_src=ops.scatter_vec_int(state.pool_src, oh_kp, row_src),
             pool_dst=ops.scatter_vec_int(state.pool_dst, oh_kp, row_dst),
@@ -401,6 +458,11 @@ def insert_rows(
         return new_state
     slots = jnp.where(ok, slots, cfg.pool_capacity)  # out-of-range => dropped
     new_state = state._replace(
+        pool_head=(
+            state.pool_head.at[slots].set(row_head, mode="drop")
+            if cfg.track_fifo_heads
+            else state.pool_head
+        ),
         pool_valid=state.pool_valid.at[slots].set(True, mode="drop"),
         pool_src=state.pool_src.at[slots].set(row_src, mode="drop"),
         pool_dst=state.pool_dst.at[slots].set(row_dst, mode="drop"),
@@ -421,12 +483,6 @@ def insert_rows(
             )
         )
     return new_state
-
-
-def purge_actor(state: ScheduleState, actor: jnp.ndarray) -> ScheduleState:
-    """Invalidate all pool entries touching ``actor`` (HardKill scrub)."""
-    touch = (state.pool_src == actor) | (state.pool_dst == actor)
-    return state._replace(pool_valid=state.pool_valid & ~touch)
 
 
 # ---------------------------------------------------------------------------
@@ -520,6 +576,23 @@ def delivery_effects(
         deliveries=state.deliveries + valid_idx.astype(jnp.int32),
         sched_hash=jnp.where(valid_idx, folded, state.sched_hash),
     )
+    if cfg.track_fifo_heads:
+        # Promote the consumed channel's successor: recompute head bits
+        # for THIS channel only (O(P); the consumed entry may not have
+        # been the head — replay delivers by content — so a plain
+        # min-seq recompute over the channel is the exact rule).
+        upd = valid_idx & ~is_timer
+        samech = (
+            (state.pool_src == src)
+            & (state.pool_dst == dst)
+            & state.pool_valid
+            & ~state.pool_timer
+        )
+        seqs = jnp.where(samech, state.pool_seq, jnp.int32(2**30))
+        new_head = samech & (state.pool_seq == jnp.min(seqs))
+        pool_head = jnp.where(samech & upd, new_head, state.pool_head)
+        pool_head = ops.set_scalar(pool_head, safe_idx, False, valid_idx, oh)
+        state = state._replace(pool_head=pool_head)
 
     # Timer memory update: delivering a timer remembers it; delivering a
     # non-timer clears all memory and unparks everything (host semantics:
@@ -668,6 +741,11 @@ def external_effects(
         started=started, isolated=isolated, stopped=stopped,
         actor_state=actor_state, cut=cut,
         pool_valid=state.pool_valid & ~touch,
+        pool_head=(
+            state.pool_head & ~touch
+            if state.pool_head.shape[0]
+            else state.pool_head
+        ),
     )
 
     # Proposed rows: the Start's initial messages (fresh-start only) and the

@@ -222,7 +222,7 @@ def make_step_fn(app: DSLApp, cfg: DeviceConfig):
         mask = deliverable_mask(state, cfg) & dispatching & ~cond_met
         if cfg.srcdst_fifo:
             # TCP-ordered channels: only FIFO heads (and timers) compete.
-            mask = mask & fifo_head_mask(state)
+            mask = mask & fifo_head_mask(state, cfg)
         count = jnp.sum(mask.astype(jnp.int32))
         any_deliverable = count > 0
 

@@ -58,6 +58,14 @@ def _is_delivery_kind(kind):
 def make_replay_run_lane(app: DSLApp, cfg: DeviceConfig):
     """Unjitted single-lane replay ``run_lane(records, key) -> ReplayResult``
     (composable with vmap/jit/shardings by callers)."""
+    import dataclasses
+
+    if cfg.track_fifo_heads:
+        # Replay matches by content + pool_seq FIFO and never reads the
+        # incremental head bits — skip their maintenance entirely
+        # (head_recompute flips track_fifo_heads off; fifo_head_mask is
+        # never called here).
+        cfg = dataclasses.replace(cfg, head_recompute=True)
     init_states, initial_rows = _precomputed(app, cfg)
     big = jnp.int32(2**30)
 

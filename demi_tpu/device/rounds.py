@@ -137,7 +137,7 @@ def make_round_step_fn(app: DSLApp, cfg: DeviceConfig):
         cond_met = _segment_cond_met(state, app, dispatching)
         cand = deliverable_mask(state, cfg) & dispatching & ~cond_met
         if cfg.srcdst_fifo:
-            cand = cand & fifo_head_mask(state)
+            cand = cand & fifo_head_mask(state, cfg)
         any_deliverable = jnp.any(cand)
 
         # Per-receiver uniform choice: argmax of iid priorities over each

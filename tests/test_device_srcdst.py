@@ -80,3 +80,64 @@ def test_fully_random_reorders_some_lane():
     assert not all(orders), (
         "FullyRandom never reordered the channel — witness is vacuous"
     )
+
+
+def test_incremental_head_bits_match_recompute():
+    """Round 5: srcdst_fifo's head test is maintained incrementally
+    (O(K*P) at insert + O(P) at consume) instead of the O(P^2)
+    same-channel compare per step. Pin: whole lanes run bit-identical
+    under both (cfg.head_recompute forces the old path), across a
+    workload with kills/hardkills (purge paths), timers (raft), and
+    relay floods (multi-row inserts)."""
+    import dataclasses
+
+    from demi_tpu.apps.raft import T_CLIENT, make_raft_app
+    from demi_tpu.device.explore import make_explore_kernel
+    from demi_tpu.external_events import HardKill, Kill
+
+    cases = []
+    app, cfg, program = _setup(srcdst_fifo=True)
+    cases.append((app, cfg, program + []))
+    bapp = make_broadcast_app(4, reliable=True)
+    bcfg = DeviceConfig.for_app(
+        bapp, pool_capacity=96, max_steps=128, max_external_ops=24,
+        srcdst_fifo=True,
+    )
+    bprog = dsl_start_events(bapp) + [
+        Send(bapp.actor_name(0), MessageConstructor(lambda: (1, 0))),
+        Kill(bapp.actor_name(1)),
+        Send(bapp.actor_name(2), MessageConstructor(lambda: (1, 1))),
+        HardKill(bapp.actor_name(3)),
+        WaitQuiescence(),
+    ]
+    cases.append((bapp, bcfg, bprog))
+    rapp = make_raft_app(3)
+    rcfg = DeviceConfig.for_app(
+        rapp, pool_capacity=96, max_steps=128, max_external_ops=24,
+        srcdst_fifo=True, timer_weight=0.3,
+    )
+    rprog = dsl_start_events(rapp) + [
+        Send(rapp.actor_name(0),
+             MessageConstructor(lambda: (T_CLIENT, 0, 7, 0, 0, 0, 0))),
+        WaitQuiescence(60),
+    ]
+    cases.append((rapp, rcfg, rprog))
+
+    for app_i, cfg_i, prog_i in cases:
+        batch = 24
+        progs = stack_programs(
+            [lower_program(app_i, cfg_i, prog_i)] * batch
+        )
+        keys = jax.random.split(jax.random.PRNGKey(5), batch)
+        fast = make_explore_kernel(app_i, cfg_i)(progs, keys)
+        slow_cfg = dataclasses.replace(cfg_i, head_recompute=True)
+        slow = make_explore_kernel(app_i, slow_cfg)(progs, keys)
+        np.testing.assert_array_equal(
+            np.asarray(fast.sched_hash), np.asarray(slow.sched_hash)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(fast.status), np.asarray(slow.status)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(fast.deliveries), np.asarray(slow.deliveries)
+        )
