@@ -30,6 +30,14 @@ def main(argv=None) -> int:
                    help="comma list: xla, pallas, mesh, mesh-pallas")
     p.add_argument("--lanes", type=int, default=24)
     p.add_argument("--seed", type=int, default=20260730)
+    p.add_argument(
+        "--mode", default="continuous", choices=("continuous", "round-pin"),
+        help="continuous: per-seed verdict parity across continuous-driver "
+             "variants; round-pin: fuzzed round-delivery lanes recorded and "
+             "replayed through the sequential replay kernel "
+             "(ignored_absent must be 0 — every round execution is a legal "
+             "sequential schedule)",
+    )
     args = p.parse_args(argv)
 
     import numpy as np
@@ -65,6 +73,9 @@ def main(argv=None) -> int:
         for v in names:
             if v.startswith("mesh"):
                 variant_kw[v]["mesh"] = mesh
+
+    if args.mode == "round-pin":
+        return _round_pin_soak(args)
 
     rng = np.random.RandomState(args.seed)
     rounds = 0
@@ -145,6 +156,122 @@ def main(argv=None) -> int:
         f"{len(names) * n * rounds} lane-verdicts compared",
         flush=True,
     )
+    return 0
+
+
+def _round_pin_soak(args) -> int:
+    """Round-delivery robustness: fuzzed programs over the three app
+    families run as single round-mode lanes with record_trace; each
+    recorded linearization replays through the SEQUENTIAL replay kernel
+    and must match exactly (ignored_absent == 0, same deliveries/
+    status/violation) — tests/test_rounds.py's pin, at soak scale."""
+    import dataclasses as _dc
+
+    import numpy as np
+
+    import jax
+
+    from ..apps.broadcast import broadcast_send_generator, make_broadcast_app
+    from ..apps.common import dsl_start_events
+    from ..apps.raft import make_raft_app, raft_send_generator
+    from ..apps.spark_dag import make_spark_app, spark_send_generator
+    from ..device import DeviceConfig
+    from ..device.encoding import lower_program
+    from ..device.explore import make_run_lane
+    from ..device.replay import make_replay_run_lane
+    from ..fuzzing import Fuzzer, FuzzerWeights
+
+    rng = np.random.RandomState(args.seed)
+    rounds = 0
+    checked = 0
+    t0 = time.time()
+    kernels = {}
+    while True:
+        if args.rounds is not None:
+            if rounds >= args.rounds:
+                break
+        elif time.time() - t0 >= args.seconds:
+            break
+        rounds += 1
+        pick = rounds % 3
+        if pick == 0:
+            app = make_raft_app(3, bug="multivote")
+            gen_msgs = raft_send_generator(app)
+            weights = FuzzerWeights(send=0.3, kill=0.1, wait_quiescence=0.3,
+                                    hard_kill=0.15, restart=0.15)
+            cfg_kw = dict(pool_capacity=96, max_steps=160,
+                          max_external_ops=24, invariant_interval=1,
+                          timer_weight=0.1)
+        elif pick == 1:
+            app = make_broadcast_app(4, reliable=False)
+            gen_msgs = broadcast_send_generator(app)
+            weights = FuzzerWeights(send=0.5, wait_quiescence=0.25, kill=0.1)
+            cfg_kw = dict(pool_capacity=64, max_steps=96, max_external_ops=24)
+        else:
+            app = make_spark_app(num_workers=3, num_stages=2,
+                                 tasks_per_stage=3, bug="stale_task")
+            gen_msgs = spark_send_generator(app)
+            weights = FuzzerWeights(send=0.4, kill=0.1, wait_quiescence=0.3,
+                                    hard_kill=0.1, restart=0.1)
+            cfg_kw = dict(pool_capacity=128, max_steps=160,
+                          max_external_ops=24, invariant_interval=1)
+        # One compiled kernel pair per app family (shapes are constant).
+        if app.name not in kernels:
+            rcfg = DeviceConfig.for_app(
+                app, **{**cfg_kw, "invariant_interval": 0},
+                round_delivery=True, record_trace=True,
+                trace_capacity=cfg_kw["max_steps"] * 2,
+            )
+            pcfg = DeviceConfig.for_app(
+                app,
+                **{
+                    **cfg_kw,
+                    "invariant_interval": 0,
+                    "max_steps": rcfg.trace_rows,
+                },
+            )
+            kernels[app.name] = (
+                rcfg,
+                jax.jit(make_run_lane(app, rcfg)),
+                jax.jit(make_replay_run_lane(app, pcfg)),
+            )
+        rcfg, run, replay = kernels[app.name]
+        fz = Fuzzer(num_events=int(rng.randint(6, 12)), weights=weights,
+                    message_gen=gen_msgs, prefix=dsl_start_events(app),
+                    max_kills=2, wait_budget=(5, 30))
+        for s in range(args.lanes):
+            base = int(rng.randint(0, 1 << 30))
+            prog = lower_program(app, rcfg, fz.generate_fuzz_test(seed=base))
+            key = jax.random.PRNGKey(base)
+            res = run(prog, key)
+            tl = int(res.trace_len)
+            if int(res.status) == 4 or tl > rcfg.trace_rows:  # overflow
+                continue
+            trace = np.asarray(res.trace)[:tl]
+            rep = replay(trace, key)
+            ok = (
+                int(rep.ignored_absent) == 0
+                and int(rep.deliveries) == int(res.deliveries)
+                and int(rep.status) == int(res.status)
+                and int(rep.violation) == int(res.violation)
+            )
+            checked += 1
+            if not ok:
+                print(
+                    f"ROUND-PIN DIVERGENCE round={rounds} app={app.name} "
+                    f"base={base}: round=({int(res.status)},"
+                    f"{int(res.violation)},{int(res.deliveries)}) "
+                    f"replay=({int(rep.status)},{int(rep.violation)},"
+                    f"{int(rep.deliveries)},ign={int(rep.ignored_absent)})",
+                    flush=True,
+                )
+                return 2
+        if rounds % 5 == 0:
+            print(
+                f"round-pin {rounds} ok, {checked} lanes "
+                f"({time.time() - t0:.0f}s)", flush=True,
+            )
+    print(f"ROUND-PIN SOAK OK: {rounds} rounds, {checked} lanes", flush=True)
     return 0
 
 
