@@ -16,9 +16,60 @@ code) with zero divergences.
 from __future__ import annotations
 
 import argparse
-import dataclasses
 import sys
 import time
+
+
+def _family(pick: int, with_conditions: bool):
+    """Shared app-family rotation for both soak modes: (app, gen_msgs,
+    weights, cfg_kw, ncond). One definition so the modes cannot drift
+    onto different configurations."""
+    import dataclasses
+
+    import jax.numpy as jnp
+
+    from ..apps.broadcast import broadcast_send_generator, make_broadcast_app
+    from ..apps.raft import make_raft_app, raft_send_generator
+    from ..apps.spark_dag import make_spark_app, spark_send_generator
+    from ..fuzzing import FuzzerWeights
+
+    if pick == 0:
+        app = make_raft_app(3, bug="multivote")
+        return (
+            app, raft_send_generator(app),
+            FuzzerWeights(send=0.3, kill=0.1, wait_quiescence=0.3,
+                          hard_kill=0.15, restart=0.15),
+            dict(pool_capacity=96, max_steps=160, max_external_ops=24,
+                 invariant_interval=1, timer_weight=0.1),
+            0,
+        )
+    if pick == 1:
+        app = make_broadcast_app(4, reliable=False)
+        weights = FuzzerWeights(send=0.5, wait_quiescence=0.25, kill=0.1)
+        ncond = 0
+        if with_conditions:
+            def _all0(states, alive):
+                return jnp.all(~alive | ((states[:, 0] & 1) != 0))
+
+            app = dataclasses.replace(app, conditions=(_all0,))
+            weights = FuzzerWeights(send=0.5, wait_quiescence=0.15,
+                                    kill=0.1, wait_condition=0.25)
+            ncond = 1
+        return (
+            app, broadcast_send_generator(app), weights,
+            dict(pool_capacity=64, max_steps=96, max_external_ops=24),
+            ncond,
+        )
+    app = make_spark_app(num_workers=3, num_stages=2, tasks_per_stage=3,
+                         bug="stale_task")
+    return (
+        app, spark_send_generator(app),
+        FuzzerWeights(send=0.4, kill=0.1, wait_quiescence=0.3,
+                      hard_kill=0.1, restart=0.1),
+        dict(pool_capacity=128, max_steps=160, max_external_ops=24,
+             invariant_interval=1),
+        0,
+    )
 
 
 def main(argv=None) -> int:
@@ -40,23 +91,20 @@ def main(argv=None) -> int:
     )
     args = p.parse_args(argv)
 
+    if args.mode == "round-pin":
+        return _round_pin_soak(args)
+
     import numpy as np
 
     import jax
     import jax.numpy as jnp
 
-    from ..apps.broadcast import broadcast_send_generator, make_broadcast_app
     from ..apps.common import dsl_start_events
-    from ..apps.raft import make_raft_app, raft_send_generator
-    from ..apps.spark_dag import make_spark_app, spark_send_generator
     from ..device import DeviceConfig, make_explore_kernel
     from ..device.continuous import ContinuousSweepDriver
     from ..device.encoding import lower_program, stack_programs
-    from ..fuzzing import Fuzzer, FuzzerWeights
+    from ..fuzzing import Fuzzer
     from ..parallel.mesh import make_mesh
-
-    def _all0(states, alive):
-        return jnp.all(~alive | ((states[:, 0] & 1) != 0))
 
     variant_kw = {
         "xla": dict(),
@@ -74,9 +122,6 @@ def main(argv=None) -> int:
             if v.startswith("mesh"):
                 variant_kw[v]["mesh"] = mesh
 
-    if args.mode == "round-pin":
-        return _round_pin_soak(args)
-
     rng = np.random.RandomState(args.seed)
     rounds = 0
     t0 = time.time()
@@ -88,34 +133,9 @@ def main(argv=None) -> int:
         elif time.time() - t0 >= args.seconds:
             break
         rounds += 1
-        pick = rounds % 3
-        if pick == 0:
-            app = make_raft_app(3, bug="multivote")
-            gen_msgs = raft_send_generator(app)
-            weights = FuzzerWeights(send=0.3, kill=0.1, wait_quiescence=0.3,
-                                    hard_kill=0.15, restart=0.15)
-            cfg_kw = dict(pool_capacity=96, max_steps=160,
-                          max_external_ops=24, invariant_interval=1,
-                          timer_weight=0.1)
-            ncond = 0
-        elif pick == 1:
-            app = dataclasses.replace(
-                make_broadcast_app(4, reliable=False), conditions=(_all0,)
-            )
-            gen_msgs = broadcast_send_generator(app)
-            weights = FuzzerWeights(send=0.5, wait_quiescence=0.15, kill=0.1,
-                                    wait_condition=0.25)
-            cfg_kw = dict(pool_capacity=64, max_steps=96, max_external_ops=24)
-            ncond = 1
-        else:
-            app = make_spark_app(num_workers=3, num_stages=2,
-                                 tasks_per_stage=3, bug="stale_task")
-            gen_msgs = spark_send_generator(app)
-            weights = FuzzerWeights(send=0.4, kill=0.1, wait_quiescence=0.3,
-                                    hard_kill=0.1, restart=0.1)
-            cfg_kw = dict(pool_capacity=128, max_steps=160,
-                          max_external_ops=24, invariant_interval=1)
-            ncond = 0
+        app, gen_msgs, weights, cfg_kw, ncond = _family(
+            rounds % 3, with_conditions=True
+        )
         cfg = DeviceConfig.for_app(app, **cfg_kw)
         fz = Fuzzer(num_events=int(rng.randint(6, 12)), weights=weights,
                     message_gen=gen_msgs, prefix=dsl_start_events(app),
@@ -165,25 +185,21 @@ def _round_pin_soak(args) -> int:
     recorded linearization replays through the SEQUENTIAL replay kernel
     and must match exactly (ignored_absent == 0, same deliveries/
     status/violation) — tests/test_rounds.py's pin, at soak scale."""
-    import dataclasses as _dc
-
     import numpy as np
 
     import jax
 
-    from ..apps.broadcast import broadcast_send_generator, make_broadcast_app
     from ..apps.common import dsl_start_events
-    from ..apps.raft import make_raft_app, raft_send_generator
-    from ..apps.spark_dag import make_spark_app, spark_send_generator
     from ..device import DeviceConfig
     from ..device.encoding import lower_program
     from ..device.explore import make_run_lane
     from ..device.replay import make_replay_run_lane
-    from ..fuzzing import Fuzzer, FuzzerWeights
+    from ..fuzzing import Fuzzer
 
     rng = np.random.RandomState(args.seed)
     rounds = 0
     checked = 0
+    skipped = 0
     t0 = time.time()
     kernels = {}
     while True:
@@ -193,28 +209,12 @@ def _round_pin_soak(args) -> int:
         elif time.time() - t0 >= args.seconds:
             break
         rounds += 1
-        pick = rounds % 3
-        if pick == 0:
-            app = make_raft_app(3, bug="multivote")
-            gen_msgs = raft_send_generator(app)
-            weights = FuzzerWeights(send=0.3, kill=0.1, wait_quiescence=0.3,
-                                    hard_kill=0.15, restart=0.15)
-            cfg_kw = dict(pool_capacity=96, max_steps=160,
-                          max_external_ops=24, invariant_interval=1,
-                          timer_weight=0.1)
-        elif pick == 1:
-            app = make_broadcast_app(4, reliable=False)
-            gen_msgs = broadcast_send_generator(app)
-            weights = FuzzerWeights(send=0.5, wait_quiescence=0.25, kill=0.1)
-            cfg_kw = dict(pool_capacity=64, max_steps=96, max_external_ops=24)
-        else:
-            app = make_spark_app(num_workers=3, num_stages=2,
-                                 tasks_per_stage=3, bug="stale_task")
-            gen_msgs = spark_send_generator(app)
-            weights = FuzzerWeights(send=0.4, kill=0.1, wait_quiescence=0.3,
-                                    hard_kill=0.1, restart=0.1)
-            cfg_kw = dict(pool_capacity=128, max_steps=160,
-                          max_external_ops=24, invariant_interval=1)
+        # Conditions stay off here: the sequential replay kernel applies
+        # records without consulting segment conditions, so a
+        # cond-gated round lane would not be a like-for-like pin.
+        app, gen_msgs, weights, cfg_kw, _nc = _family(
+            rounds % 3, with_conditions=False
+        )
         # One compiled kernel pair per app family (shapes are constant).
         if app.name not in kernels:
             rcfg = DeviceConfig.for_app(
@@ -245,7 +245,8 @@ def _round_pin_soak(args) -> int:
             key = jax.random.PRNGKey(base)
             res = run(prog, key)
             tl = int(res.trace_len)
-            if int(res.status) == 4 or tl > rcfg.trace_rows:  # overflow
+            if int(res.status) == 4 or tl > rcfg.trace_rows:
+                skipped += 1  # pool/trace overflow: config, not semantics
                 continue
             trace = np.asarray(res.trace)[:tl]
             rep = replay(trace, key)
@@ -268,10 +269,20 @@ def _round_pin_soak(args) -> int:
                 return 2
         if rounds % 5 == 0:
             print(
-                f"round-pin {rounds} ok, {checked} lanes "
-                f"({time.time() - t0:.0f}s)", flush=True,
+                f"round-pin {rounds} ok, {checked} lanes, "
+                f"{skipped} overflow-skipped ({time.time() - t0:.0f}s)",
+                flush=True,
             )
-    print(f"ROUND-PIN SOAK OK: {rounds} rounds, {checked} lanes", flush=True)
+    print(
+        f"ROUND-PIN SOAK OK: {rounds} rounds, {checked} lanes "
+        f"({skipped} overflow-skipped)",
+        flush=True,
+    )
+    if checked < max(1, (checked + skipped) // 2):
+        # Silent coverage collapse (a family overflowing on most seeds)
+        # must fail the soak, not pass vacuously.
+        print("ROUND-PIN SOAK: >50% of lanes overflow-skipped", flush=True)
+        return 3
     return 0
 
 
