@@ -141,6 +141,15 @@ class DeviceConfig:
     # sequential srcdst_fifo kernels (parity pin for the incremental
     # maintenance; tests/test_device_srcdst.py).
     head_recompute: bool = False
+    # Bit-packed boolean gathers on the one-hot path: the network/
+    # liveness tests in deliverable_mask pack their bool tables into
+    # uint32 words, cutting the one-hot compare cost by ~32x (the cut-
+    # matrix gather is O(P*N^2) unpacked — 18.9M ops/step at the
+    # config-5 shape). Opt-in TPU lever (bit-identical; parity-pinned in
+    # tests/test_device.py; ranked by bench_matrix): the shift/mask ops
+    # are XLA-validated but their Mosaic lowering is not, so the pallas
+    # backends reject it.
+    packed_gathers: bool = False
 
     def __post_init__(self):
         if self.index_mode not in ("auto", "onehot", "scatter"):
@@ -151,6 +160,11 @@ class DeviceConfig:
         if self.msg_dtype not in ("int32", "int16"):
             raise ValueError(
                 f"msg_dtype must be 'int32' or 'int16', got {self.msg_dtype!r}"
+            )
+        if self.packed_gathers and self.index_mode == "scatter":
+            raise ValueError(
+                "packed_gathers applies to the one-hot path; "
+                "index_mode='scatter' would silently ignore it"
             )
         if self.round_delivery and self.record_trace and not self.trace_capacity:
             # Round mode appends up to num_actors records per step; the
@@ -313,15 +327,32 @@ def deliverable_mask(state: ScheduleState, cfg: DeviceConfig) -> jnp.ndarray:
     oh = cfg.use_onehot
     dst = state.pool_dst
     src = state.pool_src
-    dst_ok = ops.gather_vec(state.started, dst, oh) & ~ops.gather_vec(
-        state.stopped, dst, oh
-    )
-    dst_reachable = ~ops.gather_vec(state.isolated, dst, oh)
     src_is_external = src >= n
     src_clamped = jnp.minimum(src, n - 1)
-    link_cut = ops.gather_mat(state.cut, src_clamped, dst, oh) | ops.gather_vec(
-        state.isolated, src_clamped, oh
-    )
+    if cfg.packed_gathers and not oh:
+        # Loud at trace time: 'auto' resolved to the scatter path, so
+        # the flag would silently measure nothing.
+        raise ValueError(
+            "packed_gathers requires one-hot mode; on this backend "
+            "index_mode='auto' resolves to scatter — set "
+            "index_mode='onehot' explicitly"
+        )
+    if oh and cfg.packed_gathers:
+        dst_ok = ops.packed_gather_bool(state.started, dst) & ~(
+            ops.packed_gather_bool(state.stopped, dst)
+        )
+        dst_reachable = ~ops.packed_gather_bool(state.isolated, dst)
+        link_cut = ops.packed_gather_mat(
+            state.cut, src_clamped, dst
+        ) | ops.packed_gather_bool(state.isolated, src_clamped)
+    else:
+        dst_ok = ops.gather_vec(state.started, dst, oh) & ~ops.gather_vec(
+            state.stopped, dst, oh
+        )
+        dst_reachable = ~ops.gather_vec(state.isolated, dst, oh)
+        link_cut = ops.gather_mat(
+            state.cut, src_clamped, dst, oh
+        ) | ops.gather_vec(state.isolated, src_clamped, oh)
     # timers/externals only need the receiver un-isolated; internal messages
     # must not cross a partition (either endpoint isolated or link cut).
     passes_network = jnp.where(

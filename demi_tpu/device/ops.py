@@ -18,6 +18,7 @@ case runs the explore kernel in both and compares all outputs).
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 # The one-hot forms are the SAME semantics handlers use via the dsl
@@ -126,6 +127,49 @@ def gather_mat(mat: jnp.ndarray, ri: jnp.ndarray, ci: jnp.ndarray, oh: bool):
             return picked.astype(bool)
         return picked.astype(mat.dtype)
     return mat[ri, ci]
+
+
+def pack_bits(vec: jnp.ndarray) -> jnp.ndarray:
+    """bool[N] -> uint32[ceil(N/32)] little-endian bit-pack."""
+    n = vec.shape[0]
+    pad = (-n) % 32
+    v = jnp.pad(vec, (0, pad)).reshape(-1, 32)
+    return jnp.sum(
+        v.astype(jnp.uint32)
+        << jnp.arange(32, dtype=jnp.uint32)[None, :],
+        axis=1,
+    )
+
+
+def _extract_bit(words: jnp.ndarray, idx: jnp.ndarray) -> jnp.ndarray:
+    """Select word idx>>5 from ``words`` ([W32] shared table or [P, W32]
+    per-entry rows) and extract bit idx&31 -> bool[P]."""
+    widx = idx >> 5
+    woh = widx[:, None] == jnp.arange(words.shape[-1])[None, :]
+    table = words[None, :] if words.ndim == 1 else words
+    w = jnp.sum(jnp.where(woh, table, jnp.uint32(0)), axis=1)
+    return ((w >> (idx & 31).astype(jnp.uint32)) & 1).astype(bool)
+
+
+def packed_gather_bool(vec: jnp.ndarray, idx: jnp.ndarray) -> jnp.ndarray:
+    """vec[idx] for bool vec[N], idx[P] — O(P*N/32) instead of the [P, N]
+    one-hot compare's O(P*N): the table packs to ceil(N/32) words, the
+    per-entry word select is a tiny one-hot, and the bit extract is
+    elementwise shift/mask (VPU-friendly; no dynamic gathers). Out-of-
+    range idx reads False, like the one-hot form."""
+    return _extract_bit(pack_bits(vec), idx)
+
+
+def packed_gather_mat(
+    mat: jnp.ndarray, ri: jnp.ndarray, ci: jnp.ndarray
+) -> jnp.ndarray:
+    """mat[ri, ci] for bool mat[N, M], paired idx vectors [P] — the
+    row-word contraction is O(P*N*M/32) vs the one-hot form's O(P*N*M)
+    (the dominant per-step cost at config-5 scale: P=4608, N=64 is 18.9M
+    ops unpacked)."""
+    packed = jax.vmap(pack_bits)(mat)  # [N, W32]
+    row_words = gather_rows(packed, ri, True)  # [P, W32] one-hot form
+    return _extract_bit(row_words, ci)
 
 
 def first_true_index(mask: jnp.ndarray, k, oh: bool):

@@ -61,6 +61,12 @@ def _check_pallas_cfg(cfg: DeviceConfig, interpret: Optional[bool]):
             "pallas kernels require the one-hot index mode on TPU "
             "(DeviceConfig(index_mode='onehot' or 'auto'))"
         )
+    if not interpret and cfg.packed_gathers:
+        # The packed shift/mask gathers are XLA-validated only; their
+        # Mosaic lowering (uint32 shifts on padded lanes) is unproven.
+        raise ValueError(
+            "packed_gathers is XLA-only; drop impl='pallas' or the flag"
+        )
     if not interpret and cfg.round_delivery:
         # The round step's Mosaic lowering is unvalidated (gumbel/uniform
         # sampling + 2-D record scatters); use the XLA backend for round

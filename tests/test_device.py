@@ -387,3 +387,52 @@ def test_int16_out_of_range_payload_rejected():
     ]
     with pytest.raises(ValueError, match="int16 range"):
         lower_program(app, cfg, program)
+
+
+def test_packed_gathers_bit_identical():
+    """DeviceConfig.packed_gathers (bit-packed network/liveness tests on
+    the one-hot path, round 5): whole lanes must run bit-identical with
+    and without it, across partitions/kills/timers (the packed path
+    covers started/stopped/isolated AND the cut matrix)."""
+    import dataclasses
+
+    import jax
+
+    from demi_tpu.apps.raft import T_CLIENT, make_raft_app
+    from demi_tpu.device.encoding import lower_program, stack_programs
+    from demi_tpu.device.explore import make_explore_kernel
+    from demi_tpu.external_events import (
+        Kill,
+        MessageConstructor,
+        Partition,
+        Send,
+        UnPartition,
+        WaitQuiescence,
+    )
+
+    app = make_raft_app(3)
+    cfg = DeviceConfig.for_app(
+        app, pool_capacity=96, max_steps=128, max_external_ops=24,
+        index_mode="onehot", timer_weight=0.3,
+    )
+    program = dsl_start_events(app) + [
+        Send(app.actor_name(0),
+             MessageConstructor(lambda: (T_CLIENT, 0, 7, 0, 0, 0, 0))),
+        Partition(app.actor_name(0), app.actor_name(1)),
+        WaitQuiescence(30),
+        UnPartition(app.actor_name(0), app.actor_name(1)),
+        Kill(app.actor_name(2)),
+        WaitQuiescence(30),
+    ]
+    batch = 16
+    progs = stack_programs([lower_program(app, cfg, program)] * batch)
+    keys = jax.random.split(jax.random.PRNGKey(11), batch)
+    plain = make_explore_kernel(app, cfg)(progs, keys)
+    packed = make_explore_kernel(
+        app, dataclasses.replace(cfg, packed_gathers=True)
+    )(progs, keys)
+    for field in ("status", "violation", "deliveries", "sched_hash"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(plain, field)),
+            np.asarray(getattr(packed, field)),
+        )
