@@ -291,3 +291,27 @@ def test_round_lane_lifts_to_host():
     assert lanes.size > 0
     single, host = lift_lane_to_host(app, cfg, progs, keys, int(lanes[0]))
     assert host.violation is not None
+
+
+def test_round_sweep_lane_lifts_without_explicit_trace_capacity():
+    """A round-mode SWEEP cfg (no record_trace/trace_capacity) must lift
+    violating lanes: the single-lane trace kernel defaults the capacity
+    to the max_steps*num_actors upper bound."""
+    from demi_tpu.runner import lift_lane_to_host
+
+    app = make_broadcast_app(8, reliable=False)
+    cfg = DeviceConfig.for_app(
+        app, pool_capacity=64, max_steps=96, max_external_ops=40,
+        early_exit=True, round_delivery=True,
+    )
+    program = list(dsl_start_events(app)) + [
+        Send(app.actor_name(0), MessageConstructor(lambda: (1, 0))),
+        WaitQuiescence(),
+    ]
+    progs = stack_programs([lower_program(app, cfg, program)] * 8)
+    keys = jax.random.split(jax.random.PRNGKey(9), 8)
+    res = make_explore_kernel(app, cfg)(progs, keys)
+    lanes = np.nonzero(np.asarray(res.status) == ST_VIOLATION)[0]
+    assert lanes.size
+    single, host = lift_lane_to_host(app, cfg, progs, keys, int(lanes[0]))
+    assert host.violation is not None
