@@ -439,11 +439,13 @@ def make_explore_kernel(app: DSLApp, cfg: DeviceConfig, lane_axis: str = "leadin
 def make_single_lane_trace_kernel(app: DSLApp, cfg: DeviceConfig):
     """Single-lane explore with trace recording on: re-runs a violating
     lane's seed to extract its full delivery record for host reconstruction."""
+    import dataclasses
+
     overrides = {"record_trace": True}
     if cfg.round_delivery and not cfg.trace_capacity:
         # Round steps append up to num_actors records each; a sweep cfg
         # without an explicit capacity gets the safe upper bound here —
         # it's ONE lane, so the [steps*N, rec_width] trace is small.
         overrides["trace_capacity"] = cfg.max_steps * cfg.num_actors
-    traced_cfg = DeviceConfig(**{**cfg.__dict__, **overrides})
+    traced_cfg = dataclasses.replace(cfg, **overrides)
     return jax.jit(make_run_lane(app, traced_cfg))

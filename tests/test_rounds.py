@@ -262,56 +262,39 @@ def test_round_trace_capacity_required():
         _round_cfg(app, record_trace=True)
 
 
-def test_round_lane_lifts_to_host():
-    """Full device→host lift of a round-mode violating lane: the recorded
-    linearization drives the host oracle (GuidedScheduler) to the same
-    violation — round traces are first-class citizens of the existing
-    minimization pipeline."""
+def _lift_round_violation(cfg_kw, lanes, key_seed):
+    """Shared lift ritual: round-mode sweep over the unreliable
+    broadcast, lift the first violating lane to the host oracle."""
     from demi_tpu.runner import lift_lane_to_host
 
     app = make_broadcast_app(8, reliable=False)
     cfg = DeviceConfig.for_app(
-        app,
-        pool_capacity=64,
-        max_steps=96,
-        max_external_ops=40,
-        early_exit=True,
-        round_delivery=True,
-        trace_capacity=256,
+        app, pool_capacity=64, max_steps=96, max_external_ops=40,
+        early_exit=True, round_delivery=True, **cfg_kw,
     )
     program = list(dsl_start_events(app)) + [
         Send(app.actor_name(0), MessageConstructor(lambda: (1, 0))),
         WaitQuiescence(),
     ]
-    progs = stack_programs([lower_program(app, cfg, program)] * 16)
-    keys = jax.random.split(jax.random.PRNGKey(4), 16)
+    progs = stack_programs([lower_program(app, cfg, program)] * lanes)
+    keys = jax.random.split(jax.random.PRNGKey(key_seed), lanes)
     res = make_explore_kernel(app, cfg)(progs, keys)
-    st = np.asarray(res.status)
-    lanes = np.nonzero(st == ST_VIOLATION)[0]
-    assert lanes.size > 0
-    single, host = lift_lane_to_host(app, cfg, progs, keys, int(lanes[0]))
+    hits = np.nonzero(np.asarray(res.status) == ST_VIOLATION)[0]
+    assert hits.size > 0
+    single, host = lift_lane_to_host(app, cfg, progs, keys, int(hits[0]))
     assert host.violation is not None
+
+
+def test_round_lane_lifts_to_host():
+    """Full device→host lift of a round-mode violating lane: the recorded
+    linearization drives the host oracle (GuidedScheduler) to the same
+    violation — round traces are first-class citizens of the existing
+    minimization pipeline."""
+    _lift_round_violation({"trace_capacity": 256}, lanes=16, key_seed=4)
 
 
 def test_round_sweep_lane_lifts_without_explicit_trace_capacity():
     """A round-mode SWEEP cfg (no record_trace/trace_capacity) must lift
     violating lanes: the single-lane trace kernel defaults the capacity
     to the max_steps*num_actors upper bound."""
-    from demi_tpu.runner import lift_lane_to_host
-
-    app = make_broadcast_app(8, reliable=False)
-    cfg = DeviceConfig.for_app(
-        app, pool_capacity=64, max_steps=96, max_external_ops=40,
-        early_exit=True, round_delivery=True,
-    )
-    program = list(dsl_start_events(app)) + [
-        Send(app.actor_name(0), MessageConstructor(lambda: (1, 0))),
-        WaitQuiescence(),
-    ]
-    progs = stack_programs([lower_program(app, cfg, program)] * 8)
-    keys = jax.random.split(jax.random.PRNGKey(9), 8)
-    res = make_explore_kernel(app, cfg)(progs, keys)
-    lanes = np.nonzero(np.asarray(res.status) == ST_VIOLATION)[0]
-    assert lanes.size
-    single, host = lift_lane_to_host(app, cfg, progs, keys, int(lanes[0]))
-    assert host.violation is not None
+    _lift_round_violation({}, lanes=8, key_seed=9)
