@@ -30,6 +30,14 @@ sequential kernel, NOT from legal system behavior): segment WaitCondition
 checks and interval invariant checks run once per round, and quiescence
 budgets cap the round's delivery count rather than interleaving.
 
+Pool-capacity note: a round frees all R consumed entries BEFORE
+inserting their outboxes, so the strict linearization's transient pool
+peak can exceed the round lane's by up to R <= num_actors slots — a
+sequential replay of a recorded round trace needs pool_capacity +
+num_actors headroom to be overflow-equivalent (the round-pin soak
+caught exactly this on a raft corpus: round lane DONE at 304
+deliveries, same-capacity replay ST_OVERFLOW at 293).
+
 This mode is a device-only exploration strategy with no reference
 counterpart (the reference's JVM scheduler is inherently one-message-at-
 a-time, Instrumenter.scala:913-1109); it widens the per-step parallelism

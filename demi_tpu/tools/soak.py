@@ -228,6 +228,12 @@ def _round_pin_soak(args) -> int:
                     **cfg_kw,
                     "invariant_interval": 0,
                     "max_steps": rcfg.trace_rows,
+                    # Rounds free consumed entries before inserting, so
+                    # the linearization's transient peak can exceed the
+                    # round lane's by up to num_actors slots.
+                    "pool_capacity": (
+                        cfg_kw["pool_capacity"] + app.num_actors
+                    ),
                 },
             )
             kernels[app.name] = (

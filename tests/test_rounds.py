@@ -59,7 +59,10 @@ def _pin_one(app, cfg_rnd, program, seed):
     """Record one round lane, replay sequentially, compare verdicts."""
     cfg_rep = DeviceConfig.for_app(
         app,
-        pool_capacity=cfg_rnd.pool_capacity,
+        # +N headroom: rounds free consumed entries before inserting, so
+        # the strict linearization's transient pool peak can exceed the
+        # round lane's by up to num_actors slots (see rounds.py).
+        pool_capacity=cfg_rnd.pool_capacity + app.num_actors,
         max_steps=cfg_rnd.trace_rows,
         max_external_ops=cfg_rnd.max_external_ops,
         early_exit=True,
