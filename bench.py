@@ -551,6 +551,16 @@ def main():
     )
     import jax
 
+    from demi_tpu import obs
+
+    def emit(out):
+        # Telemetry is OFF by default (the headline must measure the
+        # kernels, not the bookkeeping); DEMI_OBS=1 folds the registry
+        # snapshot into the record for instrumented bench runs.
+        if obs.enabled():
+            out["obs"] = obs.REGISTRY.snapshot()
+        print(json.dumps(out))
+
     platform = jax.devices()[0].platform
 
     out = {
@@ -565,7 +575,7 @@ def main():
         out["config4"] = bench_config4(jax)
         out["value"] = out["config4"]["schedules_per_sec"]
         out["vs_baseline"] = round(out["value"] / 10_000.0, 3)
-        print(json.dumps(out))
+        emit(out)
         return
     if args.config == 5:
         out["metric"] = (
@@ -574,7 +584,7 @@ def main():
         out["config5"] = bench_config5(jax)
         out["value"] = out["config5"]["schedules_per_sec"]
         out["vs_baseline"] = round(out["value"] / 10_000.0, 3)
-        print(json.dumps(out))
+        emit(out)
         return
     if args.config == "rehearsal":
         out["metric"] = (
@@ -583,7 +593,7 @@ def main():
         out["config5_rehearsal"] = bench_config5_rehearsal(jax)
         out["value"] = out["config5_rehearsal"]["schedules_per_sec"]
         out["vs_baseline"] = round(out["value"] / 10_000.0, 3)
-        print(json.dumps(out))
+        emit(out)
         return
 
     value, impl_info = bench_device_raft(jax)
@@ -622,7 +632,7 @@ def main():
             "config5_rehearsal": rehearsal,
         }
     )
-    print(json.dumps(out))
+    emit(out)
 
 
 if __name__ == "__main__":

@@ -402,10 +402,20 @@ class AsyncioAdapter:
             elif op == "checkpoint":
                 send({"op": "state", "state": node.checkpoint()})
             elif op == "snapshot":
-                send({"op": "state", "state": node.snapshot()})
+                # An expired/unsupported token must surface as an error
+                # reply the scheduler can raise on (HarnessError) — not
+                # kill the UDP bridge process and lose the diagnostic
+                # (same contract as asyncio_stream_adapter.serve).
+                try:
+                    send({"op": "state", "state": node.snapshot()})
+                except Exception as e:
+                    send({"op": "state", "state": None, "error": repr(e)})
             elif op == "restore":
-                node.restore(cmd["state"])
-                send({"op": "effects"})
+                try:
+                    node.restore(cmd["state"])
+                    send({"op": "effects"})
+                except Exception as e:
+                    send({"op": "effects", "error": repr(e)})
             elif op == "stop":
                 node.stop()  # no reply
             else:

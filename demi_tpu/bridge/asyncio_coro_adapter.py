@@ -372,6 +372,12 @@ class _CoroNode(_StreamNode):
     def api_start_server(self, client_connected_cb, host=None, port=None,
                          **kw):
         self.server_handler = client_connected_cb
+        # A SYN delivered before registration sat out the guarded _drain
+        # (its chunks are buffered on the conn); accept it now instead of
+        # stalling the connection until the peer's next chunk arrives.
+        for conn in list(self.conns.values()):
+            if conn.next_seq == 0 and conn.buffer:
+                self._drain(conn)
         return _completed(_Server())
 
     def api_open_connection(self, host=None, port=None, **kw):

@@ -19,6 +19,7 @@ import os
 import sys
 from typing import Optional
 
+from . import obs
 from .apps.broadcast import broadcast_send_generator, make_broadcast_app
 from .apps.common import dsl_start_events, make_host_invariant
 from .apps.raft import make_raft_app, raft_send_generator
@@ -75,23 +76,102 @@ def build_fuzzer(app: DSLApp, args) -> Fuzzer:
     )
 
 
+def _obs_begin(args) -> bool:
+    """Turn telemetry on when the run asked for an observability artifact
+    (--trace-out / --stats-out; DEMI_OBS=1 enables it regardless)."""
+    if getattr(args, "trace_out", None) or getattr(args, "stats_out", None):
+        obs.enable()
+    return obs.enabled()
+
+
+def _obs_end(args, experiment_dir: Optional[str] = None) -> None:
+    """Export the run's observability artifacts: Perfetto trace and/or
+    registry snapshot, plus obs_snapshot.json into the experiment dir so
+    `demi_tpu report` / `demi_tpu stats` can pick it up later."""
+    if not obs.enabled():
+        return
+    if getattr(args, "trace_out", None):
+        obs.TRACER.export_perfetto(args.trace_out)
+        print(
+            f"trace written to {args.trace_out} "
+            "(load in ui.perfetto.dev or chrome://tracing)"
+        )
+    snap = obs.REGISTRY.snapshot()
+    if getattr(args, "stats_out", None):
+        with open(args.stats_out, "w") as f:
+            json.dump(snap, f, indent=2, sort_keys=True)
+        print(f"metrics snapshot written to {args.stats_out}")
+    if experiment_dir and os.path.isdir(experiment_dir):
+        with open(os.path.join(experiment_dir, "obs_snapshot.json"), "w") as f:
+            json.dump(snap, f, indent=2, sort_keys=True)
+
+
+def _device_confirm_sweep(app, args, program, lanes: int = 32):
+    """Telemetry-time device sweep: with a violating ``program``, re-sweep
+    it on the device explore kernel (RNG-varied lanes) as a cross-check;
+    without one, sweep the fuzzer's own seed space — either way the traced
+    run records device sweep spans + LaneStats next to the host tiers."""
+    from .device import DeviceConfig
+    from .parallel.sweep import SweepDriver
+
+    cfg = DeviceConfig.for_app(
+        app,
+        pool_capacity=getattr(args, "pool", 256),
+        max_steps=args.max_messages,
+        max_external_ops=max(
+            16,
+            (len(program) if program is not None else args.num_events
+             + app.num_actors) + 2,
+        ),
+        invariant_interval=1,
+        timer_weight=args.timer_weight,
+    )
+    if program is not None:
+        gen = lambda s: program  # noqa: E731
+    else:
+        fuzzer = build_fuzzer(app, args)
+        gen = lambda s: fuzzer.generate_fuzz_test(seed=args.seed + s)  # noqa: E731
+    driver = SweepDriver(app, cfg, gen)
+    with obs.span(
+        "fuzz.device_confirm", lanes=lanes, confirm=program is not None
+    ):
+        result = driver.sweep(lanes, lanes, mode="chunked")
+    obs.counter("fuzz.device_confirm_violations").inc(result.violations)
+    return result
+
+
 def cmd_fuzz(args) -> int:
     from .runner import fuzz
     from .serialization import ExperimentSerializer
 
+    _obs_begin(args)
+    # The device sweep is extra WORK, not just bookkeeping: run it only
+    # when this invocation explicitly asked for observability artifacts
+    # (a global DEMI_OBS=1 must observe the run, not change it).
+    confirm_sweep = bool(args.trace_out or args.stats_out)
     app = build_app(args)
     config = SchedulerConfig(invariant_check=make_host_invariant(app))
-    result = fuzz(
-        config,
-        build_fuzzer(app, args),
-        max_executions=args.max_executions,
-        seed=args.seed,
-        max_messages=args.max_messages,
-        invariant_check_interval=1,
-        timer_weight=args.timer_weight,
-        validate_replay=True,
-    )
+    with obs.span("cli.fuzz", app=args.app, seed=args.seed):
+        result = fuzz(
+            config,
+            build_fuzzer(app, args),
+            max_executions=args.max_executions,
+            seed=args.seed,
+            max_messages=args.max_messages,
+            invariant_check_interval=1,
+            timer_weight=args.timer_weight,
+            validate_replay=True,
+        )
+        if confirm_sweep:
+            confirm = _device_confirm_sweep(
+                app, args, None if result is None else result.program
+            )
+            print(
+                f"device {'confirm ' if result is not None else ''}sweep: "
+                f"{confirm.violations}/{confirm.lanes} lanes violate"
+            )
     if result is None:
+        _obs_end(args)
         print("no violation found")
         return 1
     print(
@@ -104,6 +184,7 @@ def cmd_fuzz(args) -> int:
             app_name=args.app,
         )
         print(f"experiment saved to {args.output}")
+    _obs_end(args, args.output)
     return 0
 
 
@@ -128,6 +209,7 @@ def cmd_minimize(args) -> int:
     from .runner import FuzzResult, print_minimization_stats, run_the_gamut
     from .serialization import ExperimentDeserializer, ExperimentSerializer
 
+    _obs_begin(args)
     app = build_app(args)
     config = SchedulerConfig(invariant_check=make_host_invariant(app))
     de = ExperimentDeserializer(args.experiment, app)
@@ -163,6 +245,7 @@ def cmd_minimize(args) -> int:
             args.experiment, externals, trace, violation, app_name=args.app,
             mcs=kept,
         )
+        _obs_end(args, args.experiment)
         return 0
     # Device-batched trials are the default for DSL apps (the BASELINE
     # north-star pipeline); --host falls back to the sequential STS oracle.
@@ -173,13 +256,14 @@ def cmd_minimize(args) -> int:
         device_cfg = default_device_config(
             app, trace, externals, replay_peek=args.peek
         )
-    result = run_the_gamut(
-        config, fr, wildcards=not args.no_wildcards,
-        app=None if args.host else app,
-        device_cfg=device_cfg,
-        checkpoint_dir=args.experiment, resume=args.resume,
-        stage_budget_seconds=args.stage_budget,
-    )
+    with obs.span("cli.minimize", app=args.app):
+        result = run_the_gamut(
+            config, fr, wildcards=not args.no_wildcards,
+            app=None if args.host else app,
+            device_cfg=device_cfg,
+            checkpoint_dir=args.experiment, resume=args.resume,
+            stage_budget_seconds=args.stage_budget,
+        )
     print_minimization_stats(result)
     ExperimentSerializer.save(
         args.experiment, externals, trace, violation, app_name=args.app,
@@ -187,6 +271,7 @@ def cmd_minimize(args) -> int:
         stats=result.stats,
     )
     print(f"MCS + minimized trace saved to {args.experiment}")
+    _obs_end(args, args.experiment)
     return 0
 
 
@@ -207,6 +292,7 @@ def cmd_replay(args) -> int:
 
 
 def cmd_sweep(args) -> int:
+    _obs_begin(args)
     if args.processes > 1:
         from .parallel.distributed import launch_distributed_sweep
 
@@ -228,6 +314,7 @@ def cmd_sweep(args) -> int:
             },
         )
         print(json.dumps(summary))
+        _obs_end(args)
         return 0
 
     os.environ["DEMI_DEVICE_IMPL"] = getattr(args, "impl", "xla")
@@ -263,11 +350,13 @@ def cmd_sweep(args) -> int:
     if result.occupancy is not None:
         summary["occupancy"] = round(result.occupancy, 3)
     print(json.dumps(summary))
+    _obs_end(args)
     return 0
 
 
 def cmd_dpor(args) -> int:
     """Systematic batched DPOR search (BASELINE config 2 shape)."""
+    _obs_begin(args)
     os.environ["DEMI_DEVICE_IMPL"] = getattr(args, "impl", "xla")
     from .device import DeviceConfig
     from .device.dpor_sweep import DeviceDPOROracle
@@ -288,7 +377,8 @@ def cmd_dpor(args) -> int:
         app, cfg, config, batch_size=args.batch, max_rounds=args.rounds
     )
     program = dsl_start_events(app) + [WaitQuiescence()]
-    trace = oracle.test(program, None)
+    with obs.span("cli.dpor", app=args.app):
+        trace = oracle.test(program, None)
     print(
         json.dumps(
             {
@@ -298,6 +388,7 @@ def cmd_dpor(args) -> int:
             }
         )
     )
+    _obs_end(args)
     return 0 if trace is not None else 1
 
 
@@ -448,6 +539,66 @@ def cmd_bridge_fuzz(args) -> int:
         return 1
 
 
+def cmd_stats(args) -> int:
+    """Print a metrics-registry snapshot.
+
+    With ``-i/--input`` (or an experiment dir's obs_snapshot.json via
+    ``-e``), saved snapshots are merged (counters/histograms add) and
+    printed. Without inputs it runs an instrumented smoke workload —
+    host fuzz executions plus a small device sweep on the selected app —
+    and prints the live registry, device ``LaneStats`` totals included."""
+    inputs = list(args.input)
+    if args.experiment:
+        path = os.path.join(args.experiment, "obs_snapshot.json")
+        if not os.path.exists(path):
+            raise SystemExit(
+                f"no obs_snapshot.json in {args.experiment!r} (re-run "
+                "fuzz/minimize with --stats-out or --trace-out)"
+            )
+        inputs.append(path)
+    if inputs:
+        snaps = []
+        for path in inputs:
+            with open(path) as f:
+                snaps.append(json.load(f))
+        print(json.dumps(obs.merge_snapshots(*snaps), indent=2, sort_keys=True))
+        return 0
+
+    obs.enable()
+    from .runner import fuzz
+
+    app = build_app(args)
+    config = SchedulerConfig(invariant_check=make_host_invariant(app))
+    with obs.span("cli.stats", app=args.app):
+        fuzz(
+            config,
+            build_fuzzer(app, args),
+            max_executions=args.max_executions,
+            seed=args.seed,
+            max_messages=args.max_messages,
+            invariant_check_interval=1,
+            timer_weight=args.timer_weight,
+        )
+        from .device import DeviceConfig
+        from .parallel.sweep import SweepDriver
+
+        cfg = DeviceConfig.for_app(
+            app,
+            pool_capacity=args.pool,
+            max_steps=args.max_messages,
+            max_external_ops=max(16, args.num_events + app.num_actors + 2),
+            invariant_interval=1,
+            timer_weight=args.timer_weight,
+        )
+        fuzzer = build_fuzzer(app, args)
+        driver = SweepDriver(
+            app, cfg, lambda s: fuzzer.generate_fuzz_test(seed=args.seed + s)
+        )
+        driver.sweep(args.batch, args.batch, mode="chunked")
+    print(obs.REGISTRY.to_json())
+    return 0
+
+
 def cmd_interactive(args) -> int:
     from .schedulers.interactive import InteractiveScheduler
 
@@ -477,8 +628,21 @@ def main(argv: Optional[list] = None) -> int:
             "--partition-weight", type=float, default=0.0, dest="partition_weight"
         )
 
+    def obs_flags(p):
+        p.add_argument(
+            "--trace-out", default=None, dest="trace_out", metavar="PATH",
+            help="enable telemetry and write a Chrome/Perfetto "
+                 "trace_event JSON of this run (ui.perfetto.dev)",
+        )
+        p.add_argument(
+            "--stats-out", default=None, dest="stats_out", metavar="PATH",
+            help="enable telemetry and write the metrics-registry "
+                 "snapshot JSON (readable via `demi_tpu stats -i`)",
+        )
+
     p = sub.add_parser("fuzz", help="random fuzzing until a violation")
     common(p)
+    obs_flags(p)
     p.add_argument("--max-executions", type=int, default=200, dest="max_executions")
     p.add_argument("-o", "--output", default=None)
     p.set_defaults(fn=cmd_fuzz)
@@ -489,6 +653,7 @@ def main(argv: Optional[list] = None) -> int:
         help="device-batched oracle backend",
     )
     common(p)
+    obs_flags(p)
     p.add_argument("-e", "--experiment", required=True)
     p.add_argument("--no-wildcards", action="store_true")
     p.add_argument(
@@ -534,6 +699,7 @@ def main(argv: Optional[list] = None) -> int:
         help="kernel backend: xla (default) or pallas VMEM-resident blocks",
     )
     common(p)
+    obs_flags(p)
     p.add_argument("--batch", type=int, default=256)
     p.add_argument("--pool", type=int, default=256)
     p.add_argument(
@@ -559,10 +725,33 @@ def main(argv: Optional[list] = None) -> int:
         help="DPOR sweep kernel backend",
     )
     common(p)
+    obs_flags(p)
     p.add_argument("--batch", type=int, default=64)
     p.add_argument("--pool", type=int, default=256)
     p.add_argument("--rounds", type=int, default=10)
     p.set_defaults(fn=cmd_dpor)
+
+    p = sub.add_parser(
+        "stats",
+        help="print a metrics-registry snapshot (saved or live smoke run)",
+    )
+    common(p)
+    p.add_argument(
+        "-i", "--input", action="append", default=[], metavar="PATH",
+        help="saved snapshot JSON (repeatable; merged and printed "
+             "instead of running the smoke workload)",
+    )
+    p.add_argument(
+        "-e", "--experiment", default=None,
+        help="experiment dir whose obs_snapshot.json to print",
+    )
+    p.add_argument("--batch", type=int, default=32)
+    p.add_argument("--pool", type=int, default=128)
+    p.add_argument(
+        "--max-executions", type=int, default=8, dest="max_executions",
+        help="host fuzz executions in the smoke workload",
+    )
+    p.set_defaults(fn=cmd_stats)
 
     p = sub.add_parser("report", help="markdown report of a saved experiment")
     p.add_argument("-e", "--experiment", required=True)

@@ -20,6 +20,7 @@ import numpy as np
 
 import jax
 
+from .. import obs
 from ..config import SchedulerConfig
 from ..dsl import DSLApp
 from ..external_events import ExternalEvent
@@ -136,8 +137,17 @@ class DeviceReplayChecker:
                 [records, np.repeat(records[:1], bucket - n, axis=0)]
             )
         keys = jax.random.split(jax.random.PRNGKey(0), bucket)
-        res = self.kernel(records, keys)
-        codes = np.asarray(res.violation)[:n]
+        with obs.span(
+            "device.replay_batch", candidates=n, bucket=bucket
+        ) as sp:
+            res = self.kernel(records, keys)
+            codes = np.asarray(res.violation)[:n]
+            hits = sum(int(c) == target_code for c in codes)
+            sp.set(reproductions=hits)
+        if obs.enabled():
+            obs.counter("device.replay.candidates").inc(n)
+            obs.counter("device.replay.pad_lanes").inc(bucket - n)
+            obs.counter("device.replay.reproductions").inc(hits)
         return [int(c) == target_code for c in codes]
 
     def host_executed_trace(

@@ -24,6 +24,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from .. import obs
 from ..dsl import DSLApp
 from .core import ST_DONE, ST_VIOLATION, DeviceConfig, ScheduleState
 from .explore import (
@@ -359,6 +360,28 @@ class ContinuousSweepDriver:
         self.last_segment_seconds: float = 0.0
         self.last_harvest_seconds: float = 0.0
 
+    def _record_round_stats(self, state, finished, vio) -> None:
+        """Fold one harvest round's finished lanes into the registry
+        (device.lane.* counters, driver=continuous) plus refill/occupancy
+        gauges. Called at most once per segment round, only when
+        telemetry is enabled. Shares reduce_lanes with the chunked/DPOR
+        drivers — one definition of every counter — masked to the lanes
+        finishing THIS round (each lane is counted exactly once, at
+        harvest)."""
+        from ..obs import lane_stats as _ls
+
+        _ls.record(
+            _ls.reduce_lanes(
+                np.asarray(state.status), vio, np.asarray(state.deliveries),
+                finished,
+                invariant_interval=self.cfg.invariant_interval,
+            ),
+            driver="continuous",
+        )
+        obs.counter("device.continuous.rounds").inc()
+        if self.last_occupancy is not None:
+            obs.gauge("device.continuous.occupancy").set(self.last_occupancy)
+
     def time_to_first_violation(self, max_lanes: int = 1_000_000):
         """Wall-clock seconds until the first violating lane finishes (the
         BASELINE.md headline #2 shape, continuous-refill form). Returns
@@ -470,6 +493,11 @@ class ContinuousSweepDriver:
             if finished.any():
                 vio = np.asarray(state.violation)
                 sh = np.asarray(state.sched_hash)
+                if obs.enabled():
+                    # Round-granularity lane telemetry: the status pull
+                    # above is the round's one sync point; deliveries ride
+                    # the same harvest (never per segment step).
+                    self._record_round_stats(state, finished, vio)
                 for lane in np.flatnonzero(finished):
                     out.append(
                         (

@@ -22,6 +22,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from .. import obs
 from ..config import SchedulerConfig
 from ..dsl import DSLApp
 from ..external_events import ExternalEvent
@@ -290,7 +291,13 @@ class DeviceDPOROracle:
             )
         dpor = self._instance(externals)
         target = getattr(violation_fingerprint, "code", None)
-        found = dpor.explore(target_code=target, max_rounds=self.max_rounds)
+        with obs.span(
+            "dpor.oracle_probe", externals=len(externals)
+        ) as sp:
+            found = dpor.explore(
+                target_code=target, max_rounds=self.max_rounds
+            )
+            sp.set(found=found is not None)
         self.last_interleavings = dpor.interleavings
         if found is None:
             return None
@@ -302,6 +309,7 @@ class DeviceDPOROracle:
         try:
             result = gs.execute_guide(guide)
         except GuideDivergence:
+            obs.counter("dpor.lift_divergences").inc()
             return None  # device/host mismatch = non-reproduction
         if result.violation is None:
             return None
@@ -454,8 +462,28 @@ class DeviceDPOR:
             keys = jax.vmap(
                 lambda s: jax.random.fold_in(jax.random.PRNGKey(0), s)
             )(np.arange(self.interleavings, self.interleavings + len(batch), dtype=np.uint32))
-            res = self.kernel(progs, prescs, keys)
+            with obs.span(
+                "dpor.round", batch=len(batch), frontier=len(frontier)
+            ):
+                res = self.kernel(progs, prescs, keys)
+                jax.block_until_ready(res.violation)
             self.interleavings += len(batch)
+            if obs.enabled():
+                # Device-lane totals for the round (one on-device
+                # reduction, one pull) + the exploration-efficiency
+                # counters optimal-DPOR tuning reads (redundant = already
+                # explored, pruned = over the edit-distance cap).
+                from ..obs import lane_stats as _ls
+
+                _ls.record(
+                    _ls.reduce_lanes(
+                        res.status, res.violation, res.deliveries,
+                        len(batch),
+                        invariant_interval=self.cfg.invariant_interval,
+                    ),
+                    driver="dpor",
+                )
+                obs.counter("dpor.interleavings").inc(len(batch))
             violations = np.asarray(res.violation)
             traces = np.asarray(res.trace)
             lens = np.asarray(res.trace_len)
@@ -470,6 +498,7 @@ class DeviceDPOR:
                     traces[lane], int(lens[lane]), self.cfg.rec_width
                 ):
                     if presc in self.explored:
+                        obs.counter("dpor.prescriptions_redundant").inc()
                         continue
                     if (
                         self.max_distance is not None
@@ -477,10 +506,14 @@ class DeviceDPOR:
                         and arvind_distance(presc, self.original)
                         > self.max_distance
                     ):
+                        obs.counter("dpor.prescriptions_distance_pruned").inc()
                         continue
                     self.explored.add(presc)
                     frontier.append(presc)
+            obs.gauge("dpor.frontier_size").set(len(frontier))
+            obs.gauge("dpor.explored_set_size").set(len(self.explored))
             if hit is not None:
+                obs.counter("dpor.violations_found").inc()
                 self.frontier = frontier
                 return hit
         self.frontier = frontier

@@ -19,6 +19,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import obs
 from ..dsl import DSLApp
 from . import ops
 from .core import (
@@ -420,7 +421,7 @@ def make_explore_kernel(app: DSLApp, cfg: DeviceConfig, lane_axis: str = "leadin
     bit-identical."""
     run_lane = make_run_lane(app, cfg)
     if lane_axis == "leading":
-        return jax.jit(jax.vmap(run_lane))
+        return _counted_kernel(jax.jit(jax.vmap(run_lane)), "explore")
     if lane_axis != "trailing":
         raise ValueError(f"lane_axis must be leading/trailing, got {lane_axis!r}")
 
@@ -433,7 +434,25 @@ def make_explore_kernel(app: DSLApp, cfg: DeviceConfig, lane_axis: str = "leadin
         keys_t = jnp.moveaxis(jnp.asarray(keys), 0, -1)
         return vmapped(progs_t, keys_t)
 
-    return jax.jit(call)
+    return _counted_kernel(jax.jit(call), "explore-trailing")
+
+
+def _counted_kernel(kernel, name: str):
+    """Launch-count telemetry around a jitted lane kernel. Deliberately
+    records launches/lanes only — no block_until_ready, so async dispatch
+    (the double-buffered sweep path) keeps overlapping. Telemetry off =
+    one branch per LAUNCH (not per lane/step), so the bench headline is
+    untouched."""
+
+    def call(progs, keys, *rest):
+        if obs.enabled():
+            obs.counter("device.kernel.launches").inc(kernel=name)
+            obs.counter("device.kernel.lanes").inc(
+                int(keys.shape[0]), kernel=name
+            )
+        return kernel(progs, keys, *rest)
+
+    return call
 
 
 def make_single_lane_trace_kernel(app: DSLApp, cfg: DeviceConfig):
@@ -448,4 +467,16 @@ def make_single_lane_trace_kernel(app: DSLApp, cfg: DeviceConfig):
         # it's ONE lane, so the [steps*N, rec_width] trace is small.
         overrides["trace_capacity"] = cfg.max_steps * cfg.num_actors
     traced_cfg = dataclasses.replace(cfg, **overrides)
-    return jax.jit(make_run_lane(app, traced_cfg))
+    kernel = jax.jit(make_run_lane(app, traced_cfg))
+
+    def call(prog, key):
+        # Each call is one device->host lift (a violating lane re-traced
+        # for host reconstruction) — worth a span: lifts bound how fast
+        # sweep hits turn into minimizable experiments.
+        with obs.span("device.trace_lift"):
+            res = kernel(prog, key)
+            jax.block_until_ready(res.trace_len)
+        obs.counter("device.trace_lifts").inc()
+        return res
+
+    return call

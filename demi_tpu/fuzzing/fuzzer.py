@@ -14,6 +14,8 @@ import random as _random
 from dataclasses import dataclass
 from typing import Callable, List, Optional, Sequence
 
+from .. import obs
+
 from ..external_events import (
     ExternalEvent,
     HardKill,
@@ -159,12 +161,21 @@ class Fuzzer:
                     events.append(send)
                     generated += 1
             elif kind == "atomic_block":
+                # Cap the batch at the remaining event budget so generated
+                # programs never overshoot num_events; with <2 remaining a
+                # block is impossible — fall back to a plain send.
+                remaining = self.num_events - generated
                 batch = []
-                for _ in range(rng.randint(2, 4)):
+                if remaining >= 2:
+                    for _ in range(rng.randint(2, min(4, remaining))):
+                        send = self.message_gen.generate(rng, alive)
+                        if send is None:
+                            break
+                        batch.append(send)
+                else:
                     send = self.message_gen.generate(rng, alive)
-                    if send is None:
-                        break
-                    batch.append(send)
+                    if send is not None:
+                        batch.append(send)
                 if len(batch) >= 2:
                     events.extend(atomic_block(batch))
                     generated += len(batch)
@@ -217,6 +228,10 @@ class Fuzzer:
 
         had_postfix = bool(self.postfix)
         events.extend(self.postfix)
+        if obs.enabled():
+            obs.counter("fuzz.programs_generated").inc()
+            obs.counter("fuzz.events_generated").inc(generated)
+            obs.histogram("fuzz.program_events").observe(generated)
         if not events or not isinstance(events[-1], WaitQuiescence):
             events.append(WaitQuiescence())
         elif events[-1].budget is not None and not had_postfix:

@@ -13,6 +13,7 @@ from __future__ import annotations
 
 from typing import Any, List, Optional, Sequence
 
+from .. import obs
 from ..trace import EventTrace
 from .event_dag import AtomicEvent, EventDag, UnmodifiedEventDag
 from .stats import MinimizationStats, StageBudget
@@ -94,8 +95,14 @@ class DDMin(Minimizer):
         self.total_tests += 1
         events = candidate.get_all_events()
         self.stats.record_iteration_size(len(events))
-        trace = self.oracle.test(events, self._violation, stats=self.stats, init=self._init)
+        with obs.span("ddmin.iteration", externals=len(events)) as sp:
+            trace = self.oracle.test(
+                events, self._violation, stats=self.stats, init=self._init
+            )
+            sp.set(reproduced=trace is not None)
+        obs.counter("minimize.ddmin.trials").inc()
         if trace is not None:
+            obs.counter("minimize.ddmin.reproductions").inc()
             self.original_traces.append(trace)
         return trace
 
@@ -159,9 +166,14 @@ class BatchedDDMin(Minimizer):
             for cand in candidates:
                 self.stats.record_replay()
                 self.stats.record_iteration_size(len(cand.get_all_events()))
-            verdicts = self.oracle.test_batch(
-                [c.get_all_events() for c in candidates], violation_fingerprint
-            )
+            with obs.span(
+                "ddmin.level", granularity=n, candidates=len(candidates)
+            ):
+                verdicts = self.oracle.test_batch(
+                    [c.get_all_events() for c in candidates],
+                    violation_fingerprint,
+                )
+            obs.counter("minimize.ddmin.batched_trials").inc(len(candidates))
             adopted_idx = next(
                 (i for i, ok in enumerate(verdicts) if ok), None
             )

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
+from .. import obs
 from ..config import SchedulerConfig
 from ..external_events import ExternalEvent
 from ..schedulers.dpor import DPORScheduler
@@ -85,7 +86,13 @@ class IncrementalDDMin(Minimizer):
                 f"IncDDMin(dist={distance})", "ResumableDPOR"
             )
             ddmin = DDMin(self.oracle, check_unmodified=False, stats=self.stats)
-            candidate = ddmin.minimize(current, violation_fingerprint, init=init)
+            with obs.span(
+                "incddmin.distance", max_distance=distance,
+                externals=len(current.get_all_events()),
+            ):
+                candidate = ddmin.minimize(
+                    current, violation_fingerprint, init=init
+                )
             if len(candidate.get_all_events()) < len(current.get_all_events()):
                 current = candidate
             distance = 2 if distance == 0 else distance * 2

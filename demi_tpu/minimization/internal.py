@@ -16,6 +16,7 @@ from __future__ import annotations
 
 from typing import Any, Callable, List, Optional, Sequence, Tuple
 
+from .. import obs
 from ..events import (
     BeginUnignorableEvents,
     EndUnignorableEvents,
@@ -170,8 +171,15 @@ class STSSchedMinimizer:
             candidate = self.strategy.next_candidate(last_failing)
             if candidate is None:
                 break
-            result = self.check(candidate)
-            reproduced = result is not None
+            with obs.span(
+                "intmin.candidate", events=len(candidate.events)
+            ) as sp:
+                result = self.check(candidate)
+                reproduced = result is not None
+                sp.set(reproduced=reproduced)
+            obs.counter("minimize.internal.trials").inc()
+            if reproduced:
+                obs.counter("minimize.internal.removals").inc()
             self.strategy.on_result(reproduced)
             if reproduced:
                 last_failing = result
@@ -219,7 +227,11 @@ class BatchedInternalMinimizer:
             if not indices:
                 break
             candidates = [remove_delivery(last_failing, i) for i in indices]
-            results = self.batch_check(candidates)
+            with obs.span("intmin.round", candidates=len(candidates)):
+                results = self.batch_check(candidates)
+            obs.counter("minimize.internal.batched_trials").inc(
+                len(candidates)
+            )
             adopted = next((r for r in results if r is not None), None)
             # Every device lane is a replay trial (the host-sequential
             # minimizer would have run each one through the STS oracle).

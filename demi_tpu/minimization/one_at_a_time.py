@@ -9,6 +9,7 @@ from __future__ import annotations
 
 from typing import Any, Optional
 
+from .. import obs
 from .ddmin import Minimizer
 from .event_dag import EventDag
 from .stats import MinimizationStats
@@ -32,12 +33,19 @@ class LeftToRightRemoval(Minimizer):
                 candidate = current.remove_events([atom])
                 self.total_tests += 1
                 self.stats.record_iteration_size(len(candidate.get_all_events()))
-                if (
-                    self.oracle.test(
-                        candidate.get_all_events(), violation_fingerprint, stats=self.stats, init=init
-                    )
-                    is not None
+                obs.counter("minimize.one_at_a_time.trials").inc()
+                with obs.span(
+                    "one_at_a_time.trial",
+                    externals=len(candidate.get_all_events()),
                 ):
+                    reproduced = (
+                        self.oracle.test(
+                            candidate.get_all_events(), violation_fingerprint,
+                            stats=self.stats, init=init,
+                        )
+                        is not None
+                    )
+                if reproduced:
                     current = candidate
                     changed = True
         self.stats.record_prune_end()

@@ -1,0 +1,47 @@
+"""demi_tpu.obs: unified observability — metrics registry, span tracing,
+device-lane telemetry.
+
+Three pieces, one switch:
+
+  - ``metrics``: process-wide registry of labeled counters / gauges /
+    timing histograms with JSON snapshot + cross-process merge;
+  - ``spans``: nested ``span("stage.name", ...)`` tracing with JSONL and
+    Chrome/Perfetto ``trace_event`` export;
+  - ``lane_stats`` (import directly — it needs jax): per-sweep device
+    counters reduced on-device and pulled once per round.
+
+Everything is OFF by default; ``enable()`` (or ``DEMI_OBS=1``) turns the
+whole layer on. Disabled call sites pay one branch. The CLI surfaces the
+layer via ``demi_tpu stats`` and ``--trace-out`` / ``--stats-out`` flags
+on ``fuzz`` / ``minimize``.
+"""
+
+from .metrics import (  # noqa: F401
+    REGISTRY,
+    MetricsRegistry,
+    counter,
+    disable,
+    enable,
+    enabled,
+    gauge,
+    histogram,
+    merge_snapshots,
+    timed,
+)
+from .spans import TRACER, Tracer, span  # noqa: F401
+
+__all__ = [
+    "REGISTRY",
+    "MetricsRegistry",
+    "TRACER",
+    "Tracer",
+    "counter",
+    "disable",
+    "enable",
+    "enabled",
+    "gauge",
+    "histogram",
+    "merge_snapshots",
+    "span",
+    "timed",
+]

@@ -1,0 +1,270 @@
+"""Process-wide metrics registry: counters, gauges, timing histograms.
+
+The observability twin of minimization/stats.py's per-pipeline
+MinimizationStats: where those stats belong to ONE minimization run and
+serialize into its experiment dir, this registry aggregates across every
+subsystem in the process — fuzzer, schedulers, minimizers, device sweep
+drivers — into labeled series that snapshot to JSON and merge across
+processes (the distributed-sweep shape: each rank snapshots, the
+launcher merges).
+
+Zero dependencies (stdlib only) and OFF by default: every mutation
+checks one module-level bool, so un-enabled hot paths pay a single
+attribute load + branch. Enable with ``demi_tpu.obs.enable()`` or
+``DEMI_OBS=1``.
+
+Exploration-efficiency counters (redundant/pruned/blocked schedules) are
+the primary tuning signal for a schedule explorer (Parsimonious Optimal
+DPOR, arXiv:2405.11128); the instrumented call sites follow that naming.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+_enabled = os.environ.get("DEMI_OBS", "").strip().lower() in (
+    "1", "true", "yes", "on"
+)
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def enable() -> None:
+    global _enabled
+    _enabled = True
+
+
+def disable() -> None:
+    global _enabled
+    _enabled = False
+
+
+def _label_key(labels: Dict[str, Any]) -> str:
+    """Canonical series key: 'k=v,k2=v2' with sorted keys ('' = unlabeled)."""
+    if not labels:
+        return ""
+    return ",".join(f"{k}={labels[k]}" for k in sorted(labels))
+
+
+# Log2 bucket upper bounds for timing histograms, in seconds: 1us .. ~134s.
+# Fixed boundaries make cross-process merges exact (bucket-wise adds).
+_BUCKETS: Tuple[float, ...] = tuple(2.0 ** e for e in range(-20, 8))
+
+
+class Counter:
+    """Monotonic labeled counter."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self.series: Dict[str, float] = {}
+
+    def inc(self, n: float = 1, **labels) -> None:
+        if not _enabled:
+            return
+        key = _label_key(labels)
+        self.series[key] = self.series.get(key, 0) + n
+
+    def value(self, **labels) -> float:
+        return self.series.get(_label_key(labels), 0)
+
+    def total(self) -> float:
+        return sum(self.series.values())
+
+
+class Gauge:
+    """Last-write-wins labeled gauge (occupancy, frontier size, ...)."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self.series: Dict[str, float] = {}
+
+    def set(self, v: float, **labels) -> None:
+        if not _enabled:
+            return
+        self.series[_label_key(labels)] = float(v)
+
+    def value(self, **labels) -> Optional[float]:
+        return self.series.get(_label_key(labels))
+
+
+class Histogram:
+    """Timing histogram over fixed log2 buckets, plus count/sum/min/max.
+
+    Fixed boundaries mean merge() is a plain bucket-wise add — snapshots
+    from different processes combine exactly.
+    """
+
+    def __init__(self, name: str):
+        self.name = name
+        # label key -> [counts per bucket (+overflow), count, sum, min, max]
+        self.series: Dict[str, List[Any]] = {}
+
+    def _series(self, key: str) -> List[Any]:
+        s = self.series.get(key)
+        if s is None:
+            s = self.series[key] = [
+                [0] * (len(_BUCKETS) + 1), 0, 0.0, float("inf"), float("-inf")
+            ]
+        return s
+
+    def observe(self, v: float, **labels) -> None:
+        if not _enabled:
+            return
+        s = self._series(_label_key(labels))
+        b = 0
+        while b < len(_BUCKETS) and v > _BUCKETS[b]:
+            b += 1
+        s[0][b] += 1
+        s[1] += 1
+        s[2] += v
+        s[3] = min(s[3], v)
+        s[4] = max(s[4], v)
+
+    def count(self, **labels) -> int:
+        s = self.series.get(_label_key(labels))
+        return s[1] if s else 0
+
+    def sum(self, **labels) -> float:
+        s = self.series.get(_label_key(labels))
+        return s[2] if s else 0.0
+
+
+class _Timed:
+    """Context manager: observe the wall-clock of a block into a histogram."""
+
+    def __init__(self, hist: Histogram, labels: Dict[str, Any]):
+        self.hist = hist
+        self.labels = labels
+        self.t0 = 0.0
+
+    def __enter__(self) -> "_Timed":
+        self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.hist.observe(time.perf_counter() - self.t0, **self.labels)
+
+
+class MetricsRegistry:
+    """Name -> metric family. Creation is idempotent; a name belongs to
+    exactly one kind (re-registering under another kind raises)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: Dict[str, Any] = {}
+
+    def _get(self, name: str, cls):
+        m = self._metrics.get(name)
+        if m is None:
+            with self._lock:
+                m = self._metrics.setdefault(name, cls(name))
+        if not isinstance(m, cls):
+            raise TypeError(
+                f"metric {name!r} already registered as "
+                f"{type(m).__name__}, not {cls.__name__}"
+            )
+        return m
+
+    def counter(self, name: str) -> Counter:
+        return self._get(name, Counter)
+
+    def gauge(self, name: str) -> Gauge:
+        return self._get(name, Gauge)
+
+    def histogram(self, name: str) -> Histogram:
+        return self._get(name, Histogram)
+
+    def timed(self, name: str, **labels) -> _Timed:
+        return _Timed(self.histogram(name), labels)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._metrics.clear()
+
+    # -- snapshot / merge ---------------------------------------------------
+    def snapshot(self) -> Dict[str, Any]:
+        """JSON-able view: {"counters": {name: {labels: v}}, "gauges": ...,
+        "histograms": {name: {labels: {"buckets", "count", "sum", ...}}}}."""
+        out: Dict[str, Any] = {"counters": {}, "gauges": {}, "histograms": {}}
+        for name, m in sorted(self._metrics.items()):
+            if not m.series:
+                # Families touched only while telemetry was off recorded
+                # nothing; an empty entry would read as "measured zero".
+                continue
+            if isinstance(m, Counter):
+                out["counters"][name] = dict(m.series)
+            elif isinstance(m, Gauge):
+                out["gauges"][name] = dict(m.series)
+            else:
+                out["histograms"][name] = {
+                    key: {
+                        "buckets": list(s[0]),
+                        "count": s[1],
+                        "sum": s[2],
+                        "min": None if s[1] == 0 else s[3],
+                        "max": None if s[1] == 0 else s[4],
+                    }
+                    for key, s in m.series.items()
+                }
+        return out
+
+    def load(self, snap: Dict[str, Any]) -> None:
+        """Merge a snapshot into this registry: counters and histogram
+        buckets add, gauges last-write-win. Merging is how multi-process
+        sweeps (parallel/distributed.py shape) aggregate telemetry."""
+        for name, series in snap.get("counters", {}).items():
+            c = self.counter(name)
+            for key, v in series.items():
+                c.series[key] = c.series.get(key, 0) + v
+        for name, series in snap.get("gauges", {}).items():
+            self.gauge(name).series.update(series)
+        for name, series in snap.get("histograms", {}).items():
+            h = self.histogram(name)
+            for key, rec in series.items():
+                s = h._series(key)
+                for i, n in enumerate(rec["buckets"]):
+                    s[0][i] += n
+                s[1] += rec["count"]
+                s[2] += rec["sum"]
+                if rec["min"] is not None:
+                    s[3] = min(s[3], rec["min"])
+                if rec["max"] is not None:
+                    s[4] = max(s[4], rec["max"])
+
+    def to_json(self) -> str:
+        return json.dumps(self.snapshot(), indent=2, sort_keys=True)
+
+
+def merge_snapshots(*snaps: Dict[str, Any]) -> Dict[str, Any]:
+    """Combine snapshots (cross-process aggregation helper). ``load``
+    mutates series storage directly, so merging works with telemetry off."""
+    reg = MetricsRegistry()
+    for snap in snaps:
+        reg.load(snap)
+    return reg.snapshot()
+
+
+#: The process-wide registry every instrumented subsystem reports into.
+REGISTRY = MetricsRegistry()
+
+
+def counter(name: str) -> Counter:
+    return REGISTRY.counter(name)
+
+
+def gauge(name: str) -> Gauge:
+    return REGISTRY.gauge(name)
+
+
+def histogram(name: str) -> Histogram:
+    return REGISTRY.histogram(name)
+
+
+def timed(name: str, **labels) -> _Timed:
+    return REGISTRY.timed(name, **labels)

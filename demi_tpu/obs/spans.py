@@ -1,0 +1,174 @@
+"""Span-based structured tracing with Chrome/Perfetto trace_event export.
+
+    with span("ddmin.iteration", externals=12):
+        ...
+
+Spans nest per thread (strict stack discipline — the context manager
+enforces it), record wall-clock microseconds from a process epoch, and
+export two ways:
+
+  - ``write_jsonl(path)``: one finished span per line
+    ({"name", "ts", "dur", "tid", "args"}) for ad-hoc grepping;
+  - ``export_perfetto(path)``: Chrome ``trace_event`` JSON (matched B/E
+    duration pairs, monotonic timestamps) loadable in ``ui.perfetto.dev``
+    or ``chrome://tracing`` — the fuzz -> minimize -> replay pipeline on
+    one timeline.
+
+Recording is gated on the same module switch as the metrics registry
+(``demi_tpu.obs.enable()`` / DEMI_OBS=1): a disabled ``span(...)`` costs
+one branch and allocates nothing.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List
+
+from . import metrics as _metrics
+
+_lock = threading.Lock()
+_local = threading.local()
+_EPOCH_NS = time.perf_counter_ns()
+# Global operation counter ticked at every span enter AND exit: within a
+# thread it orders B/E events exactly as they happened, which is the only
+# tie-break that stays correct for zero-width (sub-microsecond) spans.
+_ops = itertools.count()
+
+
+def _now_us() -> int:
+    return (time.perf_counter_ns() - _EPOCH_NS) // 1000
+
+
+class Tracer:
+    """In-memory collector of finished spans.
+
+    Bounded: a DEMI_OBS=1 soak that nobody exports must not grow memory
+    forever, so past ``max_spans`` new spans are counted in ``dropped``
+    instead of stored (the prefix of the timeline is kept — B/E pairing
+    stays valid because whole spans, not events, are dropped)."""
+
+    def __init__(self, max_spans: int = 200_000):
+        self.spans: List[Dict[str, Any]] = []
+        self.max_spans = max_spans
+        self.dropped = 0
+
+    def record(self, name: str, ts: int, dur: int, tid: int, op_b: int,
+               op_e: int, args: Dict[str, Any]) -> None:
+        with _lock:
+            if len(self.spans) >= self.max_spans:
+                self.dropped += 1
+                return
+            self.spans.append(
+                {
+                    "name": name,
+                    "ts": ts,
+                    "dur": dur,
+                    "tid": tid,
+                    "op_b": op_b,
+                    "op_e": op_e,
+                    "args": args,
+                }
+            )
+
+    def clear(self) -> None:
+        with _lock:
+            self.spans.clear()
+            self.dropped = 0
+
+    # -- exports ------------------------------------------------------------
+    def write_jsonl(self, path: str) -> None:
+        with open(path, "a") as f:
+            for s in self.spans:
+                f.write(json.dumps(
+                    {k: s[k] for k in ("name", "ts", "dur", "tid", "args")}
+                ) + "\n")
+
+    def to_trace_events(self) -> List[Dict[str, Any]]:
+        """Matched B/E pairs sorted by (ts, operation order). Within a
+        thread timestamps are non-decreasing in operation order, so the
+        sort preserves the exact enter/exit sequence — begin/end events
+        nest properly for any span durations, including zero-width."""
+        pid = os.getpid()
+        events = []
+        for s in self.spans:
+            base = {"name": s["name"], "pid": pid, "tid": s["tid"],
+                    "cat": "demi"}
+            events.append(
+                {**base, "ph": "B", "ts": s["ts"], "args": s["args"],
+                 "_ord": (s["ts"], s["op_b"])}
+            )
+            events.append(
+                {**base, "ph": "E", "ts": s["ts"] + s["dur"],
+                 "_ord": (s["ts"] + s["dur"], s["op_e"])}
+            )
+        events.sort(key=lambda e: e.pop("_ord"))
+        return events
+
+    def export_perfetto(self, path: str) -> None:
+        doc = {
+            "traceEvents": self.to_trace_events(),
+            "displayTimeUnit": "ms",
+            "otherData": {
+                "producer": "demi_tpu.obs",
+                "dropped_spans": self.dropped,
+            },
+        }
+        with open(path, "w") as f:
+            json.dump(doc, f)
+
+
+#: The process-wide tracer (CLI --trace-out exports it on exit).
+TRACER = Tracer()
+
+
+class span:
+    """Context manager recording one nested span into TRACER. A span
+    entered while telemetry is disabled records nothing (one branch); a
+    span already open when telemetry is disabled still records on exit,
+    keeping the per-thread stack discipline intact."""
+
+    __slots__ = ("name", "args", "_ts", "_op", "_live")
+
+    def __init__(self, name: str, **args):
+        self.name = name
+        self.args = args
+        self._live = False
+
+    def __enter__(self) -> "span":
+        if not _metrics.enabled():
+            return self
+        self._live = True
+        self._op = next(_ops)
+        self._ts = _now_us()
+        stack = getattr(_local, "stack", None)
+        if stack is None:
+            stack = _local.stack = []
+        stack.append(self)
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        if not self._live:
+            return
+        self._live = False
+        stack = _local.stack
+        assert stack and stack[-1] is self, "span stack discipline violated"
+        stack.pop()
+        if exc_type is not None:
+            self.args["error"] = exc_type.__name__
+        TRACER.record(
+            self.name, self._ts, max(0, _now_us() - self._ts),
+            threading.get_ident() & 0xFFFF, self._op, next(_ops), self.args,
+        )
+
+    def set(self, **args) -> None:
+        """Attach result attributes discovered mid-span."""
+        self.args.update(args)
+
+
+def current_depth() -> int:
+    """Testing hook: open-span depth on this thread."""
+    return len(getattr(_local, "stack", ()))

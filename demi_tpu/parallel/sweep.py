@@ -23,6 +23,7 @@ import numpy as np
 
 import jax
 
+from .. import obs
 from ..dsl import DSLApp
 from ..device.core import ST_OVERFLOW, ST_VIOLATION, DeviceConfig
 from ..device.encoding import lower_program, stack_programs
@@ -176,15 +177,28 @@ class SweepDriver:
         """One slice-sized chunk: lanes = len(seeds). When mesh-sharded the
         batch is padded up to a multiple of the mesh axis by repeating
         seeds; padded lanes are excluded from every reported count."""
-        return self._harvest_chunk(
-            self._dispatch_chunk(seeds, base_key), slice_index
-        )
+        seeds = list(seeds)
+        with obs.span("device.sweep.chunk", lanes=len(seeds)):
+            return self._harvest_chunk(
+                self._dispatch_chunk(seeds, base_key), slice_index
+            )
 
     def _harvest_chunk(self, handle, slice_index: int = 0) -> SweepChunkResult:
         real, res, t0 = handle
         n_real = len(real)
         jax.block_until_ready(res)
         seconds = time.perf_counter() - t0
+        lane_stats = None
+        if obs.enabled():
+            # Per-sweep device-lane telemetry: totals reduced ON-DEVICE
+            # over the whole chunk, pulled host-side once (device.lane.*
+            # counters; the [B] deliveries array itself never transfers).
+            from ..obs import lane_stats as _ls
+
+            lane_stats = _ls.reduce_lanes(
+                res.status, res.violation, res.deliveries, n_real,
+                invariant_interval=self.cfg.invariant_interval,
+            )
         violations = np.asarray(res.violation)[:n_real]
         statuses = np.asarray(res.status)[:n_real]
         lanes = np.nonzero(statuses == ST_VIOLATION)[0]
@@ -193,6 +207,17 @@ class SweepDriver:
             for c in np.unique(violations)
             if c != 0
         }
+        chunk_uniq = np.unique(
+            np.asarray(res.sched_hash)[:n_real][statuses != ST_OVERFLOW]
+        )
+        if lane_stats is not None:
+            from ..obs import lane_stats as _ls
+
+            _ls.record(
+                lane_stats, driver="sweep",
+                unique_schedules=int(chunk_uniq.size),
+            )
+            obs.histogram("device.sweep.chunk_seconds").observe(seconds)
         return SweepChunkResult(
             slice_index=slice_index,
             lanes=n_real,
@@ -209,9 +234,7 @@ class SweepDriver:
             overflow_lanes=int((statuses == ST_OVERFLOW).sum()),
             # Overflowed lanes aborted mid-schedule: their truncated
             # fingerprints are not explored schedules, keep them out.
-            unique_hashes=np.unique(
-                np.asarray(res.sched_hash)[:n_real][statuses != ST_OVERFLOW]
-            ),
+            unique_hashes=chunk_uniq,
         )
 
     def sweep(
@@ -239,6 +262,9 @@ class SweepDriver:
         partition the seed space — see module docstring)."""
         if mode is None:
             mode = "continuous" if num_slices == 1 else "chunked"
+        obs.counter("device.sweep.lanes_requested").inc(
+            total_lanes, mode=mode
+        )
         if mode == "continuous":
             if num_slices != 1:
                 raise ValueError(

@@ -16,6 +16,7 @@ import dataclasses
 from dataclasses import dataclass, field
 from typing import Any, Callable, List, Optional, Sequence, Tuple
 
+from . import obs
 from .config import SchedulerConfig
 from .external_events import ExternalEvent, MessageConstructor, Send
 from .fuzzing import Fuzzer
@@ -105,18 +106,26 @@ def fuzz(
     )
     for i in range(max_executions):
         program = fuzzer.generate_fuzz_test(seed=seed + i)
-        result = sched.execute(program)
+        with obs.span("fuzz.execution", seed=seed + i) as sp:
+            result = sched.execute(program)
+            sp.set(deliveries=result.deliveries,
+                   violation=result.violation is not None)
+        obs.counter("fuzz.executions").inc()
         if result.violation is None:
             continue
+        obs.counter("fuzz.violations").inc()
         if validate_replay:
             replayer = ReplayScheduler(config)
             try:
-                replayed = replayer.replay(result.trace, program)
+                with obs.span("fuzz.validate_replay"):
+                    replayed = replayer.replay(result.trace, program)
             except ReplayException:
+                obs.counter("fuzz.nondeterministic_discarded").inc()
                 continue
             if replayed.violation is None or not replayed.violation.matches(
                 result.violation
             ):
+                obs.counter("fuzz.nondeterministic_discarded").inc()
                 continue
         return FuzzResult(
             program=program,
@@ -482,19 +491,21 @@ def run_the_gamut(
     if restored is not None:
         externals, trace = restored
     else:
-        if checker is not None:
-            oracle = DeviceSTSOracle(app, device_cfg, config, trace, checker=checker)
-            ddmin = BatchedDDMin(oracle, stats=stats, budget=stage_budget())
-            mcs_dag = ddmin.minimize(make_dag(list(externals)), violation)
-            verified = ddmin.verified_trace
-        else:
-            mcs_dag, verified = sts_sched_ddmin(
-                config, trace, externals, violation, stats=stats,
-                budget=stage_budget(),
-            )
-        externals = mcs_dag.get_all_events()
-        if verified is not None:
-            trace = verified
+        with obs.span("gamut.ddmin", externals=len(externals)) as sp:
+            if checker is not None:
+                oracle = DeviceSTSOracle(app, device_cfg, config, trace, checker=checker)
+                ddmin = BatchedDDMin(oracle, stats=stats, budget=stage_budget())
+                mcs_dag = ddmin.minimize(make_dag(list(externals)), violation)
+                verified = ddmin.verified_trace
+            else:
+                mcs_dag, verified = sts_sched_ddmin(
+                    config, trace, externals, violation, stats=stats,
+                    budget=stage_budget(),
+                )
+            externals = mcs_dag.get_all_events()
+            sp.set(mcs=len(externals))
+            if verified is not None:
+                trace = verified
         checkpoint("ddmin", externals, trace)
     record("ddmin", externals, trace)
 
@@ -511,14 +522,15 @@ def run_the_gamut(
     if restored is not None:
         externals, trace = restored
     else:
-        if checker is not None:
-            trace = _device_int_min(trace)
-        else:
-            trace = minimize_internals(
-                config, trace, externals, violation,
-                strategy=internal_strategy or OneAtATimeStrategy(), stats=stats,
-                budget=stage_budget(),
-            )
+        with obs.span("gamut.int_min", deliveries=len(trace.deliveries())):
+            if checker is not None:
+                trace = _device_int_min(trace)
+            else:
+                trace = minimize_internals(
+                    config, trace, externals, violation,
+                    strategy=internal_strategy or OneAtATimeStrategy(), stats=stats,
+                    budget=stage_budget(),
+                )
         checkpoint("int_min", externals, trace)
     record("int_min", externals, trace)
 
@@ -547,7 +559,8 @@ def run_the_gamut(
                 )
             else:
                 wc = WildcardMinimizer(check, stats=stats, budget=stage_budget())
-            trace = wc.minimize(trace, config.fingerprinter)
+            with obs.span("gamut.wildcard"):
+                trace = wc.minimize(trace, config.fingerprinter)
             checkpoint("wildcard", externals, trace)
         record("wildcard", externals, trace)
 
@@ -555,14 +568,15 @@ def run_the_gamut(
         if restored is not None:
             externals, trace = restored
         else:
-            if checker is not None:
-                trace = _device_int_min(trace)
-            else:
-                trace = minimize_internals(
-                    config, trace, externals, violation,
-                    strategy=SrcDstFIFORemoval(), stats=stats,
-                    budget=stage_budget(),
-                )
+            with obs.span("gamut.int_min2"):
+                if checker is not None:
+                    trace = _device_int_min(trace)
+                else:
+                    trace = minimize_internals(
+                        config, trace, externals, violation,
+                        strategy=SrcDstFIFORemoval(), stats=stats,
+                        budget=stage_budget(),
+                    )
             checkpoint("int_min2", externals, trace)
         record("int_min2", externals, trace)
 

@@ -25,6 +25,7 @@ import itertools
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Set, Tuple
 
+from .. import obs
 from ..events import EXTERNAL, FAILURE_DETECTOR, IdGenerator
 from .actor import Actor, Context
 
@@ -229,6 +230,10 @@ class ControlledActorSystem:
         Instrumenter.actorCrashed:184-199); effects captured before the
         crash are kept."""
         assert self.deliverable(entry), f"undeliverable entry {entry!r}"
+        if obs.enabled():
+            obs.counter("runtime.deliveries").inc(
+                kind="timer" if entry.is_timer else "message"
+            )
         if entry.rcv == FAILURE_DETECTOR:
             # The FD endpoint is scheduler-side bookkeeping, not an actor;
             # delivering to it at this layer has no actor-side effect
@@ -253,6 +258,7 @@ class ControlledActorSystem:
             # Effects performed before the crash are kept: in the reference
             # (Akka), tells made before the throw already sit in mailboxes
             # when Instrumenter.actorCrashed runs.
+            obs.counter("runtime.actor_crashes").inc()
             self.crashed.add(entry.rcv)
             return self._last_capture
 

@@ -23,6 +23,7 @@ from __future__ import annotations
 from dataclasses import dataclass
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
+from .. import obs
 from ..config import SchedulerConfig
 from ..events import (
     EXTERNAL,
@@ -155,12 +156,23 @@ class BaseScheduler:
     def execute(self, externals: Sequence[ExternalEvent]) -> ExecutionResult:
         """Run the full external-event program to completion (or a cap),
         recording the trace; returns the final invariant verdict."""
-        self.prepare(externals)
-        violation = self._run_program(list(externals))
-        if violation is None:
-            violation = self.check_invariant()
-        if violation is not None:
-            self.meta_trace.set_caused_violation()
+        with obs.span(
+            "scheduler.execute",
+            scheduler=type(self).__name__,
+            externals=len(externals),
+        ) as sp:
+            self.prepare(externals)
+            violation = self._run_program(list(externals))
+            if violation is None:
+                violation = self.check_invariant()
+            if violation is not None:
+                self.meta_trace.set_caused_violation()
+            sp.set(deliveries=self.deliveries,
+                   violation=violation is not None)
+        if obs.enabled():
+            obs.counter("scheduler.executions").inc(
+                scheduler=type(self).__name__
+            )
         return ExecutionResult(
             trace=self.trace,
             violation=violation,

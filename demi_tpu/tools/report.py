@@ -91,6 +91,38 @@ def render_report(directory: str, app=None) -> str:
                 )
             lines.append(f"\nTotal oracle trials: **{total}**")
 
+    obs_snap = _load(directory, "obs_snapshot.json")
+    if obs_snap:
+        lines += ["", "## Telemetry", ""]
+        counters = obs_snap.get("counters", {})
+        if counters:
+            lines += ["| counter | series | value |", "|---|---|---|"]
+            for name in sorted(counters):
+                for key, v in sorted(counters[name].items()):
+                    lines.append(f"| `{name}` | {key or '—'} | {v} |")
+        gauges = obs_snap.get("gauges", {})
+        if gauges:
+            lines += ["", "| gauge | series | value |", "|---|---|---|"]
+            for name in sorted(gauges):
+                for key, v in sorted(gauges[name].items()):
+                    lines.append(f"| `{name}` | {key or '—'} | {v} |")
+        hists = obs_snap.get("histograms", {})
+        if hists:
+            lines += ["", "| histogram | series | count | sum (s) | max (s) |",
+                      "|---|---|---|---|---|"]
+            for name in sorted(hists):
+                for key, rec in sorted(hists[name].items()):
+                    mx = rec.get("max")
+                    lines.append(
+                        f"| `{name}` | {key or '—'} | {rec['count']} | "
+                        f"{rec['sum']:.3f} | "
+                        f"{'—' if mx is None else f'{mx:.3f}'} |"
+                    )
+        lines.append(
+            "\nSnapshot: `obs_snapshot.json` "
+            "(merge/print: `python -m demi_tpu stats -e <dir>`)."
+        )
+
     inventory = sorted(
         f for f in os.listdir(directory) if os.path.isfile(
             os.path.join(directory, f)

@@ -279,16 +279,21 @@ def _round_pin_soak(args) -> int:
                 f"{skipped} overflow-skipped ({time.time() - t0:.0f}s)",
                 flush=True,
             )
+    if checked < max(1, (checked + skipped) // 2):
+        # Silent coverage collapse (a family overflowing on most seeds)
+        # must fail the soak, not pass vacuously — and must not log OK
+        # first (exit-3 runs used to print both lines).
+        print(
+            f"ROUND-PIN SOAK: >50% of lanes overflow-skipped "
+            f"({checked} checked, {skipped} skipped)",
+            flush=True,
+        )
+        return 3
     print(
         f"ROUND-PIN SOAK OK: {rounds} rounds, {checked} lanes "
         f"({skipped} overflow-skipped)",
         flush=True,
     )
-    if checked < max(1, (checked + skipped) // 2):
-        # Silent coverage collapse (a family overflowing on most seeds)
-        # must fail the soak, not pass vacuously.
-        print("ROUND-PIN SOAK: >50% of lanes overflow-skipped", flush=True)
-        return 3
     return 0
 
 

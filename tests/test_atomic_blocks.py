@@ -238,6 +238,36 @@ def test_fuzzer_generates_contiguous_blocks():
     assert saw_block
 
 
+def test_fuzzer_atomic_blocks_respect_event_budget():
+    """A drawn atomic block is capped at the remaining num_events budget
+    (plain send when <2 remain), so programs never overshoot the
+    requested length."""
+    from demi_tpu.apps.broadcast import broadcast_send_generator
+    from demi_tpu.external_events import Start as _Start
+    from demi_tpu.fuzzing import Fuzzer, FuzzerWeights
+
+    app = make_broadcast_app(4, reliable=False)
+    prefix = dsl_start_events(app)
+    for num_events in (3, 4, 5, 12):
+        fuzzer = Fuzzer(
+            num_events=num_events,
+            weights=FuzzerWeights(send=0.1, atomic_block=0.9),
+            message_gen=broadcast_send_generator(app),
+            prefix=prefix,
+        )
+        for seed in range(30):
+            prog = fuzzer.generate_fuzz_test(seed)
+            sanity_check_externals(prog)
+            generated = [
+                e for e in prog[len(prefix):]
+                if not (isinstance(e, WaitQuiescence) or isinstance(e, _Start))
+            ]
+            assert len(generated) <= num_events, (
+                f"num_events={num_events} seed={seed}: "
+                f"{len(generated)} generated events"
+            )
+
+
 def test_bridge_minimization_preserves_block_atomically():
     """The VERDICT's done-criterion: a real external process whose
     violation needs the arm+fire batch delivered as one unit. DDMin over

@@ -1,0 +1,325 @@
+"""Observability layer (demi_tpu/obs): registry semantics, snapshot
+merge, span nesting, Perfetto export validity, and device LaneStats
+agreement with host-side sweep accounting."""
+
+import json
+
+import numpy as np
+import pytest
+
+from demi_tpu import obs
+from demi_tpu.obs import spans as obs_spans
+
+
+@pytest.fixture
+def telemetry():
+    """Clean, enabled telemetry for one test; always restored to off."""
+    obs.REGISTRY.reset()
+    obs.TRACER.clear()
+    obs.enable()
+    yield
+    obs.disable()
+    obs.REGISTRY.reset()
+    obs.TRACER.clear()
+
+
+# ---------------------------------------------------------------------------
+# Metrics registry
+# ---------------------------------------------------------------------------
+
+def test_counter_gauge_histogram_semantics(telemetry):
+    c = obs.counter("t.count")
+    c.inc()
+    c.inc(4)
+    c.inc(2, app="raft")
+    assert c.value() == 5
+    assert c.value(app="raft") == 2
+    assert c.total() == 7
+
+    g = obs.gauge("t.gauge")
+    g.set(0.25)
+    g.set(0.75)  # last write wins
+    g.set(3, phase="b")
+    assert g.value() == 0.75
+    assert g.value(phase="b") == 3.0
+
+    h = obs.histogram("t.hist")
+    for v in (0.001, 0.002, 1.5):
+        h.observe(v)
+    assert h.count() == 3
+    assert h.sum() == pytest.approx(1.503)
+    snap = obs.REGISTRY.snapshot()
+    rec = snap["histograms"]["t.hist"][""]
+    assert sum(rec["buckets"]) == 3
+    assert rec["min"] == pytest.approx(0.001)
+    assert rec["max"] == pytest.approx(1.5)
+
+
+def test_metric_kind_conflict_raises(telemetry):
+    obs.counter("t.kind")
+    with pytest.raises(TypeError, match="already registered"):
+        obs.gauge("t.kind")
+
+
+def test_disabled_is_a_noop():
+    obs.REGISTRY.reset()
+    obs.TRACER.clear()
+    obs.disable()
+    obs.counter("t.off").inc(100)
+    obs.gauge("t.off.g").set(1)
+    obs.histogram("t.off.h").observe(1)
+    with obs.span("t.off.span"):
+        pass
+    assert obs.counter("t.off").total() == 0
+    assert obs.histogram("t.off.h").count() == 0
+    assert obs.TRACER.spans == []
+    obs.REGISTRY.reset()
+
+
+def test_snapshot_merge_round_trip(telemetry):
+    obs.counter("m.c").inc(3, k="a")
+    obs.gauge("m.g").set(0.5)
+    obs.histogram("m.h").observe(2.0)
+    snap = json.loads(json.dumps(obs.REGISTRY.snapshot()))  # JSON round trip
+
+    merged = obs.merge_snapshots(snap, snap)
+    assert merged["counters"]["m.c"]["k=a"] == 6
+    assert merged["gauges"]["m.g"][""] == 0.5
+    assert merged["histograms"]["m.h"][""]["count"] == 2
+    assert merged["histograms"]["m.h"][""]["sum"] == pytest.approx(4.0)
+    assert merged["histograms"]["m.h"][""]["max"] == pytest.approx(2.0)
+
+    # Loading into a fresh registry reproduces the totals.
+    reg = obs.MetricsRegistry()
+    reg.load(merged)
+    assert reg.snapshot() == merged
+
+
+# ---------------------------------------------------------------------------
+# Spans + Perfetto export
+# ---------------------------------------------------------------------------
+
+def _check_trace_events(events):
+    """B/E pairs must nest like a well-formed bracket sequence per tid,
+    and file order must be timestamp-monotonic."""
+    last_ts = -1
+    stacks = {}
+    for e in events:
+        assert e["ph"] in ("B", "E")
+        assert e["ts"] >= last_ts
+        last_ts = e["ts"]
+        stack = stacks.setdefault(e["tid"], [])
+        if e["ph"] == "B":
+            stack.append(e["name"])
+        else:
+            assert stack, f"E without matching B: {e}"
+            assert stack.pop() == e["name"]
+    for tid, stack in stacks.items():
+        assert stack == [], f"unclosed spans on tid {tid}: {stack}"
+
+
+def test_span_nesting_and_perfetto_export(telemetry, tmp_path):
+    with obs.span("outer", stage="x"):
+        assert obs_spans.current_depth() == 1
+        with obs.span("inner"):
+            assert obs_spans.current_depth() == 2
+        with obs.span("inner2"):
+            pass
+    assert obs_spans.current_depth() == 0
+    assert [s["name"] for s in obs.TRACER.spans] == ["inner", "inner2", "outer"]
+
+    out = tmp_path / "t.json"
+    obs.TRACER.export_perfetto(str(out))
+    doc = json.loads(out.read_text())
+    events = doc["traceEvents"]
+    assert len(events) == 6
+    _check_trace_events(events)
+    names = [e["name"] for e in events if e["ph"] == "B"]
+    assert names == ["outer", "inner", "inner2"]
+    # B events carry the span attributes.
+    outer_b = next(e for e in events if e["name"] == "outer" and e["ph"] == "B")
+    assert outer_b["args"] == {"stage": "x"}
+
+
+def test_span_error_annotation_and_jsonl(telemetry, tmp_path):
+    with pytest.raises(ValueError):
+        with obs.span("boom"):
+            raise ValueError("x")
+    assert obs.TRACER.spans[-1]["args"]["error"] == "ValueError"
+    path = tmp_path / "spans.jsonl"
+    obs.TRACER.write_jsonl(str(path))
+    lines = [json.loads(line) for line in path.read_text().splitlines()]
+    assert lines[-1]["name"] == "boom"
+
+
+def test_zero_width_spans_still_pair(telemetry):
+    # Sub-microsecond spans share begin/end timestamps; the export's
+    # operation-order tiebreak must still produce valid bracketing.
+    with obs.span("a"):
+        for _ in range(5):
+            with obs.span("z"):
+                pass
+    _check_trace_events(obs.TRACER.to_trace_events())
+
+
+# ---------------------------------------------------------------------------
+# Device LaneStats
+# ---------------------------------------------------------------------------
+
+def _small_sweep(telemetry_on: bool, mode: str):
+    from demi_tpu.apps.broadcast import (
+        broadcast_send_generator,
+        make_broadcast_app,
+    )
+    from demi_tpu.apps.common import dsl_start_events
+    from demi_tpu.device import DeviceConfig
+    from demi_tpu.fuzzing import Fuzzer, FuzzerWeights
+    from demi_tpu.parallel.sweep import SweepDriver
+
+    app = make_broadcast_app(3, reliable=False)
+    cfg = DeviceConfig.for_app(
+        app, pool_capacity=32, max_steps=48, max_external_ops=16,
+        invariant_interval=1,
+    )
+    fuzzer = Fuzzer(
+        num_events=6,
+        weights=FuzzerWeights(send=0.7, wait_quiescence=0.15),
+        message_gen=broadcast_send_generator(app),
+        prefix=dsl_start_events(app),
+    )
+    driver = SweepDriver(
+        app, cfg, lambda s: fuzzer.generate_fuzz_test(seed=s)
+    )
+    return driver.sweep(16, 8, mode=mode)
+
+
+def test_lane_stats_agree_with_sweep_results(telemetry):
+    result = _small_sweep(True, "chunked")
+    assert result.lanes == 16
+
+    def total(name):
+        return obs.counter(name).value(driver="sweep")
+
+    assert total("device.lane.lanes") == result.lanes
+    assert total("device.lane.violations") == result.violations
+    assert total("device.lane.overflow") == result.overflow_lanes
+    assert total("device.lane.done") == result.lanes - result.overflow_lanes
+    # Per-chunk unique counts upper-bound the cross-chunk dedup.
+    assert total("device.lane.unique_schedules") >= result.unique_schedules
+    assert total("device.lane.deliveries") > 0
+    # interval=1: one check per delivery plus one finalization per lane.
+    assert (
+        total("device.lane.invariant_checks")
+        == total("device.lane.deliveries") + total("device.lane.done")
+    )
+    assert obs.counter("device.kernel.lanes").value(kernel="explore") == 16
+
+
+def test_lane_stats_continuous_driver(telemetry):
+    result = _small_sweep(True, "continuous")
+
+    def total(name):
+        return obs.counter(name).value(driver="continuous")
+
+    assert total("device.lane.lanes") == result.lanes == 16
+    assert total("device.lane.violations") == result.violations
+    assert total("device.lane.overflow") == result.overflow_lanes
+    assert obs.counter("device.continuous.rounds").total() > 0
+    occ = obs.gauge("device.continuous.occupancy").value()
+    assert occ is not None and 0 < occ <= 1
+
+
+def test_reduce_lanes_masks_pad_lanes(telemetry):
+    from demi_tpu.device.core import ST_DONE, ST_OVERFLOW, ST_VIOLATION
+    from demi_tpu.obs import lane_stats as ls
+
+    status = np.asarray(
+        [ST_DONE, ST_VIOLATION, ST_OVERFLOW, ST_DONE], np.int32
+    )
+    violation = np.asarray([0, 7, 0, 0], np.int32)
+    deliveries = np.asarray([10, 5, 3, 99], np.int32)
+    stats = ls.reduce_lanes(
+        status, violation, deliveries, 3, invariant_interval=2
+    ).to_host()
+    assert stats == {
+        "lanes": 3,
+        "done": 2,
+        "violations": 1,
+        "overflow": 1,
+        "deliveries": 18,
+        # 10//2 + 5//2 + 3//2 interval checks + 2 finalizations
+        "invariant_checks": 5 + 2 + 1 + 2,
+    }
+
+
+def test_sweep_records_nothing_when_disabled():
+    obs.REGISTRY.reset()
+    obs.disable()
+    _small_sweep(False, "chunked")
+    snap = obs.REGISTRY.snapshot()
+    assert snap["counters"] == {}
+
+
+# ---------------------------------------------------------------------------
+# CLI surface
+# ---------------------------------------------------------------------------
+
+def test_cli_fuzz_trace_out_and_stats(tmp_path, capsys):
+    from demi_tpu.cli import main
+
+    obs.REGISTRY.reset()
+    obs.TRACER.clear()
+    exp = tmp_path / "exp"
+    exp.mkdir()
+    trace_path = tmp_path / "t.json"
+    try:
+        rc = main([
+            "fuzz", "--app", "broadcast", "--nodes", "3", "--bug",
+            "unreliable", "--max-executions", "50", "--max-messages", "96",
+            "-o", str(exp), "--trace-out", str(trace_path),
+        ])
+    finally:
+        obs.disable()
+    assert rc == 0
+
+    doc = json.loads(trace_path.read_text())
+    events = doc["traceEvents"]
+    _check_trace_events(events)
+    names = {e["name"] for e in events}
+    # The pipeline tiers are all on the timeline: fuzzer, scheduler,
+    # device sweep.
+    assert "fuzz.execution" in names
+    assert "scheduler.execute" in names
+    assert "device.sweep.chunk" in names
+    assert "fuzz.device_confirm" in names
+
+    # The experiment dir carries the registry snapshot...
+    snap = json.loads((exp / "obs_snapshot.json").read_text())
+    assert snap["counters"]["device.lane.lanes"]["driver=sweep"] > 0
+
+    # ...which `demi_tpu stats -e` prints...
+    capsys.readouterr()  # drain the fuzz command's output
+    rc = main(["stats", "-e", str(exp)])
+    assert rc == 0
+    printed = json.loads(capsys.readouterr().out)
+    assert printed["counters"]["fuzz.programs_generated"][""] >= 1
+    assert "device.lane.lanes" in printed["counters"]
+
+    # ...and `demi_tpu report` renders as a Telemetry section.
+    from demi_tpu.tools.report import render_report
+
+    text = render_report(str(exp))
+    assert "## Telemetry" in text
+    assert "device.lane.lanes" in text
+
+
+def test_cli_stats_merges_inputs(tmp_path, capsys):
+    from demi_tpu.cli import main
+
+    snap = {"counters": {"x": {"": 2}}, "gauges": {}, "histograms": {}}
+    a = tmp_path / "a.json"
+    a.write_text(json.dumps(snap))
+    rc = main(["stats", "-i", str(a), "-i", str(a)])
+    assert rc == 0
+    merged = json.loads(capsys.readouterr().out)
+    assert merged["counters"]["x"][""] == 4
