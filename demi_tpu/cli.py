@@ -76,6 +76,26 @@ def build_fuzzer(app: DSLApp, args) -> Fuzzer:
     )
 
 
+def _workload_discriminator(args) -> dict:
+    """Extra tuning-cache key fields beyond the static kernel shapes:
+    ``DSLApp.name`` is only the actor-name prefix ('n'/'r'/...), so two
+    workloads with the same shapes but different handlers (raft with and
+    without a seeded bug, reliable vs unreliable broadcast) would
+    otherwise collide on one cache entry and inherit each other's
+    calibrated rates."""
+    return {"workload": f"{args.app}:{args.bug or 'none'}"}
+
+
+def _autotune_requested(args) -> bool:
+    """``--autotune`` or ``DEMI_AUTOTUNE=1``. Process state is never
+    mutated: the commands thread the answer explicitly to everything
+    they build, so one --autotune ``main()`` call cannot leak autotuning
+    into later calls in the same process."""
+    from .tune import autotune_enabled
+
+    return bool(getattr(args, "autotune", False)) or autotune_enabled()
+
+
 def _obs_begin(args) -> bool:
     """Turn telemetry on when the run asked for an observability artifact
     (--trace-out / --stats-out; DEMI_OBS=1 enables it regardless)."""
@@ -151,16 +171,23 @@ def cmd_fuzz(args) -> int:
     confirm_sweep = bool(args.trace_out or args.stats_out)
     app = build_app(args)
     config = SchedulerConfig(invariant_check=make_host_invariant(app))
+    fuzzer = build_fuzzer(app, args)
+    controller = None
+    if _autotune_requested(args):
+        from .tune import ExplorationController
+
+        controller = ExplorationController(fuzzer)
     with obs.span("cli.fuzz", app=args.app, seed=args.seed):
         result = fuzz(
             config,
-            build_fuzzer(app, args),
+            fuzzer,
             max_executions=args.max_executions,
             seed=args.seed,
             max_messages=args.max_messages,
             invariant_check_interval=1,
             timer_weight=args.timer_weight,
             validate_replay=True,
+            controller=controller,
         )
         if confirm_sweep:
             confirm = _device_confirm_sweep(
@@ -170,6 +197,20 @@ def cmd_fuzz(args) -> int:
                 f"device {'confirm ' if result is not None else ''}sweep: "
                 f"{confirm.violations}/{confirm.lanes} lanes violate"
             )
+    if controller is not None:
+        weights = controller.final_weights()
+        print(
+            "autotune: "
+            + json.dumps(
+                {
+                    "rounds": controller.rounds,
+                    "weights": {
+                        k: round(v, 4) for k, v in (weights or {}).items()
+                        if v > 0
+                    },
+                }
+            )
+        )
     if result is None:
         _obs_end(args)
         print("no violation found")
@@ -294,6 +335,16 @@ def cmd_replay(args) -> int:
 def cmd_sweep(args) -> int:
     _obs_begin(args)
     if args.processes > 1:
+        if _autotune_requested(args):
+            # The weight loop and calibration run in THIS process; the
+            # distributed launcher's workers sweep in their own. Closing
+            # the loop across ranks is future work — say so rather than
+            # silently dropping the flag.
+            print(
+                "sweep: --autotune is single-process for now; ignoring it "
+                "for the distributed launcher",
+                file=sys.stderr,
+            )
         from .parallel.distributed import launch_distributed_sweep
 
         summary = launch_distributed_sweep(
@@ -331,14 +382,62 @@ def cmd_sweep(args) -> int:
         timer_weight=args.timer_weight,
     )
     fuzzer = build_fuzzer(app, args)
-    driver = SweepDriver(
-        app, cfg, lambda s: fuzzer.generate_fuzz_test(seed=args.seed + s)
-    )
-    # Default: lane-compacted continuous sweep (finished lanes are
-    # harvested and refilled at segment boundaries). --sweep-mode chunked
-    # launches fixed whole-batch kernels instead.
+    gen = lambda s: fuzzer.generate_fuzz_test(seed=args.seed + s)  # noqa: E731
     chunk = min(args.batch, getattr(args, "chunk", None) or args.batch)
-    result = driver.sweep(args.batch, chunk, mode=args.sweep_mode)
+    autotune_summary = None
+    if _autotune_requested(args):
+        # Closed loop: calibrate (variant, chunk) — cache hit skips the
+        # measurement reps entirely — then run chunked rounds with the
+        # fuzzer-weight bandit scoring each chunk's fresh fingerprints.
+        import jax
+
+        from .tune import (
+            ExplorationController,
+            TuningCache,
+            calibrate_sweep,
+            sweep_axes,
+        )
+
+        if args.sweep_mode == "continuous":
+            print(
+                "sweep: --autotune runs chunked rounds (per-chunk reward "
+                "attribution); ignoring --sweep-mode continuous",
+                file=sys.stderr,
+            )
+        platform = jax.devices()[0].platform
+        axes = sweep_axes(cfg, chunk, platform)
+        # Never calibrate a chunk the sweep can't run: the decision must
+        # describe the configuration that actually executes (and gets
+        # cached), so cap the axis at the sweep's own lane budget.
+        axes["chunk"] = [c for c in axes["chunk"] if c <= args.batch] or [
+            chunk
+        ]
+        decision = calibrate_sweep(
+            app, cfg, gen, chunk=chunk, platform=platform,
+            cache=TuningCache(), axes=axes,
+            extra_key=_workload_discriminator(args),
+        )
+        chunk = min(args.batch, int(decision.params.get("chunk", chunk)))
+        driver = SweepDriver(
+            app, cfg, gen, variant=decision.params.get("variant")
+        )
+        controller = ExplorationController(fuzzer)
+        result = driver.sweep_autotuned(args.batch, chunk, controller)
+        autotune_summary = {
+            "decision": decision.to_json(),
+            "rounds": controller.rounds,
+            "weights": {
+                k: round(v, 4)
+                for k, v in (controller.final_weights() or {}).items()
+                if v > 0
+            },
+        }
+    else:
+        driver = SweepDriver(app, cfg, gen)
+        # Default: lane-compacted continuous sweep (finished lanes are
+        # harvested and refilled at segment boundaries). --sweep-mode
+        # chunked launches fixed whole-batch kernels instead.
+        result = driver.sweep(args.batch, chunk, mode=args.sweep_mode)
     summary = {
         "lanes": result.lanes,
         "unique_schedules": result.unique_schedules,
@@ -346,9 +445,14 @@ def cmd_sweep(args) -> int:
         "codes": {str(c): n for c, n in result.codes.items()},
         "first_violating_seed": result.first_violating_seed,
         "overflow_lanes": result.overflow_lanes,
+        # Wall-clock aggregate (per-chunk seconds overlap under async
+        # dispatch; this one never double-counts).
+        "schedules_per_sec": round(result.schedules_per_sec_wall, 1),
     }
     if result.occupancy is not None:
         summary["occupancy"] = round(result.occupancy, 3)
+    if autotune_summary is not None:
+        summary["autotune"] = autotune_summary
     print(json.dumps(summary))
     _obs_end(args)
     return 0
@@ -373,21 +477,22 @@ def cmd_dpor(args) -> int:
         record_trace=True,
         record_parents=True,
     )
+    autotune = _autotune_requested(args)
     oracle = DeviceDPOROracle(
-        app, cfg, config, batch_size=args.batch, max_rounds=args.rounds
+        app, cfg, config, batch_size=args.batch, max_rounds=args.rounds,
+        autotune=autotune,
     )
     program = dsl_start_events(app) + [WaitQuiescence()]
     with obs.span("cli.dpor", app=args.app):
         trace = oracle.test(program, None)
-    print(
-        json.dumps(
-            {
-                "interleavings": oracle.last_interleavings,
-                "violation_found": trace is not None,
-                "deliveries": len(trace.deliveries()) if trace is not None else None,
-            }
-        )
-    )
+    summary = {
+        "interleavings": oracle.last_interleavings,
+        "violation_found": trace is not None,
+        "deliveries": len(trace.deliveries()) if trace is not None else None,
+    }
+    if autotune:
+        summary["autotune"] = oracle.tuner_summaries()
+    print(json.dumps(summary))
     _obs_end(args)
     return 0 if trace is not None else 1
 
@@ -539,6 +644,63 @@ def cmd_bridge_fuzz(args) -> int:
         return 1
 
 
+def cmd_tune(args) -> int:
+    """Calibrate the sweep schedule (kernel variant, chunk size) for a
+    workload and persist the decision to the tuning cache.
+
+    ``--dry-run`` resolves the candidate axes and prints any cached
+    decision WITHOUT launching a kernel — the smoke path CI exercises.
+    A second non-dry run of the same workload hits the cache and also
+    launches nothing (``source: "cached"``)."""
+    import jax
+
+    from .device import DeviceConfig
+    from .tune import TuningCache, calibrate_sweep, sweep_axes, workload_key
+
+    _obs_begin(args)
+    app = build_app(args)
+    cfg = DeviceConfig.for_app(
+        app,
+        pool_capacity=args.pool,
+        max_steps=args.max_messages,
+        max_external_ops=max(16, args.num_events + app.num_actors + 2),
+        invariant_interval=1,
+        timer_weight=args.timer_weight,
+    )
+    fuzzer = build_fuzzer(app, args)
+    gen = lambda s: fuzzer.generate_fuzz_test(seed=args.seed + s)  # noqa: E731
+    cache = TuningCache(args.cache)
+    platform = jax.devices()[0].platform
+    chunk = args.chunk or args.batch
+    if args.dry_run:
+        key = workload_key(
+            app.name, app.num_actors, cfg, platform, chunk=chunk,
+            **_workload_discriminator(args),
+        )
+        print(
+            json.dumps(
+                {
+                    "dry_run": True,
+                    "key": key,
+                    "axes": sweep_axes(cfg, chunk, platform),
+                    "cached": cache.get(key),
+                    "cache_path": cache.path,
+                }
+            )
+        )
+        _obs_end(args)
+        return 0
+    decision = calibrate_sweep(
+        app, cfg, gen, chunk=chunk, platform=platform, cache=cache,
+        reps=args.reps, extra_key=_workload_discriminator(args),
+    )
+    out = decision.to_json()
+    out["cache_path"] = cache.path
+    print(json.dumps(out))
+    _obs_end(args)
+    return 0
+
+
 def cmd_stats(args) -> int:
     """Print a metrics-registry snapshot.
 
@@ -640,9 +802,18 @@ def main(argv: Optional[list] = None) -> int:
                  "snapshot JSON (readable via `demi_tpu stats -i`)",
         )
 
+    def tune_flags(p):
+        p.add_argument(
+            "--autotune", action="store_true",
+            help="close the measurement feedback loop: adapt fuzzer "
+                 "weights / DPOR budgets / sweep shapes online from the "
+                 "obs counters (DEMI_AUTOTUNE=1 does the same)",
+        )
+
     p = sub.add_parser("fuzz", help="random fuzzing until a violation")
     common(p)
     obs_flags(p)
+    tune_flags(p)
     p.add_argument("--max-executions", type=int, default=200, dest="max_executions")
     p.add_argument("-o", "--output", default=None)
     p.set_defaults(fn=cmd_fuzz)
@@ -700,6 +871,7 @@ def main(argv: Optional[list] = None) -> int:
     )
     common(p)
     obs_flags(p)
+    tune_flags(p)
     p.add_argument("--batch", type=int, default=256)
     p.add_argument("--pool", type=int, default=256)
     p.add_argument(
@@ -726,10 +898,42 @@ def main(argv: Optional[list] = None) -> int:
     )
     common(p)
     obs_flags(p)
+    tune_flags(p)
     p.add_argument("--batch", type=int, default=64)
     p.add_argument("--pool", type=int, default=256)
     p.add_argument("--rounds", type=int, default=10)
     p.set_defaults(fn=cmd_dpor)
+
+    p = sub.add_parser(
+        "tune",
+        help="calibrate sweep kernel variant/chunk for a workload "
+             "(decision persisted to the tuning cache)",
+    )
+    common(p)
+    obs_flags(p)
+    p.add_argument("--batch", type=int, default=256)
+    p.add_argument("--pool", type=int, default=256)
+    p.add_argument(
+        "--chunk", type=int, default=None,
+        help="device batch size per launch to calibrate around "
+             "(default: --batch)",
+    )
+    p.add_argument(
+        "--reps", type=int, default=3,
+        help="timed reps per candidate (first rep is always an extra "
+             "dropped warm-up)",
+    )
+    p.add_argument(
+        "--cache", default=None, metavar="PATH",
+        help="tuning cache file (default: DEMI_TUNE_CACHE or "
+             "~/.cache/demi_tpu/tune.json)",
+    )
+    p.add_argument(
+        "--dry-run", action="store_true", dest="dry_run",
+        help="print candidate axes + any cached decision without "
+             "launching kernels",
+    )
+    p.set_defaults(fn=cmd_tune)
 
     p = sub.add_parser(
         "stats",

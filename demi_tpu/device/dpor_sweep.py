@@ -244,6 +244,7 @@ class DeviceDPOROracle:
         batch_size: int = 64,
         max_rounds: int = 20,
         initial_trace=None,
+        autotune: bool = False,
     ):
         self.app = app
         self.cfg = cfg
@@ -253,10 +254,27 @@ class DeviceDPOROracle:
         self.last_interleavings = 0
         self.initial_trace = initial_trace
         self.max_distance: Optional[int] = None
+        # Measurement-guided budget control: each resumable DPOR instance
+        # gets its own DporBudgetTuner (frontier dynamics are
+        # per-subsequence), fed by the per-round redundant/pruned counts.
+        self.autotune = autotune
         self._instances: Dict[Tuple, DeviceDPOR] = {}
 
     def set_initial_trace(self, trace) -> None:
         self.initial_trace = trace
+
+    def tuner_summaries(self) -> List[dict]:
+        """Public view of each resumable instance's budget-tuner state
+        (empty unless ``autotune=True``) — what the CLI reports."""
+        return [
+            {
+                "rounds": inst.tuner.rounds,
+                "round_batch": inst.tuner.round_batch,
+                "max_distance": inst.tuner.max_distance,
+            }
+            for inst in self._instances.values()
+            if inst.tuner is not None
+        ]
 
     def _instance(self, externals) -> DeviceDPOR:
         key = tuple(e.eid for e in externals)
@@ -269,8 +287,26 @@ class DeviceDPOROracle:
                         self.app, self.cfg, self.initial_trace, externals
                     )
                 )
+            if self.autotune:
+                from ..tune import DporBudgetTuner
+
+                inst.tuner = DporBudgetTuner(
+                    batch=self.batch_size, max_distance=self.max_distance
+                )
             self._instances[key] = inst
         inst.max_distance = self.max_distance
+        if inst.tuner is not None:
+            # The caller's budget (IncrementalDDMin's growing cap) is the
+            # floor; a tuner that widened past it keeps its wider budget.
+            inst.tuner.max_distance = (
+                self.max_distance
+                if inst.tuner.max_distance is None
+                else max_distance_union(
+                    inst.tuner.max_distance, self.max_distance
+                )
+            )
+            if inst.tuner.max_distance is not None:
+                inst.max_distance = inst.tuner.max_distance
         return inst
 
     def test(self, externals, violation_fingerprint, stats=None, init=None):
@@ -319,6 +355,13 @@ class DeviceDPOROracle:
             return None
         result.trace.set_original_externals(list(externals))
         return result.trace
+
+
+def max_distance_union(a: Optional[int], b: Optional[int]) -> Optional[int]:
+    """The looser of two edit-distance budgets (None = unbounded)."""
+    if a is None or b is None:
+        return None
+    return max(a, b)
 
 
 def steering_prescription(
@@ -410,6 +453,16 @@ class DeviceDPOR:
         self.original: Optional[Tuple] = None
         self.max_distance: Optional[int] = None
         self.interleavings = 0
+        # Measurement-guided budget control (demi_tpu/tune): when set, the
+        # tuner sees each round's fresh/redundant/pruned prescription
+        # counts and adjusts max_distance and round_batch online. The
+        # kernel batch stays compiled at batch_size; round_batch caps how
+        # many FRONTIER prescriptions are dispatched per round — surplus
+        # lanes run prescription-free random exploration, so a
+        # redundant-saturated frontier trades prescribed lanes for
+        # diversification instead of re-deriving known schedules.
+        self.tuner = None
+        self.round_batch = batch_size
 
     def seed(self, prescription: Tuple[Tuple[int, ...], ...]) -> None:
         """Plant an initial prescription at the head of the frontier (and
@@ -447,7 +500,8 @@ class DeviceDPOR:
             )
             rest.sort(key=len, reverse=True)
             frontier = head + rest
-            batch, frontier = frontier[: self.batch_size], frontier[self.batch_size :]
+            take = max(1, min(self.round_batch, self.batch_size))
+            batch, frontier = frontier[:take], frontier[take:]
             # Pad to a fixed batch size so the kernel compiles once; pad
             # lanes run prescription-free (fresh random exploration) and
             # their results feed the frontier like any other lane.
@@ -493,11 +547,16 @@ class DeviceDPOR:
                 if code != 0 and (target_code is None or code == target_code):
                     hit = (traces[lane], int(lens[lane]))
                     break
+            # Local fresh/redundant/pruned counts: the tuner's per-round
+            # signal, needed whether or not telemetry is on (the obs
+            # counters still carry the cross-round totals).
+            fresh_n = redundant_n = pruned_n = 0
             for lane in range(len(batch)):
                 for presc in racing_prescriptions(
                     traces[lane], int(lens[lane]), self.cfg.rec_width
                 ):
                     if presc in self.explored:
+                        redundant_n += 1
                         obs.counter("dpor.prescriptions_redundant").inc()
                         continue
                     if (
@@ -506,12 +565,22 @@ class DeviceDPOR:
                         and arvind_distance(presc, self.original)
                         > self.max_distance
                     ):
+                        pruned_n += 1
                         obs.counter("dpor.prescriptions_distance_pruned").inc()
                         continue
+                    fresh_n += 1
                     self.explored.add(presc)
                     frontier.append(presc)
             obs.gauge("dpor.frontier_size").set(len(frontier))
             obs.gauge("dpor.explored_set_size").set(len(self.explored))
+            if self.tuner is not None:
+                self.tuner.observe_round(
+                    fresh=fresh_n, redundant=redundant_n, pruned=pruned_n,
+                    frontier=len(frontier),
+                )
+                self.round_batch = self.tuner.round_batch
+                if self.tuner.max_distance is not None:
+                    self.max_distance = self.tuner.max_distance
             if hit is not None:
                 obs.counter("dpor.violations_found").inc()
                 self.frontier = frontier

@@ -337,6 +337,65 @@ def make_any_step_fn(app: DSLApp, cfg: DeviceConfig):
     return make_step_fn(app, cfg)
 
 
+#: The explore-kernel variant family: backend (xla | pallas) × lane axis
+#: (leading | '-trailing') × loop form ('-ee' = early-exit while_loop) ×
+#: delivery granularity ('-round' = round-delivery mode, whose invariant
+#: checks are round-granularity — semantics-preserving only when
+#: ``invariant_interval == 0``). These are the names bench.py measures
+#: and the autotuner (demi_tpu/tune) selects among.
+EXPLORE_VARIANTS: Tuple[str, ...] = (
+    "xla",
+    "xla-trailing",
+    "xla-ee",
+    "xla-trailing-ee",
+    "xla-round-ee",
+    "xla-trailing-round-ee",
+    "pallas",
+    "pallas-trailing",
+    "pallas-trailing-ee",
+)
+
+
+def variant_config(cfg: DeviceConfig, name: str) -> DeviceConfig:
+    """The DeviceConfig a variant name implies ('-ee' / '-round' are cfg
+    toggles; backend and lane axis are kernel-construction choices)."""
+    import dataclasses
+
+    overrides = {}
+    if name.endswith("-ee"):
+        overrides["early_exit"] = True
+    if "-round" in name:
+        overrides["round_delivery"] = True
+    return dataclasses.replace(cfg, **overrides) if overrides else cfg
+
+
+def make_explore_kernel_variant(
+    app: DSLApp, cfg: DeviceConfig, name: str, block_lanes: int = 256
+):
+    """Build the explore kernel for a named variant — ONE parser for the
+    variant grammar, shared by bench.py's measurement matrix and the
+    autotuner's calibration reps so the two can never mean different
+    kernels by the same name."""
+    base = name.split("-")[0]
+    if base not in ("xla", "pallas"):
+        raise ValueError(f"unknown explore variant {name!r}")
+    lane_axis = "trailing" if "-trailing" in name else "leading"
+    k_cfg = variant_config(cfg, name)
+    if base == "pallas":
+        from .pallas_explore import make_explore_kernel_pallas
+
+        # Launch telemetry parity with the XLA builds (which wrap inside
+        # make_explore_kernel): an unwrapped backend would read as zero
+        # launches next to populated lane counters.
+        return _counted_kernel(
+            make_explore_kernel_pallas(
+                app, k_cfg, block_lanes=block_lanes, lane_axis=lane_axis
+            ),
+            name,
+        )
+    return make_explore_kernel(app, k_cfg, lane_axis=lane_axis)
+
+
 def resolve_impl(impl: str, cfg: DeviceConfig, driver: str) -> str:
     """Backend selection rule shared by the sweep drivers: round mode is
     XLA-only (pallas_explore guard), and an env/arg-forced pallas must

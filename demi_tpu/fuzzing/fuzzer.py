@@ -11,7 +11,7 @@ clock, Fuzzer.scala:67 — fixed here for reproducibility).
 from __future__ import annotations
 
 import random as _random
-from dataclasses import dataclass
+from dataclasses import dataclass, fields
 from typing import Callable, List, Optional, Sequence
 
 from .. import obs
@@ -67,6 +67,20 @@ class FuzzerWeights:
     # minimized all-or-nothing, unignorable under STS replay.
     atomic_block: float = 0.0
 
+    def as_dict(self) -> dict:
+        """kind -> weight, in field order (the tuner's coordinate space)."""
+        return {f.name: getattr(self, f.name) for f in fields(self)}
+
+    @classmethod
+    def from_dict(cls, weights: dict) -> "FuzzerWeights":
+        """Inverse of ``as_dict``; unknown kinds are rejected so a tuner
+        typo can't silently drop a weight."""
+        known = {f.name for f in fields(cls)}
+        unknown = set(weights) - known
+        if unknown:
+            raise ValueError(f"unknown fuzzer weight kinds: {sorted(unknown)}")
+        return cls(**weights)
+
 
 class Fuzzer:
     def __init__(
@@ -98,6 +112,22 @@ class Fuzzer:
         # races (e.g. lost-vote-durability) are unreachable. The trailing
         # drain wait stays unlimited.
         self.wait_budget = wait_budget
+
+    def set_weights(self, weights: FuzzerWeights) -> None:
+        """Swap the choice weights at runtime (the autotune loop retunes
+        them between sweep rounds). ``generate_fuzz_test`` reads
+        ``self.weights`` per call, so the swap takes effect on the next
+        generated program; a given (weights, seed) pair always yields the
+        same program regardless of when the swap happened."""
+        total = sum(getattr(weights, f.name) for f in fields(FuzzerWeights))
+        if total <= 0:
+            raise ValueError("fuzzer weights must have a positive total")
+        self.weights = weights
+        if obs.enabled():
+            for f in fields(FuzzerWeights):
+                obs.gauge("fuzz.weight").set(
+                    getattr(weights, f.name), kind=f.name
+                )
 
     def generate_fuzz_test(self, seed: int) -> List[ExternalEvent]:
         rng = _random.Random(seed)

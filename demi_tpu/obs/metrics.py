@@ -89,6 +89,13 @@ class Gauge:
             return
         self.series[_label_key(labels)] = float(v)
 
+    def force_set(self, v: float, **labels) -> None:
+        """Record regardless of the telemetry switch — the same direct
+        series write ``load``/merge uses. For rare, load-bearing facts
+        that must reach every snapshot (e.g. autotune decisions: a run
+        that changed its own knobs must say so), never for hot paths."""
+        self.series[_label_key(labels)] = float(v)
+
     def value(self, **labels) -> Optional[float]:
         return self.series.get(_label_key(labels))
 

@@ -58,6 +58,11 @@ class SweepResult:
     # Lane-step occupancy of the sweep (continuous mode only): fraction of
     # scanned lane-steps spent on live lanes. Chunked sweeps leave it None.
     occupancy: Optional[float] = None
+    # Wall-clock seconds of the whole sweep, set by ``SweepDriver.sweep``
+    # / ``sweep_autotuned``. Per-chunk ``seconds`` overlap under async
+    # dispatch (each spans dispatch→harvest), so their sum double-counts
+    # overlapped time; this is the honest denominator for throughput.
+    wall_seconds: Optional[float] = None
 
     @property
     def lanes(self) -> int:
@@ -69,8 +74,21 @@ class SweepResult:
 
     @property
     def schedules_per_sec(self) -> float:
+        """Throughput from SUMMED per-chunk seconds. Only meaningful when
+        chunks never overlapped (strictly sequential harvesting); under
+        ``sweep_async`` double-buffering the sum double-counts wall time.
+        Prefer ``schedules_per_sec_wall``."""
         secs = sum(c.seconds for c in self.chunks)
         return self.lanes / secs if secs > 0 else 0.0
+
+    @property
+    def schedules_per_sec_wall(self) -> float:
+        """Wall-clock throughput (the number bench/report quote). Falls
+        back to the summed-seconds rate for results built chunk-by-chunk
+        outside the driver (no wall clock recorded)."""
+        if self.wall_seconds and self.wall_seconds > 0:
+            return self.lanes / self.wall_seconds
+        return self.schedules_per_sec
 
     @property
     def codes(self) -> dict:
@@ -112,31 +130,67 @@ class SweepDriver:
         program_gen: Callable[[int], Sequence[ExternalEvent]],
         mesh=None,
         use_mesh: bool = False,
+        variant: Optional[str] = None,
     ):
+        """``variant`` (an ``EXPLORE_VARIANTS`` name, e.g. the autotuner's
+        calibrated pick) selects the single-host kernel build: '-ee' /
+        '-round' fold into cfg, lane axis and backend into kernel
+        construction. Round variants coarsen invariant checks to round
+        granularity — callers pass them only when that is
+        semantics-preserving (``invariant_interval == 0``), which is the
+        rule the autotuner itself applies. None keeps the env-selected
+        backend (DEMI_DEVICE_IMPL) on the default build."""
+        from ..device.explore import resolve_impl, variant_config
+
+        if variant is not None:
+            cfg = variant_config(cfg, variant)
         self.app = app
         self.cfg = cfg
         self.program_gen = program_gen
-        from ..device.explore import resolve_impl
-
+        self.variant = variant
         impl = resolve_impl(
-            os.environ.get("DEMI_DEVICE_IMPL", "xla"), cfg, "SweepDriver"
+            variant.split("-")[0]
+            if variant is not None
+            else os.environ.get("DEMI_DEVICE_IMPL", "xla"),
+            cfg,
+            "SweepDriver",
         )
         self.impl = impl
+        # The mesh/pallas builds are wrapped in _counted_kernel here for
+        # launch-telemetry parity: make_explore_kernel (XLA) and
+        # make_explore_kernel_variant wrap their own, but the sharded
+        # and plain-pallas constructors don't.
+        from ..device.explore import _counted_kernel
+
         if use_mesh:
             self.mesh = mesh or make_mesh()
             if impl == "pallas":
                 from .mesh import shard_explore_kernel_pallas
 
-                self.kernel = shard_explore_kernel_pallas(app, cfg, self.mesh)
+                self.kernel = _counted_kernel(
+                    shard_explore_kernel_pallas(app, cfg, self.mesh),
+                    "explore-mesh-pallas",
+                )
             else:
-                self.kernel = shard_explore_kernel(app, cfg, self.mesh)
+                self.kernel = _counted_kernel(
+                    shard_explore_kernel(app, cfg, self.mesh),
+                    "explore-mesh",
+                )
             self._align = self.mesh.shape[LANES]
+        elif variant is not None:
+            from ..device.explore import make_explore_kernel_variant
+
+            self.mesh = None
+            self.kernel = make_explore_kernel_variant(app, cfg, variant)
+            self._align = 1
         else:
             self.mesh = None
             if impl == "pallas":
                 from ..device.pallas_explore import make_explore_kernel_pallas
 
-                self.kernel = make_explore_kernel_pallas(app, cfg)
+                self.kernel = _counted_kernel(
+                    make_explore_kernel_pallas(app, cfg), "explore-pallas"
+                )
             else:
                 self.kernel = make_explore_kernel(app, cfg)
             self._align = 1
@@ -275,6 +329,7 @@ class SweepDriver:
                 total_lanes, chunk_size, stop_on_violation
             )
         result = SweepResult()
+        t0 = time.perf_counter()
         seed = 0
         chunk_idx = 0
         while seed < total_lanes:
@@ -287,6 +342,7 @@ class SweepDriver:
             chunk_idx += 1
             if stop_on_violation and chunk.violations:
                 break
+        result.wall_seconds = time.perf_counter() - t0
         return result
 
     def _continuous_driver(self, batch: int, base_key: int = 0):
@@ -354,6 +410,49 @@ class SweepDriver:
         )
         result = SweepResult(chunks=[chunk])
         result.occupancy = drv.last_occupancy
+        # One chunk, harvested synchronously: its seconds ARE wall time.
+        result.wall_seconds = chunk.seconds
+        return result
+
+    def sweep_autotuned(
+        self,
+        total_lanes: int,
+        chunk_size: int,
+        controller,
+        base_key: int = 0,
+    ) -> SweepResult:
+        """Chunked sweep with the measurement-guided weight loop closed:
+        before each chunk the controller proposes fuzzer weights (the
+        chunk's programs are generated under them — ``_programs`` lowers
+        per chunk, so the swap takes effect immediately); on harvest the
+        chunk is scored by its NEW unique schedule fingerprints plus
+        violations (cross-chunk dedup lives in the controller).
+
+        Chunked on purpose: continuous refill interleaves programs from
+        many proposals in one segment, destroying reward attribution.
+        The round-trip per chunk is the price of a clean bandit signal.
+        """
+        result = SweepResult()
+        t0 = time.perf_counter()
+        seed = 0
+        while seed < total_lanes:
+            n = min(chunk_size, total_lanes - seed)
+            controller.begin_round()
+            chunk = self.run_chunk(
+                range(seed, seed + n), slice_index=0, base_key=base_key
+            )
+            controller.end_round(
+                hashes=(
+                    chunk.unique_hashes
+                    if chunk.unique_hashes is not None
+                    else ()
+                ),
+                violations=chunk.violations,
+                lanes=chunk.lanes,
+            )
+            result.chunks.append(chunk)
+            seed += n
+        result.wall_seconds = time.perf_counter() - t0
         return result
 
     def sweep_async(

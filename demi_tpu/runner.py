@@ -83,6 +83,22 @@ class GamutResult:
     stats: MinimizationStats = field(default_factory=MinimizationStats)
 
 
+def _trace_fingerprint(trace: EventTrace) -> int:
+    """Order-sensitive digest of a trace's delivered sequence — the host
+    analog of the device ``sched_hash`` the autotune reward dedups on."""
+    parts = []
+    for u in trace.deliveries():
+        ev = u.event
+        parts.append(
+            (
+                type(ev).__name__,
+                getattr(ev, "receiver", ""),
+                str(getattr(ev, "msg", "")),
+            )
+        )
+    return hash(tuple(parts))
+
+
 def fuzz(
     config: SchedulerConfig,
     fuzzer: Fuzzer,
@@ -92,11 +108,18 @@ def fuzz(
     invariant_check_interval: int = 0,
     timer_weight: float = 1.0,
     validate_replay: bool = False,
+    controller=None,
 ) -> Optional[FuzzResult]:
     """Generate fuzz tests and run them until a violation is found
     (reference: RunnerUtils.fuzz, RunnerUtils.scala:62-147). With
     ``validate_replay``, nondeterministic violations (those a strict replay
-    cannot reproduce) are discarded (RunnerUtils.scala:101-132)."""
+    cannot reproduce) are discarded (RunnerUtils.scala:101-132).
+
+    ``controller`` (a ``demi_tpu.tune.ExplorationController``) closes the
+    measurement loop on the host tier: each execution runs under proposed
+    fuzzer weights and is scored by whether its delivered sequence was new
+    (plus a violation bonus), so event kinds that keep finding fresh
+    schedules earn weight."""
     sched = RandomScheduler(
         config,
         seed=seed,
@@ -105,12 +128,20 @@ def fuzz(
         timer_weight=timer_weight,
     )
     for i in range(max_executions):
+        if controller is not None:
+            controller.begin_round()
         program = fuzzer.generate_fuzz_test(seed=seed + i)
         with obs.span("fuzz.execution", seed=seed + i) as sp:
             result = sched.execute(program)
             sp.set(deliveries=result.deliveries,
                    violation=result.violation is not None)
         obs.counter("fuzz.executions").inc()
+        if controller is not None:
+            controller.end_round(
+                hashes=[_trace_fingerprint(result.trace)],
+                violations=int(result.violation is not None),
+                lanes=1,
+            )
         if result.violation is None:
             continue
         obs.counter("fuzz.violations").inc()

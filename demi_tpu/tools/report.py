@@ -94,6 +94,22 @@ def render_report(directory: str, app=None) -> str:
     obs_snap = _load(directory, "obs_snapshot.json")
     if obs_snap:
         lines += ["", "## Telemetry", ""]
+        # Autotune decisions first (tune.* gauges): when the run adjusted
+        # its own knobs — fuzzer weights, DPOR budgets, sweep shapes —
+        # the report must lead with what was chosen, not bury it in the
+        # generic gauge table below.
+        tune_gauges = {
+            name: series
+            for name, series in obs_snap.get("gauges", {}).items()
+            if name.startswith("tune.")
+        }
+        if tune_gauges:
+            lines += ["### Tuning decisions", ""]
+            for name in sorted(tune_gauges):
+                for key, v in sorted(tune_gauges[name].items()):
+                    label = f" {key}" if key else ""
+                    lines.append(f"- `{name}`{label} = {v}")
+            lines.append("")
         counters = obs_snap.get("counters", {})
         if counters:
             lines += ["| counter | series | value |", "|---|---|---|"]

@@ -1,0 +1,52 @@
+"""demi_tpu.tune: measurement-guided exploration autotuning.
+
+The consumer of the ``demi_tpu.obs`` layer: per-round measurements
+(unique schedule fingerprints, violations, redundant / distance-pruned
+prescription counts, chunk timings) drive online adjustment of the
+explorer's knobs —
+
+  - fuzzer event-kind weights (``WeightTuner`` via
+    ``ExplorationController``, wired into sweep chunks and host fuzz);
+  - DeviceDPOR ``max_distance`` + frontier round batch
+    (``DporBudgetTuner``);
+  - sweep chunk size / segment length / explore-kernel variant
+    (``calibrate_sweep`` — short warm-up-dropped median reps, persisted
+    to a JSON ``TuningCache`` keyed by workload shape so a second run
+    warm-starts without re-calibrating).
+
+Everything is OFF by default: ``DEMI_AUTOTUNE=1`` (or ``--autotune`` on
+the CLI) turns the loop on; with it off, no tuned path runs and outputs
+are byte-identical to the untuned explorer.
+"""
+
+from .cache import TuningCache, default_cache_path, workload_key  # noqa: F401
+from .calibrate import (  # noqa: F401
+    SweepDecision,
+    calibrate_sweep,
+    coordinate_descent,
+    median_rate,
+    sweep_axes,
+)
+from .controller import (  # noqa: F401
+    DporBudgetTuner,
+    ExplorationController,
+    WeightTuner,
+    autotune_enabled,
+    record_decision,
+)
+
+__all__ = [
+    "DporBudgetTuner",
+    "ExplorationController",
+    "SweepDecision",
+    "TuningCache",
+    "WeightTuner",
+    "autotune_enabled",
+    "calibrate_sweep",
+    "coordinate_descent",
+    "default_cache_path",
+    "median_rate",
+    "record_decision",
+    "sweep_axes",
+    "workload_key",
+]

@@ -1,0 +1,121 @@
+"""Persistent tuning cache: calibration decisions keyed by workload shape.
+
+Calibration (tune/calibrate.py) costs real kernel launches — reps per
+candidate across the variant/chunk/segment axes. A decision is a pure
+function of the workload shape (app, actor count, static DeviceConfig
+fields) and the platform it was measured on, so a second run of the same
+workload should warm-start from the persisted decision instead of
+re-calibrating (the acceptance shape: calibrate once, amortize forever).
+
+One JSON file, read-modify-write whole: decisions are tiny (a dict of
+chosen knob values + per-candidate rates) and tuning runs are rare, so a
+flat file beats a real store. Location: ``DEMI_TUNE_CACHE`` or
+``~/.cache/demi_tpu/tune.json``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+from typing import Any, Dict, Optional
+
+
+def default_cache_path() -> str:
+    env = os.environ.get("DEMI_TUNE_CACHE")
+    if env:
+        return env
+    return os.path.join(
+        os.path.expanduser("~"), ".cache", "demi_tpu", "tune.json"
+    )
+
+
+def workload_key(
+    app_name: str,
+    num_actors: int,
+    cfg,
+    platform: str,
+    **extra: Any,
+) -> str:
+    """Stable cache key for one workload shape: the fields that change
+    which schedule wins (kernel shapes + platform), NOT per-run knobs like
+    seeds. ``cfg`` is a DeviceConfig (duck-typed: only the static shape
+    fields are read)."""
+    parts = {
+        "app": app_name,
+        "actors": num_actors,
+        "platform": platform,
+        "pool": cfg.pool_capacity,
+        "steps": cfg.max_steps,
+        "ext": cfg.max_external_ops,
+        "inv": cfg.invariant_interval,
+        "round": int(bool(cfg.round_delivery)),
+        "ee": int(bool(cfg.early_exit)),
+        "msg_dtype": str(getattr(cfg, "msg_dtype", "int32")),
+    }
+    parts.update(extra)
+    return ",".join(f"{k}={parts[k]}" for k in sorted(parts))
+
+
+class TuningCache:
+    """get/put of JSON-able decisions under workload keys, persisted to
+    one file. Corrupt or unreadable files degrade to an empty cache (a
+    stale cache must never break a run — worst case we re-calibrate)."""
+
+    def __init__(self, path: Optional[str] = None):
+        self.path = path or default_cache_path()
+        self._data: Optional[Dict[str, Any]] = None
+
+    def _load(self) -> Dict[str, Any]:
+        if self._data is None:
+            try:
+                with open(self.path) as f:
+                    data = json.load(f)
+                self._data = data if isinstance(data, dict) else {}
+            except (OSError, ValueError):
+                self._data = {}
+        return self._data
+
+    def get(self, key: str) -> Optional[Dict[str, Any]]:
+        entry = self._load().get(key)
+        return dict(entry) if isinstance(entry, dict) else None
+
+    def put(self, key: str, decision: Dict[str, Any]) -> None:
+        data = self._load()
+        data[key] = dict(decision)
+        # An unwritable path (read-only $HOME, locked-down CI) must not
+        # crash a run whose calibration already succeeded — same
+        # degrade-don't-break contract as the read path; the in-memory
+        # entry still serves this process, only persistence is lost.
+        try:
+            directory = os.path.dirname(self.path) or "."
+            os.makedirs(directory, exist_ok=True)
+            # Atomic replace: concurrent sweeps must not read a
+            # half-written cache (they'd silently fall back to
+            # re-calibration — correct but wasteful; never a torn JSON).
+            fd, tmp = tempfile.mkstemp(dir=directory, suffix=".tmp")
+            try:
+                with os.fdopen(fd, "w") as f:
+                    json.dump(data, f, indent=2, sort_keys=True)
+                os.replace(tmp, self.path)
+            except BaseException:
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+                raise
+        except OSError as e:
+            import sys
+
+            print(
+                f"demi_tpu.tune: cache not persisted to {self.path!r} "
+                f"({e}); this run keeps its decision in memory",
+                file=sys.stderr,
+            )
+
+    def clear(self) -> None:
+        self._data = {}
+        try:
+            os.unlink(self.path)
+        except OSError:
+            pass

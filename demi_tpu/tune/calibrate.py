@@ -1,0 +1,268 @@
+"""Sweep-shape calibration: pick (kernel variant, chunk size, segment
+length) from short measured reps, coordinate-descent style.
+
+BENCH_r05 shows the explore-kernel impl variants differ by ~10% on the
+same workload with the winner platform-dependent, and rep spread of ±15%
+— so calibration (a) drops the first warm-up rep and scores the median,
+and (b) walks one knob axis at a time (arXiv:2406.20037's
+coordinate-descent schedule search) instead of the full cross product:
+sum(len(axis)) measurements, not product.
+
+The measurement function is injectable: production uses a real chunked
+kernel launch per candidate; tests drive the same search logic with a
+synthetic rate table and zero device work.
+
+Decisions persist to the ``TuningCache`` keyed by workload shape +
+platform, so a second run of the same workload warm-starts: cache hit =
+no kernel launches at all.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+from .cache import TuningCache, workload_key
+from .controller import record_decision
+
+#: Knob axes walked in order. ``variant`` first: the impl choice shifts
+#: the whole rate curve, so later shape knobs should be tuned on the
+#: winning kernel.
+KNOB_ORDER = ("variant", "chunk", "seg")
+
+
+@dataclass
+class SweepDecision:
+    """One calibration outcome: chosen knob values + the evidence."""
+
+    params: Dict[str, Any]
+    rate: float  # schedules/sec of the chosen point (median rep)
+    source: str  # "calibrated" | "cached" | "default"
+    rates: Dict[str, float] = field(default_factory=dict)  # point -> rate
+    key: Optional[str] = None
+    calibration_seconds: float = 0.0
+
+    def to_json(self) -> Dict[str, Any]:
+        return {
+            "params": dict(self.params),
+            "rate": round(self.rate, 1),
+            "source": self.source,
+            "rates": {k: round(v, 1) for k, v in self.rates.items()},
+            "key": self.key,
+            "calibration_seconds": round(self.calibration_seconds, 2),
+        }
+
+    @classmethod
+    def from_json(cls, obj: Dict[str, Any], source: str) -> "SweepDecision":
+        return cls(
+            params=dict(obj.get("params", {})),
+            rate=float(obj.get("rate", 0.0)),
+            source=source,
+            rates=dict(obj.get("rates", {})),
+            key=obj.get("key"),
+        )
+
+
+def _point_key(params: Dict[str, Any]) -> str:
+    return ",".join(f"{k}={params[k]}" for k in sorted(params))
+
+
+def median_rate(rates: Sequence[float], drop_first: bool = True) -> float:
+    """Median of measured reps, first (warm-up) rep dropped when there is
+    anything left after dropping — the anti-±15%-spread rule bench.py and
+    calibration share."""
+    rs = list(rates)
+    if drop_first and len(rs) > 1:
+        rs = rs[1:]
+    if not rs:
+        return 0.0
+    rs.sort()
+    return rs[len(rs) // 2]
+
+
+def coordinate_descent(
+    axes: Dict[str, Sequence[Any]],
+    measure: Callable[[Dict[str, Any]], float],
+    start: Dict[str, Any],
+    order: Sequence[str] = KNOB_ORDER,
+) -> "tuple[Dict[str, Any], float, Dict[str, float]]":
+    """Walk each axis once, adopting the argmax with other knobs fixed at
+    their current best. Returns (best params, best rate, all measured
+    rates). Measurement failures (a variant that doesn't lower on this
+    backend) score 0 and lose naturally."""
+    current = dict(start)
+    rates: Dict[str, float] = {}
+    best_rate = 0.0
+
+    def score(params: Dict[str, Any]) -> float:
+        key = _point_key(params)
+        if key not in rates:
+            try:
+                rates[key] = float(measure(dict(params)))
+            except Exception:
+                rates[key] = 0.0
+        return rates[key]
+
+    best_rate = score(current)
+    for knob in order:
+        if knob not in axes or knob not in current:
+            continue
+        for value in axes[knob]:
+            if value == current[knob]:
+                continue
+            trial = dict(current)
+            trial[knob] = value
+            r = score(trial)
+            if r > best_rate:
+                best_rate = r
+                current = trial
+    return current, best_rate, rates
+
+
+def sweep_axes(
+    cfg, chunk: int, platform: str, continuous: bool = False
+) -> Dict[str, List[Any]]:
+    """Candidate axes for a sweep on this workload.
+
+    Variants are restricted to the semantics-preserving set: lane-axis
+    and early-exit change nothing observable; round-delivery coarsens
+    invariant checks to round granularity, so it is only a candidate
+    when ``invariant_interval == 0`` (checks only at quiescence — same
+    verdicts either way, the bench config-5 equivalence). Pallas is
+    excluded on CPU (interpret mode is an emulation, not a measurement).
+    The ``seg`` (segment length) axis only exists for continuous drivers;
+    a chunked launch has no segment knob."""
+    from ..device.explore import EXPLORE_VARIANTS
+
+    variants = [
+        v for v in EXPLORE_VARIANTS
+        if (cfg.invariant_interval == 0 or "-round" not in v)
+        and (platform != "cpu" or not v.startswith("pallas"))
+    ]
+    axes: Dict[str, List[Any]] = {
+        "variant": variants,
+        "chunk": sorted({max(8, chunk // 2), chunk, chunk * 2}),
+    }
+    if continuous:
+        axes["seg"] = sorted({
+            max(8, min(64, cfg.max_steps // 8)),
+            max(8, min(64, cfg.max_steps // 4)),
+            max(8, min(128, cfg.max_steps // 2)),
+        })
+    return axes
+
+
+def make_chunked_measure(
+    app, cfg, program_gen, *, reps: int = 3, base_key: int = 0
+):
+    """Real measurement for one candidate point: build the variant
+    kernel, run ``reps`` chunk-sized launches (first dropped as warm-up —
+    it carries compilation), return median lanes/sec. ``seg`` is ignored
+    here (a chunked launch has no segment knob); the axis only moves
+    rates for continuous drivers, whose measure fn callers supply."""
+    import numpy as np
+
+    import jax
+
+    from ..device.encoding import lower_program, stack_programs
+    from ..device.explore import make_explore_kernel_variant
+
+    kernels: Dict[str, Any] = {}
+    progs_by_chunk: Dict[int, Any] = {}
+
+    def measure(params: Dict[str, Any]) -> float:
+        chunk = int(params["chunk"])
+        variant = params["variant"]
+        kernel = kernels.get(variant)
+        if kernel is None:
+            kernel = kernels[variant] = make_explore_kernel_variant(
+                app, cfg, variant
+            )
+        progs = progs_by_chunk.get(chunk)
+        if progs is None:
+            progs = progs_by_chunk[chunk] = stack_programs(
+                [lower_program(app, cfg, program_gen(s)) for s in range(chunk)]
+            )
+        rates = []
+        for rep in range(reps + 1):  # +1: the dropped warm-up rep
+            keys = jax.vmap(
+                lambda s: jax.random.fold_in(
+                    jax.random.PRNGKey(base_key + rep), s
+                )
+            )(np.arange(chunk, dtype=np.uint32))
+            t0 = time.perf_counter()
+            res = kernel(progs, keys)
+            jax.block_until_ready(res.status)
+            rates.append(chunk / (time.perf_counter() - t0))
+        return median_rate(rates, drop_first=True)
+
+    return measure
+
+
+def calibrate_sweep(
+    app,
+    cfg,
+    program_gen=None,
+    *,
+    chunk: int,
+    platform: Optional[str] = None,
+    cache: Optional[TuningCache] = None,
+    measure: Optional[Callable[[Dict[str, Any]], float]] = None,
+    axes: Optional[Dict[str, Sequence[Any]]] = None,
+    reps: int = 3,
+    extra_key: Optional[Dict[str, Any]] = None,
+) -> SweepDecision:
+    """The calibration entry point: cache lookup, else coordinate-descent
+    over the candidate axes with measured reps, decision recorded in the
+    obs registry and persisted back to the cache."""
+    if platform is None:
+        import jax
+
+        platform = jax.devices()[0].platform
+    cache = cache or TuningCache()
+    key = workload_key(
+        app.name, app.num_actors, cfg, platform, chunk=chunk,
+        **(extra_key or {}),
+    )
+    cached = cache.get(key)
+    if cached is not None:
+        decision = SweepDecision.from_json(cached, source="cached")
+        decision.key = key
+        _record_sweep_decision(decision)
+        return decision
+
+    axes = dict(axes) if axes is not None else sweep_axes(cfg, chunk, platform)
+    defaults = {
+        "variant": "xla",
+        "chunk": chunk,
+        "seg": max(8, min(64, cfg.max_steps // 4)),
+    }
+    start = {knob: defaults.get(knob) for knob in axes}
+    for knob, candidates in axes.items():
+        if candidates and start.get(knob) not in candidates:
+            start[knob] = candidates[0]
+    if measure is None:
+        measure = make_chunked_measure(app, cfg, program_gen, reps=reps)
+    t0 = time.perf_counter()
+    params, rate, rates = coordinate_descent(axes, measure, start)
+    decision = SweepDecision(
+        params=params,
+        rate=rate,
+        source="calibrated",
+        rates=rates,
+        key=key,
+        calibration_seconds=time.perf_counter() - t0,
+    )
+    _record_sweep_decision(decision)
+    cache.put(key, decision.to_json())
+    return decision
+
+
+def _record_sweep_decision(decision: SweepDecision) -> None:
+    record_decision("sweep.variant", decision.params.get("variant", "xla"))
+    for knob in ("chunk", "seg"):
+        if knob in decision.params:
+            record_decision(f"sweep.{knob}", int(decision.params[knob]))
+    record_decision("sweep.rate", decision.rate)
+    record_decision("sweep.source", decision.source)

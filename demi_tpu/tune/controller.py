@@ -1,0 +1,316 @@
+"""Online exploration tuners: the obs→throughput feedback loop.
+
+PR 1 made every tier of the explorer measurable (registry counters,
+spans, per-round ``LaneStats``); this module is the consumer. Three
+tuners, one per knob family, all driven by per-round measurements and
+all safe to run with telemetry off:
+
+  - ``WeightTuner``: coordinate-descent over fuzzer event-kind weights
+    (the bandit arm = one kind nudged up or down), rewarding kinds whose
+    rounds yield new unique schedule fingerprints or violations — the
+    arXiv:2406.20037 shape (measure, nudge one coordinate, keep if
+    better) applied to program generation instead of kernel schedules.
+  - ``DporBudgetTuner``: adjusts DeviceDPOR ``max_distance`` and the
+    per-round frontier batch from the redundant / distance-pruned
+    prescription counters (the exploration-efficiency signals
+    parsimonious optimal DPOR, arXiv:2405.11128, names as primary).
+  - ``ExplorationController``: the sweep-round glue — proposes weights
+    before a chunk, scores it on harvest, and threads decisions into the
+    obs registry and the tuning cache.
+
+Decision recording writes registry series DIRECTLY (the documented
+merge path — ``MetricsRegistry.load`` does the same), so tuning
+decisions land in every snapshot even when the hot-path telemetry
+switch is off: a run that changed its own knobs must say so.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Dict, Optional, Sequence
+
+from .. import obs
+
+
+def autotune_enabled() -> bool:
+    """The env master switch, ``DEMI_AUTOTUNE=1``. The CLI ``--autotune``
+    flag does NOT set it (process state stays unmutated); commands thread
+    the flag explicitly to everything they build. Components that only
+    run standalone (bench's rehearsal drive) read this directly."""
+    return os.environ.get("DEMI_AUTOTUNE", "").strip().lower() in (
+        "1", "true", "yes", "on"
+    )
+
+
+def record_decision(name: str, value, **labels) -> None:
+    """Record a tuning decision into the process registry regardless of
+    the telemetry switch (``Gauge.force_set`` — decisions must reach
+    every snapshot: a run that changed its own knobs must say so).
+    Numeric values become gauges; strings become a ``=1`` gauge labeled
+    with the choice so snapshots stay numeric."""
+    gauge = obs.REGISTRY.gauge(f"tune.{name}")
+    if isinstance(value, (int, float)) and not isinstance(value, bool):
+        gauge.force_set(float(value), **labels)
+    else:
+        # One current choice per gauge: drop superseded choice= series
+        # so a re-decided run's snapshot can't list two contradictory
+        # picks (string gauges carry no other label dimensions).
+        gauge.series.clear()
+        gauge.force_set(1.0, **{**labels, "choice": str(value)})
+
+
+# ---------------------------------------------------------------------------
+# Fuzzer event-kind weights
+# ---------------------------------------------------------------------------
+
+@dataclass
+class _Coordinate:
+    kind: str
+    direction: float  # multiplicative factor for the next trial
+
+
+class WeightTuner:
+    """Coordinate-descent bandit over fuzzer event-kind weights.
+
+    Protocol (one round = one sweep chunk / fuzz batch):
+
+        trial = tuner.propose()        # weights dict for this round
+        ... run the round with trial ...
+        tuner.observe(reward)          # accept/revert, advance coordinate
+
+    Reward is normalized per lane by the caller (new unique fingerprints
+    + violation bonus). A trial is adopted only when it beats the
+    incumbent's running estimate by ``min_gain`` — a degenerate signal
+    (all-zero or flat rewards) therefore never moves the weights and the
+    defaults survive untouched (the fallback the tests pin)."""
+
+    def __init__(
+        self,
+        weights: Dict[str, float],
+        step: float = 1.6,
+        min_weight: float = 0.005,
+        max_weight: float = 4.0,
+        min_gain: float = 0.02,
+        ema: float = 0.5,
+    ):
+        # Only kinds the workload opted into are tuned: raising a zero
+        # weight would change the *language* of generated programs
+        # (e.g. enabling partitions on an app never fuzzed with them),
+        # not just the mix.
+        self.base = dict(weights)
+        self.current = {k: v for k, v in weights.items() if v > 0}
+        self.kinds = sorted(self.current)
+        self.step = step
+        self.min_weight = min_weight
+        self.max_weight = max_weight
+        self.min_gain = min_gain
+        self._ema = ema
+        self.baseline: Optional[float] = None  # incumbent reward estimate
+        self._pending: Optional[_Coordinate] = None
+        self._cursor = 0
+        self._directions = {k: step for k in self.kinds}
+        self.rounds = 0
+        self.accepted = 0
+
+    def weights(self) -> Dict[str, float]:
+        """Current incumbent weights, merged over the full base dict."""
+        out = dict(self.base)
+        out.update(self.current)
+        return out
+
+    def propose(self) -> Dict[str, float]:
+        """Weights for the next round. The first round (and every round
+        after an accept/revert) measures the incumbent or a one-kind
+        nudge, alternating so the baseline estimate stays fresh."""
+        if not self.kinds:
+            return dict(self.base)
+        if self.baseline is None or self.rounds % 2 == 0:
+            # Re-measure the incumbent: drifting workloads (later seeds
+            # explore different program regions) would otherwise let a
+            # stale baseline accept noise.
+            self._pending = None
+            return self.weights()
+        kind = self.kinds[self._cursor % len(self.kinds)]
+        self._pending = _Coordinate(kind, self._directions[kind])
+        trial = dict(self.current)
+        trial[kind] = min(
+            self.max_weight,
+            max(self.min_weight, trial[kind] * self._pending.direction),
+        )
+        out = dict(self.base)
+        out.update(trial)
+        return out
+
+    def observe(self, reward: float) -> None:
+        self.rounds += 1
+        pending, self._pending = self._pending, None
+        if pending is None:
+            # Incumbent round: fold into the baseline estimate.
+            if self.baseline is None:
+                self.baseline = reward
+            else:
+                self.baseline = (
+                    self._ema * reward + (1 - self._ema) * self.baseline
+                )
+            return
+        assert self.baseline is not None
+        kind = pending.kind
+        if reward > self.baseline + self.min_gain and reward > 0:
+            # Adopt the nudge, keep pushing the same direction.
+            self.current[kind] = min(
+                self.max_weight,
+                max(self.min_weight, self.current[kind] * pending.direction),
+            )
+            self.baseline = reward
+            self.accepted += 1
+            record_decision("fuzz.weight", self.current[kind], kind=kind)
+        else:
+            # Revert; try the opposite direction on this kind next visit.
+            self._directions[kind] = (
+                1.0 / self.step
+                if pending.direction >= 1.0
+                else self.step
+            )
+            self._cursor += 1
+
+
+# ---------------------------------------------------------------------------
+# DPOR budgets
+# ---------------------------------------------------------------------------
+
+class DporBudgetTuner:
+    """Per-round control of DeviceDPOR's ``max_distance`` and frontier
+    batch from the redundant / distance-pruned prescription counts.
+
+    Prescriptions derived from a round fall into three bins: *fresh*
+    (new frontier work), *redundant* (already explored — lanes spent
+    re-deriving known schedules), and *distance-pruned* (cut by the edit
+    -distance cap). The prescriptions the cap rejects are exactly the
+    parsimonious-DPOR signal that the budget, not the space, is the
+    binding constraint:
+
+      - pruned-heavy rounds widen ``max_distance`` (×2, bounded);
+      - fresh-starved redundant-heavy rounds halve the round batch
+        (don't burn a full frontier batch on a saturating search);
+      - fresh-rich rounds grow the round batch back toward the compiled
+        maximum (the kernel is padded to it anyway — use the lanes).
+    """
+
+    def __init__(
+        self,
+        batch: int,
+        max_distance: Optional[int] = None,
+        min_batch: int = 8,
+        max_distance_cap: int = 64,
+        pruned_threshold: float = 0.25,
+        redundant_threshold: float = 0.6,
+    ):
+        self.batch = batch
+        self.min_batch = min(min_batch, batch)
+        self.round_batch = batch
+        self.max_distance = max_distance
+        self.max_distance_cap = max_distance_cap
+        self.pruned_threshold = pruned_threshold
+        self.redundant_threshold = redundant_threshold
+        self.rounds = 0
+
+    def observe_round(
+        self, *, fresh: int, redundant: int, pruned: int, frontier: int
+    ) -> None:
+        self.rounds += 1
+        total = fresh + redundant + pruned
+        if total == 0:
+            return
+        if (
+            self.max_distance is not None
+            and pruned / total > self.pruned_threshold
+            and self.max_distance < self.max_distance_cap
+        ):
+            # max(1, ...): a zero budget (IncrementalDDMin's first
+            # distance rung) must still be widenable — 0*2 would pin it
+            # forever while claiming adjustments.
+            widened = min(
+                self.max_distance_cap, max(1, self.max_distance * 2)
+            )
+            if widened != self.max_distance:
+                self.max_distance = widened
+                record_decision("dpor.max_distance", self.max_distance)
+        if (
+            redundant / total > self.redundant_threshold
+            and fresh < self.round_batch // 4
+            and self.round_batch > self.min_batch
+        ):
+            self.round_batch = max(self.min_batch, self.round_batch // 2)
+            record_decision("dpor.round_batch", self.round_batch)
+        elif (
+            fresh >= self.round_batch // 2
+            and self.round_batch < self.batch
+        ):
+            self.round_batch = min(self.batch, self.round_batch * 2)
+            record_decision("dpor.round_batch", self.round_batch)
+
+
+# ---------------------------------------------------------------------------
+# Sweep-round controller (fuzzer weights over device sweeps)
+# ---------------------------------------------------------------------------
+
+class ExplorationController:
+    """The sweep-round feedback loop: before each chunk, propose fuzzer
+    weights; on harvest, reward the proposal by the chunk's NEW unique
+    schedule fingerprints (cross-chunk dedup — re-finding an old schedule
+    earns nothing) plus a violation bonus.
+
+    The controller owns the cross-round seen-hash set so reward
+    attribution is exact even though the sweep driver's own per-chunk
+    dedup is chunk-local."""
+
+    #: Reward weight of a violating lane vs one new unique schedule —
+    #: violations are the point of exploring, weigh them like a cluster
+    #: of new schedules.
+    VIOLATION_BONUS = 10.0
+
+    def __init__(self, fuzzer=None, weight_tuner: Optional[WeightTuner] = None):
+        self.fuzzer = fuzzer
+        if weight_tuner is None and fuzzer is not None:
+            weight_tuner = WeightTuner(fuzzer.weights.as_dict())
+        self.weight_tuner = weight_tuner
+        self.seen_hashes: set = set()
+        self.rounds = 0
+        self.last_reward: Optional[float] = None
+
+    def begin_round(self) -> None:
+        if self.fuzzer is None or self.weight_tuner is None:
+            return
+        proposal = self.weight_tuner.propose()
+        self.fuzzer.set_weights(
+            type(self.fuzzer.weights).from_dict(proposal)
+        )
+
+    def end_round(
+        self,
+        *,
+        hashes: Sequence[int] = (),
+        violations: int = 0,
+        lanes: int = 1,
+    ) -> float:
+        fresh = 0
+        for h in hashes:
+            h = int(h)
+            if h not in self.seen_hashes:
+                self.seen_hashes.add(h)
+                fresh += 1
+        reward = (fresh + self.VIOLATION_BONUS * violations) / max(lanes, 1)
+        self.rounds += 1
+        self.last_reward = reward
+        if self.weight_tuner is not None:
+            self.weight_tuner.observe(reward)
+        if obs.enabled():
+            obs.counter("tune.rounds").inc()
+            obs.histogram("tune.round_reward").observe(reward)
+        return reward
+
+    def final_weights(self) -> Optional[Dict[str, float]]:
+        if self.weight_tuner is None:
+            return None
+        return self.weight_tuner.weights()
