@@ -19,6 +19,9 @@ Extra keys reported for the record:
   - config4: BASELINE config 4 — Spark DAGScheduler fuzz sweep with the
     job-completion invariant on the seeded stale_task bug
     (schedules/sec + violations found).
+  - config6: prefix-fork vs scratch replay-trial throughput on a deep
+    raft internal-minimization level (fork speedup, prefix-hit rate,
+    steps_saved; DEMI_PREFIX_FORK-independent — both paths are measured).
   - config5: BASELINE config 5 — 64-actor reliable broadcast sweep
     (schedules/sec + lanes swept; 1M lanes on TPU, smaller on CPU
     fallback; override with DEMI_BENCH_CONFIG5_LANES). Runs in
@@ -29,8 +32,8 @@ Extra keys reported for the record:
   - platform: the JAX platform the numbers were measured on.
 
 Modes: `python bench.py` runs everything; `--config 2` / `--config 3` /
-`--config 4` / `--config 5` / `--config rehearsal` run a single section
-(same one-line JSON with that key populated).
+`--config 4` / `--config 5` / `--config 6` / `--config rehearsal` run a
+single section (same one-line JSON with that key populated).
 
 DEMI_AUTOTUNE=1 lets the measurement-guided tuner (demi_tpu/tune) pick
 the rehearsal drive's (kernel variant, batch, segment) from short
@@ -555,6 +558,103 @@ def bench_config5(jax, total_lanes=None):
     }
 
 
+def bench_config6(jax):
+    """Config 6: prefix-fork vs scratch trial throughput on a deep raft
+    internal-minimization level. The level's candidates (each omitting one
+    delivery from a recorded schedule) are identical up to the first
+    removed index — the prefix-fork sweet spot: the shared prefix replays
+    ONCE per first-divergence bucket on a trunk lane and the candidates
+    fork from the snapshot (device/fork.py). Scratch and fork verdicts are
+    bit-identical; the section reports the throughput ratio, prefix-hit
+    rate, and steps_saved. Depth/size knobs: DEMI_BENCH_CONFIG6_NODES /
+    _COMMANDS / _BUDGET / _CANDIDATES / _REPS."""
+    from demi_tpu.apps.common import dsl_start_events, make_host_invariant
+    from demi_tpu.apps.raft import T_CLIENT, make_raft_app
+    from demi_tpu.config import SchedulerConfig
+    from demi_tpu.device.batch_oracle import (
+        DeviceReplayChecker,
+        default_device_config,
+    )
+    from demi_tpu.external_events import MessageConstructor, Send, WaitQuiescence
+    from demi_tpu.minimization.internal import (
+        removable_delivery_indices,
+        remove_delivery,
+    )
+    from demi_tpu.schedulers import RandomScheduler
+
+    nodes = int(os.environ.get("DEMI_BENCH_CONFIG6_NODES", 3))
+    commands = int(os.environ.get("DEMI_BENCH_CONFIG6_COMMANDS", 3))
+    # Depth default measured on CPU: 192 deliveries -> ~1.85x fork
+    # speedup (the win grows with prefix length; 64 -> only ~1.3x).
+    budget = int(os.environ.get("DEMI_BENCH_CONFIG6_BUDGET", 192))
+    app = make_raft_app(nodes)
+    config = SchedulerConfig(invariant_check=make_host_invariant(app))
+    program = dsl_start_events(app) + [
+        Send(
+            app.actor_name(i % nodes),
+            MessageConstructor(lambda v=10 + i: (T_CLIENT, 0, v, 0, 0, 0, 0)),
+        )
+        for i in range(commands)
+    ] + [WaitQuiescence(budget=budget)]
+    # The recorded schedule to minimize: depth is what matters here (the
+    # win grows with prefix length), not a violation — replay trials cost
+    # the same either way.
+    result = RandomScheduler(
+        config, seed=0, max_messages=4 * budget, invariant_check_interval=1,
+        timer_weight=0.2,
+    ).execute(program)
+    trace = result.trace
+    trace.set_original_externals(list(program))
+    indices = removable_delivery_indices(trace)
+    cap = int(os.environ.get("DEMI_BENCH_CONFIG6_CANDIDATES", 0))
+    if cap:
+        indices = indices[:cap]
+    candidates = [remove_delivery(trace, i) for i in indices]
+    if len(candidates) < 2:  # pragma: no cover - fixture is delivery-rich
+        return {"error": "too few removable deliveries to measure"}
+    device_cfg = default_device_config(app, trace, program)
+    target = 1  # arbitrary: throughput does not depend on the verdict
+    reps = int(os.environ.get("DEMI_BENCH_CONFIG6_REPS", 3))
+    bucket = int(os.environ.get("DEMI_BENCH_CONFIG6_BUCKET", 8))
+    exts = [program] * len(candidates)
+
+    def measure(checker):
+        # Warm-up pass compiles the kernels (and, for the fork checker,
+        # populates the trunk cache — the steady state of consecutive
+        # internal-minimization rounds, which reuse trunks).
+        verdicts = checker.verdicts(candidates, exts, target)
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            verdicts = checker.verdicts(candidates, exts, target)
+        return len(candidates) * reps / (time.perf_counter() - t0), verdicts
+
+    scratch_rate, scratch_verdicts = measure(
+        DeviceReplayChecker(app, device_cfg, config, prefix_fork=False)
+    )
+    fork_checker = DeviceReplayChecker(
+        app, device_cfg, config, prefix_fork=True, fork_bucket=bucket
+    )
+    fork_rate, fork_verdicts = measure(fork_checker)
+    st = fork_checker.fork_stats
+    probes = st["prefix_hits"] + st["prefix_misses"]
+    return {
+        "app": f"raft{nodes}",
+        "deliveries": len(trace.deliveries()),
+        "candidates": len(candidates),
+        "reps": reps,
+        "scratch_trials_per_sec": round(scratch_rate, 1),
+        "fork_trials_per_sec": round(fork_rate, 1),
+        "speedup": round(fork_rate / scratch_rate, 2) if scratch_rate else None,
+        # Bit-exactness is the contract, so record it next to the rates.
+        "verdicts_match": scratch_verdicts == fork_verdicts,
+        "prefix_hit_rate": round(st["prefix_hits"] / probes, 3) if probes else 0.0,
+        "steps_saved": st["steps_saved"],
+        "forked_lanes": st["forked_lanes"],
+        "scratch_lanes": st["scratch_lanes"],
+        "fork_groups": st["groups"],
+    }
+
+
 def bench_config5_rehearsal(jax, total_lanes=None):
     """Config-5 machinery rehearsal at >=1e5 lanes (VERDICT r3 #6): the
     64-actor *reliable* flood runs ~1 lane/sec on CPU, so the full config
@@ -732,7 +832,7 @@ def bench_config5_rehearsal(jax, total_lanes=None):
 def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--config", default=None,
-                        help="run only one section: 2, 3, 4, 5, or "
+                        help="run only one section: 2, 3, 4, 5, 6, or "
                              "'rehearsal'")
     args = parser.parse_args()
     if args.config is not None and args.config != "rehearsal":
@@ -808,6 +908,16 @@ def main():
         out["vs_baseline"] = round(out["value"] / 10_000.0, 3)
         emit(out)
         return
+    if args.config == 6:
+        out["metric"] = (
+            "oracle trials/sec (prefix-fork internal-minimization level, raft)"
+        )
+        out["unit"] = "trials/sec"
+        out["config6"] = bench_config6(jax)
+        out["value"] = out["config6"].get("fork_trials_per_sec")
+        out["vs_baseline"] = round((out["value"] or 0) / 10_000.0, 3)
+        emit(out)
+        return
     if args.config == "rehearsal":
         out["metric"] = (
             "schedules/sec (config-5 machinery rehearsal, >=1e5 lanes)"
@@ -830,6 +940,7 @@ def main():
     config3 = bench_config3(jax)
     config4 = bench_config4(jax)
     config5 = bench_config5(jax)
+    config6 = bench_config6(jax)
     rehearsal = bench_config5_rehearsal(jax)
     out.update(
         {
@@ -855,6 +966,7 @@ def main():
             "config3": config3,
             "config4": config4,
             "config5": config5,
+            "config6": config6,
             "config5_rehearsal": rehearsal,
         }
     )

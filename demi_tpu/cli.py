@@ -247,6 +247,10 @@ def cmd_minimize(args) -> int:
     # The flag is authoritative: it must also override a pre-set
     # DEMI_DEVICE_IMPL in the caller's environment.
     os.environ["DEMI_DEVICE_IMPL"] = getattr(args, "impl", "xla")
+    if getattr(args, "prefix_fork", False):
+        # Same contract as --impl: the env switch is what the checker /
+        # DPOR constructors read, so the flag reaches every stage.
+        os.environ["DEMI_PREFIX_FORK"] = "1"
     from .runner import FuzzResult, print_minimization_stats, run_the_gamut
     from .serialization import ExperimentDeserializer, ExperimentSerializer
 
@@ -369,6 +373,8 @@ def cmd_sweep(args) -> int:
         return 0
 
     os.environ["DEMI_DEVICE_IMPL"] = getattr(args, "impl", "xla")
+    if getattr(args, "prefix_fork", False):
+        os.environ["DEMI_PREFIX_FORK"] = "1"
     from .device import DeviceConfig
     from .parallel.sweep import SweepDriver
 
@@ -453,6 +459,8 @@ def cmd_sweep(args) -> int:
         summary["occupancy"] = round(result.occupancy, 3)
     if autotune_summary is not None:
         summary["autotune"] = autotune_summary
+    if driver.fork_stats is not None:
+        summary["prefix_fork"] = driver.fork_stats
     print(json.dumps(summary))
     _obs_end(args)
     return 0
@@ -462,6 +470,8 @@ def cmd_dpor(args) -> int:
     """Systematic batched DPOR search (BASELINE config 2 shape)."""
     _obs_begin(args)
     os.environ["DEMI_DEVICE_IMPL"] = getattr(args, "impl", "xla")
+    if getattr(args, "prefix_fork", False):
+        os.environ["DEMI_PREFIX_FORK"] = "1"
     from .device import DeviceConfig
     from .device.dpor_sweep import DeviceDPOROracle
 
@@ -492,6 +502,8 @@ def cmd_dpor(args) -> int:
     }
     if autotune:
         summary["autotune"] = oracle.tuner_summaries()
+    if oracle.fork_stats is not None:
+        summary["prefix_fork"] = oracle.fork_stats
     print(json.dumps(summary))
     _obs_end(args)
     return 0 if trace is not None else 1
@@ -810,6 +822,15 @@ def main(argv: Optional[list] = None) -> int:
                  "obs counters (DEMI_AUTOTUNE=1 does the same)",
         )
 
+    def fork_flags(p):
+        p.add_argument(
+            "--prefix-fork", action="store_true", dest="prefix_fork",
+            help="prefix-fork replay: snapshot device state at shared-"
+                 "prefix branch points and fork lane batches instead of "
+                 "re-executing prefixes (bit-identical results; "
+                 "DEMI_PREFIX_FORK=1 does the same; off by default)",
+        )
+
     p = sub.add_parser("fuzz", help="random fuzzing until a violation")
     common(p)
     obs_flags(p)
@@ -825,6 +846,7 @@ def main(argv: Optional[list] = None) -> int:
     )
     common(p)
     obs_flags(p)
+    fork_flags(p)
     p.add_argument("-e", "--experiment", required=True)
     p.add_argument("--no-wildcards", action="store_true")
     p.add_argument(
@@ -872,6 +894,7 @@ def main(argv: Optional[list] = None) -> int:
     common(p)
     obs_flags(p)
     tune_flags(p)
+    fork_flags(p)
     p.add_argument("--batch", type=int, default=256)
     p.add_argument("--pool", type=int, default=256)
     p.add_argument(
@@ -899,6 +922,7 @@ def main(argv: Optional[list] = None) -> int:
     common(p)
     obs_flags(p)
     tune_flags(p)
+    fork_flags(p)
     p.add_argument("--batch", type=int, default=64)
     p.add_argument("--pool", type=int, default=256)
     p.add_argument("--rounds", type=int, default=10)

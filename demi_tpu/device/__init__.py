@@ -25,16 +25,34 @@ except Exception:  # pragma: no cover
 from .continuous import ContinuousSweepDriver
 from .core import DeviceConfig, ScheduleState
 from .explore import make_explore_kernel, make_single_lane_trace_kernel
+from .fork import (
+    PrefixCache,
+    PrefixPlanner,
+    PrefixSnapshot,
+    fork_lanes,
+    make_dpor_prefix_runner,
+    make_explore_prefix_runner,
+    make_replay_prefix_runner,
+    prefix_fork_enabled,
+)
 from .pallas_explore import make_explore_kernel_pallas, make_replay_kernel_pallas
 from .replay import make_replay_kernel
 
 __all__ = [
     "ContinuousSweepDriver",
     "DeviceConfig",
+    "PrefixCache",
+    "PrefixPlanner",
+    "PrefixSnapshot",
     "ScheduleState",
+    "fork_lanes",
+    "make_dpor_prefix_runner",
     "make_explore_kernel",
     "make_explore_kernel_pallas",
+    "make_explore_prefix_runner",
     "make_replay_kernel_pallas",
+    "make_replay_prefix_runner",
     "make_single_lane_trace_kernel",
     "make_replay_kernel",
+    "prefix_fork_enabled",
 ]

@@ -72,6 +72,8 @@ class DeviceReplayChecker:
         config: SchedulerConfig,
         impl: Optional[str] = None,
         mesh=None,
+        prefix_fork: Optional[bool] = None,
+        fork_bucket: int = 8,
     ):
         self.app = app
         self.cfg = cfg
@@ -103,6 +105,45 @@ class DeviceReplayChecker:
         else:
             self.kernel = make_replay_kernel(app, cfg)
         self.max_records = cfg.max_steps + cfg.max_external_ops
+        # Prefix-fork (device/fork.py, DEMI_PREFIX_FORK=1 / --prefix-fork):
+        # a level's candidates are identical up to the first removed index,
+        # so the shared prefix is replayed ONCE on a trunk lane and each
+        # first-divergence bucket forks from the (LRU-cached) snapshot —
+        # verdicts stay bit-identical to scratch replay.
+        from .fork import prefix_fork_enabled
+
+        self._forker = None
+        if prefix_fork_enabled(prefix_fork):
+            from .fork import PrefixForker, make_replay_prefix_runner
+
+            if impl == "pallas" and mesh is None:
+                import sys
+
+                print(
+                    "DeviceReplayChecker: prefix-fork trunk/fork lanes run "
+                    "on the XLA replay kernel (bit-identical verdicts)",
+                    file=sys.stderr,
+                )
+            if mesh is not None:
+                from ..parallel.mesh import shard_replay_kernel
+
+                self._fork_kernel = shard_replay_kernel(
+                    app, cfg, mesh, start_state=True
+                )
+            else:
+                self._fork_kernel = make_replay_kernel(
+                    app, cfg, start_state=True
+                )
+            self._forker = PrefixForker(
+                make_replay_prefix_runner(app, cfg),
+                bucket=fork_bucket,
+                driver="replay",
+            )
+
+    @property
+    def fork_stats(self) -> Optional[dict]:
+        """Prefix-fork statistics (None when forking is off)."""
+        return None if self._forker is None else self._forker.stats_view()
 
     def verdicts(
         self,
@@ -120,13 +161,29 @@ class DeviceReplayChecker:
                 for cand, ext in zip(candidates, externals_per_candidate)
             ]
         )
-        # Pad the batch axis to a power-of-two bucket: DDMin levels and
-        # removal rounds shrink the candidate count every iteration, and an
-        # unpadded batch would recompile the kernel per distinct size
-        # (profiled: a 150-delivery raft case spent ~4 min, ~100 compiles,
-        # in ONE internal stage). Padding rows replay candidate 0 again;
-        # their verdicts are sliced off.
         n = len(candidates)
+        with obs.span(
+            "device.replay_batch", candidates=n
+        ) as sp:
+            if self._forker is not None and n >= 2:
+                codes = self._forked_codes(records, n)
+            else:
+                codes = self._scratch_codes(records, n)
+            hits = sum(int(c) == target_code for c in codes)
+            sp.set(reproductions=hits)
+        if obs.enabled():
+            obs.counter("device.replay.candidates").inc(n)
+            obs.counter("device.replay.reproductions").inc(hits)
+        return [int(c) == target_code for c in codes]
+
+    def _scratch_codes(self, records: np.ndarray, n: int) -> np.ndarray:
+        """Replay ``records`` from step 0 and return per-lane violation
+        codes. Pads the batch axis to a power-of-two bucket: DDMin levels
+        and removal rounds shrink the candidate count every iteration, and
+        an unpadded batch would recompile the kernel per distinct size
+        (profiled: a 150-delivery raft case spent ~4 min, ~100 compiles,
+        in ONE internal stage). Padding rows replay candidate 0 again;
+        their verdicts are sliced off."""
         bucket = max(8, 1 << (n - 1).bit_length())
         if self.mesh is not None:
             from ..parallel.mesh import pad_batch_to_devices
@@ -137,18 +194,54 @@ class DeviceReplayChecker:
                 [records, np.repeat(records[:1], bucket - n, axis=0)]
             )
         keys = jax.random.split(jax.random.PRNGKey(0), bucket)
-        with obs.span(
-            "device.replay_batch", candidates=n, bucket=bucket
-        ) as sp:
-            res = self.kernel(records, keys)
-            codes = np.asarray(res.violation)[:n]
-            hits = sum(int(c) == target_code for c in codes)
-            sp.set(reproductions=hits)
+        res = self.kernel(records, keys)
         if obs.enabled():
-            obs.counter("device.replay.candidates").inc(n)
             obs.counter("device.replay.pad_lanes").inc(bucket - n)
-            obs.counter("device.replay.reproductions").inc(hits)
-        return [int(c) == target_code for c in codes]
+        return np.asarray(res.violation)[:n]
+
+    def _forked_codes(self, records: np.ndarray, n: int) -> np.ndarray:
+        """Prefix-fork verdicts: group candidates by bucketed shared
+        prefix, replay each group's trunk once (LRU-cached across calls —
+        consecutive ddmin levels and internal rounds share trunks), fork
+        the lanes over the remaining suffixes. Groups too small to
+        amortize a trunk fall back to the scratch kernel."""
+        from .fork import padded_size
+
+        lengths = (records[:, :, 0] != 0).sum(axis=1)
+        groups, scratch = self._forker.plan(records, lengths)
+        codes = np.zeros(n, np.int32)
+        r = records.shape[1]
+        for g in groups:
+            if not self._forker.should_fork(g):
+                scratch.extend(g.indices)
+                continue
+            p = g.prefix_len
+            trunk_records = np.zeros_like(records[0])
+            trunk_records[:p] = records[g.indices[0], :p]
+            snap, trunk_steps, hit = self._forker.trunk(
+                g.key, trunk_records, jax.random.PRNGKey(0)
+            )
+            suffixes = np.zeros(
+                (len(g.indices), r, records.shape[2]), np.int32
+            )
+            suffixes[:, : r - p] = records[g.indices, p:]
+            bucket = padded_size(len(g.indices), self.mesh)
+            if bucket > len(g.indices):
+                suffixes = np.concatenate(
+                    [suffixes, np.repeat(suffixes[:1], bucket - len(g.indices), axis=0)]
+                )
+            keys = jax.random.split(jax.random.PRNGKey(0), bucket)
+            res = self._fork_kernel(suffixes, keys, snap)
+            codes[np.asarray(g.indices)] = np.asarray(res.violation)[
+                : len(g.indices)
+            ]
+            self._forker.note_group(len(g.indices), trunk_steps, hit)
+        if scratch:
+            codes[np.asarray(scratch)] = self._scratch_codes(
+                records[np.asarray(scratch)], len(scratch)
+            )
+            self._forker.note_scratch(len(scratch))
+        return codes
 
     def host_executed_trace(
         self,

@@ -45,20 +45,15 @@ from .encoding import lower_program
 from .explore import ExtProgram, LaneResult, _finalize, make_step_fn
 
 
-def make_dpor_run_lane(app: DSLApp, cfg: DeviceConfig):
-    """Unjitted single-lane DPOR sweep ``run_lane(prog, prescription, key)
-    -> LaneResult`` (composable with vmap/jit by callers — the XLA kernel
-    below and the pallas twin in pallas_explore.py).
-    cfg must have record_trace and record_parents on.
-
-    Dispatch follows the prescription while records match (absent records
-    are skipped — divergence tolerance), then falls back to the explore
-    step's random choice."""
-    assert cfg.record_trace and cfg.record_parents
-    base_step = make_step_fn(app, cfg)
+def make_prescribed_dispatch(app: DSLApp, cfg: DeviceConfig):
+    """``prescribed_dispatch(state, presc, cursor) -> (state', cursor',
+    found)``: deliver the first matchable prescribed record at/after
+    ``cursor`` (skipping absent ones — divergence tolerance), with the
+    per-delivery invariant check. Shared by the lane step below and the
+    prefix-fork trunk runner (device/fork.py) so the two cannot drift."""
     big = jnp.int32(2**30)
     r_max = cfg.max_steps
-    recw = cfg.rec_width
+    oh = cfg.use_onehot
 
     def match_record(state: ScheduleState, rec):
         is_timer_rec = rec[0] == REC_TIMER
@@ -74,56 +69,77 @@ def make_dpor_run_lane(app: DSLApp, cfg: DeviceConfig):
         idx = jnp.argmin(seqs).astype(jnp.int32)
         return jnp.where(jnp.any(match), idx, jnp.int32(cfg.pool_capacity))
 
+    def prescribed_dispatch(state: ScheduleState, presc, cursor):
+        # Skip past absent prescribed records to the first matchable one.
+        def cond(c3):
+            c, idx, _ = c3
+            rec_kind = ops.get_scalar(
+                presc[:, 0], jnp.minimum(c, r_max - 1), oh
+            )
+            in_range = (c < r_max) & (
+                (rec_kind == REC_DELIVERY) | (rec_kind == REC_TIMER)
+            )
+            return in_range & (idx >= cfg.pool_capacity)
+
+        def body(c3):
+            c, _, skips = c3
+            idx = match_record(
+                state, ops.get_row(presc, jnp.minimum(c, r_max - 1), oh)
+            )
+            found = idx < cfg.pool_capacity
+            return (
+                jnp.where(found, c, c + 1),
+                idx,
+                skips + jnp.where(found, 0, 1),
+            )
+
+        c, idx, _ = jax.lax.while_loop(
+            cond, body, (cursor, jnp.int32(cfg.pool_capacity), jnp.int32(0))
+        )
+        found = idx < cfg.pool_capacity
+        new_state = deliver_index(state, cfg, app, idx)
+        # Per-delivery invariant checks apply during prefix replay too
+        # (transient violations — e.g. two-leaders healed by a later
+        # step-down — are exactly what DPOR prescribes its way into).
+        if cfg.invariant_interval:
+            code = jnp.where(
+                found, check_invariant(new_state, app), jnp.int32(0)
+            )
+            new_state = new_state._replace(
+                status=jnp.where(
+                    code != 0, jnp.int32(ST_VIOLATION), new_state.status
+                ),
+                violation=jnp.where(
+                    code != 0, code.astype(jnp.int32), new_state.violation
+                ),
+            )
+        return new_state, jnp.where(found, c + 1, c), found
+
+    return prescribed_dispatch
+
+
+def make_dpor_run_lane(app: DSLApp, cfg: DeviceConfig):
+    """Unjitted single-lane DPOR sweep ``run_lane(prog, prescription, key,
+    start_state=None) -> LaneResult`` (composable with vmap/jit by callers
+    — the XLA kernel below and the pallas twin in pallas_explore.py).
+    cfg must have record_trace and record_parents on.
+
+    Dispatch follows the prescription while records match (absent records
+    are skipped — divergence tolerance), then falls back to the explore
+    step's random choice. ``start_state`` (a device/fork.py
+    PrefixSnapshot) resumes from a trunk's state + committed cursor with
+    this lane's own rng; the default None keeps today's lowering
+    byte-identical."""
+    assert cfg.record_trace and cfg.record_parents
+    base_step = make_step_fn(app, cfg)
+    r_max = cfg.max_steps
+    recw = cfg.rec_width
+    prescribed_dispatch = make_prescribed_dispatch(app, cfg)
+
     def step(carry, presc, prog):
         state, cursor = carry
 
         oh = cfg.use_onehot
-
-        def prescribed_dispatch(state, cursor):
-            # Skip past absent prescribed records to the first matchable one.
-            def cond(c3):
-                c, idx, _ = c3
-                rec_kind = ops.get_scalar(
-                    presc[:, 0], jnp.minimum(c, r_max - 1), oh
-                )
-                in_range = (c < r_max) & (
-                    (rec_kind == REC_DELIVERY) | (rec_kind == REC_TIMER)
-                )
-                return in_range & (idx >= cfg.pool_capacity)
-
-            def body(c3):
-                c, _, skips = c3
-                idx = match_record(
-                    state, ops.get_row(presc, jnp.minimum(c, r_max - 1), oh)
-                )
-                found = idx < cfg.pool_capacity
-                return (
-                    jnp.where(found, c, c + 1),
-                    idx,
-                    skips + jnp.where(found, 0, 1),
-                )
-
-            c, idx, _ = jax.lax.while_loop(
-                cond, body, (cursor, jnp.int32(cfg.pool_capacity), jnp.int32(0))
-            )
-            found = idx < cfg.pool_capacity
-            new_state = deliver_index(state, cfg, app, idx)
-            # Per-delivery invariant checks apply during prefix replay too
-            # (transient violations — e.g. two-leaders healed by a later
-            # step-down — are exactly what DPOR prescribes its way into).
-            if cfg.invariant_interval:
-                code = jnp.where(
-                    found, check_invariant(new_state, app), jnp.int32(0)
-                )
-                new_state = new_state._replace(
-                    status=jnp.where(
-                        code != 0, jnp.int32(ST_VIOLATION), new_state.status
-                    ),
-                    violation=jnp.where(
-                        code != 0, code.astype(jnp.int32), new_state.violation
-                    ),
-                )
-            return new_state, jnp.where(found, c + 1, c), found
 
         in_dispatch = state.status == ST_DISPATCH
         rec_kind = ops.get_scalar(
@@ -135,7 +151,9 @@ def make_dpor_run_lane(app: DSLApp, cfg: DeviceConfig):
 
         def with_prescription(args):
             state, cursor = args
-            new_state, new_cursor, found = prescribed_dispatch(state, cursor)
+            new_state, new_cursor, found = prescribed_dispatch(
+                state, presc, cursor
+            )
             # If nothing in the prescription matched, fall back to the
             # normal (random) step from the ORIGINAL state.
             fell_back = ~found
@@ -154,15 +172,35 @@ def make_dpor_run_lane(app: DSLApp, cfg: DeviceConfig):
         )
         return (state, cursor), None
 
-    def run_lane(prog: ExtProgram, presc, key) -> LaneResult:
-        state = init_state(app, cfg, key)
+    def run_lane(prog: ExtProgram, presc, key, start_state=None) -> LaneResult:
+        if start_state is None:
+            state = init_state(app, cfg, key)
+            cursor0 = jnp.int32(0)
+            (state, _cursor), _ = jax.lax.scan(
+                lambda carry, _: step(carry, presc, prog),
+                (state, cursor0), None, length=cfg.max_steps,
+            )
+        else:
+            # Forked lane: the trunk delivered the shared-prefix records
+            # (rng untouched — prescribed dispatch never splits it), so
+            # resuming with this lane's key and the remaining step budget
+            # is bit-identical to a scratch lane. Frozen lanes' steps are
+            # no-ops, so the while_loop matches the fixed-length scan.
+            state = start_state.state._replace(rng=key)
 
-        def body(carry, _):
-            return step(carry, presc, prog)
+            def cond(carry):
+                (s, _cur), i = carry
+                return (s.status < ST_DONE) & (i < cfg.max_steps)
 
-        (state, _cursor), _ = jax.lax.scan(
-            body, (state, jnp.int32(0)), None, length=cfg.max_steps
-        )
+            def body(carry):
+                sc, i = carry
+                sc, _ = step(sc, presc, prog)
+                return sc, i + 1
+
+            (state, _cursor), _ = jax.lax.while_loop(
+                cond, body,
+                ((state, start_state.cursor), start_state.steps),
+            )
         state = jax.lax.cond(
             state.status < ST_DONE, lambda s: _finalize(s, app, cfg), lambda s: s, state
         )
@@ -178,10 +216,20 @@ def make_dpor_run_lane(app: DSLApp, cfg: DeviceConfig):
     return run_lane
 
 
-def make_dpor_kernel(app: DSLApp, cfg: DeviceConfig):
+def make_dpor_kernel(app: DSLApp, cfg: DeviceConfig, start_state: bool = False):
     """jitted ``kernel(progs[B], prescriptions[B, R, recw], keys[B]) ->
-    LaneResult[B]`` (see make_dpor_run_lane)."""
-    return jax.jit(jax.vmap(make_dpor_run_lane(app, cfg)))
+    LaneResult[B]`` (see make_dpor_run_lane). ``start_state=True`` adds a
+    fourth argument — a device/fork.py PrefixSnapshot broadcast across the
+    lane axis — resuming the whole batch from one trunk's state."""
+    run_lane = make_dpor_run_lane(app, cfg)
+    if not start_state:
+        return jax.jit(jax.vmap(run_lane))
+    return jax.jit(
+        jax.vmap(
+            lambda prog, presc, key, snap: run_lane(prog, presc, key, snap),
+            in_axes=(0, 0, 0, None),
+        )
+    )
 
 
 # ---------------------------------------------------------------------------
@@ -245,6 +293,7 @@ class DeviceDPOROracle:
         max_rounds: int = 20,
         initial_trace=None,
         autotune: bool = False,
+        prefix_fork: Optional[bool] = None,
     ):
         self.app = app
         self.cfg = cfg
@@ -253,6 +302,7 @@ class DeviceDPOROracle:
         self.max_rounds = max_rounds
         self.last_interleavings = 0
         self.initial_trace = initial_trace
+        self.prefix_fork = prefix_fork
         self.max_distance: Optional[int] = None
         # Measurement-guided budget control: each resumable DPOR instance
         # gets its own DporBudgetTuner (frontier dynamics are
@@ -262,6 +312,23 @@ class DeviceDPOROracle:
 
     def set_initial_trace(self, trace) -> None:
         self.initial_trace = trace
+
+    @property
+    def fork_stats(self) -> Optional[dict]:
+        """Aggregate prefix-fork statistics across the resumable
+        instances (None when forking is off) — what the CLI reports."""
+        stats = [
+            inst._forker.stats_view()
+            for inst in self._instances.values()
+            if inst._forker is not None
+        ]
+        if not stats:
+            return None
+        out: Dict[str, int] = {}
+        for s in stats:
+            for k, v in s.items():
+                out[k] = out.get(k, 0) + v
+        return out
 
     def tuner_summaries(self) -> List[dict]:
         """Public view of each resumable instance's budget-tuner state
@@ -280,7 +347,10 @@ class DeviceDPOROracle:
         key = tuple(e.eid for e in externals)
         inst = self._instances.get(key)
         if inst is None:
-            inst = DeviceDPOR(self.app, self.cfg, externals, self.batch_size)
+            inst = DeviceDPOR(
+                self.app, self.cfg, externals, self.batch_size,
+                prefix_fork=self.prefix_fork,
+            )
             if self.initial_trace is not None:
                 inst.seed(
                     steering_prescription(
@@ -410,6 +480,8 @@ class DeviceDPOR:
         batch_size: int = 64,
         impl: Optional[str] = None,
         mesh=None,
+        prefix_fork: Optional[bool] = None,
+        fork_bucket: int = 8,
     ):
         assert cfg.record_trace and cfg.record_parents
         self.app = app
@@ -447,6 +519,39 @@ class DeviceDPOR:
             self.kernel = make_dpor_kernel(app, cfg)
         self.prog = lower_program(app, cfg, list(program))
         self.batch_size = batch_size
+        # Prefix-fork (device/fork.py, DEMI_PREFIX_FORK=1 / --prefix-fork):
+        # frontier prescriptions grouped by shared prefix; each group
+        # resumes from a (LRU-cached) trunk snapshot instead of replaying
+        # the prefix per lane. Per-lane keys are assigned by batch
+        # position on both paths, so round results are bit-identical.
+        from .fork import prefix_fork_enabled
+
+        self._forker = None
+        if prefix_fork_enabled(prefix_fork):
+            from .fork import PrefixForker, make_dpor_prefix_runner
+
+            if impl == "pallas" and mesh is None:
+                import sys
+
+                print(
+                    "DeviceDPOR: prefix-fork trunk/fork lanes run on the "
+                    "XLA DPOR kernel (bit-identical semantics)",
+                    file=sys.stderr,
+                )
+            if mesh is None:
+                self._fork_kernel = make_dpor_kernel(app, cfg, start_state=True)
+            else:
+                from ..parallel.mesh import shard_dpor_kernel
+
+                self._fork_kernel = shard_dpor_kernel(
+                    app, cfg, mesh, start_state=True
+                )
+            self._forker = PrefixForker(
+                make_dpor_prefix_runner(app, cfg),
+                bucket=fork_bucket,
+                driver="dpor",
+            )
+        self._mesh = mesh
         self.explored: Set[Tuple] = set()
         self.frontier: List[Tuple] = [tuple()]
         self.explored.add(tuple())
@@ -480,6 +585,77 @@ class DeviceDPOR:
                 out[k, t] = rec
         return out
 
+    def _progs(self, b: int) -> ExtProgram:
+        return ExtProgram(
+            op=np.broadcast_to(self.prog.op, (b,) + np.asarray(self.prog.op).shape),
+            a=np.broadcast_to(self.prog.a, (b,) + np.asarray(self.prog.a).shape),
+            b=np.broadcast_to(self.prog.b, (b,) + np.asarray(self.prog.b).shape),
+            msg=np.broadcast_to(self.prog.msg, (b,) + np.asarray(self.prog.msg).shape),
+        )
+
+    def _launch_round(self, prescs: np.ndarray, keys, batch: List[Tuple]):
+        """One frontier round's lane work, harvested to LaneResult arrays.
+
+        Scratch mode: one whole-batch kernel launch. Prefix-fork mode:
+        prescriptions grouped by bucketed shared prefix (PrefixPlanner);
+        each group resumes from a cached trunk snapshot via the
+        ``start_state=`` kernel, everything else (prescription-free pads
+        included) runs the scratch kernel. Per-lane keys follow batch
+        position on both paths, so per-lane results are bit-identical."""
+        if self._forker is None or len(batch) < 2:
+            res = self.kernel(self._progs(len(batch)), prescs, keys)
+            jax.block_until_ready(res.violation)
+            return res
+        from .fork import padded_size
+
+        keys = np.asarray(keys)
+        lengths = np.asarray([len(p) for p in batch])
+        groups, scratch = self._forker.plan(prescs, lengths)
+        parts: List[Tuple[List[int], LaneResult]] = []
+
+        for g in groups:
+            if not self._forker.should_fork(g):
+                scratch.extend(g.indices)
+                continue
+            trunk_presc = np.zeros_like(prescs[0])
+            trunk_presc[: g.prefix_len] = prescs[g.indices[0], : g.prefix_len]
+            snap, trunk_steps, hit = self._forker.trunk(
+                g.key,
+                ExtProgram(*(np.asarray(x) for x in self.prog)),
+                trunk_presc,
+                jax.random.PRNGKey(0),
+            )
+            full = g.indices + [g.indices[0]] * (
+                padded_size(len(g.indices), self._mesh) - len(g.indices)
+            )
+            res_g = self._fork_kernel(
+                self._progs(len(full)), prescs[full], keys[full], snap
+            )
+            parts.append((g.indices, res_g))
+            self._forker.note_group(len(g.indices), trunk_steps, hit)
+            obs.histogram("dpor.prefix_group_size").observe(len(g.indices))
+        if scratch:
+            full = scratch + [scratch[0]] * (
+                padded_size(len(scratch), self._mesh) - len(scratch)
+            )
+            res_s = self.kernel(self._progs(len(full)), prescs[full], keys[full])
+            parts.append((scratch, res_s))
+            self._forker.note_scratch(len(scratch))
+        # Merge the parts back into batch order (np arrays quack like the
+        # LaneResult the harvesting loops read).
+        b = len(batch)
+        merged = {}
+        for field in LaneResult._fields:
+            ref = np.asarray(getattr(parts[0][1], field))
+            merged[field] = np.zeros((b,) + ref.shape[1:], ref.dtype)
+        for idx, res in parts:
+            jax.block_until_ready(res.violation)
+            for field in LaneResult._fields:
+                merged[field][np.asarray(idx)] = np.asarray(
+                    getattr(res, field)
+                )[: len(idx)]
+        return LaneResult(**merged)
+
     def explore(
         self, target_code: Optional[int] = None, max_rounds: int = 20
     ) -> Optional[Tuple[np.ndarray, int]]:
@@ -507,20 +683,13 @@ class DeviceDPOR:
             # their results feed the frontier like any other lane.
             batch = batch + [tuple()] * (self.batch_size - len(batch))
             prescs = self._pack(batch)
-            progs = ExtProgram(
-                op=np.broadcast_to(self.prog.op, (len(batch),) + self.prog.op.shape),
-                a=np.broadcast_to(self.prog.a, (len(batch),) + self.prog.a.shape),
-                b=np.broadcast_to(self.prog.b, (len(batch),) + self.prog.b.shape),
-                msg=np.broadcast_to(self.prog.msg, (len(batch),) + self.prog.msg.shape),
-            )
             keys = jax.vmap(
                 lambda s: jax.random.fold_in(jax.random.PRNGKey(0), s)
             )(np.arange(self.interleavings, self.interleavings + len(batch), dtype=np.uint32))
             with obs.span(
                 "dpor.round", batch=len(batch), frontier=len(frontier)
             ):
-                res = self.kernel(progs, prescs, keys)
-                jax.block_until_ready(res.violation)
+                res = self._launch_round(prescs, keys, batch)
             self.interleavings += len(batch)
             if obs.enabled():
                 # Device-lane totals for the round (one on-device

@@ -425,12 +425,24 @@ def make_run_lane(app: DSLApp, cfg: DeviceConfig):
     trace kernel (the pair whose agreement the device→host lift relies on)."""
     step = make_any_step_fn(app, cfg)
 
-    def run_lane(prog: ExtProgram, key) -> LaneResult:
-        state = init_state(app, cfg, key)
+    def run_lane(prog: ExtProgram, key, start_state=None) -> LaneResult:
+        if start_state is None:
+            state = init_state(app, cfg, key)
+            i0 = jnp.int32(0)
+        else:
+            # Forked lane (device/fork.py): resume from the trunk's
+            # snapshot with this lane's own rng. The trunk only ran
+            # injection steps, which never consume rng, so the resumed
+            # stream is bit-identical to a scratch lane's with this key.
+            state = start_state.state._replace(rng=key)
+            i0 = start_state.steps
 
-        if cfg.early_exit:
+        if cfg.early_exit or start_state is not None:
             # Under vmap the cond is OR-reduced across the batch: the loop
-            # runs only as long as some lane is still live.
+            # runs only as long as some lane is still live. (Forked lanes
+            # always take this form — their remaining budget is dynamic —
+            # and a frozen lane's step is a bit-exact no-op, so the result
+            # matches the fixed-length scan.)
             def cond(carry):
                 s, i = carry
                 return (s.status < ST_DONE) & (i < cfg.max_steps)
@@ -440,7 +452,7 @@ def make_run_lane(app: DSLApp, cfg: DeviceConfig):
                 return step(s, prog), i + 1
 
             state, _ = jax.lax.while_loop(
-                cond, wl_body, (state, jnp.int32(0))
+                cond, wl_body, (state, i0)
             )
         else:
             def body(state, _):
@@ -465,7 +477,12 @@ def make_run_lane(app: DSLApp, cfg: DeviceConfig):
     return run_lane
 
 
-def make_explore_kernel(app: DSLApp, cfg: DeviceConfig, lane_axis: str = "leading"):
+def make_explore_kernel(
+    app: DSLApp,
+    cfg: DeviceConfig,
+    lane_axis: str = "leading",
+    start_state: bool = False,
+):
     """Returns jitted ``kernel(progs: ExtProgram[B], keys[B]) -> LaneResult[B]``.
 
     Each lane runs its external program to completion (or a cap) delivering
@@ -477,8 +494,25 @@ def make_explore_kernel(app: DSLApp, cfg: DeviceConfig, lane_axis: str = "leadin
     vectorizes — instead of a pool-sized minor axis padded to the vector
     width. The public interface is unchanged (inputs/outputs stay
     lane-leading; transposes happen inside the jit) and results are
-    bit-identical."""
+    bit-identical.
+
+    ``start_state=True`` adds a third kernel argument — a device/fork.py
+    ``PrefixSnapshot`` broadcast across the lane axis — so a batch forks
+    from one trunk's injection-prefix state with per-lane rng; False keeps
+    the two-argument lowering byte-identical."""
     run_lane = make_run_lane(app, cfg)
+    if start_state:
+        if lane_axis != "leading":
+            raise ValueError("start_state fork kernels are lane-leading only")
+        return _counted_kernel(
+            jax.jit(
+                jax.vmap(
+                    lambda prog, key, snap: run_lane(prog, key, snap),
+                    in_axes=(0, 0, None),
+                )
+            ),
+            "explore-fork",
+        )
     if lane_axis == "leading":
         return _counted_kernel(jax.jit(jax.vmap(run_lane)), "explore")
     if lane_axis != "trailing":

@@ -1,0 +1,477 @@
+"""Prefix-fork replay: snapshot device state at branch points, fork lanes.
+
+Every device lane used to replay its schedule from step 0 even though the
+dominant workloads are trials that share long common prefixes by
+construction: a DPOR backtrack prescription is "the executed prefix plus
+one flipped racing delivery", and a ``BatchedDDMin`` /
+``BatchedInternalMinimizer`` level's candidates are identical up to the
+first removed index. Parsimonious Optimal DPOR (PAPERS.md) gets its
+asymptotic win precisely from not re-exploring shared prefixes; the O(1)
+autoregressive-caching line of work is the same insight applied to
+accelerator state: checkpoint once, fork many.
+
+Device side: ``ScheduleState`` is a fixed-shape NamedTuple, so a snapshot
+IS the state. A trunk lane executes the shared prefix once
+(``make_replay_prefix_runner`` / ``make_explore_prefix_runner`` /
+``make_dpor_prefix_runner``); the ``start_state=``-built kernels broadcast
+the snapshot across the lane axis (``vmap(in_axes=None)`` — no per-lane
+copy is materialized) and resume with per-lane divergence: remaining
+replay records, the full prescription plus the trunk's committed cursor,
+or a fresh per-lane rng. Forked results are bit-exact vs scratch because
+(a) the trunk replays exactly what a scratch lane's prefix would have and
+(b) rng is never consumed before the fork point — injection steps and
+prescription-following dispatch never split it (explore.make_step_fn
+commits the split only on dispatch steps; prescribed deliveries bypass
+the random chooser entirely). The DPOR trunk FREEZES (bit-exact no-op)
+the moment no remaining prefix record matches, so the fork lanes redo
+that step's decision with the full prescription and their own rng.
+
+Host side: ``PrefixPlanner`` groups a batch of trials by longest common
+prefix, bucketed to multiples of ``bucket`` rows so trunk/fork shapes
+stay static (a ddmin level's candidates land one group per
+first-divergence bucket); ``PrefixCache`` LRU-keeps packed snapshots
+keyed by prefix hash so consecutive ddmin levels and DPOR rounds reuse
+trunks across kernel launches.
+
+Everything is opt-in: ``DEMI_PREFIX_FORK=1`` / ``--prefix-fork`` (or the
+explicit ``prefix_fork=True`` constructor args). With it off, kernels are
+built without the ``start_state`` input and their lowering is
+byte-identical to the pre-fork tree.
+
+Telemetry (``fork.*`` series, plus ``dpor.prefix_group_size``): cache
+hits/misses, ``fork.steps_saved`` (prefix steps the fork lanes did NOT
+re-execute, net of the trunk's own run on a cache miss), and group-size
+histograms — the signal a future tuner can use to learn the bucket
+granularity.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+from collections import OrderedDict
+from typing import Callable, Dict, List, NamedTuple, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .. import obs
+from ..dsl import DSLApp
+from . import ops
+from .core import (
+    REC_NONE,
+    ST_DISPATCH,
+    ST_DONE,
+    ST_INJECT,
+    DeviceConfig,
+    ScheduleState,
+    init_state,
+)
+
+
+def prefix_fork_enabled(explicit: Optional[bool] = None) -> bool:
+    """Resolve the prefix-fork switch: an explicit constructor arg wins,
+    otherwise ``DEMI_PREFIX_FORK`` (off by default)."""
+    if explicit is not None:
+        return bool(explicit)
+    return os.environ.get("DEMI_PREFIX_FORK", "").strip().lower() in (
+        "1", "true", "yes", "on"
+    )
+
+
+class PrefixSnapshot(NamedTuple):
+    """A trunk lane's state at the branch point. ``state`` is the whole
+    ScheduleState pytree (already fixed-shape); the scalars carry the
+    loop position so forked lanes keep scratch-identical budgets."""
+
+    state: ScheduleState
+    steps: jnp.ndarray  # int32: fused-loop steps consumed (explore/dpor) /
+    #                     records applied (replay)
+    cursor: jnp.ndarray  # int32: prescription cursor committed by the trunk
+    ignored: jnp.ndarray  # int32: replay ignored-absent count so far
+    peeked: jnp.ndarray  # int32: replay peek-enabled count so far
+
+
+def fork_lanes(snapshot: PrefixSnapshot, keys) -> ScheduleState:
+    """Broadcast a trunk snapshot across the lane axis with per-lane rng
+    divergence — the materialized form of what the ``start_state=``
+    kernels do implicitly via ``vmap(in_axes=None)``."""
+    b = keys.shape[0]
+    state = jax.tree_util.tree_map(
+        lambda x: jnp.broadcast_to(x, (b,) + x.shape), snapshot.state
+    )
+    return state._replace(rng=keys)
+
+
+def prefix_digest(*parts: bytes) -> bytes:
+    """Compact cache key for a prefix's raw bytes."""
+    h = hashlib.blake2b(digest_size=16)
+    for p in parts:
+        h.update(p)
+    return h.digest()
+
+
+def pad_pow2(n: int, floor: int = 8) -> int:
+    """Power-of-two batch bucket (same scheme as the replay checker's
+    level padding) so fork-group launches reuse compiled shapes."""
+    return max(floor, 1 << (n - 1).bit_length())
+
+
+def padded_size(n: int, mesh=None) -> int:
+    """The launch size for a fork group or scratch sub-batch: power-of-two
+    bucketed, then rounded to a mesh-axis multiple when sharded — the one
+    padding rule all three fork call sites (replay checker, DeviceDPOR,
+    sweep driver) share."""
+    n = pad_pow2(n)
+    if mesh is not None:
+        from ..parallel.mesh import pad_batch_to_devices
+
+        n = pad_batch_to_devices(n, mesh)
+    return n
+
+
+# ---------------------------------------------------------------------------
+# Trunk runners: execute ONE lane through a shared prefix, capture state
+# ---------------------------------------------------------------------------
+
+def make_replay_prefix_runner(app: DSLApp, cfg: DeviceConfig):
+    """jitted ``run_prefix(records[R, recw], key) -> PrefixSnapshot``:
+    apply the prefix records (compact, REC_NONE-terminated — one static
+    shape for every prefix length) on a single trunk lane and capture the
+    full replay carry (state + ignored/peeked counters)."""
+    from .replay import _replay_cfg, make_replay_apply_fn
+
+    cfg = _replay_cfg(cfg)
+    apply_one = make_replay_apply_fn(app, cfg)
+    oh = cfg.use_onehot
+
+    def run_prefix(records, key) -> PrefixSnapshot:
+        state = init_state(app, cfg, key)
+        n_rec = records.shape[0]
+
+        def cond(carry):
+            s, _ig, _pk, i = carry
+            kind = ops.get_scalar(
+                records[:, 0], jnp.minimum(i, n_rec - 1), oh
+            )
+            return (i < n_rec) & (kind != REC_NONE) & (s.status < ST_DONE)
+
+        def body(carry):
+            s, ig, pk, i = carry
+            rec = ops.get_row(records, jnp.minimum(i, n_rec - 1), oh)
+            s, ig, pk = apply_one(s, ig, pk, rec)
+            return (s, ig, pk, i + 1)
+
+        state, ignored, peeked, i = jax.lax.while_loop(
+            cond, body, (state, jnp.int32(0), jnp.int32(0), jnp.int32(0))
+        )
+        return PrefixSnapshot(
+            state=state, steps=i, cursor=i, ignored=ignored, peeked=peeked
+        )
+
+    return jax.jit(run_prefix)
+
+
+def make_explore_prefix_runner(app: DSLApp, cfg: DeviceConfig):
+    """jitted ``run_prefix(prog: ExtProgram, key) -> PrefixSnapshot``: run
+    the fused step through the initial injection segment (deterministic —
+    rng is only consumed on dispatch steps) and stop the moment the lane
+    leaves ST_INJECT. Lanes sharing the program rows up to (one past) the
+    first wait-like op share this state bit-exactly."""
+    from .explore import make_any_step_fn
+
+    step = make_any_step_fn(app, cfg)
+
+    def run_prefix(prog, key) -> PrefixSnapshot:
+        state = init_state(app, cfg, key)
+
+        def cond(carry):
+            s, i = carry
+            return (s.status == ST_INJECT) & (i < cfg.max_steps)
+
+        def body(carry):
+            s, i = carry
+            return step(s, prog), i + 1
+
+        state, steps = jax.lax.while_loop(
+            cond, body, (state, jnp.int32(0))
+        )
+        return PrefixSnapshot(
+            state=state, steps=steps, cursor=jnp.int32(0),
+            ignored=jnp.int32(0), peeked=jnp.int32(0),
+        )
+
+    return jax.jit(run_prefix)
+
+
+def make_dpor_prefix_runner(app: DSLApp, cfg: DeviceConfig):
+    """jitted ``run_prefix(prog, presc[R, recw], key) -> PrefixSnapshot``:
+    follow the prefix prescription (injection steps included) and FREEZE —
+    a bit-exact no-op, state and cursor untouched — the first time no
+    remaining prefix record matches the pool. A scratch lane would decide
+    that step by scanning the full prescription (and possibly falling back
+    to its rng); the fork lanes redo exactly that from the snapshot, so
+    stopping before the decision is what keeps parity exact."""
+    from .dpor_sweep import make_prescribed_dispatch
+    from .explore import make_step_fn
+
+    assert cfg.record_trace and cfg.record_parents
+    base_step = make_step_fn(app, cfg)
+    pdispatch = make_prescribed_dispatch(app, cfg)
+
+    def run_prefix(prog, presc, key) -> PrefixSnapshot:
+        state = init_state(app, cfg, key)
+
+        def cond(carry):
+            s, _cur, i, frozen = carry
+            return (s.status < ST_DONE) & ~frozen & (i < cfg.max_steps)
+
+        def body(carry):
+            s, cur, i, _frozen = carry
+            in_dispatch = s.status == ST_DISPATCH
+
+            def dispatch_side(args):
+                s, cur = args
+                ns, ncur, found = pdispatch(s, presc, cur)
+                out = jax.tree_util.tree_map(
+                    lambda a, b: jnp.where(found, b, a), s, ns
+                )
+                return out, jnp.where(found, ncur, cur), ~found
+
+            def inject_side(args):
+                s, cur = args
+                return base_step(s, prog), cur, jnp.bool_(False)
+
+            ns, ncur, froze = jax.lax.cond(
+                in_dispatch, dispatch_side, inject_side, (s, cur)
+            )
+            # A frozen "step" took no action: don't charge the budget.
+            return ns, ncur, i + (~froze).astype(jnp.int32), froze
+
+        state, cursor, steps, _ = jax.lax.while_loop(
+            cond, body,
+            (state, jnp.int32(0), jnp.int32(0), jnp.bool_(False)),
+        )
+        return PrefixSnapshot(
+            state=state, steps=steps, cursor=cursor,
+            ignored=jnp.int32(0), peeked=jnp.int32(0),
+        )
+
+    return jax.jit(run_prefix)
+
+
+# ---------------------------------------------------------------------------
+# Host-side planning: group trials by bucketed longest common prefix
+# ---------------------------------------------------------------------------
+
+class PrefixGroup(NamedTuple):
+    prefix_len: int  # shared rows (a multiple of the planner bucket)
+    indices: List[int]  # batch positions sharing the prefix
+    key: bytes  # digest of the shared prefix rows (cache key)
+
+
+class PrefixPlanner:
+    """Group a batch of trials (row-compact int32 record arrays) by
+    longest common prefix, bucketed to multiples of ``bucket`` rows so
+    trunk/fork shapes stay static.
+
+    ``plan(records[n, R, w], lengths[n])`` returns ``(groups, scratch)``:
+    each group's members share ``records[:, :prefix_len]`` byte-exactly;
+    trials with no shareable prefix (divergence inside bucket 0) land in
+    ``scratch``. Recursion only descends while a chunk-partition keeps at
+    least ``min_group`` members together, so a ddmin level's candidates —
+    identical up to the first removed index — come out as one group per
+    first-divergence bucket."""
+
+    def __init__(self, bucket: int = 8, min_group: int = 2):
+        if bucket < 1:
+            raise ValueError(f"bucket must be >= 1, got {bucket}")
+        self.bucket = bucket
+        self.min_group = min_group
+
+    def plan(
+        self, records: np.ndarray, lengths: Sequence[int]
+    ) -> Tuple[List[PrefixGroup], List[int]]:
+        records = np.asarray(records)
+        lengths = np.asarray(lengths)
+        groups: List[PrefixGroup] = []
+        scratch: List[int] = []
+
+        def chunk_key(i: int, depth: int) -> bytes:
+            lo = depth * self.bucket
+            return records[i, lo: lo + self.bucket].tobytes()
+
+        def emit(idxs: List[int], depth: int) -> None:
+            if depth == 0:
+                scratch.extend(idxs)
+                return
+            p = depth * self.bucket
+            groups.append(
+                PrefixGroup(
+                    prefix_len=p,
+                    indices=list(idxs),
+                    key=prefix_digest(records[idxs[0], :p].tobytes()),
+                )
+            )
+
+        def split(idxs: List[int], depth: int) -> None:
+            deeper: Dict[bytes, List[int]] = {}
+            rest: List[int] = []
+            for i in idxs:
+                # Only descend through FULL chunks: a trial ending inside
+                # the next chunk forks at the current boundary instead of
+                # grouping on padding bytes.
+                if lengths[i] >= (depth + 1) * self.bucket:
+                    deeper.setdefault(chunk_key(i, depth), []).append(i)
+                else:
+                    rest.append(i)
+            for sub in deeper.values():
+                if len(sub) >= self.min_group:
+                    split(sub, depth + 1)
+                else:
+                    rest.extend(sub)
+            if rest:
+                emit(rest, depth)
+
+        split(list(range(records.shape[0])), 0)
+        return groups, scratch
+
+
+class PrefixCache:
+    """LRU of packed trunk snapshots keyed by prefix hash. Entries are
+    ``(PrefixSnapshot, trunk_steps)``; one snapshot is a single lane's
+    state (a few pool-sized arrays), so a few dozen stay cheap while
+    letting consecutive ddmin levels / DPOR rounds reuse trunks across
+    kernel launches."""
+
+    def __init__(self, capacity: int = 32):
+        self.capacity = capacity
+        self._entries: "OrderedDict[bytes, Tuple[PrefixSnapshot, int]]" = (
+            OrderedDict()
+        )
+        self.hits = 0
+        self.misses = 0
+
+    def get(self, key: bytes) -> Optional[Tuple[PrefixSnapshot, int]]:
+        entry = self._entries.get(key)
+        if entry is None:
+            self.misses += 1
+            return None
+        self._entries.move_to_end(key)
+        self.hits += 1
+        return entry
+
+    def put(self, key: bytes, snapshot: PrefixSnapshot, steps: int) -> None:
+        self._entries[key] = (snapshot, steps)
+        self._entries.move_to_end(key)
+        while len(self._entries) > self.capacity:
+            self._entries.popitem(last=False)
+
+    def __contains__(self, key: bytes) -> bool:
+        return key in self._entries
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+
+class PrefixForker:
+    """Planner + cache + trunk-runner glue shared by the replay checker,
+    ``DeviceDPOR``, and the sweep driver's chunked mode. ``runner`` is a
+    jitted trunk runner returning a PrefixSnapshot; statistics accumulate
+    in ``stats`` (always) and the ``fork.*`` obs series (when telemetry
+    is on)."""
+
+    def __init__(
+        self,
+        runner: Callable[..., PrefixSnapshot],
+        bucket: int = 8,
+        capacity: int = 32,
+        min_group: int = 2,
+        driver: str = "replay",
+    ):
+        self.planner = PrefixPlanner(bucket=bucket, min_group=min_group)
+        self.cache = PrefixCache(capacity)
+        self.runner = runner
+        self.driver = driver
+        self.stats = {
+            "groups": 0,
+            "forked_lanes": 0,
+            "scratch_lanes": 0,
+            "prefix_hits": 0,
+            "prefix_misses": 0,
+            "steps_saved": 0,
+        }
+        # steps_saved terms awaiting a host pull: (trunk-steps scalar,
+        # multiplier). Resolving a fresh trunk's steps immediately would
+        # block async dispatch, so terms accumulate and are pulled lazily
+        # (next plan() or stats_view()) — by then the trunk has long run.
+        self._deferred: List[Tuple[object, int]] = []
+
+    def plan(self, records, lengths):
+        self.resolve_deferred()
+        return self.planner.plan(records, lengths)
+
+    def should_fork(self, group: PrefixGroup) -> bool:
+        """Fork when the trunk amortizes: a real shared prefix and either
+        enough members or an already-cached trunk (free reuse)."""
+        return group.prefix_len > 0 and self.amortizes(
+            len(group.indices), group.key
+        )
+
+    def amortizes(self, n: int, key: bytes) -> bool:
+        """The trunk-amortization rule shared by every fork call site
+        (the sweep driver groups by exact digest rather than PrefixGroup,
+        so it applies this directly)."""
+        return n >= self.planner.min_group or key in self.cache
+
+    def trunk(self, key: bytes, *args) -> Tuple[PrefixSnapshot, object, bool]:
+        """Cached trunk snapshot: ``(snapshot, trunk_steps, cache_hit)``.
+        ``trunk_steps`` stays a device scalar on a fresh miss (pulling it
+        here would block async dispatch); it is only read host-side when
+        the deferred steps_saved terms resolve."""
+        entry = self.cache.get(key)
+        if entry is not None:
+            self.stats["prefix_hits"] += 1
+            obs.counter("fork.prefix_hits").inc(driver=self.driver)
+            return entry[0], entry[1], True
+        snapshot = self.runner(*args)
+        self.cache.put(key, snapshot, snapshot.steps)
+        self.stats["prefix_misses"] += 1
+        obs.counter("fork.prefix_misses").inc(driver=self.driver)
+        return snapshot, snapshot.steps, False
+
+    def note_group(self, size: int, trunk_steps, cache_hit: bool) -> None:
+        """Account one fork-group launch: every member skipped the trunk's
+        steps; a cache miss pays the trunk once. The steps term is
+        deferred (see ``_deferred``)."""
+        self.stats["groups"] += 1
+        self.stats["forked_lanes"] += size
+        self._deferred.append((trunk_steps, size - (0 if cache_hit else 1)))
+        obs.histogram("fork.group_size").observe(size, driver=self.driver)
+
+    def note_scratch(self, n: int) -> None:
+        self.stats["scratch_lanes"] += n
+
+    def resolve_deferred(self) -> None:
+        """Pull any deferred steps_saved terms host-side. Call sites that
+        bypass plan() (the sweep driver groups by exact digest) invoke
+        this at the START of each round — the previous round's trunks
+        have long completed, so the pull costs no dispatch overlap and
+        the deferred list stays bounded by one round's groups."""
+        if not self._deferred:
+            return
+        saved = sum(
+            int(jax.device_get(steps)) * mult
+            for steps, mult in self._deferred
+        )
+        self._deferred.clear()
+        self.stats["steps_saved"] += saved
+        obs.counter("fork.steps_saved").inc(saved, driver=self.driver)
+
+    def stats_view(self) -> dict:
+        """The statistics dict with every deferred term resolved — what
+        the drivers' ``fork_stats`` surfaces."""
+        self.resolve_deferred()
+        return dict(self.stats)

@@ -55,17 +55,24 @@ def _is_delivery_kind(kind):
     return (kind == REC_DELIVERY) | (kind == REC_TIMER) | (kind == REC_WILDCARD)
 
 
-def make_replay_run_lane(app: DSLApp, cfg: DeviceConfig):
-    """Unjitted single-lane replay ``run_lane(records, key) -> ReplayResult``
-    (composable with vmap/jit/shardings by callers)."""
+def _replay_cfg(cfg: DeviceConfig) -> DeviceConfig:
+    """Replay matches by content + pool_seq FIFO and never reads the
+    incremental head bits — skip their maintenance entirely
+    (head_recompute flips track_fifo_heads off; fifo_head_mask is never
+    called here). Shared with the prefix-fork trunk runner (device/fork.py)
+    so trunk snapshots and fork lanes agree on every array shape."""
     import dataclasses
 
     if cfg.track_fifo_heads:
-        # Replay matches by content + pool_seq FIFO and never reads the
-        # incremental head bits — skip their maintenance entirely
-        # (head_recompute flips track_fifo_heads off; fifo_head_mask is
-        # never called here).
         cfg = dataclasses.replace(cfg, head_recompute=True)
+    return cfg
+
+
+def make_replay_record_fn(app: DSLApp, cfg: DeviceConfig):
+    """The fused record application ``replay_record(state, rec, active) ->
+    (state', peek_hit)`` shared by ``make_replay_run_lane`` and the
+    prefix-fork trunk runner. ``cfg`` must be pre-normalized by
+    ``_replay_cfg``."""
     init_states, initial_rows = _precomputed(app, cfg)
     big = jnp.int32(2**30)
 
@@ -197,21 +204,53 @@ def make_replay_run_lane(app: DSLApp, cfg: DeviceConfig):
             )
         return state, peek_hit
 
-    def run_lane(records, key) -> ReplayResult:
-        state = init_state(app, cfg, key)
+    return replay_record
 
-        def apply_one(state, ignored, peeked, rec):
-            before = state.deliveries
-            state, peek_hit = replay_record(
-                state, rec, state.status < ST_DONE
-            )
-            was_delivery = _is_delivery_kind(rec[0])
-            skipped = was_delivery & (state.deliveries == before) & (state.status < ST_DONE)
-            return (
-                state,
-                ignored + skipped.astype(jnp.int32),
-                peeked + peek_hit.astype(jnp.int32),
-            )
+
+def make_replay_apply_fn(app: DSLApp, cfg: DeviceConfig):
+    """``apply_one(state, ignored, peeked, rec)`` — one record plus the
+    ignored-absent / peek accounting, shared by the lane loop below and
+    the prefix-fork trunk (device/fork.py). ``cfg`` must be pre-normalized
+    by ``_replay_cfg``."""
+    replay_record = make_replay_record_fn(app, cfg)
+
+    def apply_one(state, ignored, peeked, rec):
+        before = state.deliveries
+        state, peek_hit = replay_record(
+            state, rec, state.status < ST_DONE
+        )
+        was_delivery = _is_delivery_kind(rec[0])
+        skipped = was_delivery & (state.deliveries == before) & (state.status < ST_DONE)
+        return (
+            state,
+            ignored + skipped.astype(jnp.int32),
+            peeked + peek_hit.astype(jnp.int32),
+        )
+
+    return apply_one
+
+
+def make_replay_run_lane(app: DSLApp, cfg: DeviceConfig):
+    """Unjitted single-lane replay ``run_lane(records, key,
+    start_state=None) -> ReplayResult`` (composable with vmap/jit/shardings
+    by callers). ``start_state`` (a device/fork.py PrefixSnapshot) resumes
+    the lane from a trunk snapshot — ``records`` are then the remaining
+    (left-shifted) suffix; the default None keeps today's lowering
+    byte-identical."""
+    cfg = _replay_cfg(cfg)
+    apply_one = make_replay_apply_fn(app, cfg)
+
+    def run_lane(records, key, start_state=None) -> ReplayResult:
+        if start_state is None:
+            state = init_state(app, cfg, key)
+            ignored0 = peeked0 = jnp.int32(0)
+        else:
+            # Forked lane: the trunk already applied the shared prefix.
+            # rng is per-lane for contract parity with the explore fork
+            # (replay itself never consumes it).
+            state = start_state.state._replace(rng=key)
+            ignored0 = start_state.ignored
+            peeked0 = start_state.peeked
 
         if cfg.early_exit:
             # Stop at trailing padding (REC_NONE) or a finished lane; under
@@ -237,7 +276,7 @@ def make_replay_run_lane(app: DSLApp, cfg: DeviceConfig):
 
             state, ignored, peeked, _ = jax.lax.while_loop(
                 cond, wl_body,
-                (state, jnp.int32(0), jnp.int32(0), jnp.int32(0)),
+                (state, ignored0, peeked0, jnp.int32(0)),
             )
         else:
             def body(carry, rec):
@@ -246,7 +285,7 @@ def make_replay_run_lane(app: DSLApp, cfg: DeviceConfig):
                 return (state, ignored, peeked), None
 
             (state, ignored, peeked), _ = jax.lax.scan(
-                body, (state, jnp.int32(0), jnp.int32(0)), records
+                body, (state, ignored0, peeked0), records
             )
         # Aborted lanes (overflow) must not report a verdict computed from
         # truncated state — mask their violation to 0 so batched-oracle
@@ -270,7 +309,20 @@ def make_replay_run_lane(app: DSLApp, cfg: DeviceConfig):
     return run_lane
 
 
-def make_replay_kernel(app: DSLApp, cfg: DeviceConfig):
+def make_replay_kernel(app: DSLApp, cfg: DeviceConfig, start_state: bool = False):
     """Returns jitted ``kernel(records[B, R, rec_width], keys[B]) ->
-    ReplayResult[B]`` replaying each lane's prescribed schedule."""
-    return jax.jit(jax.vmap(make_replay_run_lane(app, cfg)))
+    ReplayResult[B]`` replaying each lane's prescribed schedule.
+
+    With ``start_state=True`` the kernel takes a third argument — a
+    device/fork.py ``PrefixSnapshot`` shared across the lane axis
+    (``vmap in_axes=None``) — and ``records`` are each lane's remaining
+    suffix; False keeps the two-argument lowering byte-identical."""
+    run_lane = make_replay_run_lane(app, cfg)
+    if not start_state:
+        return jax.jit(jax.vmap(run_lane))
+    return jax.jit(
+        jax.vmap(
+            lambda records, key, snap: run_lane(records, key, snap),
+            in_axes=(0, 0, None),
+        )
+    )

@@ -45,12 +45,30 @@ def sweep_sharding(mesh: Mesh, axis: str = LANES) -> Tuple[NamedSharding, NamedS
     return NamedSharding(mesh, P(axis)), NamedSharding(mesh, P())
 
 
-def _shard_lane_kernel(run_lane, mesh: Mesh, axis: str, n_in: int = 2):
+def _shard_lane_kernel(
+    run_lane, mesh: Mesh, axis: str, n_in: int = 2, start_state: bool = False
+):
     """vmap a single-lane fn and shard its lane batch over the mesh: all
     ``n_in`` inputs and the outputs are sharded on their leading (lane)
     dimension; each device advances its lane shard independently — the
-    pjit/ICI scale-out."""
+    pjit/ICI scale-out.
+
+    ``start_state=True`` appends a trailing PrefixSnapshot argument
+    (device/fork.py) broadcast over the lane axis (vmap in_axes=None) and
+    fully replicated over the mesh: every device forks its lane shard
+    from the same trunk state."""
     batch_sharding = NamedSharding(mesh, P(axis))
+    if start_state:
+        replicated = NamedSharding(mesh, P())
+        fn = {
+            2: lambda a, b, snap: run_lane(a, b, snap),
+            3: lambda a, b, c, snap: run_lane(a, b, c, snap),
+        }[n_in]
+        return jax.jit(
+            jax.vmap(fn, in_axes=(0,) * n_in + (None,)),
+            in_shardings=(batch_sharding,) * n_in + (replicated,),
+            out_shardings=batch_sharding,
+        )
     return jax.jit(
         jax.vmap(run_lane),
         in_shardings=(batch_sharding,) * n_in,
@@ -58,27 +76,52 @@ def _shard_lane_kernel(run_lane, mesh: Mesh, axis: str, n_in: int = 2):
     )
 
 
-def shard_explore_kernel(app: DSLApp, cfg: DeviceConfig, mesh: Mesh, axis: str = LANES):
+def shard_explore_kernel(
+    app: DSLApp,
+    cfg: DeviceConfig,
+    mesh: Mesh,
+    axis: str = LANES,
+    start_state: bool = False,
+):
     """Explore sweep with the lane batch sharded over the mesh."""
-    return _shard_lane_kernel(make_run_lane(app, cfg), mesh, axis)
+    return _shard_lane_kernel(
+        make_run_lane(app, cfg), mesh, axis, start_state=start_state
+    )
 
 
-def shard_replay_kernel(app: DSLApp, cfg: DeviceConfig, mesh: Mesh, axis: str = LANES):
+def shard_replay_kernel(
+    app: DSLApp,
+    cfg: DeviceConfig,
+    mesh: Mesh,
+    axis: str = LANES,
+    start_state: bool = False,
+):
     """Batched replay (minimization trials) sharded over the mesh: one
     DDMin level's candidate subsequences spread across chips."""
     from ..device.replay import make_replay_run_lane
 
-    return _shard_lane_kernel(make_replay_run_lane(app, cfg), mesh, axis)
+    return _shard_lane_kernel(
+        make_replay_run_lane(app, cfg), mesh, axis, start_state=start_state
+    )
 
 
-def shard_dpor_kernel(app: DSLApp, cfg: DeviceConfig, mesh: Mesh, axis: str = LANES):
+def shard_dpor_kernel(
+    app: DSLApp,
+    cfg: DeviceConfig,
+    mesh: Mesh,
+    axis: str = LANES,
+    start_state: bool = False,
+):
     """DPOR frontier batches sharded over the mesh: each device replays
     its shard of the round's prescriptions (prescription-guided explore
     lanes are independent, so no collectives inside a round — the
     frontier/backtrack analysis stays on the host)."""
     from ..device.dpor_sweep import make_dpor_run_lane
 
-    return _shard_lane_kernel(make_dpor_run_lane(app, cfg), mesh, axis, n_in=3)
+    return _shard_lane_kernel(
+        make_dpor_run_lane(app, cfg), mesh, axis, n_in=3,
+        start_state=start_state,
+    )
 
 
 def shard_explore_kernel_pallas(

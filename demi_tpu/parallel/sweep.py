@@ -22,6 +22,7 @@ from typing import Any, Callable, List, Optional, Sequence, Tuple
 import numpy as np
 
 import jax
+import jax.numpy as jnp
 
 from .. import obs
 from ..dsl import DSLApp
@@ -131,6 +132,7 @@ class SweepDriver:
         mesh=None,
         use_mesh: bool = False,
         variant: Optional[str] = None,
+        prefix_fork: Optional[bool] = None,
     ):
         """``variant`` (an ``EXPLORE_VARIANTS`` name, e.g. the autotuner's
         calibrated pick) selects the single-host kernel build: '-ee' /
@@ -139,7 +141,19 @@ class SweepDriver:
         granularity — callers pass them only when that is
         semantics-preserving (``invariant_interval == 0``), which is the
         rule the autotuner itself applies. None keeps the env-selected
-        backend (DEMI_DEVICE_IMPL) on the default build."""
+        backend (DEMI_DEVICE_IMPL) on the default build.
+
+        ``prefix_fork`` (default: the DEMI_PREFIX_FORK env switch) makes
+        the CHUNKED dispatch path group a chunk's lanes by shared
+        injection prefix — program rows up to one past the first
+        wait-like op — run each group's deterministic injection segment
+        once on a trunk lane (LRU-cached across chunks) and fork the
+        group from the snapshot via the ``start_state=`` kernel with
+        per-lane rng. Injection never consumes rng, so per-seed results
+        are bit-identical to scratch. Continuous-mode sweeps (the
+        single-slice default) refill mid-flight and keep their own
+        compaction; forking applies to run_chunk / sweep(mode='chunked')
+        / sweep_async / sweep_autotuned."""
         from ..device.explore import resolve_impl, variant_config
 
         if variant is not None:
@@ -195,6 +209,34 @@ class SweepDriver:
                 self.kernel = make_explore_kernel(app, cfg)
             self._align = 1
         self._cont_cache = None
+        from ..device.fork import prefix_fork_enabled
+
+        self._forker = None
+        if prefix_fork_enabled(prefix_fork):
+            from ..device.fork import PrefixForker, make_explore_prefix_runner
+
+            if self.impl == "pallas":
+                import sys
+
+                print(
+                    "SweepDriver: prefix-fork trunk/fork lanes run on the "
+                    "XLA explore kernel (bit-identical results)",
+                    file=sys.stderr,
+                )
+            self._fork_kernel = (
+                shard_explore_kernel(app, self.cfg, self.mesh, start_state=True)
+                if self.mesh is not None
+                else make_explore_kernel(app, self.cfg, start_state=True)
+            )
+            self._forker = PrefixForker(
+                make_explore_prefix_runner(app, self.cfg), driver="sweep"
+            )
+
+    @property
+    def fork_stats(self) -> Optional[dict]:
+        """Prefix-fork statistics (None when forking is off)."""
+        return None if self._forker is None else self._forker.stats_view()
+
     def _programs(self, seeds: Sequence[int]):
         # Lowered per call: seeds are disjoint across chunks, so a
         # driver-lifetime cache would only ever grow (sweeps can cover 1M+
@@ -222,8 +264,88 @@ class SweepDriver:
             lambda s: jax.random.fold_in(jax.random.PRNGKey(base_key), s)
         )(np.asarray(padded, np.uint32))
         t0 = time.perf_counter()
-        res = self.kernel(progs, keys)
+        if self._forker is not None:
+            res = self._dispatch_forked(progs, keys)
+        else:
+            res = self.kernel(progs, keys)
         return real, res, t0
+
+    def _dispatch_forked(self, progs, keys):
+        """Chunked dispatch with prefix forking: lanes grouped by shared
+        injection prefix, each group resumed from a (cached) trunk
+        snapshot; singletons with no cached trunk run the scratch kernel.
+        Group results are sliced, concatenated, and inverse-permuted back
+        to chunk order ON DEVICE, so async dispatch is preserved."""
+        from ..device.core import OP_END, OP_WAIT, OP_WAITCOND
+        from ..device.explore import LaneResult
+        from ..device.fork import padded_size, prefix_digest
+
+        self._forker.resolve_deferred()  # prior chunk's steps_saved terms
+        op = np.asarray(progs.op)
+        a, b, msg = np.asarray(progs.a), np.asarray(progs.b), np.asarray(progs.msg)
+        batch = op.shape[0]
+        groups: dict = {}
+        for i in range(batch):
+            # The trunk's injection segment reads program rows up to the
+            # first wait-like/END op, plus the NEXT op's kind (final_seg
+            # lookahead) — rows [:j+2] over-cover that exactly.
+            boundary = np.nonzero(
+                (op[i] == OP_WAIT) | (op[i] == OP_WAITCOND) | (op[i] == OP_END)
+            )[0]
+            j = int(boundary[0]) if len(boundary) else op.shape[1] - 1
+            end = min(j + 2, op.shape[1])
+            digest = prefix_digest(
+                op[i, :end].tobytes(), a[i, :end].tobytes(),
+                b[i, :end].tobytes(), msg[i, :end].tobytes(),
+            )
+            groups.setdefault(digest, []).append(i)
+
+        def take(tree, idx):
+            idx = np.asarray(idx)
+            return jax.tree_util.tree_map(lambda x: jnp.asarray(x)[idx], tree)
+
+        parts = []  # (original indices, sliced LaneResult)
+        scratch: list = []
+        for digest, idx in groups.items():
+            if not self._forker.amortizes(len(idx), digest):
+                scratch.extend(idx)
+                continue
+            snap, trunk_steps, hit = self._forker.trunk(
+                digest,
+                jax.tree_util.tree_map(lambda x: np.asarray(x)[idx[0]], progs),
+                jax.random.PRNGKey(0),
+            )
+            full = idx + [idx[0]] * (padded_size(len(idx), self.mesh) - len(idx))
+            res = self._fork_kernel(take(progs, full), take(keys, full), snap)
+            parts.append(
+                (idx, jax.tree_util.tree_map(lambda x: x[: len(idx)], res))
+            )
+            self._forker.note_group(len(idx), trunk_steps, hit)
+        if scratch:
+            full = scratch + [scratch[0]] * (
+                padded_size(len(scratch), self.mesh) - len(scratch)
+            )
+            res = self.kernel(take(progs, full), take(keys, full))
+            parts.append(
+                (scratch, jax.tree_util.tree_map(lambda x: x[: len(scratch)], res))
+            )
+            self._forker.note_scratch(len(scratch))
+        order = [i for idx, _ in parts for i in idx]
+        inv = np.empty(batch, np.int64)
+        inv[np.asarray(order)] = np.arange(batch)
+        return LaneResult(
+            *(
+                jnp.take(
+                    jnp.concatenate(
+                        [jnp.asarray(getattr(res, f)) for _, res in parts],
+                        axis=0,
+                    ),
+                    jnp.asarray(inv),
+                    axis=0,
+                )
+                for f in LaneResult._fields
+            )
+        )
 
     def run_chunk(
         self, seeds: Sequence[int], slice_index: int = 0, base_key: int = 0

@@ -147,6 +147,43 @@ def main(argv=None):
                         "impl": tag, "batch": batch, "block_lanes": bl,
                         "error": repr(e)[:300],
                     }), flush=True)
+    # Prefix-fork explore (start_state=): the trunk runs the shared
+    # injection prefix once, lanes fork from the snapshot with per-lane
+    # rng — results bit-identical to scratch. This column keeps the fork
+    # kernels measured (and their lowering exercised) next to the scratch
+    # ones on every matrix run.
+    from ..device.explore import make_explore_kernel as _mek
+    from ..device.fork import make_explore_prefix_runner
+
+    for batch in batches[:1]:
+        try:
+            snap = make_explore_prefix_runner(app, cfg)(
+                prog1, jax.random.PRNGKey(0)
+            )
+            fork_kernel = _mek(app, cfg, start_state=True)
+            progs = stack_programs([prog1] * batch)
+            keys0 = jax.random.split(jax.random.PRNGKey(0), batch)
+            t0 = time.perf_counter()
+            jax.block_until_ready(fork_kernel(progs, keys0, snap))
+            comp = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            for r in range(1, args.reps + 1):
+                res = fork_kernel(
+                    progs, jax.random.split(jax.random.PRNGKey(r), batch), snap
+                )
+            jax.block_until_ready(res)
+            secs = time.perf_counter() - t0
+            print(json.dumps({
+                "impl": "xla-fork", "platform": platform, "batch": batch,
+                "schedules_per_sec": round(args.reps * batch / secs, 1),
+                "compile_s": round(comp, 1),
+                "trunk_steps": int(snap.steps),
+            }), flush=True)
+        except Exception as e:
+            print(json.dumps({
+                "impl": "xla-fork", "batch": batch, "error": repr(e)[:300],
+            }), flush=True)
+
     # Sustained continuous-refill throughput (the config-5 shape): the
     # segment/refill driver on the same workload — ranks the refill
     # path's overhead against the one-shot kernels on this hardware.

@@ -110,7 +110,40 @@ def render_report(directory: str, app=None) -> str:
                     label = f" {key}" if key else ""
                     lines.append(f"- `{name}`{label} = {v}")
             lines.append("")
+        # Prefix-fork summary (fork.* counters + the dpor.prefix_group_size
+        # histogram): when the run forked lane batches off trunk snapshots,
+        # say how much prefix work it skipped — next to the tuning
+        # decisions, since the bucket granularity is a future tuner knob.
         counters = obs_snap.get("counters", {})
+        hists = obs_snap.get("histograms", {})
+        fork_counters = {
+            name: series
+            for name, series in counters.items()
+            if name.startswith("fork.")
+        }
+        fork_hists = {
+            name: series
+            for name, series in hists.items()
+            if name in ("fork.group_size", "dpor.prefix_group_size")
+        }
+        if fork_counters or fork_hists:
+            lines += ["### Prefix-fork", ""]
+            for name in sorted(fork_counters):
+                for key, v in sorted(fork_counters[name].items()):
+                    label = f" {key}" if key else ""
+                    lines.append(f"- `{name}`{label} = {v:g}")
+            for name in sorted(fork_hists):
+                for key, rec in sorted(fork_hists[name].items()):
+                    label = f" {key}" if key else ""
+                    if rec["count"]:
+                        avg = rec["sum"] / rec["count"]
+                        lines.append(
+                            f"- `{name}`{label}: {rec['count']} groups, "
+                            f"mean size {avg:.1f}, max {rec['max']:g}"
+                        )
+                    else:
+                        lines.append(f"- `{name}`{label}: 0 groups")
+            lines.append("")
         if counters:
             lines += ["| counter | series | value |", "|---|---|---|"]
             for name in sorted(counters):

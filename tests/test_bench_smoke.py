@@ -1,0 +1,74 @@
+"""Tier-1 bench smoke: the bench.py sections run at tiny shapes and emit
+their JSON keys. bench drift previously had no coverage — a renamed or
+dropped key surfaced only on the next (scarce) TPU window."""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_bench(config: str, env_extra: dict) -> dict:
+    env = dict(os.environ, JAX_PLATFORMS="cpu", **env_extra)
+    # The smoke must measure the DEFAULT paths: strip switches that would
+    # change kernels or output keys.
+    for var in ("DEMI_OBS", "DEMI_AUTOTUNE", "DEMI_PREFIX_FORK",
+                "DEMI_DEVICE_IMPL", "DEMI_BENCH_IMPL"):
+        env.pop(var, None)
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--config", config],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=420,
+    )
+    assert out.returncode == 0, out.stderr[-3000:]
+    record = json.loads(out.stdout.strip().splitlines()[-1])
+    for key in ("metric", "value", "unit", "vs_baseline"):
+        assert key in record, (key, record)
+    return record
+
+
+def test_bench_config2_smoke():
+    record = _run_bench("2", {"DEMI_BENCH_DPOR_ROUNDS": "1"})
+    assert record["metric"].startswith("interleavings/sec")
+    section = record["config2"]
+    for key in ("app", "batch", "rounds", "interleavings",
+                "interleavings_per_sec", "frontier", "explored", "seconds"):
+        assert key in section, key
+    assert record["value"] == section["interleavings_per_sec"]
+    assert section["interleavings"] > 0
+
+
+def test_bench_config3_smoke():
+    record = _run_bench("3", {})
+    assert record["metric"].startswith("oracle replays/sec")
+    section = record["config3"]
+    assert "error" not in section, section
+    for key in ("app", "externals", "mcs_externals", "ddmin_levels",
+                "replays", "replays_per_sec", "seconds"):
+        assert key in section, key
+    assert section["replays"] > 0
+    assert section["mcs_externals"] <= section["externals"]
+
+
+def test_bench_config6_smoke():
+    record = _run_bench(
+        "6",
+        {
+            "DEMI_BENCH_CONFIG6_BUDGET": "16",
+            "DEMI_BENCH_CONFIG6_CANDIDATES": "8",
+            "DEMI_BENCH_CONFIG6_REPS": "1",
+        },
+    )
+    assert record["metric"].startswith("oracle trials/sec")
+    section = record["config6"]
+    assert "error" not in section, section
+    for key in ("app", "deliveries", "candidates", "reps",
+                "scratch_trials_per_sec", "fork_trials_per_sec", "speedup",
+                "verdicts_match", "prefix_hit_rate", "steps_saved",
+                "forked_lanes", "scratch_lanes", "fork_groups"):
+        assert key in section, key
+    # The acceptance-grade speedup needs the DEEP level (bench default);
+    # at smoke depth only the bit-exactness contract is asserted.
+    assert section["verdicts_match"] is True
+    assert section["forked_lanes"] > 0
