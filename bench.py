@@ -22,6 +22,11 @@ Extra keys reported for the record:
   - config6: prefix-fork vs scratch replay-trial throughput on a deep
     raft internal-minimization level (fork speedup, prefix-hit rate,
     steps_saved; DEMI_PREFIX_FORK-independent — both paths are measured).
+  - config7: async minimization pipeline vs the synchronous oracle —
+    end-to-end wall clock of a deep raft ddmin+internal minimization
+    (speedup, speculation hits/waste, lowering-cache hit rate, overlap
+    fraction; DEMI_ASYNC_MIN-independent — both paths are measured, and
+    verdicts_match / mcs_match pin bit-exactness).
   - config5: BASELINE config 5 — 64-actor reliable broadcast sweep
     (schedules/sec + lanes swept; 1M lanes on TPU, smaller on CPU
     fallback; override with DEMI_BENCH_CONFIG5_LANES). Runs in
@@ -32,8 +37,9 @@ Extra keys reported for the record:
   - platform: the JAX platform the numbers were measured on.
 
 Modes: `python bench.py` runs everything; `--config 2` / `--config 3` /
-`--config 4` / `--config 5` / `--config 6` / `--config rehearsal` run a
-single section (same one-line JSON with that key populated).
+`--config 4` / `--config 5` / `--config 6` / `--config 7` /
+`--config rehearsal` run a single section (same one-line JSON with that
+key populated).
 
 DEMI_AUTOTUNE=1 lets the measurement-guided tuner (demi_tpu/tune) pick
 the rehearsal drive's (kernel variant, batch, segment) from short
@@ -655,6 +661,203 @@ def bench_config6(jax):
     }
 
 
+def bench_config7(jax):
+    """Config 7: the full async minimization pipeline vs the synchronous
+    scratch oracle — end-to-end wall clock of a deep raft ddmin +
+    internal minimization. Both paths run the SAME minimizers on the
+    SAME recorded violation; the pipeline side turns on every PR-4
+    feature: lower-once/gather-many candidate lowering, the
+    dispatch/harvest split (speculative host execution between dispatch
+    and harvest), speculative next-level dispatch into the idle padded
+    lanes, and prefix-fork replay with HIERARCHICAL trunks (a trunk-cache
+    miss resumes the parent bucket's cached trunk instead of replaying
+    its full prefix). The contract keys — verdicts_match / mcs_match —
+    assert the pipeline's results are bit-identical; every feature stays
+    off by default everywhere (both paths are measured regardless of the
+    env). Knobs: DEMI_BENCH_CONFIG7_NODES / _COMMANDS / _BUDGET /
+    _SEEDS / _DEPTH_CAP / _REPS / _BUCKET."""
+    from demi_tpu.apps.common import dsl_start_events, make_host_invariant
+    from demi_tpu.apps.raft import T_CLIENT, make_raft_app
+    from demi_tpu.config import SchedulerConfig
+    from demi_tpu.device.batch_oracle import (
+        DeviceReplayChecker,
+        DeviceSTSOracle,
+        default_device_config,
+        make_batched_internal_check,
+    )
+    from demi_tpu.external_events import MessageConstructor, Send, WaitQuiescence
+    from demi_tpu.minimization.ddmin import BatchedDDMin, make_dag
+    from demi_tpu.minimization.internal import BatchedInternalMinimizer
+    from demi_tpu.minimization.stats import MinimizationStats
+    from demi_tpu.schedulers import RandomScheduler
+
+    nodes = int(os.environ.get("DEMI_BENCH_CONFIG7_NODES", 3))
+    commands = int(os.environ.get("DEMI_BENCH_CONFIG7_COMMANDS", 3))
+    budget = int(os.environ.get("DEMI_BENCH_CONFIG7_BUDGET", 240))
+    seeds = int(os.environ.get("DEMI_BENCH_CONFIG7_SEEDS", 40))
+    # Depth cap: a 300-delivery minimization runs ~13s per ROUND on a
+    # 2-core CPU box (the pipeline is for exactly that scale, but the
+    # bench must finish); default targets the ~120-delivery class.
+    depth_cap = int(os.environ.get("DEMI_BENCH_CONFIG7_DEPTH_CAP", 160))
+    reps = int(os.environ.get("DEMI_BENCH_CONFIG7_REPS", 3))
+    bucket = int(os.environ.get("DEMI_BENCH_CONFIG7_BUCKET", 8))
+    app = make_raft_app(nodes, bug="multivote")
+    config = SchedulerConfig(invariant_check=make_host_invariant(app))
+    program = dsl_start_events(app) + [
+        Send(
+            app.actor_name(i % nodes),
+            MessageConstructor(lambda v=10 + i: (T_CLIENT, 0, v, 0, 0, 0, 0)),
+        )
+        for i in range(commands)
+    ] + [WaitQuiescence()]
+    # Deepest violating execution under the depth cap: the pipeline's
+    # win scales with trace depth (host lowering and bookkeeping
+    # executions are O(depth) per candidate), and multivote violations
+    # land anywhere from ~15 to ~400 deliveries depending on the seed.
+    fr = None
+    best = -1
+    for seed in range(seeds):
+        r = RandomScheduler(
+            config, seed=seed, max_messages=budget,
+            invariant_check_interval=1,
+        ).execute(program)
+        if r.violation is None:
+            continue
+        depth = len(r.trace.deliveries())
+        if depth <= depth_cap and depth > best:
+            fr, best = r, depth
+    if fr is None:  # pragma: no cover - multivote violates reliably
+        return {"error": "no violation found to minimize"}
+    trace = fr.trace
+    trace.set_original_externals(list(program))
+    device_cfg = default_device_config(app, trace, program)
+
+    class LoggingChecker(DeviceReplayChecker):
+        """Records the verdict stream so sync/async bit-exactness is a
+        measured fact, not an assumption: sync logs in verdicts(), async
+        logs at harvest (verdicts() routes through dispatch there)."""
+
+        def __init__(self, *a, **kw):
+            super().__init__(*a, **kw)
+            self.vlog = []
+
+        def verdicts(self, *a, **kw):
+            v = super().verdicts(*a, **kw)
+            if not self.async_enabled:
+                self.vlog.append(tuple(v))
+            return v
+
+        def dispatch(self, *a, **kw):
+            pending = super().dispatch(*a, **kw)
+            inner = pending.harvest
+
+            def harvest():
+                fresh = pending._verdicts is None
+                v = inner()
+                if fresh:
+                    self.vlog.append(tuple(v))
+                return v
+
+            pending.harvest = harvest
+            return pending
+
+    def pipeline(checker, speculative):
+        stats = MinimizationStats()
+        oracle = DeviceSTSOracle(
+            app, device_cfg, config, trace, checker=checker
+        )
+        ddmin = BatchedDDMin(oracle, stats=stats, speculative=speculative)
+        mcs = ddmin.minimize(make_dag(list(program)), fr.violation)
+        ext = mcs.get_all_events()
+        base = ddmin.verified_trace
+        if base is None:  # pragma: no cover - MCS host-verifies
+            raise RuntimeError("MCS failed host verification")
+        minimizer = BatchedInternalMinimizer(
+            make_batched_internal_check(checker, list(ext), fr.violation),
+            stats=stats,
+            speculative=speculative,
+        )
+        final = minimizer.minimize(base)
+        return ext, final, ddmin.levels, minimizer
+
+    # Interleaved reps + medians (the bench_device_raft rule: machine
+    # drift must land on both variants equally — single-run wall clocks
+    # on a busy 2-core box spread ±15%).
+    s_checker = LoggingChecker(
+        app, device_cfg, config, prefix_fork=False, async_min=False
+    )
+    a_checker = LoggingChecker(
+        app, device_cfg, config, prefix_fork=True, fork_bucket=bucket,
+        async_min=True,
+    )
+    pipeline(s_checker, False)  # warm-up: compile + steady-state caches
+    pipeline(a_checker, True)
+    sync_times, async_times = [], []
+    for _ in range(reps):
+        s_checker.vlog = []
+        t0 = time.perf_counter()
+        s_out = pipeline(s_checker, False)
+        sync_times.append(time.perf_counter() - t0)
+        a_checker.vlog = []
+        t0 = time.perf_counter()
+        a_out = pipeline(a_checker, True)
+        async_times.append(time.perf_counter() - t0)
+    sync_secs = sorted(sync_times)[len(sync_times) // 2]
+    async_secs = sorted(async_times)[len(async_times) // 2]
+    s_ext, s_final, s_levels, _ = s_out
+    a_ext, a_final, a_levels, a_im = a_out
+    from demi_tpu.device.encoding import lower_expected_trace
+
+    s_bytes = lower_expected_trace(
+        app, device_cfg, s_final, s_ext, s_checker.max_records
+    ).tobytes()
+    a_bytes = lower_expected_trace(
+        app, device_cfg, a_final, a_ext, a_checker.max_records
+    ).tobytes()
+    pipe = a_checker.pipeline_snapshot()
+    fork = a_checker.fork_stats
+    return {
+        "app": f"raft{nodes}",
+        "deliveries": len(trace.deliveries()),
+        "externals": len(program),
+        "mcs_externals": len(s_ext),
+        "final_deliveries": len(s_final.deliveries()),
+        "ddmin_levels": s_levels,
+        "reps": reps,
+        "sync_seconds": round(sync_secs, 2),
+        "async_seconds": round(async_secs, 2),
+        "speedup": round(sync_secs / async_secs, 2) if async_secs else None,
+        # Bit-exactness contract: identical verdict stream, identical
+        # MCS, identical final minimized schedule (record bytes).
+        "verdicts_match": s_checker.vlog == a_checker.vlog,
+        "mcs_match": (
+            [e.eid for e in s_ext] == [e.eid for e in a_ext]
+            and s_levels == a_levels
+            and s_bytes == a_bytes
+        ),
+        "speculation_hits": pipe["spec_hits"],
+        "speculation_waste": pipe["spec_waste"],
+        # Speculative host executions (the predicted adoption, run
+        # between dispatch and harvest) from the timed run's minimizer.
+        "spec_exec_hits": a_im.spec_exec_hits,
+        "spec_exec_waste": a_im.spec_exec_waste,
+        "lowering_cache_hit_rate": pipe["lowering_cache_hit_rate"],
+        "overlap_fraction": pipe["overlap_fraction"],
+        "launches": pipe["launches"],
+        "fork": {
+            "prefix_hit_rate": round(
+                fork["prefix_hits"]
+                / max(1, fork["prefix_hits"] + fork["prefix_misses"]),
+                3,
+            ),
+            # Hierarchical trunks: misses served by resuming an ancestor
+            # trunk (O(bucket)) instead of a full-prefix replay (O(p)).
+            "parent_trunks": fork["parent_trunks"],
+            "steps_saved": fork["steps_saved"],
+        },
+    }
+
+
 def bench_config5_rehearsal(jax, total_lanes=None):
     """Config-5 machinery rehearsal at >=1e5 lanes (VERDICT r3 #6): the
     64-actor *reliable* flood runs ~1 lane/sec on CPU, so the full config
@@ -832,7 +1035,7 @@ def bench_config5_rehearsal(jax, total_lanes=None):
 def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--config", default=None,
-                        help="run only one section: 2, 3, 4, 5, 6, or "
+                        help="run only one section: 2, 3, 4, 5, 6, 7, or "
                              "'rehearsal'")
     args = parser.parse_args()
     if args.config is not None and args.config != "rehearsal":
@@ -918,6 +1121,18 @@ def main():
         out["vs_baseline"] = round((out["value"] or 0) / 10_000.0, 3)
         emit(out)
         return
+    if args.config == 7:
+        out["metric"] = (
+            "pipeline speedup (async vs sync minimization, deep raft "
+            "ddmin+internal)"
+        )
+        out["unit"] = "x"
+        out["config7"] = bench_config7(jax)
+        out["value"] = out["config7"].get("speedup")
+        # Target: >= 1.3x end-to-end on CPU at the default depth.
+        out["vs_baseline"] = round((out["value"] or 0) / 1.3, 3)
+        emit(out)
+        return
     if args.config == "rehearsal":
         out["metric"] = (
             "schedules/sec (config-5 machinery rehearsal, >=1e5 lanes)"
@@ -941,6 +1156,7 @@ def main():
     config4 = bench_config4(jax)
     config5 = bench_config5(jax)
     config6 = bench_config6(jax)
+    config7 = bench_config7(jax)
     rehearsal = bench_config5_rehearsal(jax)
     out.update(
         {
@@ -967,6 +1183,7 @@ def main():
             "config4": config4,
             "config5": config5,
             "config6": config6,
+            "config7": config7,
             "config5_rehearsal": rehearsal,
         }
     )

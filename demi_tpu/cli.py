@@ -251,6 +251,10 @@ def cmd_minimize(args) -> int:
         # Same contract as --impl: the env switch is what the checker /
         # DPOR constructors read, so the flag reaches every stage.
         os.environ["DEMI_PREFIX_FORK"] = "1"
+    if getattr(args, "async_min", False):
+        # The checker and every minimizer read DEMI_ASYNC_MIN, so the
+        # whole gamut pipelines without threading a parameter through.
+        os.environ["DEMI_ASYNC_MIN"] = "1"
     from .runner import FuzzResult, print_minimization_stats, run_the_gamut
     from .serialization import ExperimentDeserializer, ExperimentSerializer
 
@@ -831,6 +835,16 @@ def main(argv: Optional[list] = None) -> int:
                  "DEMI_PREFIX_FORK=1 does the same; off by default)",
         )
 
+    def async_min_flags(p):
+        p.add_argument(
+            "--async-min", action="store_true", dest="async_min",
+            help="async minimization pipeline: lower-once/gather-many "
+                 "candidate lowering, dispatch/harvest split, and "
+                 "speculative next-level dispatch into idle padded lanes "
+                 "(bit-identical verdicts and MCS; DEMI_ASYNC_MIN=1 does "
+                 "the same; off by default)",
+        )
+
     p = sub.add_parser("fuzz", help="random fuzzing until a violation")
     common(p)
     obs_flags(p)
@@ -847,6 +861,7 @@ def main(argv: Optional[list] = None) -> int:
     common(p)
     obs_flags(p)
     fork_flags(p)
+    async_min_flags(p)
     p.add_argument("-e", "--experiment", required=True)
     p.add_argument("--no-wildcards", action="store_true")
     p.add_argument(

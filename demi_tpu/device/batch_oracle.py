@@ -9,12 +9,24 @@ produce the bookkeeping EventTrace.
 
 Record arrays are padded to one static shape so every round reuses the same
 compiled kernel.
+
+Async pipeline surface (DEMI_ASYNC_MIN=1 / ``async_min=True``): the
+checker adds a ``dispatch``/``harvest`` split (``PendingVerdicts`` keeps
+verdict codes on device — no per-group blocking ``np.asarray``), a
+``CandidateLowerer`` so a level's candidates lower as row-gathers off one
+base lowering, and speculative candidate lanes riding the padded buckets:
+harvested speculative codes seed a digest-keyed verdict cache the next
+dispatch consumes, shrinking (or skipping) its launch. Verdicts are a
+pure function of a lane's record bytes — replay lanes never consume rng —
+so every async answer is bit-identical to the synchronous path's
+(tests/test_async_min.py pins this).
 """
 
 from __future__ import annotations
 
 import os
-from typing import Any, Callable, List, Optional, Sequence
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -24,12 +36,34 @@ from .. import obs
 from ..config import SchedulerConfig
 from ..dsl import DSLApp
 from ..external_events import ExternalEvent
+from ..minimization.pipeline import (
+    DEFAULT_SPECULATION_CAP,
+    async_min_enabled,
+    padded_bucket,
+)
 from ..minimization.test_oracle import IntViolation, TestOracle
 from ..schedulers.replay import STSScheduler
 from ..trace import EventTrace
 from .core import DeviceConfig
-from .encoding import lower_expected_trace
+from .encoding import CandidateLowerer, lower_expected_trace
 from .replay import make_replay_kernel
+
+#: Per-bucket-size replay key batches. Replay lanes never consume their
+#: rng (injection and prescribed dispatch never split it), yet every
+#: group/level used to rebuild ``jax.random.split(PRNGKey(0), bucket)``
+#: from scratch — pure host churn on the minimization hot path. Bucket
+#: sizes are power-of-two (plus mesh-rounded) so a handful of entries
+#: serve a whole gamut run.
+_REPLAY_KEYS: Dict[int, Any] = {}
+
+
+def replay_keys(bucket: int):
+    keys = _REPLAY_KEYS.get(bucket)
+    if keys is None:
+        keys = _REPLAY_KEYS[bucket] = jax.random.split(
+            jax.random.PRNGKey(0), bucket
+        )
+    return keys
 
 
 def default_device_config(
@@ -74,6 +108,7 @@ class DeviceReplayChecker:
         mesh=None,
         prefix_fork: Optional[bool] = None,
         fork_bucket: int = 8,
+        async_min: Optional[bool] = None,
     ):
         self.app = app
         self.cfg = cfg
@@ -134,16 +169,81 @@ class DeviceReplayChecker:
                 self._fork_kernel = make_replay_kernel(
                     app, cfg, start_state=True
                 )
+            from .fork import make_replay_prefix_resume_runner
+
             self._forker = PrefixForker(
                 make_replay_prefix_runner(app, cfg),
                 bucket=fork_bucket,
                 driver="replay",
+                # Hierarchical trunks: derive a missing trunk by resuming
+                # the nearest cached ancestor over only the remaining
+                # bucket rows (bit-exact vs a scratch trunk run).
+                resume_runner=make_replay_prefix_resume_runner(app, cfg),
             )
+        # Async minimization pipeline (DEMI_ASYNC_MIN=1 / --async-min):
+        # lower-once/gather-many candidate lowering, dispatch/harvest
+        # split (verdicts stay on device until harvested), speculative
+        # next-level candidates riding the idle padded lanes. Verdicts
+        # are a pure function of a lane's record bytes (replay never
+        # consumes rng), so every async answer is bit-identical to the
+        # synchronous path's.
+        self._async = async_min_enabled(async_min)
+        self._lowerer = (
+            CandidateLowerer(app, cfg, self.max_records) if self._async else None
+        )
+        self._spec_cache: Dict[bytes, int] = {}
+        self.pipeline_stats = {
+            "dispatches": 0,
+            "launches": 0,
+            "lanes_launched": 0,
+            "spec_dispatched": 0,
+            "spec_hits": 0,
+            "spec_waste": 0,
+            "dispatch_seconds": 0.0,
+            "overlap_seconds": 0.0,
+            "harvest_wait_seconds": 0.0,
+        }
+
+    @property
+    def async_enabled(self) -> bool:
+        return self._async
 
     @property
     def fork_stats(self) -> Optional[dict]:
         """Prefix-fork statistics (None when forking is off)."""
         return None if self._forker is None else self._forker.stats_view()
+
+    def pipeline_snapshot(self) -> dict:
+        """Pipeline statistics + the lowering cache's view — what bench
+        config 7 and the CLI surface (None-safe: zeros when async is
+        off)."""
+        out = dict(self.pipeline_stats)
+        if self._lowerer is not None:
+            out.update(
+                {f"lower_{k}": v for k, v in self._lowerer.stats.items()}
+            )
+            out["lowering_cache_hit_rate"] = round(
+                self._lowerer.hit_rate(), 3
+            )
+        from ..minimization.pipeline import overlap_fraction
+
+        out["overlap_fraction"] = round(overlap_fraction(out), 3)
+        for k in ("overlap_seconds", "harvest_wait_seconds"):
+            out[k] = round(out[k], 4)
+        return out
+
+    def prime_base(
+        self, trace: EventTrace, externals: Sequence[ExternalEvent]
+    ) -> None:
+        """Register a level/round baseline with the gather lowerer so its
+        candidate subsequences lower as row-gathers. No-op when async is
+        off; a base too large for the static record shape is skipped
+        (its candidates full-lower — correct, just slower)."""
+        if self._lowerer is not None:
+            try:
+                self._lowerer.register_base(trace, list(externals))
+            except ValueError:
+                pass
 
     def verdicts(
         self,
@@ -153,6 +253,14 @@ class DeviceReplayChecker:
     ) -> List[bool]:
         if not candidates:
             return []
+        if self._async:
+            # Same codes, same order — dispatch/harvest back-to-back still
+            # consults the speculative verdict cache and the gather
+            # lowerer, so synchronous call sites share the pipeline's
+            # host-side wins.
+            return self.dispatch(
+                candidates, externals_per_candidate, target_code
+            ).harvest()
         records = np.stack(
             [
                 lower_expected_trace(
@@ -176,6 +284,198 @@ class DeviceReplayChecker:
             obs.counter("device.replay.reproductions").inc(hits)
         return [int(c) == target_code for c in codes]
 
+    # -- async pipeline: dispatch/harvest split -----------------------------
+
+    def dispatch(
+        self,
+        candidates: Sequence[EventTrace],
+        externals_per_candidate: Sequence[Sequence[ExternalEvent]],
+        target_code: int,
+        speculate: Optional[
+            Sequence[Tuple[EventTrace, Sequence[ExternalEvent]]]
+        ] = None,
+    ) -> "PendingVerdicts":
+        """Launch every candidate's replay and return WITHOUT pulling the
+        verdicts off device (no blocking ``np.asarray`` — not even per
+        fork group). ``speculate`` offers next-level candidates that ride
+        the launches' idle padded lanes (the lanes that today replay
+        duplicate rows); their harvested codes seed a digest-keyed verdict
+        cache the NEXT dispatch consults, so a correct prediction turns a
+        whole level into cache hits. Requires ``async_min``."""
+        if not self._async:
+            raise RuntimeError(
+                "DeviceReplayChecker.dispatch requires async_min "
+                "(DEMI_ASYNC_MIN=1 / --async-min)"
+            )
+        t0 = time.perf_counter()
+        n = len(candidates)
+        pending = PendingVerdicts(self, n, target_code)
+        if n == 0:
+            return pending
+        self.pipeline_stats["dispatches"] += 1
+        lowered = [
+            self._lowerer.lower(cand, list(ext))
+            for cand, ext in zip(candidates, externals_per_candidate)
+        ]
+        records = np.stack([r for r, _ in lowered])
+        # Consume the previous launch's speculative verdicts (digest-keyed:
+        # a verdict is a pure function of the record bytes). The cache is
+        # single-shot — whatever this dispatch doesn't consume was a
+        # misprediction and is discarded.
+        consumed = set()
+        for i, (_, digest) in enumerate(lowered):
+            code = self._spec_cache.get(digest)
+            if code is not None:
+                pending.codes[i] = code
+                consumed.add(digest)
+        waste = len(self._spec_cache) - len(consumed)
+        if self._spec_cache:
+            self.pipeline_stats["spec_hits"] += len(consumed)
+            self.pipeline_stats["spec_waste"] += waste
+            obs.counter("pipe.spec_hits").inc(len(consumed))
+            obs.counter("pipe.spec_waste").inc(waste)
+        self._spec_cache = {}
+        todo = [i for i in range(n) if pending.codes[i] == pending.UNRESOLVED]
+        spec_pool: List[list] = []
+        for strace, sext in list(speculate or [])[:DEFAULT_SPECULATION_CAP]:
+            srec, sdig = self._lowerer.lower(strace, list(sext))
+            spec_pool.append([sdig, srec, False])
+        if todo:
+            if self._forker is not None and len(todo) >= 2:
+                self._dispatch_forked(pending, records, todo, spec_pool)
+            else:
+                self._dispatch_scratch(pending, records, todo, spec_pool)
+        elif spec_pool:
+            # Every candidate was a speculation hit: the level costs no
+            # launch at all, and the NEXT level's speculation rides a
+            # padding-only launch sized to one bucket.
+            self._dispatch_scratch(pending, records, [], spec_pool)
+        pending.mark_dispatched(time.perf_counter() - t0)
+        return pending
+
+    def _dispatch_scratch(
+        self,
+        pending: "PendingVerdicts",
+        records: np.ndarray,
+        idxs: List[int],
+        spec_pool: List[list],
+    ) -> None:
+        """Scratch-replay launch for candidate positions ``idxs``, with
+        speculative candidates packed into the padding lanes (leftover
+        padding replays row 0, exactly like the synchronous path)."""
+        rows = [records[np.asarray(idxs, np.intp)]] if idxs else []
+        m = len(idxs)
+        # padded_bucket is the ONE bucket formula: speculation_room's
+        # free-lane estimate in minimization/pipeline.py assumes it
+        # matches the dispatch-side padding exactly.
+        bucket = padded_bucket(m)
+        if self.mesh is not None:
+            from ..parallel.mesh import pad_batch_to_devices
+
+            bucket = pad_batch_to_devices(bucket, self.mesh)
+        spec_lanes: List[Tuple[int, bytes]] = []
+        fill: List[np.ndarray] = []
+        for entry in spec_pool:
+            if m + len(fill) >= bucket:
+                break
+            if entry[2]:
+                continue
+            entry[2] = True
+            spec_lanes.append((m + len(fill), entry[0]))
+            fill.append(entry[1])
+        if fill:
+            rows.append(np.stack(fill))
+        pad = bucket - m - len(fill)
+        if pad:
+            first = records[idxs[0]] if idxs else (
+                fill[0] if fill else records[0]
+            )
+            rows.append(np.repeat(first[None], pad, axis=0))
+        batch = np.concatenate(rows) if len(rows) > 1 else rows[0]
+        res = self.kernel(batch, replay_keys(bucket))
+        self.pipeline_stats["launches"] += 1
+        self.pipeline_stats["lanes_launched"] += bucket
+        if obs.enabled():
+            obs.counter("device.replay.pad_lanes").inc(pad)
+        pending.add_part(
+            res.violation,
+            np.asarray(idxs, np.intp),
+            np.arange(len(idxs), dtype=np.intp),
+            spec_lanes,
+        )
+
+    def _dispatch_forked(
+        self,
+        pending: "PendingVerdicts",
+        records: np.ndarray,
+        idxs: List[int],
+        spec_pool: List[list],
+    ) -> None:
+        """Prefix-fork launches with deferred harvest: same grouping,
+        trunks (hierarchical), and fork kernels as ``_forked_codes``, but
+        each group's violation vector stays on device until the pending
+        handle is harvested. Speculative candidates ride a group's padding
+        only when they share the group's prefix byte-exactly (their fork
+        suffix is then well-defined); the rest ride the scratch launch."""
+        from .fork import padded_size
+
+        sub = records[np.asarray(idxs, np.intp)]
+        lengths = (sub[:, :, 0] != 0).sum(axis=1)
+        groups, scratch = self._forker.plan(sub, lengths)
+        r = sub.shape[1]
+        for g in groups:
+            if not self._forker.should_fork(g):
+                scratch.extend(g.indices)
+                continue
+            p = g.prefix_len
+            trunk_records = np.zeros_like(sub[0])
+            trunk_records[:p] = sub[g.indices[0], :p]
+            snap, trunk_steps, hit = self._forker.trunk_hier(
+                g.key, trunk_records, jax.random.PRNGKey(0), p
+            )
+            k = len(g.indices)
+            suffixes = np.zeros((k, r, sub.shape[2]), np.int32)
+            suffixes[:, : r - p] = sub[g.indices, p:]
+            bucket = padded_size(k, self.mesh)
+            spec_lanes: List[Tuple[int, bytes]] = []
+            fill: List[np.ndarray] = []
+            prefix_bytes = sub[g.indices[0], :p].tobytes()
+            for entry in spec_pool:
+                if k + len(fill) >= bucket:
+                    break
+                if entry[2] or entry[1][:p].tobytes() != prefix_bytes:
+                    continue
+                entry[2] = True
+                spec_lanes.append((k + len(fill), entry[0]))
+                srow = np.zeros((r, sub.shape[2]), np.int32)
+                srow[: r - p] = entry[1][p:]
+                fill.append(srow)
+            parts = [suffixes]
+            if fill:
+                parts.append(np.stack(fill))
+            pad = bucket - k - len(fill)
+            if pad:
+                parts.append(np.repeat(suffixes[:1], pad, axis=0))
+            batch = np.concatenate(parts) if len(parts) > 1 else parts[0]
+            res = self._fork_kernel(batch, replay_keys(bucket), snap)
+            self.pipeline_stats["launches"] += 1
+            self.pipeline_stats["lanes_launched"] += bucket
+            pending.add_part(
+                res.violation,
+                np.asarray([idxs[i] for i in g.indices], np.intp),
+                np.arange(k, dtype=np.intp),
+                spec_lanes,
+            )
+            self._forker.note_group(k, trunk_steps, hit)
+        if scratch:
+            self._dispatch_scratch(
+                pending, records, [idxs[i] for i in scratch], spec_pool
+            )
+            self._forker.note_scratch(len(scratch))
+        # Leftover speculation (no scratch launch, no prefix-compatible
+        # group padding) is simply dropped: speculation only ever rides
+        # lanes that already exist — it never pays for its own launch.
+
     def _scratch_codes(self, records: np.ndarray, n: int) -> np.ndarray:
         """Replay ``records`` from step 0 and return per-lane violation
         codes. Pads the batch axis to a power-of-two bucket: DDMin levels
@@ -184,7 +484,7 @@ class DeviceReplayChecker:
         (profiled: a 150-delivery raft case spent ~4 min, ~100 compiles,
         in ONE internal stage). Padding rows replay candidate 0 again;
         their verdicts are sliced off."""
-        bucket = max(8, 1 << (n - 1).bit_length())
+        bucket = padded_bucket(n)
         if self.mesh is not None:
             from ..parallel.mesh import pad_batch_to_devices
 
@@ -193,8 +493,7 @@ class DeviceReplayChecker:
             records = np.concatenate(
                 [records, np.repeat(records[:1], bucket - n, axis=0)]
             )
-        keys = jax.random.split(jax.random.PRNGKey(0), bucket)
-        res = self.kernel(records, keys)
+        res = self.kernel(records, replay_keys(bucket))
         if obs.enabled():
             obs.counter("device.replay.pad_lanes").inc(bucket - n)
         return np.asarray(res.violation)[:n]
@@ -218,8 +517,8 @@ class DeviceReplayChecker:
             p = g.prefix_len
             trunk_records = np.zeros_like(records[0])
             trunk_records[:p] = records[g.indices[0], :p]
-            snap, trunk_steps, hit = self._forker.trunk(
-                g.key, trunk_records, jax.random.PRNGKey(0)
+            snap, trunk_steps, hit = self._forker.trunk_hier(
+                g.key, trunk_records, jax.random.PRNGKey(0), p
             )
             suffixes = np.zeros(
                 (len(g.indices), r, records.shape[2]), np.int32
@@ -230,8 +529,7 @@ class DeviceReplayChecker:
                 suffixes = np.concatenate(
                     [suffixes, np.repeat(suffixes[:1], bucket - len(g.indices), axis=0)]
                 )
-            keys = jax.random.split(jax.random.PRNGKey(0), bucket)
-            res = self._fork_kernel(suffixes, keys, snap)
+            res = self._fork_kernel(suffixes, replay_keys(bucket), snap)
             codes[np.asarray(g.indices)] = np.asarray(res.violation)[
                 : len(g.indices)
             ]
@@ -262,13 +560,86 @@ class DeviceReplayChecker:
         return sts.test_with_trace(candidate, list(externals), violation)
 
 
+class PendingVerdicts:
+    """Handle for a dispatched candidate batch: verdict codes stay on
+    device (one ``np.asarray`` per launch happens only inside
+    ``harvest``), so the host plans — and speculatively executes — while
+    the device crunches. The wall clock between dispatch-return and
+    harvest is the pipeline's overlap; the blocking pull inside harvest
+    is what's left of the old per-group stall."""
+
+    UNRESOLVED = -(1 << 40)  # outside the int32 violation-code range
+
+    def __init__(self, checker: DeviceReplayChecker, n: int, target_code: int):
+        self.checker = checker
+        self.n = n
+        self.target_code = target_code
+        self.codes = np.full(n, self.UNRESOLVED, np.int64)
+        self._parts: List[tuple] = []
+        self._dispatched_at: Optional[float] = None
+        self._verdicts: Optional[List[bool]] = None
+
+    def add_part(self, violation_dev, cand_idx, lane_idx, spec_lanes) -> None:
+        self._parts.append((violation_dev, cand_idx, lane_idx, spec_lanes))
+
+    def mark_dispatched(self, dispatch_seconds: float) -> None:
+        self.checker.pipeline_stats["dispatch_seconds"] += dispatch_seconds
+        self._dispatched_at = time.perf_counter()
+
+    def harvest(self) -> List[bool]:
+        """Pull every part's codes host-side (idempotent) and seed the
+        checker's speculative verdict cache from the spec lanes."""
+        if self._verdicts is not None:
+            return self._verdicts
+        stats = self.checker.pipeline_stats
+        if self._dispatched_at is not None:
+            overlap = time.perf_counter() - self._dispatched_at
+            stats["overlap_seconds"] += overlap
+            obs.counter("pipe.overlap_seconds").inc(overlap)
+        t0 = time.perf_counter()
+        spec_count = 0
+        for violation_dev, cand_idx, lane_idx, spec_lanes in self._parts:
+            arr = np.asarray(violation_dev)
+            if cand_idx.size:
+                self.codes[cand_idx] = arr[lane_idx]
+            for lane, digest in spec_lanes:
+                self.checker._spec_cache[digest] = int(arr[lane])
+                spec_count += 1
+        self._parts = []
+        wait = time.perf_counter() - t0
+        stats["harvest_wait_seconds"] += wait
+        stats["spec_dispatched"] += spec_count
+        obs.counter("pipe.harvest_wait_seconds").inc(wait)
+        if spec_count:
+            obs.counter("pipe.spec_dispatched").inc(spec_count)
+        if self.n and bool((self.codes == self.UNRESOLVED).any()):
+            raise RuntimeError(
+                "PendingVerdicts.harvest: unresolved candidate lanes"
+            )
+        self._verdicts = [int(c) == self.target_code for c in self.codes]
+        if obs.enabled():
+            obs.counter("device.replay.candidates").inc(self.n)
+            obs.counter("device.replay.reproductions").inc(
+                sum(self._verdicts)
+            )
+        return self._verdicts
+
+
 def make_batched_internal_check(
     checker: DeviceReplayChecker,
     externals: Sequence[ExternalEvent],
     violation: IntViolation,
 ) -> Callable[[List[EventTrace]], List[Optional[EventTrace]]]:
     """batch_check for BatchedInternalMinimizer: device verdicts for all
-    candidates, host execution only for the first reproducing one."""
+    candidates, host execution only for the first reproducing one.
+
+    The returned closure also carries the async-pipeline surface the
+    speculative minimizer round uses when the checker runs with
+    ``async_min``: ``dispatch_round`` (non-blocking launch with a base
+    hint for the gather lowerer + speculative next-round candidates),
+    ``host_execute`` (the bookkeeping STS execution, callable BETWEEN
+    dispatch and harvest so it overlaps device work), and
+    ``supports_async``."""
 
     def batch_check(candidates: List[EventTrace]) -> List[Optional[EventTrace]]:
         verdicts = checker.verdicts(
@@ -285,6 +656,26 @@ def make_batched_internal_check(
                     break
         return out
 
+    def dispatch_round(
+        candidates: List[EventTrace],
+        base: Optional[EventTrace] = None,
+        speculate: Optional[List[EventTrace]] = None,
+    ) -> PendingVerdicts:
+        if base is not None:
+            checker.prime_base(base, externals)
+        return checker.dispatch(
+            candidates,
+            [externals] * len(candidates),
+            violation.code,
+            speculate=[(s, externals) for s in (speculate or [])],
+        )
+
+    def host_execute(candidate: EventTrace) -> Optional[EventTrace]:
+        return checker.host_executed_trace(candidate, externals, violation)
+
+    batch_check.dispatch_round = dispatch_round
+    batch_check.host_execute = host_execute
+    batch_check.supports_async = checker.async_enabled
     return batch_check
 
 
@@ -307,6 +698,14 @@ class DeviceSTSOracle(TestOracle):
         self.checker = checker or DeviceReplayChecker(app, cfg, config)
         self.original_trace = original_trace
         self.config = config
+        self._primed = False
+
+    @property
+    def supports_async(self) -> bool:
+        """True when the backing checker runs the async pipeline — what
+        the speculative minimizers probe before using dispatch_batch /
+        test_window."""
+        return self.checker.async_enabled
 
     def _project(self, externals: Sequence[ExternalEvent]) -> EventTrace:
         return (
@@ -332,9 +731,75 @@ class DeviceSTSOracle(TestOracle):
         )
 
     def test_batch(
-        self, candidates: Sequence[Sequence[ExternalEvent]], violation_fingerprint
+        self,
+        candidates: Sequence[Sequence[ExternalEvent]],
+        violation_fingerprint,
     ) -> List[bool]:
+        self._prime()
         projected = [self._project(c) for c in candidates]
         return self.checker.verdicts(
             projected, candidates, violation_fingerprint.code
         )
+
+    def _prime(self) -> None:
+        """Register the MASTER base with the gather lowerer: the filtered
+        original trace. Every candidate projection — any external subset,
+        any known-absent pruning outcome — is an event-subsequence of it
+        (projection only ever drops events), so one registration serves
+        every ddmin level."""
+        if not self.checker.async_enabled or self._primed:
+            return
+        self._primed = True
+        ext = self.original_trace.original_externals
+        if ext is None:
+            return
+        master = (
+            self.original_trace.filter_failure_detector_messages()
+            .filter_checkpoint_messages()
+        )
+        self.checker.prime_base(master, list(ext))
+
+    def dispatch_batch(
+        self,
+        candidates: Sequence[Sequence[ExternalEvent]],
+        violation_fingerprint,
+        speculate: Optional[Sequence[Sequence[ExternalEvent]]] = None,
+    ) -> PendingVerdicts:
+        """Non-blocking ``test_batch``: returns the pending handle, with
+        ``speculate`` (the predicted NEXT level's candidates) riding the
+        launch's idle padded lanes. Requires the checker's async mode."""
+        self._prime()
+        projected = [self._project(c) for c in candidates]
+        spec = [(self._project(s), s) for s in (speculate or [])]
+        return self.checker.dispatch(
+            projected, candidates, violation_fingerprint.code, speculate=spec
+        )
+
+    def test_window(
+        self,
+        candidates: Sequence[Sequence[ExternalEvent]],
+        violation_fingerprint,
+    ) -> List[Callable[[], Optional[EventTrace]]]:
+        """One device launch for a whole speculation window of ``test``
+        calls: returns per-candidate lazy resolvers. ``resolvers[i]()``
+        behaves exactly like ``test(candidates[i], ...)`` — device verdict
+        gates a host bookkeeping execution — but the device work for the
+        whole window was batched up front, so a sequential scan that
+        consults only a prefix of the window (stopping at its first
+        reproduction) discards the rest as speculation waste."""
+        self._prime()
+        projected = [self._project(c) for c in candidates]
+        verdicts = self.checker.verdicts(
+            projected, candidates, violation_fingerprint.code
+        )
+
+        def resolver(i: int) -> Optional[EventTrace]:
+            if not verdicts[i]:
+                return None
+            return self.checker.host_executed_trace(
+                projected[i], candidates[i], violation_fingerprint
+            )
+
+        return [
+            (lambda i=i: resolver(i)) for i in range(len(candidates))
+        ]

@@ -10,10 +10,13 @@ Unique ids, MsgSends, and markers for the minimization stack).
 
 from __future__ import annotations
 
+import hashlib
+from collections import OrderedDict
 from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from .. import obs
 from ..dsl import DSLApp
 from ..events import (
     BeginWaitCondition,
@@ -146,37 +149,39 @@ def _actor_or_external(app: DSLApp, name: str) -> int:
         return app.num_actors
 
 
-def lower_expected_trace(
+def lower_expected_rows(
     app: DSLApp,
     cfg: DeviceConfig,
     trace: EventTrace,
     externals: Sequence[ExternalEvent],
-    max_records: int,
-) -> np.ndarray:
-    """Lower a projected/filtered EventTrace (the output of
-    subsequence_intersection) into replay records [max_records, rec_width].
-
-    External Send payloads are re-bound via their constructors first, and
-    the corresponding delivery records carry the re-bound payload (uid
-    linkage), so payload shrinking composes with device replay."""
+) -> List[Tuple[int, Optional[List[int]]]]:
+    """Per-event record rows for a projected/filtered EventTrace: one
+    ``(uid, row-or-None)`` pair per trace event, in trace order. A ``None``
+    row marks an event with no device meaning in replay (internal sends,
+    wait/quiescence markers). Each row is a pure function of the event
+    itself (plus its own external Send's re-bound payload), which is what
+    makes the ``CandidateLowerer``'s row-gather sound: a candidate that is
+    an event-subsequence of a base trace lowers to exactly the base's rows
+    for the surviving uids."""
     w = cfg.msg_width
     rebound = trace.recompute_external_msg_sends(externals)
-    recs: List[List[int]] = []
+    rows: List[Tuple[int, Optional[List[int]]]] = []
     uid_payload = {}
     for u, ev in zip(trace.events, rebound):
+        row: Optional[List[int]] = None
         if isinstance(ev, SpawnEvent):
-            recs.append([REC_EXT_BASE + OP_START, app.actor_id(ev.name), 0] + [0] * w)
+            row = [REC_EXT_BASE + OP_START, app.actor_id(ev.name), 0] + [0] * w
         elif isinstance(ev, KillEvent):
-            recs.append([REC_EXT_BASE + OP_KILL, app.actor_id(ev.name), 0] + [0] * w)
+            row = [REC_EXT_BASE + OP_KILL, app.actor_id(ev.name), 0] + [0] * w
         elif isinstance(ev, HardKillEvent):
-            recs.append([REC_EXT_BASE + OP_HARDKILL, app.actor_id(ev.name), 0] + [0] * w)
+            row = [REC_EXT_BASE + OP_HARDKILL, app.actor_id(ev.name), 0] + [0] * w
         elif isinstance(ev, PartitionEvent):
-            recs.append(
+            row = (
                 [REC_EXT_BASE + OP_PARTITION, app.actor_id(ev.a), app.actor_id(ev.b)]
                 + [0] * w
             )
         elif isinstance(ev, UnPartitionEvent):
-            recs.append(
+            row = (
                 [REC_EXT_BASE + OP_UNPARTITION, app.actor_id(ev.a), app.actor_id(ev.b)]
                 + [0] * w
             )
@@ -184,9 +189,7 @@ def lower_expected_trace(
             if ev.is_external:
                 payload = _msg_row(app, ev.msg, w)
                 uid_payload[u.id] = payload
-                recs.append(
-                    [REC_EXT_BASE + OP_SEND, app.actor_id(ev.rcv), 0] + payload
-                )
+                row = [REC_EXT_BASE + OP_SEND, app.actor_id(ev.rcv), 0] + payload
             # internal sends re-occur as delivery side effects
         elif isinstance(ev, MsgEvent):
             if isinstance(ev.msg, WildCardMatch):
@@ -202,20 +205,29 @@ def lower_expected_trace(
                         "lowerable to the device tier"
                     )
                 policy = 1 if wc.policy == "last" else 0
-                recs.append(
+                row = (
                     [REC_WILDCARD, app.actor_id(ev.rcv), policy, wc.class_tag]
                     + [0] * (w - 1)
                 )
-                continue
-            src = _actor_or_external(app, ev.snd)
-            payload = uid_payload.get(u.id, None)
-            if payload is None:
-                payload = _msg_row(app, ev.msg, w)
-            recs.append([REC_DELIVERY, src, app.actor_id(ev.rcv)] + payload)
+            else:
+                src = _actor_or_external(app, ev.snd)
+                payload = uid_payload.get(u.id, None)
+                if payload is None:
+                    payload = _msg_row(app, ev.msg, w)
+                row = [REC_DELIVERY, src, app.actor_id(ev.rcv)] + payload
         elif isinstance(ev, TimerDelivery):
             rid = app.actor_id(ev.rcv)
-            recs.append([REC_TIMER, rid, rid] + _msg_row(app, ev.msg, w))
+            row = [REC_TIMER, rid, rid] + _msg_row(app, ev.msg, w)
         # Quiescence / wait markers have no device meaning in replay.
+        rows.append((u.id, row))
+    return rows
+
+
+def _pack_records(
+    cfg: DeviceConfig, recs: Sequence[Sequence[int]], max_records: int
+) -> np.ndarray:
+    """Assemble compact record rows into the padded [max_records,
+    rec_width] array the replay kernels consume, with the shared guards."""
     if len(recs) > max_records:
         raise ValueError(f"expected trace has {len(recs)} records > {max_records}")
     # Records are compact (no mid-sequence REC_NONE holes): the replay
@@ -231,6 +243,183 @@ def lower_expected_trace(
         out[i, : len(r)] = r
     _check_msg_range(cfg, out[:, 3 : 3 + cfg.msg_width])
     return out
+
+
+def lower_expected_trace(
+    app: DSLApp,
+    cfg: DeviceConfig,
+    trace: EventTrace,
+    externals: Sequence[ExternalEvent],
+    max_records: int,
+) -> np.ndarray:
+    """Lower a projected/filtered EventTrace (the output of
+    subsequence_intersection) into replay records [max_records, rec_width].
+
+    External Send payloads are re-bound via their constructors first, and
+    the corresponding delivery records carry the re-bound payload (uid
+    linkage), so payload shrinking composes with device replay."""
+    recs = [row for _uid, row in lower_expected_rows(app, cfg, trace, externals)
+            if row is not None]
+    return _pack_records(cfg, recs, max_records)
+
+
+class CandidateLowerer:
+    """Lower-once/gather-many candidate lowering (the async-minimization
+    pipeline's host-side hot-path fix): ddmin and internal-minimization
+    candidates are event-subsequences of one base trace, so the base is
+    lowered to per-event rows ONCE and each candidate materializes as a
+    NumPy row-gather instead of a fresh ``lower_expected_trace`` Python
+    loop. Soundness rests on ``lower_expected_rows``: a surviving event's
+    row depends only on the event (and its own Send's payload), and
+    subsequence projection / delivery removal reuse the base trace's
+    ``Unique`` objects, so gathered rows equal a from-scratch lowering
+    byte-for-byte (pinned by tests/test_async_min.py).
+
+    Two LRU layers: ``bases`` (uid -> row-index maps + the packed row
+    matrix) and ``candidates`` keyed by (base token, surviving-uid tuple)
+    — equivalently the (trace id, removed-index set) of the level that
+    produced the candidate. Unknown uids (wildcarded deliveries get fresh
+    Uniques, host re-executions renumber) fall back to a full lowering,
+    which is then registered as a new base so the NEXT round's candidates
+    gather again."""
+
+    def __init__(
+        self,
+        app: DSLApp,
+        cfg: DeviceConfig,
+        max_records: int,
+        base_capacity: int = 8,
+        candidate_capacity: int = 256,
+    ):
+        self.app = app
+        self.cfg = cfg
+        self.max_records = max_records
+        self.base_capacity = base_capacity
+        self.candidate_capacity = candidate_capacity
+        self._bases: "OrderedDict[int, dict]" = OrderedDict()
+        self._candidates: "OrderedDict[tuple, tuple]" = OrderedDict()
+        self._base_token = 0
+        self.stats = {"full": 0, "gathers": 0, "cached": 0, "bases": 0}
+
+    def hit_rate(self) -> float:
+        """Fraction of lowerings served without a full Python lowering."""
+        served = self.stats["gathers"] + self.stats["cached"]
+        total = served + self.stats["full"]
+        return served / total if total else 0.0
+
+    def _register_base(self, rows: np.ndarray, row_of, ref) -> int:
+        self._base_token += 1
+        self._bases[self._base_token] = {
+            "rows": rows, "row_of": row_of, "ref": ref,
+        }
+        self.stats["bases"] += 1
+        while len(self._bases) > self.base_capacity:
+            self._bases.popitem(last=False)
+        return self._base_token
+
+    def register_base(
+        self, trace: EventTrace, externals: Sequence[ExternalEvent]
+    ) -> None:
+        """Explicitly lower+register a base (e.g. a round's baseline or a
+        ddmin level's current dag projection) so the level's candidates
+        gather instead of full-lowering. Idempotent enough: a base whose
+        uid set is already gatherable registers via the gather path."""
+        self._lower_impl(trace, externals, register=True)
+
+    def lower(
+        self, trace: EventTrace, externals: Sequence[ExternalEvent]
+    ) -> Tuple[np.ndarray, bytes]:
+        """Lower one candidate; returns (records [max_records, rec_width],
+        digest). The digest keys the speculative verdict cache: verdicts
+        are a pure function of the record bytes (replay lanes never
+        consume rng), so equal digests may share a verdict bit-exactly."""
+        return self._lower_impl(trace, externals, register=False)
+
+    def _lower_impl(self, trace, externals, register: bool):
+        # Keys are Unique WRAPPER identities, not Unique.id: a MsgSend and
+        # its delivery share one uid (the send/delivery linkage), and
+        # wildcard minimization rewraps deliveries into fresh events under
+        # the same uid — both would alias a uid-keyed map. The base holds
+        # references to its wrappers, so a live id() can't be reused and
+        # ``ref.get(id(u)) is u`` means exactly "this event, unmodified,
+        # is part of the base". Identity misses fall back to a full
+        # lowering (correct for wildcarded / re-executed traces).
+        keys = tuple(id(u) for u in trace.events)
+        for token in reversed(self._bases):
+            base = self._bases[token]
+            row_of, ref = base["row_of"], base["ref"]
+            idx: List[int] = []
+            ok = True
+            for u in trace.events:
+                k = id(u)
+                if ref.get(k) is not u:
+                    ok = False
+                    break
+                r = row_of.get(k)
+                if r is not None:
+                    # Subsequence order check rides along: gathered row
+                    # indices must be strictly increasing.
+                    if idx and r <= idx[-1]:
+                        ok = False
+                        break
+                    idx.append(r)
+            if not ok:
+                continue
+            cand_key = (token, keys)
+            # register=True must reach the gather path below (the point
+            # is to install a new base), so it skips the shortcut.
+            hit = None if register else self._candidates.get(cand_key)
+            if hit is not None:
+                self._candidates.move_to_end(cand_key)
+                self.stats["cached"] += 1
+                obs.counter("pipe.lower_cached").inc()
+                return hit
+            if len(idx) > self.max_records:
+                raise ValueError(
+                    f"expected trace has {len(idx)} records > {self.max_records}"
+                )
+            rows = base["rows"][np.asarray(idx, np.intp)] if idx else (
+                np.zeros((0, self.cfg.rec_width), np.int32)
+            )
+            out = np.zeros((self.max_records, self.cfg.rec_width), np.int32)
+            out[: len(idx)] = rows
+            digest = hashlib.blake2b(out.tobytes(), digest_size=16).digest()
+            self.stats["gathers"] += 1
+            obs.counter("pipe.lower_gather").inc()
+            if register:
+                new_row_of = {}
+                for u in trace.events:
+                    if id(u) in row_of:
+                        new_row_of[id(u)] = len(new_row_of)
+                self._register_base(
+                    rows, new_row_of, {id(u): u for u in trace.events}
+                )
+            self._remember_candidate((token, keys), out, digest)
+            return out, digest
+
+        # No base covers this candidate: full lowering, registered as a
+        # fresh base so the next round's subsequences gather.
+        pairs = lower_expected_rows(self.app, self.cfg, trace, externals)
+        recs = [row for _uid, row in pairs if row is not None]
+        out = _pack_records(self.cfg, recs, self.max_records)
+        digest = hashlib.blake2b(out.tobytes(), digest_size=16).digest()
+        self.stats["full"] += 1
+        obs.counter("pipe.lower_full").inc()
+        row_of: dict = {}
+        for u, (_uid, row) in zip(trace.events, pairs):
+            if row is not None:
+                row_of[id(u)] = len(row_of)
+        token = self._register_base(
+            out[: len(recs)].copy(), row_of, {id(u): u for u in trace.events}
+        )
+        self._remember_candidate((token, keys), out, digest)
+        return out, digest
+
+    def _remember_candidate(self, key, records, digest) -> None:
+        self._candidates[key] = (records, digest)
+        self._candidates.move_to_end(key)
+        while len(self._candidates) > self.candidate_capacity:
+            self._candidates.popitem(last=False)
 
 
 # ---------------------------------------------------------------------------

@@ -38,11 +38,20 @@ explicit ``prefix_fork=True`` constructor args). With it off, kernels are
 built without the ``start_state`` input and their lowering is
 byte-identical to the pre-fork tree.
 
+Hierarchical trunks (``PrefixForker.trunk_hier`` +
+``make_replay_prefix_resume_runner``): a trunk-cache miss no longer
+replays its full prefix — the nearest cached ancestor trunk (one or more
+planner buckets shorter) is resumed over just the remaining rows, so a
+miss costs O(bucket) and the PrefixCache becomes a trunk tree shared
+across ddmin levels and DPOR rounds. Wired into the replay checker
+(minimization's oracle); the DPOR/sweep drivers keep full-prefix trunks.
+
 Telemetry (``fork.*`` series, plus ``dpor.prefix_group_size``): cache
-hits/misses, ``fork.steps_saved`` (prefix steps the fork lanes did NOT
-re-execute, net of the trunk's own run on a cache miss), and group-size
-histograms — the signal a future tuner can use to learn the bucket
-granularity.
+hits/misses, ``fork.trunk_parent_hits`` (misses served by resuming an
+ancestor trunk), ``fork.steps_saved`` (prefix steps the fork lanes did
+NOT re-execute, net of the trunk's own run on a cache miss), and
+group-size histograms — the signal the tuner's ``calibrate_fork`` axis
+(demi_tpu/tune) uses to learn the bucket granularity.
 """
 
 from __future__ import annotations
@@ -59,6 +68,7 @@ import jax.numpy as jnp
 
 from .. import obs
 from ..dsl import DSLApp
+from ..minimization.pipeline import padded_bucket
 from . import ops
 from .core import (
     REC_NONE,
@@ -114,9 +124,11 @@ def prefix_digest(*parts: bytes) -> bytes:
 
 
 def pad_pow2(n: int, floor: int = 8) -> int:
-    """Power-of-two batch bucket (same scheme as the replay checker's
-    level padding) so fork-group launches reuse compiled shapes."""
-    return max(floor, 1 << (n - 1).bit_length())
+    """Power-of-two batch bucket so fork-group launches reuse compiled
+    shapes. Delegates to ``pipeline.padded_bucket`` — the ONE bucket
+    formula; ``speculation_room``'s free-lane estimate assumes dispatch
+    padding matches it exactly."""
+    return max(floor, padded_bucket(n))
 
 
 def padded_size(n: int, mesh=None) -> int:
@@ -172,6 +184,51 @@ def make_replay_prefix_runner(app: DSLApp, cfg: DeviceConfig):
         )
 
     return jax.jit(run_prefix)
+
+
+def make_replay_prefix_resume_runner(app: DSLApp, cfg: DeviceConfig):
+    """jitted ``resume_prefix(records[R, recw], snap) -> PrefixSnapshot``:
+    extend a cached ancestor trunk by applying only the REMAINING prefix
+    records (compact, REC_NONE-terminated) — the hierarchical-trunk step.
+    A trunk-cache miss used to replay its full p-row prefix from scratch;
+    deriving it from the parent bucket's cached trunk costs O(bucket)
+    instead of O(p), turning the PrefixCache into a trunk tree shared
+    across ddmin levels and DPOR rounds. Bit-exact vs a scratch trunk:
+    record application is deterministic and replay lanes never consume
+    rng, so state(parent) + suffix rows == state(full prefix); a parent
+    that finished early (status >= ST_DONE mid-prefix) applies zero
+    suffix rows, exactly where the scratch run would have stopped."""
+    from .replay import _replay_cfg, make_replay_apply_fn
+
+    cfg = _replay_cfg(cfg)
+    apply_one = make_replay_apply_fn(app, cfg)
+    oh = cfg.use_onehot
+
+    def resume_prefix(records, snap: PrefixSnapshot) -> PrefixSnapshot:
+        n_rec = records.shape[0]
+
+        def cond(carry):
+            s, _ig, _pk, i = carry
+            kind = ops.get_scalar(
+                records[:, 0], jnp.minimum(i, n_rec - 1), oh
+            )
+            return (i < n_rec) & (kind != REC_NONE) & (s.status < ST_DONE)
+
+        def body(carry):
+            s, ig, pk, i = carry
+            rec = ops.get_row(records, jnp.minimum(i, n_rec - 1), oh)
+            s, ig, pk = apply_one(s, ig, pk, rec)
+            return (s, ig, pk, i + 1)
+
+        state, ignored, peeked, i = jax.lax.while_loop(
+            cond, body, (snap.state, snap.ignored, snap.peeked, jnp.int32(0))
+        )
+        return PrefixSnapshot(
+            state=state, steps=snap.steps + i, cursor=snap.cursor + i,
+            ignored=ignored, peeked=peeked,
+        )
+
+    return jax.jit(resume_prefix)
 
 
 def make_explore_prefix_runner(app: DSLApp, cfg: DeviceConfig):
@@ -363,6 +420,16 @@ class PrefixCache:
         self.hits += 1
         return entry
 
+    def peek(self, key: bytes) -> Optional[Tuple[PrefixSnapshot, int]]:
+        """Lookup WITHOUT hit/miss accounting — used by the hierarchical
+        ancestor search, whose probes are derivation opportunities, not
+        trunk requests (they would otherwise skew the hit rate the tuner
+        reads). A found ancestor still refreshes its LRU position."""
+        entry = self._entries.get(key)
+        if entry is not None:
+            self._entries.move_to_end(key)
+        return entry
+
     def put(self, key: bytes, snapshot: PrefixSnapshot, steps: int) -> None:
         self._entries[key] = (snapshot, steps)
         self._entries.move_to_end(key)
@@ -390,10 +457,16 @@ class PrefixForker:
         capacity: int = 32,
         min_group: int = 2,
         driver: str = "replay",
+        resume_runner: Optional[Callable[..., PrefixSnapshot]] = None,
     ):
         self.planner = PrefixPlanner(bucket=bucket, min_group=min_group)
         self.cache = PrefixCache(capacity)
         self.runner = runner
+        # Hierarchical trunks: ``resume_runner(suffix_records, snapshot)``
+        # extends a cached ancestor trunk by only the remaining rows; with
+        # it unset, every cache miss replays its full prefix (the pre-
+        # hierarchical behavior, still used by the DPOR/sweep drivers).
+        self.resume_runner = resume_runner
         self.driver = driver
         self.stats = {
             "groups": 0,
@@ -401,6 +474,7 @@ class PrefixForker:
             "scratch_lanes": 0,
             "prefix_hits": 0,
             "prefix_misses": 0,
+            "parent_trunks": 0,
             "steps_saved": 0,
         }
         # steps_saved terms awaiting a host pull: (trunk-steps scalar,
@@ -441,6 +515,44 @@ class PrefixForker:
         self.stats["prefix_misses"] += 1
         obs.counter("fork.prefix_misses").inc(driver=self.driver)
         return snapshot, snapshot.steps, False
+
+    def trunk_hier(
+        self, key: bytes, trunk_records, rng_key, prefix_len: int
+    ) -> Tuple[PrefixSnapshot, object, bool]:
+        """``trunk`` with hierarchical derivation: on a cache miss, walk
+        the prefix down one planner bucket at a time looking for a cached
+        ancestor trunk, and derive the missing trunk by resuming it over
+        only the remaining rows (O(bucket) instead of O(prefix)). The
+        derived snapshot is cached under the full key, so the PrefixCache
+        becomes a trunk TREE: a deep ddmin level's trunk forks off the
+        previous level's, which forked off the one before it."""
+        if self.resume_runner is None or key in self.cache:
+            return self.trunk(key, trunk_records, rng_key)
+        b = self.planner.bucket
+        for q in range(prefix_len - b, 0, -b):
+            parent = self.cache.peek(
+                prefix_digest(trunk_records[:q].tobytes())
+            )
+            if parent is None:
+                continue
+            suffix = np.zeros_like(trunk_records)
+            suffix[: prefix_len - q] = trunk_records[q:prefix_len]
+            snapshot = self.resume_runner(suffix, parent[0])
+            self.cache.put(key, snapshot, snapshot.steps)
+            # The full-key lookup genuinely missed; the ancestor hit is
+            # its own (cheaper) event.
+            self.stats["prefix_misses"] += 1
+            self.stats["parent_trunks"] += 1
+            obs.counter("fork.prefix_misses").inc(driver=self.driver)
+            obs.counter("fork.trunk_parent_hits").inc(driver=self.driver)
+            # note_group will charge this miss as a FULL trunk run
+            # (steps_saved term trunk_steps*(size-1)), but the
+            # derivation only paid the suffix — credit the parent's
+            # prefix steps so the evidence the fork tuner reads is not
+            # biased against deep hierarchical workloads.
+            self._deferred.append((parent[1], 1))
+            return snapshot, snapshot.steps, False
+        return self.trunk(key, trunk_records, rng_key)
 
     def note_group(self, size: int, trunk_steps, cache_hit: bool) -> None:
         """Account one fork-group launch: every member skipped the trunk's

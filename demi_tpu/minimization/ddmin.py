@@ -16,6 +16,7 @@ from typing import Any, List, Optional, Sequence
 from .. import obs
 from ..trace import EventTrace
 from .event_dag import AtomicEvent, EventDag, UnmodifiedEventDag
+from .pipeline import async_min_enabled, speculation_room
 from .stats import MinimizationStats, StageBudget
 from .test_oracle import TestOracle
 
@@ -30,11 +31,18 @@ class Minimizer:
 class DDMin(Minimizer):
     def __init__(self, oracle: TestOracle, check_unmodified: bool = False,
                  stats: Optional[MinimizationStats] = None,
-                 budget: Optional[StageBudget] = None):
+                 budget: Optional[StageBudget] = None,
+                 speculative: Optional[bool] = None):
         self.oracle = oracle
         self.check_unmodified = check_unmodified
         self.budget = budget or StageBudget()
         self.stats = stats or MinimizationStats()
+        # Speculative pair-testing (DEMI_ASYNC_MIN=1): each recursion
+        # level's left AND right halves replay in ONE device launch; the
+        # right half's verdict is consulted only if the left fails (the
+        # sequential order), so decisions — and the MCS — stay
+        # bit-identical while launches halve.
+        self.speculative = async_min_enabled(speculative)
         self.original_traces: List[EventTrace] = []  # violating traces seen
         self._violation = None
         self._init = None
@@ -82,14 +90,55 @@ class DDMin(Minimizer):
         left_dag = dag.remove_events(atoms[mid:])
         right_dag = dag.remove_events(atoms[:mid])
 
-        if self._test(left_dag.union(remainder)) is not None:
-            return self._ddmin2(left_dag, remainder)
-        if self._test(right_dag.union(remainder)) is not None:
-            return self._ddmin2(right_dag, remainder)
+        if self._use_pairs():
+            left_cand = left_dag.union(remainder)
+            right_cand = right_dag.union(remainder)
+            resolvers = self.oracle.test_window(
+                [left_cand.get_all_events(), right_cand.get_all_events()],
+                self._violation,
+            )
+            if self._consult(resolvers[0], left_cand) is not None:
+                # Right's device lanes were speculative waste: the
+                # sequential path never tests it after a left success.
+                obs.counter("pipe.window_waste").inc()
+                return self._ddmin2(left_dag, remainder)
+            obs.counter("pipe.window_hits").inc()
+            if self._consult(resolvers[1], right_cand) is not None:
+                return self._ddmin2(right_dag, remainder)
+        else:
+            if self._test(left_dag.union(remainder)) is not None:
+                return self._ddmin2(left_dag, remainder)
+            if self._test(right_dag.union(remainder)) is not None:
+                return self._ddmin2(right_dag, remainder)
         # Interference.
         left_min = self._ddmin2(left_dag, right_dag.union(remainder))
         right_min = self._ddmin2(right_dag, left_min.union(remainder))
         return left_min.union(right_min)
+
+    def _use_pairs(self) -> bool:
+        return (
+            self.speculative
+            and self._init is None
+            and getattr(self.oracle, "supports_async", False)
+            and getattr(self.oracle, "test_window", None) is not None
+        )
+
+    def _consult(self, resolve, candidate: EventDag) -> Optional[EventTrace]:
+        """One lazy window resolution with ``_test``'s exact bookkeeping
+        (the device work already happened in the batched window; the host
+        verification runs here, on consult)."""
+        self.total_tests += 1
+        events = candidate.get_all_events()
+        self.stats.record_replay()
+        self.stats.record_iteration_size(len(events))
+        with obs.span("ddmin.iteration", externals=len(events)) as sp:
+            trace = resolve()
+            sp.set(reproduced=trace is not None)
+        obs.counter("minimize.ddmin.trials").inc()
+        if trace is not None:
+            obs.counter("minimize.ddmin.reproductions").inc()
+            self.original_traces.append(trace)
+        return trace
 
     def _test(self, candidate: EventDag) -> Optional[EventTrace]:
         self.total_tests += 1
@@ -127,20 +176,58 @@ class BatchedDDMin(Minimizer):
     redundant trials for one kernel launch per level."""
 
     def __init__(self, oracle, stats: Optional[MinimizationStats] = None,
-                 budget: Optional[StageBudget] = None):
+                 budget: Optional[StageBudget] = None,
+                 speculative: Optional[bool] = None):
         # oracle must provide test_batch(list_of_externals, fp) -> [bool];
         # test(...) is used once at the end to host-verify the MCS.
         self.oracle = oracle
         self.budget = budget or StageBudget()
         self.stats = stats or MinimizationStats()
+        # Speculative level dispatch (DEMI_ASYNC_MIN=1): each level is
+        # dispatched with the PREDICTED next level's candidates (the
+        # no-reproduction branch: granularity doubling over the same dag)
+        # riding its idle padded lanes; a correct prediction turns the
+        # next level into verdict-cache hits and skips its launch.
+        # Verdicts alone pick the adopted branch, so the MCS is
+        # bit-identical to the synchronous path's.
+        self.speculative = async_min_enabled(speculative)
         self.levels = 0
         self.verified_trace = None  # host-verified MCS execution (or None)
+
+    @staticmethod
+    def _level(current: EventDag, n: int, limit: Optional[int] = None):
+        """One ddmin level's candidate set at granularity ``n`` (clamped):
+        the n subsets and, past binary granularity, the n complements.
+        ``limit`` materializes only the first candidates — speculation has
+        only that many free lanes, and every candidate costs an O(atoms)
+        ``remove_events`` walk on the host hot path."""
+        atoms = current.get_atomic_events()
+        n = min(n, len(atoms))
+        size = (len(atoms) + n - 1) // n
+        chunks = [atoms[i * size : (i + 1) * size] for i in range(n)]
+        chunks = [c for c in chunks if c]
+        total = len(chunks) * (2 if len(chunks) > 2 else 1)
+        want = total if limit is None else min(total, limit)
+        candidates = [
+            current.remove_events(
+                [a for j, c in enumerate(chunks) if j != i for a in c]
+            )
+            for i in range(min(want, len(chunks)))
+        ]
+        n_subsets = len(candidates)
+        candidates += [
+            current.remove_events(c) for c in chunks[: want - n_subsets]
+        ]
+        return candidates, n_subsets, n
 
     def minimize(self, dag: EventDag, violation_fingerprint: Any, init=None) -> EventDag:
         if init is not None:
             raise NotImplementedError(
                 "BatchedDDMin does not thread init through test_batch"
             )
+        use_async = self.speculative and getattr(
+            self.oracle, "supports_async", False
+        )
         self.stats.update_strategy("BatchedDDMin", type(self.oracle).__name__)
         self.stats.record_prune_start()
         current = dag
@@ -152,16 +239,8 @@ class BatchedDDMin(Minimizer):
             if self.budget.exhausted():
                 self.stats.record_budget_exhausted()
                 break
-            n = min(n, len(atoms))
-            size = (len(atoms) + n - 1) // n
-            chunks = [atoms[i * size : (i + 1) * size] for i in range(n)]
-            chunks = [c for c in chunks if c]
-            subsets = [
-                current.remove_events([a for j, c in enumerate(chunks) if j != i for a in c])
-                for i in range(len(chunks))
-            ]
-            complements = [current.remove_events(c) for c in chunks]
-            candidates = subsets + (complements if len(chunks) > 2 else [])
+            candidates, n_subsets, n = self._level(current, n)
+            subsets = candidates[:n_subsets]
             self.levels += 1
             for cand in candidates:
                 self.stats.record_replay()
@@ -169,10 +248,28 @@ class BatchedDDMin(Minimizer):
             with obs.span(
                 "ddmin.level", granularity=n, candidates=len(candidates)
             ):
-                verdicts = self.oracle.test_batch(
-                    [c.get_all_events() for c in candidates],
-                    violation_fingerprint,
-                )
+                if use_async:
+                    # Predicted branch: no candidate reproduces, so the
+                    # next level is a granularity doubling of the SAME
+                    # dag — plannable before any verdict lands. Cap the
+                    # speculation at the lanes that can ride free.
+                    spec = None
+                    room = speculation_room(len(candidates))
+                    if n < len(atoms) and room:
+                        spec_cands, _, _ = self._level(
+                            current, min(len(atoms), 2 * n), limit=room
+                        )
+                        spec = [c.get_all_events() for c in spec_cands]
+                    verdicts = self.oracle.dispatch_batch(
+                        [c.get_all_events() for c in candidates],
+                        violation_fingerprint,
+                        speculate=spec,
+                    ).harvest()
+                else:
+                    verdicts = self.oracle.test_batch(
+                        [c.get_all_events() for c in candidates],
+                        violation_fingerprint,
+                    )
             obs.counter("minimize.ddmin.batched_trials").inc(len(candidates))
             adopted_idx = next(
                 (i for i, ok in enumerate(verdicts) if ok), None

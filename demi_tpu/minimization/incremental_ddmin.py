@@ -66,6 +66,7 @@ class IncrementalDDMin(Minimizer):
         dpor_kwargs: Optional[dict] = None,
         initial_trace: Optional[EventTrace] = None,
         oracle: Optional[TestOracle] = None,
+        speculative: Optional[bool] = None,
     ):
         # ``oracle`` override: any resumable DPOR-style oracle exposing a
         # ``max_distance`` attribute — notably the device-batched
@@ -76,6 +77,13 @@ class IncrementalDDMin(Minimizer):
         )
         self.max_max_distance = max_max_distance
         self.stats = stats or MinimizationStats()
+        # Threaded into every per-distance DDMin: when the oracle carries
+        # the async replay surface (supports_async + test_window — the
+        # replay-backed oracles do, the DPOR oracles fall back cleanly),
+        # each recursion level's left/right probes batch into one launch.
+        from .pipeline import async_min_enabled
+
+        self.speculative = async_min_enabled(speculative)
 
     def minimize(self, dag: EventDag, violation_fingerprint: Any, init=None) -> EventDag:
         current = dag
@@ -85,7 +93,8 @@ class IncrementalDDMin(Minimizer):
             self.stats.update_strategy(
                 f"IncDDMin(dist={distance})", "ResumableDPOR"
             )
-            ddmin = DDMin(self.oracle, check_unmodified=False, stats=self.stats)
+            ddmin = DDMin(self.oracle, check_unmodified=False, stats=self.stats,
+                          speculative=self.speculative)
             with obs.span(
                 "incddmin.distance", max_distance=distance,
                 externals=len(current.get_all_events()),

@@ -12,24 +12,48 @@ from typing import Any, Optional
 from .. import obs
 from .ddmin import Minimizer
 from .event_dag import EventDag
+from .pipeline import async_min_enabled
 from .stats import MinimizationStats
 from .test_oracle import TestOracle
 
 
 class LeftToRightRemoval(Minimizer):
-    def __init__(self, oracle: TestOracle, stats: Optional[MinimizationStats] = None):
+    def __init__(self, oracle: TestOracle, stats: Optional[MinimizationStats] = None,
+                 speculative: Optional[bool] = None, window: int = 8):
         self.oracle = oracle
         self.stats = stats or MinimizationStats()
+        # Windowed speculation (DEMI_ASYNC_MIN=1, device oracle): the
+        # scan predicts that removals do NOT reproduce — the common case
+        # — and batches ``window`` single-removal candidates from the
+        # current baseline into one device launch. Verdicts are consulted
+        # strictly in scan order; an adoption discards the rest of the
+        # window (those candidates were built from the stale baseline)
+        # and the scan resumes from the new one — the exact decision
+        # sequence of the sequential loop.
+        self.speculative = async_min_enabled(speculative)
+        self.window = window
         self.total_tests = 0
 
     def minimize(self, dag: EventDag, violation_fingerprint: Any, init=None) -> EventDag:
         self.stats.update_strategy("LeftToRightRemoval", type(self.oracle).__name__)
         self.stats.record_prune_start()
+        use_window = (
+            self.speculative
+            and init is None
+            and getattr(self.oracle, "supports_async", False)
+            and getattr(self.oracle, "test_window", None) is not None
+        )
         current = dag
         changed = True
         while changed:
             changed = False
-            for atom in list(current.get_atomic_events()):
+            atoms = list(current.get_atomic_events())
+            if use_window:
+                current, changed = self._windowed_pass(
+                    current, atoms, violation_fingerprint
+                )
+                continue
+            for atom in atoms:
                 candidate = current.remove_events([atom])
                 self.total_tests += 1
                 self.stats.record_iteration_size(len(candidate.get_all_events()))
@@ -51,3 +75,42 @@ class LeftToRightRemoval(Minimizer):
         self.stats.record_prune_end()
         self.stats.record_minimized_counts(0, len(current.get_all_events()), 0)
         return current
+
+    def _windowed_pass(self, current, atoms, violation_fingerprint):
+        """One left-to-right pass in speculative windows. Consulted
+        trials carry the sequential loop's exact bookkeeping; lanes past
+        an adoption were speculation waste (the sequential loop would
+        have rebuilt them from the new baseline)."""
+        changed = False
+        pos = 0
+        while pos < len(atoms):
+            window = atoms[pos : pos + self.window]
+            candidates = [current.remove_events([a]) for a in window]
+            resolvers = self.oracle.test_window(
+                [c.get_all_events() for c in candidates],
+                violation_fingerprint,
+            )
+            consulted = len(window)
+            for j, candidate in enumerate(candidates):
+                self.total_tests += 1
+                self.stats.record_replay()
+                self.stats.record_iteration_size(
+                    len(candidate.get_all_events())
+                )
+                obs.counter("minimize.one_at_a_time.trials").inc()
+                with obs.span(
+                    "one_at_a_time.trial",
+                    externals=len(candidate.get_all_events()),
+                ):
+                    reproduced = resolvers[j]() is not None
+                if reproduced:
+                    current = candidate
+                    changed = True
+                    consulted = j + 1
+                    break
+            # Speculation economy: lanes consulted past the first (free
+            # batching) vs lanes discarded by an adoption.
+            obs.counter("pipe.window_hits").inc(max(0, consulted - 1))
+            obs.counter("pipe.window_waste").inc(len(window) - consulted)
+            pos += consulted
+        return current, changed

@@ -1,0 +1,76 @@
+"""Async minimization pipeline switch + shared speculation accounting.
+
+BENCH_r05's gap: the device replays ~1000 schedules/sec while the host
+minimization loop manages ~33 — every level re-lowers each candidate from
+scratch, blocks on ``np.asarray`` before planning the next level, and the
+adopted candidate's host bookkeeping execution runs serially after the
+harvest. The pipeline closes the gap three ways (all off by default,
+``DEMI_ASYNC_MIN=1`` / ``--async-min``):
+
+1. **Lower-once/gather-many** (`device/encoding.py::CandidateLowerer`):
+   a level's candidates are subsequences of one base trace, so the base
+   lowers to rows once and candidates materialize as NumPy row-gathers.
+2. **Dispatch/harvest split** (`device/batch_oracle.py`): verdicts stay
+   on device until harvested; the host plans (and speculatively
+   host-executes) between dispatch and harvest.
+3. **Speculative level dispatch**: the predicted next level's candidates
+   ride the CURRENT launch's idle padded lanes (the lanes that would
+   otherwise replay duplicate padding rows); harvested speculative
+   verdicts are keyed by record digest and consumed by the next dispatch
+   when the prediction held — mispredictions are discarded, so verdicts
+   alone still pick every branch and results stay bit-identical to the
+   synchronous oracle (pinned by tests/test_async_min.py).
+
+Telemetry (``pipe.*``): ``pipe.lower_gather`` / ``pipe.lower_cached`` /
+``pipe.lower_full`` (lowering-cache behavior), ``pipe.spec_dispatched`` /
+``pipe.spec_hits`` / ``pipe.spec_waste`` (speculation economy),
+``pipe.overlap_seconds`` / ``pipe.harvest_wait_seconds`` (how much host
+planning actually hid under device execution). report.py renders them as
+the Telemetry "Pipeline" block.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+
+def async_min_enabled(explicit: Optional[bool] = None) -> bool:
+    """Resolve the async-minimization switch: an explicit constructor arg
+    wins, otherwise ``DEMI_ASYNC_MIN`` (off by default) — the same
+    contract as ``prefix_fork_enabled``, so the flag reaches every stage
+    of a gamut run from the environment."""
+    if explicit is not None:
+        return bool(explicit)
+    return os.environ.get("DEMI_ASYNC_MIN", "").strip().lower() in (
+        "1", "true", "yes", "on"
+    )
+
+
+#: Cap on speculative candidates offered per dispatch. Speculation only
+#: ever rides idle padded lanes, so the real bound is the padding of the
+#: launch it rides; this cap just keeps the host-side planning (candidate
+#: construction + gather lowering) proportional to what can possibly fit.
+DEFAULT_SPECULATION_CAP = 64
+
+
+def padded_bucket(n: int) -> int:
+    """The replay checker's power-of-two batch bucket for ``n`` candidates
+    (mesh rounding excluded) — what the speculative minimizers use to cap
+    their next-level planning at the lanes that can actually ride free."""
+    return max(8, 1 << (max(n, 1) - 1).bit_length())
+
+
+def speculation_room(n: int, cap: int = DEFAULT_SPECULATION_CAP) -> int:
+    """Idle padded lanes a ``n``-candidate launch offers speculation."""
+    return min(cap, max(0, padded_bucket(n) - n))
+
+
+def overlap_fraction(stats: dict) -> float:
+    """Fraction of harvest-side latency hidden under host planning:
+    overlap / (overlap + blocking harvest wait). 0.0 when nothing was
+    dispatched asynchronously."""
+    overlap = stats.get("overlap_seconds", 0.0)
+    wait = stats.get("harvest_wait_seconds", 0.0)
+    total = overlap + wait
+    return overlap / total if total > 0 else 0.0

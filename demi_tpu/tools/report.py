@@ -144,6 +144,56 @@ def render_report(directory: str, app=None) -> str:
                     else:
                         lines.append(f"- `{name}`{label}: 0 groups")
             lines.append("")
+        # Async-minimization pipeline summary (pipe.* counters): how much
+        # host planning hid under device execution, what speculation paid
+        # off, and how often candidate lowering was a gather instead of a
+        # full Python loop — the three levers DEMI_ASYNC_MIN pulls.
+        pipe = {
+            name: sum(series.values())
+            for name, series in counters.items()
+            if name.startswith("pipe.")
+        }
+        if pipe:
+            lines += ["### Pipeline", ""]
+
+            def _ratio(num, den):
+                return f"{num / den:.1%}" if den else "n/a"
+
+            overlap = pipe.get("pipe.overlap_seconds", 0.0)
+            wait = pipe.get("pipe.harvest_wait_seconds", 0.0)
+            lines.append(
+                f"- overlap fraction: {_ratio(overlap, overlap + wait)} "
+                f"({overlap:.2f}s planned under device execution, "
+                f"{wait:.2f}s blocked harvesting)"
+            )
+            spec_hits = pipe.get("pipe.spec_hits", 0)
+            spec_waste = pipe.get("pipe.spec_waste", 0)
+            lines.append(
+                f"- speculative lanes: {pipe.get('pipe.spec_dispatched', 0):g} "
+                f"dispatched, {spec_hits:g} hits / {spec_waste:g} wasted "
+                f"({_ratio(spec_hits, spec_hits + spec_waste)} useful)"
+            )
+            if "pipe.spec_exec_hits" in pipe or "pipe.spec_exec_waste" in pipe:
+                lines.append(
+                    f"- speculative host executions: "
+                    f"{pipe.get('pipe.spec_exec_hits', 0):g} hits / "
+                    f"{pipe.get('pipe.spec_exec_waste', 0):g} wasted"
+                )
+            if "pipe.window_hits" in pipe or "pipe.window_waste" in pipe:
+                lines.append(
+                    f"- window speculation: {pipe.get('pipe.window_hits', 0):g} "
+                    f"batched trials saved a launch, "
+                    f"{pipe.get('pipe.window_waste', 0):g} discarded"
+                )
+            gathers = pipe.get("pipe.lower_gather", 0)
+            cached = pipe.get("pipe.lower_cached", 0)
+            full = pipe.get("pipe.lower_full", 0)
+            lines.append(
+                f"- lowering cache: {_ratio(gathers + cached, gathers + cached + full)} "
+                f"hit rate ({gathers:g} gathers, {cached:g} cached, "
+                f"{full:g} full lowerings)"
+            )
+            lines.append("")
         if counters:
             lines += ["| counter | series | value |", "|---|---|---|"]
             for name in sorted(counters):

@@ -21,9 +21,15 @@ are byte-identical to the untuned explorer.
 
 from .cache import TuningCache, default_cache_path, workload_key  # noqa: F401
 from .calibrate import (  # noqa: F401
+    FORK_BUCKET_AXIS,
+    ForkDecision,
     SweepDecision,
+    calibrate_fork,
     calibrate_sweep,
     coordinate_descent,
+    depth_bucket,
+    fork_signals,
+    make_fork_measure,
     median_rate,
     sweep_axes,
 )
@@ -38,13 +44,19 @@ from .controller import (  # noqa: F401
 __all__ = [
     "DporBudgetTuner",
     "ExplorationController",
+    "FORK_BUCKET_AXIS",
+    "ForkDecision",
     "SweepDecision",
     "TuningCache",
     "WeightTuner",
     "autotune_enabled",
+    "calibrate_fork",
     "calibrate_sweep",
     "coordinate_descent",
     "default_cache_path",
+    "depth_bucket",
+    "fork_signals",
+    "make_fork_measure",
     "median_rate",
     "record_decision",
     "sweep_axes",

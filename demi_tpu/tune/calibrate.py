@@ -259,6 +259,207 @@ def calibrate_sweep(
     return decision
 
 
+# ---------------------------------------------------------------------------
+# Prefix-fork calibration: fork_bucket axis + per-depth on/off decision
+# ---------------------------------------------------------------------------
+
+#: fork_bucket candidates; 0 means "prefix-fork off for this workload
+#: depth" — the on/off decision falls out of the same argmax that picks
+#: the granularity (ROADMAP prefix-fork follow-on: tuner-learned bucket).
+FORK_BUCKET_AXIS = (0, 4, 8, 16, 32)
+
+
+def depth_bucket(depth: int) -> int:
+    """Power-of-two bucket of a workload's delivery depth. Fork economics
+    scale with prefix length (bench config 6: 192 deliveries -> ~1.85x,
+    64 -> ~1.3x), so decisions cache per depth bucket, not per exact
+    depth — a 100- and a 120-delivery minimization share one decision."""
+    return 1 << max(0, (max(1, depth) - 1).bit_length())
+
+
+def fork_signals() -> Dict[str, float]:
+    """Decision evidence from the already-recorded fork telemetry:
+    ``fork.steps_saved`` (prefix work the fork lanes skipped) and the
+    mean group sizes of the ``fork.group_size`` / ``dpor.prefix_group_size``
+    histograms. A mean group size under 2 means trunks don't amortize and
+    the calibrated off-decision is expected; recorded into the decision
+    so the cache entry explains itself."""
+    from .. import obs
+
+    out: Dict[str, float] = {}
+    snap = obs.REGISTRY.snapshot()
+    steps = snap.get("counters", {}).get("fork.steps_saved", {})
+    if steps:
+        out["steps_saved"] = float(sum(steps.values()))
+    for name, label in (
+        ("fork.group_size", "mean_group_size"),
+        ("dpor.prefix_group_size", "mean_dpor_group_size"),
+    ):
+        series = snap.get("histograms", {}).get(name, {})
+        count = sum(rec["count"] for rec in series.values())
+        if count:
+            total = sum(rec["sum"] for rec in series.values())
+            out[label] = round(total / count, 2)
+    return out
+
+
+@dataclass
+class ForkDecision:
+    """One fork calibration outcome for a (workload shape, depth bucket):
+    the chosen bucket (0 = fork off) plus the measured evidence."""
+
+    bucket: int
+    rate: float
+    source: str  # "calibrated" | "cached" | "default"
+    rates: Dict[str, float] = field(default_factory=dict)
+    signals: Dict[str, float] = field(default_factory=dict)
+    key: Optional[str] = None
+    calibration_seconds: float = 0.0
+
+    @property
+    def enabled(self) -> bool:
+        return self.bucket > 0
+
+    def to_json(self) -> Dict[str, Any]:
+        return {
+            "bucket": int(self.bucket),
+            "enabled": self.enabled,
+            "rate": round(self.rate, 1),
+            "source": self.source,
+            "rates": {k: round(v, 1) for k, v in self.rates.items()},
+            "signals": dict(self.signals),
+            "key": self.key,
+            "calibration_seconds": round(self.calibration_seconds, 2),
+        }
+
+    @classmethod
+    def from_json(cls, obj: Dict[str, Any], source: str) -> "ForkDecision":
+        return cls(
+            bucket=int(obj.get("bucket", 0)),
+            rate=float(obj.get("rate", 0.0)),
+            source=source,
+            rates=dict(obj.get("rates", {})),
+            signals=dict(obj.get("signals", {})),
+            key=obj.get("key"),
+        )
+
+
+def make_fork_measure(
+    app, device_cfg, config, candidates, externals, *,
+    target_code: int = 1, reps: int = 2
+):
+    """Real measurement for one fork_bucket candidate: a fresh
+    DeviceReplayChecker per point (bucket 0 = fork off), one warm-up
+    verdicts pass (compiles kernels + populates the trunk cache — the
+    steady state of consecutive minimization rounds), then ``reps`` timed
+    passes; returns trials/sec. The winning checker's fork stats land in
+    ``measure.signals`` for the decision record."""
+    from ..device.batch_oracle import DeviceReplayChecker
+
+    exts = [externals] * len(candidates)
+
+    def measure(params: Dict[str, Any]) -> float:
+        bucket = int(params["fork_bucket"])
+        checker = DeviceReplayChecker(
+            app, device_cfg, config,
+            prefix_fork=bucket > 0, fork_bucket=bucket or 8,
+        )
+        checker.verdicts(candidates, exts, target_code)  # warm-up
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            checker.verdicts(candidates, exts, target_code)
+        rate = len(candidates) * reps / (time.perf_counter() - t0)
+        if checker.fork_stats is not None:
+            st = checker.fork_stats
+            lanes = st["forked_lanes"] + st["scratch_lanes"]
+            measure.signals[f"bucket={bucket}"] = {
+                "steps_saved": st["steps_saved"],
+                "forked_fraction": round(
+                    st["forked_lanes"] / lanes, 3
+                ) if lanes else 0.0,
+                "parent_trunks": st["parent_trunks"],
+            }
+        return rate
+
+    measure.signals = {}
+    return measure
+
+
+def calibrate_fork(
+    app,
+    cfg,
+    *,
+    depth: int,
+    platform: Optional[str] = None,
+    cache: Optional[TuningCache] = None,
+    measure: Optional[Callable[[Dict[str, Any]], float]] = None,
+    axis: Optional[Sequence[int]] = None,
+    extra_key: Optional[Dict[str, Any]] = None,
+) -> ForkDecision:
+    """Calibrate the prefix-fork bucket (and the fork on/off decision)
+    for one workload shape + depth bucket. Caching contract as
+    ``calibrate_sweep``: cache hit = no measurements at all; otherwise a
+    single-axis coordinate-descent walk over ``FORK_BUCKET_AXIS`` with
+    bucket 0 (fork off) competing on equal terms, persisted to the
+    TuningCache and recorded as ``tune.fork.*`` decisions. Unlike
+    ``calibrate_sweep`` there is no default ``measure`` — a real one
+    needs the workload's candidate traces (``make_fork_measure``), which
+    this signature does not carry — so a cache miss requires it."""
+    if platform is None:
+        import jax
+
+        platform = jax.devices()[0].platform
+    cache = cache or TuningCache()
+    key = workload_key(
+        app.name, app.num_actors, cfg, platform,
+        axis="fork", depth=depth_bucket(depth), **(extra_key or {}),
+    )
+    cached = cache.get(key)
+    if cached is not None:
+        decision = ForkDecision.from_json(cached, source="cached")
+        decision.key = key
+        _record_fork_decision(decision)
+        return decision
+
+    if measure is None:
+        raise ValueError(
+            "calibrate_fork: cache miss for %r and no measure given — "
+            "build one with make_fork_measure(app, device_cfg, config, "
+            "candidates, externals)" % (key,)
+        )
+    candidates = list(axis) if axis is not None else list(FORK_BUCKET_AXIS)
+    start = {"fork_bucket": candidates[0]}
+    t0 = time.perf_counter()
+    params, rate, rates = coordinate_descent(
+        {"fork_bucket": candidates}, measure, start, order=("fork_bucket",)
+    )
+    decision = ForkDecision(
+        bucket=int(params["fork_bucket"]),
+        rate=rate,
+        source="calibrated",
+        rates=rates,
+        signals={
+            **fork_signals(),
+            **{
+                k: v for k, v in getattr(measure, "signals", {}).items()
+                if k == f"bucket={int(params['fork_bucket'])}"
+            },
+        },
+        key=key,
+        calibration_seconds=time.perf_counter() - t0,
+    )
+    _record_fork_decision(decision)
+    cache.put(key, decision.to_json())
+    return decision
+
+
+def _record_fork_decision(decision: ForkDecision) -> None:
+    record_decision("fork.bucket", int(decision.bucket))
+    record_decision("fork.enabled", int(decision.enabled))
+    record_decision("fork.rate", decision.rate)
+    record_decision("fork.source", decision.source)
+
+
 def _record_sweep_decision(decision: SweepDecision) -> None:
     record_decision("sweep.variant", decision.params.get("variant", "xla"))
     for knob in ("chunk", "seg"):

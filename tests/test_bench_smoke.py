@@ -15,7 +15,7 @@ def _run_bench(config: str, env_extra: dict) -> dict:
     # The smoke must measure the DEFAULT paths: strip switches that would
     # change kernels or output keys.
     for var in ("DEMI_OBS", "DEMI_AUTOTUNE", "DEMI_PREFIX_FORK",
-                "DEMI_DEVICE_IMPL", "DEMI_BENCH_IMPL"):
+                "DEMI_ASYNC_MIN", "DEMI_DEVICE_IMPL", "DEMI_BENCH_IMPL"):
         env.pop(var, None)
     out = subprocess.run(
         [sys.executable, os.path.join(REPO, "bench.py"), "--config", config],
@@ -72,3 +72,34 @@ def test_bench_config6_smoke():
     # at smoke depth only the bit-exactness contract is asserted.
     assert section["verdicts_match"] is True
     assert section["forked_lanes"] > 0
+
+
+def test_bench_config7_smoke():
+    record = _run_bench(
+        "7",
+        {
+            # Tiny end-to-end pipeline: shallow violation scan, one rep.
+            "DEMI_BENCH_CONFIG7_BUDGET": "120",
+            "DEMI_BENCH_CONFIG7_SEEDS": "10",
+            "DEMI_BENCH_CONFIG7_COMMANDS": "0",
+            "DEMI_BENCH_CONFIG7_REPS": "1",
+        },
+    )
+    assert record["metric"].startswith("pipeline speedup")
+    section = record["config7"]
+    assert "error" not in section, section
+    for key in ("app", "deliveries", "externals", "mcs_externals",
+                "final_deliveries", "ddmin_levels", "reps",
+                "sync_seconds", "async_seconds", "speedup",
+                "verdicts_match", "mcs_match",
+                "speculation_hits", "speculation_waste", "spec_exec_hits",
+                "spec_exec_waste",
+                "lowering_cache_hit_rate", "overlap_fraction", "launches",
+                "fork"):
+        assert key in section, key
+    for key in ("prefix_hit_rate", "parent_trunks", "steps_saved"):
+        assert key in section["fork"], key
+    # The acceptance-grade >=1.3x needs the DEEP fixture (bench default);
+    # at smoke depth only the bit-exactness contract is asserted.
+    assert section["verdicts_match"] is True
+    assert section["mcs_match"] is True

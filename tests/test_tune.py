@@ -20,8 +20,10 @@ from demi_tpu.tune import (
     TuningCache,
     WeightTuner,
     autotune_enabled,
+    calibrate_fork,
     calibrate_sweep,
     coordinate_descent,
+    depth_bucket,
     median_rate,
     workload_key,
 )
@@ -230,6 +232,89 @@ def test_calibrate_sweep_synthetic_and_cache_roundtrip(tmp_path):
         measure=measure, axes=axes,
     )
     assert d3.source == "calibrated"
+
+
+def test_calibrate_fork_bucket_axis_and_off_decision(tmp_path):
+    """calibrate_fork walks the fork_bucket axis with 0 (= fork off)
+    competing on equal terms, persists per (shape, depth-bucket), and a
+    same-depth-bucket second call is a cache hit with no measurements."""
+    cache = TuningCache(str(tmp_path / "cache.json"))
+    calls = []
+
+    def measure(p):
+        calls.append(int(p["fork_bucket"]))
+        return {0: 100.0, 4: 120.0, 8: 180.0, 16: 140.0, 32: 90.0}[
+            int(p["fork_bucket"])
+        ]
+
+    d1 = calibrate_fork(
+        _App(), _ShapeCfg(), depth=100, platform="cpu", cache=cache,
+        measure=measure,
+    )
+    assert d1.source == "calibrated" and d1.bucket == 8 and d1.enabled
+    assert set(calls) == {0, 4, 8, 16, 32}
+
+    calls.clear()
+    # depth 120 shares the 128 depth bucket with depth 100: cache hit.
+    assert depth_bucket(100) == depth_bucket(120) == 128
+    d2 = calibrate_fork(
+        _App(), _ShapeCfg(), depth=120, platform="cpu",
+        cache=TuningCache(str(tmp_path / "cache.json")), measure=measure,
+    )
+    assert d2.source == "cached" and d2.bucket == 8 and calls == []
+
+    # A shallow workload where scratch wins calibrates fork OFF.
+    d3 = calibrate_fork(
+        _App(), _ShapeCfg(), depth=10, platform="cpu", cache=cache,
+        measure=lambda p: 100.0 if int(p["fork_bucket"]) == 0 else 60.0,
+    )
+    assert d3.bucket == 0 and not d3.enabled
+
+
+@pytest.mark.slow
+def test_calibrate_fork_real_measure(tmp_path):
+    """Real fork calibration (slow): make_fork_measure drives actual
+    DeviceReplayCheckers over an internal-minimization level and the
+    decision persists with its fork-telemetry evidence."""
+    from demi_tpu.apps.common import dsl_start_events, make_host_invariant
+    from demi_tpu.apps.raft import make_raft_app
+    from demi_tpu.config import SchedulerConfig
+    from demi_tpu.device.batch_oracle import default_device_config
+    from demi_tpu.external_events import WaitQuiescence
+    from demi_tpu.minimization.internal import (
+        removable_delivery_indices,
+        remove_delivery,
+    )
+    from demi_tpu.schedulers import RandomScheduler
+    from demi_tpu.tune import make_fork_measure
+
+    app = make_raft_app(3)
+    config = SchedulerConfig(invariant_check=make_host_invariant(app))
+    program = dsl_start_events(app) + [WaitQuiescence(budget=48)]
+    result = RandomScheduler(
+        config, seed=0, max_messages=200, invariant_check_interval=1
+    ).execute(program)
+    trace = result.trace
+    trace.set_original_externals(list(program))
+    indices = removable_delivery_indices(trace)[:12]
+    candidates = [remove_delivery(trace, i) for i in indices]
+    device_cfg = default_device_config(app, trace, program)
+    measure = make_fork_measure(
+        app, device_cfg, config, candidates, list(program), reps=1
+    )
+    cache = TuningCache(str(tmp_path / "cache.json"))
+    decision = calibrate_fork(
+        _App(), _ShapeCfg(), depth=len(trace.deliveries()),
+        platform="cpu", cache=cache, measure=measure, axis=(0, 8),
+    )
+    assert decision.source == "calibrated"
+    assert decision.bucket in (0, 8)
+    assert decision.rates  # both points measured
+    d2 = calibrate_fork(
+        _App(), _ShapeCfg(), depth=len(trace.deliveries()),
+        platform="cpu", cache=cache, measure=measure, axis=(0, 8),
+    )
+    assert d2.source == "cached"
 
 
 def test_tuning_cache_survives_corrupt_file(tmp_path):
