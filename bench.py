@@ -27,6 +27,12 @@ Extra keys reported for the record:
     (speedup, speculation hits/waste, lowering-cache hit rate, overlap
     fraction; DEMI_ASYNC_MIN-independent — both paths are measured, and
     verdicts_match / mcs_match pin bit-exactness).
+  - config8: async DPOR frontier throughput — double-buffered in-flight
+    rounds + prefix forking with prescribed-resume trunks vs the
+    synchronous scratch loop on the config-2 raft fixture (frontier
+    rounds/sec + speedup; explored_match / frontier_match /
+    interleavings_match pin that the async pipeline explores the EXACT
+    same schedule space).
   - config5: BASELINE config 5 — 64-actor reliable broadcast sweep
     (schedules/sec + lanes swept; 1M lanes on TPU, smaller on CPU
     fallback; override with DEMI_BENCH_CONFIG5_LANES). Runs in
@@ -38,8 +44,8 @@ Extra keys reported for the record:
 
 Modes: `python bench.py` runs everything; `--config 2` / `--config 3` /
 `--config 4` / `--config 5` / `--config 6` / `--config 7` /
-`--config rehearsal` run a single section (same one-line JSON with that
-key populated).
+`--config 8` / `--config rehearsal` run a single section (same one-line
+JSON with that key populated).
 
 DEMI_AUTOTUNE=1 lets the measurement-guided tuner (demi_tpu/tune) pick
 the rehearsal drive's (kernel variant, batch, segment) from short
@@ -858,6 +864,161 @@ def bench_config7(jax):
     }
 
 
+def bench_config8(jax):
+    """Config 8: async DPOR frontier throughput — the synchronous
+    scratch loop vs the async pipeline (double-buffered in-flight rounds
+    + prefix forking with prescribed-resume trunks armed) on a DEEP
+    seeded raft fixture, measured as frontier rounds/sec over the SAME
+    round budget. The fixture is the oracle-probe shape the pipeline
+    exists for (the config-7 recipe): fuzz the deepest multivote
+    violation under the depth cap, seed the frontier with its steering
+    prescription, and explore uncapped — racing prescriptions then run
+    hundreds of records deep, so each round carries a real host share
+    (the O(n^2) racing-pair scan) for the in-flight round to overlap.
+    Both variants follow the same generation-frozen round policy and
+    identical per-lane keys, so the explored set, frontier, and
+    interleaving count are asserted EQUAL — the async side may only be
+    faster, never different. Every feature stays off by default; the
+    bench passes explicit constructor args. Knobs:
+    DEMI_BENCH_CONFIG8_ROUNDS / _REPS / _BATCH / _BUCKET / _WARM /
+    _BUDGET / _SEEDS / _DEPTH_CAP."""
+    from demi_tpu.apps.common import dsl_start_events, make_host_invariant
+    from demi_tpu.apps.raft import T_CLIENT, make_raft_app
+    from demi_tpu.config import SchedulerConfig
+    from demi_tpu.device.batch_oracle import default_device_config
+    from demi_tpu.device.dpor_sweep import (
+        DeviceDPOR,
+        make_dpor_kernel,
+        steering_prescription,
+    )
+    from demi_tpu.external_events import MessageConstructor, Send, WaitQuiescence
+    from demi_tpu.schedulers import RandomScheduler
+
+    nodes, commands = 3, 3
+    budget = int(os.environ.get("DEMI_BENCH_CONFIG8_BUDGET", 240))
+    seeds = int(os.environ.get("DEMI_BENCH_CONFIG8_SEEDS", 40))
+    depth_cap = int(os.environ.get("DEMI_BENCH_CONFIG8_DEPTH_CAP", 120))
+    app = make_raft_app(nodes, bug="multivote")
+    config = SchedulerConfig(invariant_check=make_host_invariant(app))
+    program = dsl_start_events(app) + [
+        Send(
+            app.actor_name(i % nodes),
+            MessageConstructor(lambda v=10 + i: (T_CLIENT, 0, v, 0, 0, 0, 0)),
+        )
+        for i in range(commands)
+    ] + [WaitQuiescence()]
+    fr = None
+    best = -1
+    for seed in range(seeds):
+        r = RandomScheduler(
+            config, seed=seed, max_messages=budget,
+            invariant_check_interval=1,
+        ).execute(program)
+        if r.violation is None:
+            continue
+        depth = len(r.trace.deliveries())
+        if depth <= depth_cap and depth > best:
+            fr, best = r, depth
+    if fr is None:  # pragma: no cover - multivote violates reliably
+        return {"error": "no violation found to seed the frontier"}
+    trace = fr.trace
+    trace.set_original_externals(list(program))
+    cfg = default_device_config(
+        app, trace, program, record_trace=True, record_parents=True,
+    )
+    presc = steering_prescription(app, cfg, trace, program)
+
+    platform = jax.devices()[0].platform
+    batch = int(os.environ.get(
+        "DEMI_BENCH_CONFIG8_BATCH", 64 if platform not in ("cpu",) else 16
+    ))
+    rounds = int(os.environ.get("DEMI_BENCH_CONFIG8_ROUNDS", 4))
+    reps = int(os.environ.get("DEMI_BENCH_CONFIG8_REPS", 3))
+    bucket = int(os.environ.get("DEMI_BENCH_CONFIG8_BUCKET", 8))
+    # Warm-up rounds: compile the kernels AND saturate the frontier with
+    # deep racing prescriptions, so the timed rounds measure the
+    # steady-state regime (deep generation, full batches).
+    warm = int(os.environ.get("DEMI_BENCH_CONFIG8_WARM", 3))
+    # One compiled kernel pair serves every rep (a fresh DeviceDPOR per
+    # rep resets the frontier; sharing kernels keeps compilation out of
+    # the timed region after the warm-up rep).
+    kernel = make_dpor_kernel(app, cfg)
+    fork_kernel = make_dpor_kernel(app, cfg, start_state=True)
+
+    def run(async_side):
+        if async_side:
+            dpor = DeviceDPOR(
+                app, cfg, program, batch_size=batch,
+                prefix_fork=True, fork_bucket=bucket,
+                double_buffer=True, kernel=kernel, fork_kernel=fork_kernel,
+            )
+        else:
+            dpor = DeviceDPOR(
+                app, cfg, program, batch_size=batch,
+                prefix_fork=False, double_buffer=False, kernel=kernel,
+            )
+        dpor.seed(presc)
+        dpor.explore(max_rounds=warm)
+        before = dpor.interleavings
+        t0 = time.perf_counter()
+        dpor.explore(max_rounds=rounds)
+        secs = time.perf_counter() - t0
+        return dpor, dpor.interleavings - before, secs
+
+    run(False)  # warm-up rep: compilation + trunk-cache steady state
+    run(True)
+    sync_times, async_times = [], []
+    s_dpor = a_dpor = None
+    measured = 0
+    for _ in range(reps):
+        # Interleaved reps + medians (the config-7 rule: machine drift
+        # must land on both variants equally).
+        s_dpor, measured, secs = run(False)
+        sync_times.append(secs)
+        a_dpor, a_measured, secs = run(True)
+        async_times.append(secs)
+        assert a_measured == measured
+    sync_secs = sorted(sync_times)[len(sync_times) // 2]
+    async_secs = sorted(async_times)[len(async_times) // 2]
+    fork = a_dpor._forker.stats_view()
+    return {
+        "app": f"raft{nodes}",
+        "seed_deliveries": best,
+        "batch": batch,
+        "rounds": rounds,
+        "warm_rounds": warm,
+        "reps": reps,
+        "interleavings": measured,
+        "sync_seconds": round(sync_secs, 3),
+        "async_seconds": round(async_secs, 3),
+        "speedup": round(sync_secs / async_secs, 2) if async_secs else None,
+        "sync_rounds_per_sec": (
+            round(rounds / sync_secs, 2) if sync_secs else None
+        ),
+        "async_rounds_per_sec": (
+            round(rounds / async_secs, 2) if async_secs else None
+        ),
+        # The equality contract: the async pipeline must explore the
+        # EXACT same schedule space, not a faster different one.
+        "explored_match": s_dpor.explored == a_dpor.explored,
+        "frontier_match": s_dpor.frontier == a_dpor.frontier,
+        "interleavings_match": s_dpor.interleavings == a_dpor.interleavings,
+        "explored": len(s_dpor.explored),
+        "frontier": len(s_dpor.frontier),
+        # In-flight round economy (the calibrate_dpor_inflight signal).
+        "inflight": dict(a_dpor.async_stats),
+        "fork": {
+            "prefix_hit_rate": round(
+                fork["prefix_hits"]
+                / max(1, fork["prefix_hits"] + fork["prefix_misses"]),
+                3,
+            ),
+            "parent_trunks": fork["parent_trunks"],
+            "steps_saved": fork["steps_saved"],
+        },
+    }
+
+
 def bench_config5_rehearsal(jax, total_lanes=None):
     """Config-5 machinery rehearsal at >=1e5 lanes (VERDICT r3 #6): the
     64-actor *reliable* flood runs ~1 lane/sec on CPU, so the full config
@@ -1035,8 +1196,8 @@ def bench_config5_rehearsal(jax, total_lanes=None):
 def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--config", default=None,
-                        help="run only one section: 2, 3, 4, 5, 6, 7, or "
-                             "'rehearsal'")
+                        help="run only one section: 2, 3, 4, 5, 6, 7, 8, "
+                             "or 'rehearsal'")
     args = parser.parse_args()
     if args.config is not None and args.config != "rehearsal":
         args.config = int(args.config)
@@ -1133,6 +1294,17 @@ def main():
         out["vs_baseline"] = round((out["value"] or 0) / 1.3, 3)
         emit(out)
         return
+    if args.config == 8:
+        out["metric"] = (
+            "frontier rounds/sec (async vs sync DeviceDPOR, 3-node raft)"
+        )
+        out["unit"] = "rounds/sec"
+        out["config8"] = bench_config8(jax)
+        out["value"] = out["config8"].get("async_rounds_per_sec")
+        # Target: >= 1.2x over the synchronous scratch loop on CPU.
+        out["vs_baseline"] = round((out["config8"].get("speedup") or 0) / 1.2, 3)
+        emit(out)
+        return
     if args.config == "rehearsal":
         out["metric"] = (
             "schedules/sec (config-5 machinery rehearsal, >=1e5 lanes)"
@@ -1157,6 +1329,7 @@ def main():
     config5 = bench_config5(jax)
     config6 = bench_config6(jax)
     config7 = bench_config7(jax)
+    config8 = bench_config8(jax)
     rehearsal = bench_config5_rehearsal(jax)
     out.update(
         {
@@ -1184,6 +1357,7 @@ def main():
             "config5": config5,
             "config6": config6,
             "config7": config7,
+            "config8": config8,
             "config5_rehearsal": rehearsal,
         }
     )

@@ -408,12 +408,6 @@ def cmd_sweep(args) -> int:
             sweep_axes,
         )
 
-        if args.sweep_mode == "continuous":
-            print(
-                "sweep: --autotune runs chunked rounds (per-chunk reward "
-                "attribution); ignoring --sweep-mode continuous",
-                file=sys.stderr,
-            )
         platform = jax.devices()[0].platform
         axes = sweep_axes(cfg, chunk, platform)
         # Never calibrate a chunk the sweep can't run: the decision must
@@ -432,7 +426,13 @@ def cmd_sweep(args) -> int:
             app, cfg, gen, variant=decision.params.get("variant")
         )
         controller = ExplorationController(fuzzer)
-        result = driver.sweep_autotuned(args.batch, chunk, controller)
+        # --sweep-mode continuous rides the lane-compacted continuous
+        # driver with segment-boundary reward attribution (lanes tagged
+        # by the proposal epoch that generated them); chunked keeps the
+        # original one-proposal-per-chunk loop.
+        result = driver.sweep_autotuned(
+            args.batch, chunk, controller, mode=args.sweep_mode
+        )
         autotune_summary = {
             "decision": decision.to_json(),
             "rounds": controller.rounds,
@@ -476,6 +476,11 @@ def cmd_dpor(args) -> int:
     os.environ["DEMI_DEVICE_IMPL"] = getattr(args, "impl", "xla")
     if getattr(args, "prefix_fork", False):
         os.environ["DEMI_PREFIX_FORK"] = "1"
+    if getattr(args, "async_min", False):
+        # DeviceDPOROracle reads DEMI_ASYNC_MIN for the frontier's
+        # double-buffered in-flight rounds (platform-gated on CPU — see
+        # tune.calibrate_dpor_inflight) and the test_window surface.
+        os.environ["DEMI_ASYNC_MIN"] = "1"
     from .device import DeviceConfig
     from .device.dpor_sweep import DeviceDPOROracle
 
@@ -492,11 +497,34 @@ def cmd_dpor(args) -> int:
         record_parents=True,
     )
     autotune = _autotune_requested(args)
+    program = dsl_start_events(app) + [WaitQuiescence()]
+    inflight_decision = None
+    double_buffer = None
+    if autotune and getattr(args, "async_min", False):
+        # The double-buffer axis is a real trade on CPU (a mispredicted
+        # in-flight launch burns host cores), so under --autotune the
+        # decision is measured — and cached, a second run launches
+        # nothing. Non-CPU platforms decide "on" without measuring.
+        import jax
+
+        from .tune import calibrate_dpor_inflight, make_dpor_inflight_measure
+
+        platform = jax.devices()[0].platform
+        inflight_decision = calibrate_dpor_inflight(
+            app, cfg, batch=args.batch,
+            measure=(
+                make_dpor_inflight_measure(
+                    app, cfg, program, batch=args.batch
+                )
+                if platform == "cpu"
+                else None
+            ),
+        )
+        double_buffer = inflight_decision.enabled
     oracle = DeviceDPOROracle(
         app, cfg, config, batch_size=args.batch, max_rounds=args.rounds,
-        autotune=autotune,
+        autotune=autotune, double_buffer=double_buffer,
     )
-    program = dsl_start_events(app) + [WaitQuiescence()]
     with obs.span("cli.dpor", app=args.app):
         trace = oracle.test(program, None)
     summary = {
@@ -506,8 +534,13 @@ def cmd_dpor(args) -> int:
     }
     if autotune:
         summary["autotune"] = oracle.tuner_summaries()
+    if inflight_decision is not None:
+        summary["inflight_decision"] = inflight_decision.to_json()
     if oracle.fork_stats is not None:
         summary["prefix_fork"] = oracle.fork_stats
+    if oracle.supports_async:
+        # In-flight round economics (speculative launches used/discarded).
+        summary["async"] = oracle.async_stats()
     print(json.dumps(summary))
     _obs_end(args)
     return 0 if trace is not None else 1
@@ -938,6 +971,7 @@ def main(argv: Optional[list] = None) -> int:
     obs_flags(p)
     tune_flags(p)
     fork_flags(p)
+    async_min_flags(p)
     p.add_argument("--batch", type=int, default=64)
     p.add_argument("--pool", type=int, default=256)
     p.add_argument("--rounds", type=int, default=10)

@@ -227,6 +227,10 @@ class DeviceReplayChecker:
             )
         from ..minimization.pipeline import overlap_fraction
 
+        spec_total = out["spec_hits"] + out["spec_waste"]
+        out["spec_hit_rate"] = (
+            round(out["spec_hits"] / spec_total, 3) if spec_total else 0.0
+        )
         out["overlap_fraction"] = round(overlap_fraction(out), 3)
         for k in ("overlap_seconds", "harvest_wait_seconds"):
             out[k] = round(out[k], 4)
@@ -334,6 +338,17 @@ class DeviceReplayChecker:
             self.pipeline_stats["spec_waste"] += waste
             obs.counter("pipe.spec_hits").inc(len(consumed))
             obs.counter("pipe.spec_waste").inc(waste)
+            # The measured free-lane hit rate, visible to the tuner in
+            # every snapshot (force_set — same contract as tune.*
+            # decisions): of the speculative lanes dispatched so far,
+            # the fraction whose verdicts the next level consumed.
+            total = (
+                self.pipeline_stats["spec_hits"]
+                + self.pipeline_stats["spec_waste"]
+            )
+            obs.REGISTRY.gauge("pipe.spec_hit_rate").force_set(
+                round(self.pipeline_stats["spec_hits"] / total, 3)
+            )
         self._spec_cache = {}
         todo = [i for i in range(n) if pending.codes[i] == pending.UNRESOLVED]
         spec_pool: List[list] = []

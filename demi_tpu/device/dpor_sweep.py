@@ -282,7 +282,22 @@ class DeviceDPOROracle:
     ResumableDPOR, IncrementalDeltaDebugging.scala:94-122). With
     ``initial_trace`` set, each fresh instance is seeded with the recorded
     schedule's prescription; ``max_distance`` (set by IncrementalDDMin)
-    caps backtracks by edit distance to it."""
+    caps backtracks by edit distance to it.
+
+    One jitted DPOR kernel (and fork kernel) is shared across the
+    resumable instances — the kernel closes over (app, cfg) only, the
+    program is data — so a DDMin run probing many subsequences compiles
+    once instead of once per subsequence.
+
+    Async surface (``async_min``, default the ``DEMI_ASYNC_MIN`` env
+    switch): ``supports_async`` + ``test_window`` let the speculative
+    minimizers (DDMin's left/right pair batching, LeftToRightRemoval's
+    windows) batch a whole window of probes' frontier rounds into one
+    device launch (``explore_window``); each probe's instance state
+    commits only when its resolver is consulted, so an unconsulted probe
+    leaves its resumable frontier exactly as the sequential path would.
+    ``double_buffer`` threads through to each instance's in-flight round
+    dispatch (see DeviceDPOR)."""
 
     def __init__(
         self,
@@ -294,7 +309,12 @@ class DeviceDPOROracle:
         initial_trace=None,
         autotune: bool = False,
         prefix_fork: Optional[bool] = None,
+        async_min: Optional[bool] = None,
+        double_buffer: Optional[bool] = None,
     ):
+        from ..minimization.pipeline import async_min_enabled
+        from .fork import prefix_fork_enabled
+
         self.app = app
         self.cfg = cfg
         self.config = config
@@ -308,7 +328,26 @@ class DeviceDPOROracle:
         # gets its own DporBudgetTuner (frontier dynamics are
         # per-subsequence), fed by the per-round redundant/pruned counts.
         self.autotune = autotune
+        self._async = async_min_enabled(async_min)
+        self._double_buffer = double_buffer
+        # Shared kernels (pallas builds its own per-instance closures;
+        # mesh sharding isn't an oracle concern).
+        impl = os.environ.get("DEMI_DEVICE_IMPL", "xla")
+        self._kernel = (
+            make_dpor_kernel(app, cfg) if impl != "pallas" else None
+        )
+        self._fork_kernel = (
+            make_dpor_kernel(app, cfg, start_state=True)
+            if impl != "pallas" and prefix_fork_enabled(prefix_fork)
+            else None
+        )
         self._instances: Dict[Tuple, DeviceDPOR] = {}
+
+    @property
+    def supports_async(self) -> bool:
+        """True when the async-minimization pipeline is on — what the
+        speculative minimizers probe before using ``test_window``."""
+        return self._async
 
     def set_initial_trace(self, trace) -> None:
         self.initial_trace = trace
@@ -343,6 +382,15 @@ class DeviceDPOROracle:
             if inst.tuner is not None
         ]
 
+    def async_stats(self) -> Dict[str, int]:
+        """In-flight round economics summed across the resumable
+        instances — what the CLI and bench config 8 report."""
+        out = {"inflight_rounds": 0, "inflight_hits": 0, "inflight_waste": 0}
+        for inst in self._instances.values():
+            for k in out:
+                out[k] += inst.async_stats[k]
+        return out
+
     def _instance(self, externals) -> DeviceDPOR:
         key = tuple(e.eid for e in externals)
         inst = self._instances.get(key)
@@ -350,6 +398,9 @@ class DeviceDPOROracle:
             inst = DeviceDPOR(
                 self.app, self.cfg, externals, self.batch_size,
                 prefix_fork=self.prefix_fork,
+                double_buffer=self._double_buffer,
+                kernel=self._kernel,
+                fork_kernel=self._fork_kernel,
             )
             if self.initial_trace is not None:
                 inst.seed(
@@ -379,12 +430,8 @@ class DeviceDPOROracle:
                 inst.max_distance = inst.tuner.max_distance
         return inst
 
-    def test(self, externals, violation_fingerprint, stats=None, init=None):
-        from ..schedulers.guided import GuidedScheduler, GuideDivergence
-        from .encoding import device_trace_to_guide
-
-        if stats is not None:
-            stats.record_replay()
+    @staticmethod
+    def _check_fingerprint(violation_fingerprint) -> None:
         if violation_fingerprint is not None and not hasattr(
             violation_fingerprint, "code"
         ):
@@ -395,18 +442,14 @@ class DeviceDPOROracle:
                 "DeviceDPOROracle needs an IntViolation-style fingerprint "
                 f"(got {type(violation_fingerprint).__name__})"
             )
-        dpor = self._instance(externals)
-        target = getattr(violation_fingerprint, "code", None)
-        with obs.span(
-            "dpor.oracle_probe", externals=len(externals)
-        ) as sp:
-            found = dpor.explore(
-                target_code=target, max_rounds=self.max_rounds
-            )
-            sp.set(found=found is not None)
-        self.last_interleavings = dpor.interleavings
-        if found is None:
-            return None
+
+    def _lift(self, externals, found, violation_fingerprint):
+        """Lift a violating device lane to a full host EventTrace via
+        GuidedScheduler — the host half of a probe (and the part
+        ``test_window`` keeps on-consult, in sequential order)."""
+        from ..schedulers.guided import GuidedScheduler, GuideDivergence
+        from .encoding import device_trace_to_guide
+
         records, trace_len = found
         guide = device_trace_to_guide(self.app, records, trace_len)
         gs = GuidedScheduler(self.config, self.app)
@@ -426,12 +469,135 @@ class DeviceDPOROracle:
         result.trace.set_original_externals(list(externals))
         return result.trace
 
+    def test(self, externals, violation_fingerprint, stats=None, init=None):
+        if stats is not None:
+            stats.record_replay()
+        self._check_fingerprint(violation_fingerprint)
+        dpor = self._instance(externals)
+        target = getattr(violation_fingerprint, "code", None)
+        with obs.span(
+            "dpor.oracle_probe", externals=len(externals)
+        ) as sp:
+            found = dpor.explore(
+                target_code=target, max_rounds=self.max_rounds
+            )
+            sp.set(found=found is not None)
+        self.last_interleavings = dpor.interleavings
+        if found is None:
+            return None
+        return self._lift(list(externals), found, violation_fingerprint)
+
+    def test_window(self, candidates, violation_fingerprint):
+        """One batched window of DPOR probes: per-candidate lazy
+        resolvers whose consulted prefix behaves exactly like sequential
+        ``test`` calls. The device work — every probe's frontier rounds —
+        runs eagerly up front via ``explore_window`` (left and right
+        probes' rounds share launches), but each probe's resumable
+        instance state (explored set, frontier, interleavings, tuner)
+        commits only when its resolver is consulted: the pre-window
+        snapshot is restored immediately after exploration, and the
+        resolver swaps in the post-window snapshot. A probe the caller
+        never consults — DDMin's right half after a left success — leaves
+        its instance exactly as the sequential path (which never ran it)
+        would have. The host lift stays on-consult, in consult order."""
+        self._check_fingerprint(violation_fingerprint)
+        target = getattr(violation_fingerprint, "code", None)
+        probes: List[tuple] = []
+        window: List[DeviceDPOR] = []
+        seen_keys = set()
+        for ext in candidates:
+            key = tuple(e.eid for e in ext)
+            if key in seen_keys:
+                # Duplicate subsequence in one window: the second probe
+                # must observe the first's committed state, which only
+                # exists at consult time — resolve it sequentially.
+                probes.append((list(ext), None, None))
+                continue
+            seen_keys.add(key)
+            dpor = self._instance(ext)
+            probes.append((list(ext), dpor, _dpor_search_state(dpor)))
+            window.append(dpor)
+        with obs.span("dpor.window", probes=len(window)) as sp:
+            founds = explore_window(window, target, self.max_rounds)
+            sp.set(found=sum(f is not None for f in founds))
+        posts = [_dpor_search_state(d) for d in window]
+        by_inst = {id(d): k for k, d in enumerate(window)}
+        for _ext, dpor, pre in probes:
+            if dpor is not None:
+                _dpor_restore_state(dpor, pre)
+
+        def resolver(i: int):
+            ext, dpor, _pre = probes[i]
+            if dpor is None:
+                return self.test(ext, violation_fingerprint)
+            k = by_inst[id(dpor)]
+            _dpor_restore_state(dpor, posts[k])
+            self.last_interleavings = dpor.interleavings
+            found = founds[k]
+            if found is None:
+                return None
+            return self._lift(ext, found, violation_fingerprint)
+
+        return [(lambda i=i: resolver(i)) for i in range(len(probes))]
+
 
 def max_distance_union(a: Optional[int], b: Optional[int]) -> Optional[int]:
     """The looser of two edit-distance budgets (None = unbounded)."""
     if a is None or b is None:
         return None
     return max(a, b)
+
+
+def _resolve_double_buffer(explicit: Optional[bool] = None) -> bool:
+    """Resolve the in-flight-round switch: an explicit constructor arg
+    wins (bench and the calibrated tune axis pass one); otherwise the
+    feature rides the ``DEMI_ASYNC_MIN`` umbrella flag and defaults on
+    only where speculation is free — platforms where host and device are
+    disjoint. On CPU the device lanes run on the host's own cores, so a
+    mispredicted in-flight launch burns real compute; there the tuner
+    (``tune.calibrate_dpor_inflight``) must measure the trade."""
+    if explicit is not None:
+        return bool(explicit)
+    from ..minimization.pipeline import async_min_enabled
+
+    if not async_min_enabled(None):
+        return False
+    return jax.devices()[0].platform != "cpu"
+
+
+def _dpor_search_state(dpor: "DeviceDPOR") -> tuple:
+    """Snapshot of a DeviceDPOR's host-side search state — everything a
+    round mutates. ``test_window`` uses it to run speculative probes'
+    rounds eagerly (their device work shares the window launch) while
+    committing their instance state only on consult, so an unconsulted
+    probe leaves its resumable frontier exactly as the sequential path
+    would have."""
+    tuner = None
+    if dpor.tuner is not None:
+        tuner = (
+            dpor.tuner.rounds, dpor.tuner.round_batch,
+            dpor.tuner.max_distance,
+        )
+    return (
+        set(dpor.explored), list(dpor.frontier), dpor.original,
+        dpor.max_distance, dpor.interleavings, dpor.round_batch,
+        dict(dpor.async_stats), tuner,
+    )
+
+
+def _dpor_restore_state(dpor: "DeviceDPOR", state: tuple) -> None:
+    (
+        dpor.explored, dpor.frontier, dpor.original, dpor.max_distance,
+        dpor.interleavings, dpor.round_batch, async_stats, tuner,
+    ) = (
+        set(state[0]), list(state[1]), state[2], state[3], state[4],
+        state[5], dict(state[6]), state[7],
+    )
+    if tuner is not None and dpor.tuner is not None:
+        (
+            dpor.tuner.rounds, dpor.tuner.round_batch,
+            dpor.tuner.max_distance,
+        ) = tuner
 
 
 def steering_prescription(
@@ -470,7 +636,18 @@ class DeviceDPOR:
     across test() calls, :225-254); ``seed`` plants an initial-trace
     prescription; ``max_distance`` caps accepted backtracks by modified
     edit distance to the seeded schedule (ArvindDistanceOrdering's metric
-    over record identities)."""
+    over record identities).
+
+    ``double_buffer`` (default: on under ``DEMI_ASYNC_MIN`` on non-CPU
+    platforms — see ``_resolve_double_buffer``) overlaps rounds: round
+    N+1's prescriptions are planned, grouped, and dispatched as a FULL
+    in-flight launch while round N's codes are still on device, on the
+    prediction that round N's harvest adds nothing that outranks the
+    current frontier. A correct prediction makes the next harvest free of
+    dispatch latency; a misprediction discards the in-flight launch
+    unharvested, so the explored set, frontier, and every per-lane result
+    stay bit-identical to the synchronous loop (lane keys depend only on
+    the round index, which speculation preserves)."""
 
     def __init__(
         self,
@@ -482,6 +659,10 @@ class DeviceDPOR:
         mesh=None,
         prefix_fork: Optional[bool] = None,
         fork_bucket: int = 8,
+        fork_min_group: Optional[int] = None,
+        double_buffer: Optional[bool] = None,
+        kernel=None,
+        fork_kernel=None,
     ):
         assert cfg.record_trace and cfg.record_parents
         self.app = app
@@ -515,6 +696,12 @@ class DeviceDPOR:
             self.kernel = make_dpor_kernel_pallas(
                 app, cfg, block_lanes=min(64, batch_size)
             )
+        elif kernel is not None:
+            # A caller-shared kernel (DeviceDPOROracle keeps one per
+            # app/cfg): every fresh DeviceDPOR otherwise jits its own
+            # closure, so a DDMin run probing many subsequences would
+            # recompile the identical kernel per subsequence.
+            self.kernel = kernel
         else:
             self.kernel = make_dpor_kernel(app, cfg)
         self.prog = lower_program(app, cfg, list(program))
@@ -528,7 +715,11 @@ class DeviceDPOR:
 
         self._forker = None
         if prefix_fork_enabled(prefix_fork):
-            from .fork import PrefixForker, make_dpor_prefix_runner
+            from .fork import (
+                PrefixForker,
+                make_dpor_prefix_resume_runner,
+                make_dpor_prefix_runner,
+            )
 
             if impl == "pallas" and mesh is None:
                 import sys
@@ -539,19 +730,47 @@ class DeviceDPOR:
                     file=sys.stderr,
                 )
             if mesh is None:
-                self._fork_kernel = make_dpor_kernel(app, cfg, start_state=True)
+                self._fork_kernel = fork_kernel or make_dpor_kernel(
+                    app, cfg, start_state=True
+                )
             else:
                 from ..parallel.mesh import shard_dpor_kernel
 
                 self._fork_kernel = shard_dpor_kernel(
                     app, cfg, mesh, start_state=True
                 )
+            if fork_min_group is None:
+                # Frontier racing prescriptions cluster in small sibling
+                # groups (children of one parent trace), and a trunk run
+                # is a SINGLE-lane O(prefix) execution: on CPU — where a
+                # vectorized lane costs nearly as much as a scalar one —
+                # a 2-lane group cannot amortize it, so require groups
+                # the trunk genuinely pays for. On accelerators the
+                # batched lanes are effectively free next to the trunk
+                # launch, so keep the planner's permissive default.
+                fork_min_group = 4 if jax.devices()[0].platform == "cpu" else 2
             self._forker = PrefixForker(
                 make_dpor_prefix_runner(app, cfg),
                 bucket=fork_bucket,
+                min_group=fork_min_group,
                 driver="dpor",
+                # Prescribed-resume trunks: a trunk-cache miss resumes
+                # the nearest cached ancestor over the remaining
+                # prescription rows (O(bucket)) instead of re-following
+                # the full prefix (O(p)) — the DPOR twin of the replay
+                # checker's hierarchical trunks.
+                resume_runner=make_dpor_prefix_resume_runner(app, cfg),
             )
         self._mesh = mesh
+        self._double_buffer = _resolve_double_buffer(double_buffer)
+        # In-flight round economics (the signal calibrate_dpor_inflight
+        # and bench config 8 read): speculative launches, and how many
+        # were used vs discarded.
+        self.async_stats = {
+            "inflight_rounds": 0,
+            "inflight_hits": 0,
+            "inflight_waste": 0,
+        }
         self.explored: Set[Tuple] = set()
         self.frontier: List[Tuple] = [tuple()]
         self.explored.add(tuple())
@@ -593,25 +812,65 @@ class DeviceDPOR:
             msg=np.broadcast_to(self.prog.msg, (b,) + np.asarray(self.prog.msg).shape),
         )
 
-    def _launch_round(self, prescs: np.ndarray, keys, batch: List[Tuple]):
-        """One frontier round's lane work, harvested to LaneResult arrays.
+    def _select_batch(
+        self, frontier: List[Tuple]
+    ) -> Tuple[List[Tuple], List[Tuple]]:
+        """Pure round selection: ``(batch, rest)`` for one frontier round
+        — deepest-first with a seeded initial prescription pinned to the
+        head, padded to ``batch_size`` with prescription-free lanes. Does
+        NOT mutate the input, and is deterministic in (frontier,
+        round_batch): because rounds select from the FROZEN generation
+        (fresh prescriptions join the next generation — see ``explore``),
+        the double-buffered loop's in-flight round is the real next round
+        whenever this selection re-runs unchanged after the harvest."""
+        frontier = list(frontier)
+        head, rest = (
+            ([frontier[0]], frontier[1:])
+            if self.original is not None and frontier
+            and frontier[0] == self.original
+            else ([], frontier)
+        )
+        rest.sort(key=len, reverse=True)
+        frontier = head + rest
+        take = max(1, min(self.round_batch, self.batch_size))
+        batch, rest = frontier[:take], frontier[take:]
+        batch = batch + [tuple()] * (self.batch_size - len(batch))
+        return batch, rest
+
+    def _round_keys(self, n: int, base: int):
+        """Per-lane keys for one round: position in the cumulative
+        interleaving count. Every round is padded to ``batch_size``, so
+        ``base`` advances deterministically — a speculative round N+1
+        dispatched before round N's harvest derives the exact keys the
+        synchronous loop would."""
+        return jax.vmap(
+            lambda s: jax.random.fold_in(jax.random.PRNGKey(0), s)
+        )(np.arange(base, base + n, dtype=np.uint32))
+
+    def _dispatch_round(self, prescs: np.ndarray, keys, batch: List[Tuple]):
+        """Launch one frontier round's lane work WITHOUT pulling results
+        — the dispatch half of the round (pair with ``_harvest_round``).
+        Returns a list of ``(indices, device LaneResult)`` parts;
+        ``indices=None`` means the whole batch in order.
 
         Scratch mode: one whole-batch kernel launch. Prefix-fork mode:
         prescriptions grouped by bucketed shared prefix (PrefixPlanner);
         each group resumes from a cached trunk snapshot via the
-        ``start_state=`` kernel, everything else (prescription-free pads
-        included) runs the scratch kernel. Per-lane keys follow batch
-        position on both paths, so per-lane results are bit-identical."""
+        ``start_state=`` kernel — a trunk-cache miss first tries to
+        derive the trunk by resuming the nearest cached ancestor over
+        the remaining prescribed rows (``trunk_hier_prescribed``,
+        O(bucket) instead of O(prefix)) — and everything else
+        (prescription-free pads included) runs the scratch kernel.
+        Per-lane keys follow batch position on both paths, so per-lane
+        results are bit-identical."""
         if self._forker is None or len(batch) < 2:
-            res = self.kernel(self._progs(len(batch)), prescs, keys)
-            jax.block_until_ready(res.violation)
-            return res
+            return [(None, self.kernel(self._progs(len(batch)), prescs, keys))]
         from .fork import padded_size
 
         keys = np.asarray(keys)
         lengths = np.asarray([len(p) for p in batch])
         groups, scratch = self._forker.plan(prescs, lengths)
-        parts: List[Tuple[List[int], LaneResult]] = []
+        parts: List[Tuple[Optional[List[int]], LaneResult]] = []
 
         for g in groups:
             if not self._forker.should_fork(g):
@@ -619,11 +878,12 @@ class DeviceDPOR:
                 continue
             trunk_presc = np.zeros_like(prescs[0])
             trunk_presc[: g.prefix_len] = prescs[g.indices[0], : g.prefix_len]
-            snap, trunk_steps, hit = self._forker.trunk(
+            snap, trunk_steps, hit = self._forker.trunk_hier_prescribed(
                 g.key,
                 ExtProgram(*(np.asarray(x) for x in self.prog)),
                 trunk_presc,
                 jax.random.PRNGKey(0),
+                g.prefix_len,
             )
             full = g.indices + [g.indices[0]] * (
                 padded_size(len(g.indices), self._mesh) - len(g.indices)
@@ -641,13 +901,20 @@ class DeviceDPOR:
             res_s = self.kernel(self._progs(len(full)), prescs[full], keys[full])
             parts.append((scratch, res_s))
             self._forker.note_scratch(len(scratch))
-        # Merge the parts back into batch order (np arrays quack like the
-        # LaneResult the harvesting loops read).
-        b = len(batch)
+        return parts
+
+    def _harvest_round(self, parts, batch_len: int) -> LaneResult:
+        """Block on a dispatched round's parts and merge them back into
+        batch order (np arrays quack like the LaneResult the harvesting
+        loops read)."""
+        if len(parts) == 1 and parts[0][0] is None:
+            res = parts[0][1]
+            jax.block_until_ready(res.violation)
+            return res
         merged = {}
         for field in LaneResult._fields:
             ref = np.asarray(getattr(parts[0][1], field))
-            merged[field] = np.zeros((b,) + ref.shape[1:], ref.dtype)
+            merged[field] = np.zeros((batch_len,) + ref.shape[1:], ref.dtype)
         for idx, res in parts:
             jax.block_until_ready(res.violation)
             for field in LaneResult._fields:
@@ -656,103 +923,285 @@ class DeviceDPOR:
                 )[: len(idx)]
         return LaneResult(**merged)
 
+    def _launch_round(self, prescs: np.ndarray, keys, batch: List[Tuple]):
+        """One frontier round's lane work, harvested to LaneResult arrays
+        (the synchronous dispatch+harvest pair)."""
+        return self._harvest_round(
+            self._dispatch_round(prescs, keys, batch), len(batch)
+        )
+
+    def _process_round(
+        self,
+        res: LaneResult,
+        batch: List[Tuple],
+        target_code: Optional[int],
+        frontier: List[Tuple],
+        frontier_extra: int = 0,
+    ) -> Optional[Tuple[np.ndarray, int]]:
+        """The host half of a frontier round: telemetry, the violation
+        scan, racing-prescription derivation (appended to ``frontier`` in
+        place — the caller's NEXT-generation list under the frozen-
+        generation policy), and tuner feedback (``frontier_extra`` counts
+        worklist entries outside the sink list — the frozen generation's
+        remainder — so the tuner sees the full frontier size). Returns a
+        violating lane's (records, trace_len) or None."""
+        self.interleavings += len(batch)
+        if obs.enabled():
+            # Device-lane totals for the round (one on-device
+            # reduction, one pull) + the exploration-efficiency
+            # counters optimal-DPOR tuning reads (redundant = already
+            # explored, pruned = over the edit-distance cap).
+            from ..obs import lane_stats as _ls
+
+            _ls.record(
+                _ls.reduce_lanes(
+                    res.status, res.violation, res.deliveries,
+                    len(batch),
+                    invariant_interval=self.cfg.invariant_interval,
+                ),
+                driver="dpor",
+            )
+            obs.counter("dpor.interleavings").inc(len(batch))
+        violations = np.asarray(res.violation)
+        traces = np.asarray(res.trace)
+        lens = np.asarray(res.trace_len)
+        hit = None
+        for lane in range(len(batch)):
+            code = int(violations[lane])
+            if code != 0 and (target_code is None or code == target_code):
+                hit = (traces[lane], int(lens[lane]))
+                break
+        # Local fresh/redundant/pruned counts: the tuner's per-round
+        # signal, needed whether or not telemetry is on (the obs
+        # counters still carry the cross-round totals).
+        fresh_n = redundant_n = pruned_n = 0
+        for lane in range(len(batch)):
+            for presc in racing_prescriptions(
+                traces[lane], int(lens[lane]), self.cfg.rec_width
+            ):
+                if presc in self.explored:
+                    redundant_n += 1
+                    obs.counter("dpor.prescriptions_redundant").inc()
+                    continue
+                if (
+                    self.max_distance is not None
+                    and self.original is not None
+                    and arvind_distance(presc, self.original)
+                    > self.max_distance
+                ):
+                    pruned_n += 1
+                    obs.counter("dpor.prescriptions_distance_pruned").inc()
+                    continue
+                fresh_n += 1
+                self.explored.add(presc)
+                frontier.append(presc)
+        obs.gauge("dpor.explored_set_size").set(len(self.explored))
+        if self.tuner is not None:
+            self.tuner.observe_round(
+                fresh=fresh_n, redundant=redundant_n, pruned=pruned_n,
+                frontier=len(frontier) + frontier_extra,
+            )
+            self.round_batch = self.tuner.round_batch
+            if self.tuner.max_distance is not None:
+                self.max_distance = self.tuner.max_distance
+        return hit
+
+    def _note_inflight(self, outcome: str) -> None:
+        self.async_stats[f"inflight_{outcome}"] += 1
+        obs.counter(f"dpor.inflight_{outcome}").inc()
+
     def explore(
         self, target_code: Optional[int] = None, max_rounds: int = 20
     ) -> Optional[Tuple[np.ndarray, int]]:
         """Returns (records, trace_len) of a violating lane, or None.
-        Continues from the persisted frontier; call again for more rounds."""
-        frontier = self.frontier
-        for _ in range(max_rounds):
-            if not frontier:
-                self.frontier = frontier
-                return None
-            # Deepest-first; a seeded initial prescription (index 0) stays
-            # first in round one regardless of length.
-            head, rest = (
-                ([frontier[0]], frontier[1:])
-                if self.original is not None and frontier
-                and frontier[0] == self.original
-                else ([], frontier)
-            )
-            rest.sort(key=len, reverse=True)
-            frontier = head + rest
-            take = max(1, min(self.round_batch, self.batch_size))
-            batch, frontier = frontier[:take], frontier[take:]
-            # Pad to a fixed batch size so the kernel compiles once; pad
-            # lanes run prescription-free (fresh random exploration) and
-            # their results feed the frontier like any other lane.
-            batch = batch + [tuple()] * (self.batch_size - len(batch))
-            prescs = self._pack(batch)
-            keys = jax.vmap(
-                lambda s: jax.random.fold_in(jax.random.PRNGKey(0), s)
-            )(np.arange(self.interleavings, self.interleavings + len(batch), dtype=np.uint32))
-            with obs.span(
-                "dpor.round", batch=len(batch), frontier=len(frontier)
-            ):
-                res = self._launch_round(prescs, keys, batch)
-            self.interleavings += len(batch)
-            if obs.enabled():
-                # Device-lane totals for the round (one on-device
-                # reduction, one pull) + the exploration-efficiency
-                # counters optimal-DPOR tuning reads (redundant = already
-                # explored, pruned = over the edit-distance cap).
-                from ..obs import lane_stats as _ls
+        Continues from the persisted frontier; call again for more rounds.
 
-                _ls.record(
-                    _ls.reduce_lanes(
-                        res.status, res.violation, res.deliveries,
-                        len(batch),
-                        invariant_interval=self.cfg.invariant_interval,
-                    ),
-                    driver="dpor",
-                )
-                obs.counter("dpor.interleavings").inc(len(batch))
-            violations = np.asarray(res.violation)
-            traces = np.asarray(res.trace)
-            lens = np.asarray(res.trace_len)
-            hit = None
-            for lane in range(len(batch)):
-                code = int(violations[lane])
-                if code != 0 and (target_code is None or code == target_code):
-                    hit = (traces[lane], int(lens[lane]))
+        Rounds are GENERATION-FROZEN: each round's batch is selected from
+        the generation frozen at the previous generation boundary, and
+        the fresh prescriptions a harvest derives join the NEXT
+        generation (picked up when the current one drains). This is
+        breadth-style worklist processing — deepest-first within a
+        generation — and it is what makes the next round plannable before
+        the current round's codes ever leave the device: the harvest
+        cannot reorder the generation it was selected from.
+
+        With ``double_buffer`` on, round N+1's batch is selected from the
+        frozen-generation remainder and dispatched as a FULL in-flight
+        launch while round N's codes are still on device. The plan is
+        re-checked after the harvest by re-running the (pure,
+        deterministic) selection: an exact batch match means the
+        in-flight launch IS the next round (per-lane keys depend only on
+        the cumulative interleaving count, which padding makes
+        deterministic); a mismatch — the tuner moved ``round_batch``
+        mid-round — discards the launch unharvested. Either way every
+        harvested round is byte-identical to the synchronous loop's,
+        which follows the exact same generation policy."""
+        gen = self.frontier
+        pending: List[Tuple] = []  # the NEXT generation, fed by harvests
+        inflight = None  # (batch, parts, n_real) for the next round
+        found = None
+        for _ in range(max_rounds):
+            if inflight is not None:
+                batch, parts, _ = inflight
+                inflight = None
+                # A hit is an in-flight launch actually harvested as the
+                # next round — adoption alone isn't enough (the budget
+                # can expire first, which counts as waste, so every
+                # dispatched launch lands in exactly one bucket).
+                self._note_inflight("hits")
+            else:
+                if not gen:
+                    gen, pending = pending, []
+                if not gen:
                     break
-            # Local fresh/redundant/pruned counts: the tuner's per-round
-            # signal, needed whether or not telemetry is on (the obs
-            # counters still carry the cross-round totals).
-            fresh_n = redundant_n = pruned_n = 0
-            for lane in range(len(batch)):
-                for presc in racing_prescriptions(
-                    traces[lane], int(lens[lane]), self.cfg.rec_width
-                ):
-                    if presc in self.explored:
-                        redundant_n += 1
-                        obs.counter("dpor.prescriptions_redundant").inc()
-                        continue
-                    if (
-                        self.max_distance is not None
-                        and self.original is not None
-                        and arvind_distance(presc, self.original)
-                        > self.max_distance
-                    ):
-                        pruned_n += 1
-                        obs.counter("dpor.prescriptions_distance_pruned").inc()
-                        continue
-                    fresh_n += 1
-                    self.explored.add(presc)
-                    frontier.append(presc)
-            obs.gauge("dpor.frontier_size").set(len(frontier))
-            obs.gauge("dpor.explored_set_size").set(len(self.explored))
-            if self.tuner is not None:
-                self.tuner.observe_round(
-                    fresh=fresh_n, redundant=redundant_n, pruned=pruned_n,
-                    frontier=len(frontier),
+                batch, gen = self._select_batch(gen)
+                parts = self._dispatch_round(
+                    self._pack(batch),
+                    self._round_keys(len(batch), self.interleavings),
+                    batch,
                 )
-                self.round_batch = self.tuner.round_batch
-                if self.tuner.max_distance is not None:
-                    self.max_distance = self.tuner.max_distance
+            spec = None
+            if self._double_buffer and gen:
+                sbatch, srest = self._select_batch(gen)
+                sparts = self._dispatch_round(
+                    self._pack(sbatch),
+                    self._round_keys(
+                        len(sbatch), self.interleavings + len(batch)
+                    ),
+                    sbatch,
+                )
+                # len(gen) - len(srest) real entries precede the padding
+                # in sbatch — the count the budget-expiry requeue needs
+                # (a genuine root ``tuple()`` entry is falsy, so
+                # truthiness can't separate it from padding).
+                spec = (sbatch, sparts, len(gen) - len(srest))
+                self._note_inflight("rounds")
+            with obs.span(
+                "dpor.round", batch=len(batch), frontier=len(gen)
+            ):
+                res = self._harvest_round(parts, len(batch))
+            hit = self._process_round(
+                res, batch, target_code, pending, frontier_extra=len(gen)
+            )
+            obs.gauge("dpor.frontier_size").set(len(gen) + len(pending))
+            if hit is not None:
+                if spec is not None:
+                    self._note_inflight("waste")
+                obs.counter("dpor.violations_found").inc()
+                found = hit
+                break
+            if spec is not None:
+                sbatch, sparts, sreal = spec
+                abatch, arest = self._select_batch(gen)
+                if abatch == sbatch:
+                    inflight = (sbatch, sparts, sreal)
+                    gen = arest
+                else:
+                    self._note_inflight("waste")
+        if inflight is not None:
+            # The round budget expired with a speculative round still on
+            # device: it was never harvested, so its prescriptions go
+            # back to the worklist head and the next explore() call
+            # re-selects (and re-dispatches) them.
+            batch, _parts, n_real = inflight
+            gen = list(batch[:n_real]) + gen
+            self._note_inflight("waste")
+        self.frontier = gen + pending
+        return found
+
+
+def explore_window(
+    dpors: Sequence["DeviceDPOR"],
+    target_code: Optional[int],
+    max_rounds: int,
+) -> List[Optional[Tuple[np.ndarray, int]]]:
+    """Run several DeviceDPOR searches in lockstep, batching concurrent
+    frontier rounds' device work — the engine under
+    ``DeviceDPOROracle.test_window`` (IncrementalDDMin's speculative
+    left/right DDMin probe pairs). Per round, every live instance's batch
+    becomes ONE combined kernel launch when the instances share a kernel
+    and run scratch (the common DeviceDPOROracle case: one jitted kernel
+    serves every resumable instance); under prefix forking each
+    instance's fork groups dispatch before any is harvested, so device
+    work still overlaps across the window. Each instance's host-side
+    round processing is untouched — explored sets, frontiers,
+    interleavings, and per-lane keys are all per-instance, so results
+    are bit-identical to running the searches sequentially."""
+    n = len(dpors)
+    found: List[Optional[Tuple[np.ndarray, int]]] = [None] * n
+    done = [False] * n
+    # Per-instance generation split, mirroring explore(): rounds select
+    # from the frozen generation, fresh prescriptions join the pending
+    # next generation — same policy, so committed states match the
+    # sequential path exactly.
+    frontiers = [list(d.frontier) for d in dpors]
+    pendings: List[List[Tuple]] = [[] for _ in dpors]
+    for _ in range(max_rounds):
+        live = []
+        for i in range(n):
+            if done[i]:
+                continue
+            if not frontiers[i]:
+                frontiers[i], pendings[i] = pendings[i], []
+            if frontiers[i]:
+                live.append(i)
+        if not live:
+            break
+        staged = []
+        for i in live:
+            batch, frontiers[i] = dpors[i]._select_batch(frontiers[i])
+            staged.append(
+                (i, batch, dpors[i]._pack(batch),
+                 dpors[i]._round_keys(len(batch), dpors[i].interleavings))
+            )
+        combined = (
+            len(staged) > 1
+            and all(dpors[i]._forker is None for i, *_ in staged)
+            and len({id(dpors[i].kernel) for i, *_ in staged}) == 1
+        )
+        results: List[Tuple[int, List[Tuple], LaneResult]] = []
+        if combined:
+            # One launch for the whole window: lanes are elementwise
+            # under vmap, so concatenating the instances' (prog, presc,
+            # key) rows yields exactly each instance's own round results.
+            progs = [dpors[i]._progs(len(b)) for i, b, *_ in staged]
+            res = dpors[staged[0][0]].kernel(
+                ExtProgram(*(
+                    np.concatenate([np.asarray(getattr(p, f)) for p in progs])
+                    for f in ExtProgram._fields
+                )),
+                np.concatenate([prescs for _, _, prescs, _ in staged]),
+                np.concatenate([np.asarray(keys) for *_, keys in staged]),
+            )
+            jax.block_until_ready(res.violation)
+            off = 0
+            for i, batch, _prescs, _keys in staged:
+                results.append((i, batch, LaneResult(*(
+                    np.asarray(getattr(res, f))[off: off + len(batch)]
+                    for f in LaneResult._fields
+                ))))
+                off += len(batch)
+        else:
+            handles = [
+                (i, batch, dpors[i]._dispatch_round(prescs, keys, batch))
+                for i, batch, prescs, keys in staged
+            ]
+            results = [
+                (i, batch, dpors[i]._harvest_round(parts, len(batch)))
+                for i, batch, parts in handles
+            ]
+        for i, batch, res in results:
+            with obs.span(
+                "dpor.round", batch=len(batch), frontier=len(frontiers[i])
+            ):
+                hit = dpors[i]._process_round(
+                    res, batch, target_code, pendings[i],
+                    frontier_extra=len(frontiers[i]),
+                )
             if hit is not None:
                 obs.counter("dpor.violations_found").inc()
-                self.frontier = frontier
-                return hit
-        self.frontier = frontier
-        return None
+                found[i] = hit
+                done[i] = True
+    for i, d in enumerate(dpors):
+        d.frontier = frontiers[i] + pendings[i]
+    return found

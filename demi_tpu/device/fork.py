@@ -43,8 +43,16 @@ Hierarchical trunks (``PrefixForker.trunk_hier`` +
 replays its full prefix — the nearest cached ancestor trunk (one or more
 planner buckets shorter) is resumed over just the remaining rows, so a
 miss costs O(bucket) and the PrefixCache becomes a trunk tree shared
-across ddmin levels and DPOR rounds. Wired into the replay checker
-(minimization's oracle); the DPOR/sweep drivers keep full-prefix trunks.
+across ddmin levels and DPOR rounds. All three drivers derive:
+``trunk_hier`` serves the replay checker (suffix-record resume),
+``trunk_hier_prescribed`` + ``make_dpor_prefix_resume_runner`` serve
+``DeviceDPOR`` (the freeze semantics make the ancestor's end state
+exactly the longer trunk's state at the freeze step, so the resume
+re-follows the FULL prescription from the committed cursor), and
+``trunk_from`` + ``make_explore_prefix_resume_runner`` serve the sweep
+driver (every group trunk resumes the chunk-wide base trunk — the
+common injection rows below the first wait — over just its remaining
+injection rows).
 
 Telemetry (``fork.*`` series, plus ``dpor.prefix_group_size``): cache
 hits/misses, ``fork.trunk_parent_hits`` (misses served by resuming an
@@ -263,14 +271,86 @@ def make_explore_prefix_runner(app: DSLApp, cfg: DeviceConfig):
     return jax.jit(run_prefix)
 
 
-def make_dpor_prefix_runner(app: DSLApp, cfg: DeviceConfig):
-    """jitted ``run_prefix(prog, presc[R, recw], key) -> PrefixSnapshot``:
-    follow the prefix prescription (injection steps included) and FREEZE —
-    a bit-exact no-op, state and cursor untouched — the first time no
-    remaining prefix record matches the pool. A scratch lane would decide
-    that step by scanning the full prescription (and possibly falling back
-    to its rng); the fork lanes redo exactly that from the snapshot, so
-    stopping before the decision is what keeps parity exact."""
+def make_explore_prefix_base_runner(app: DSLApp, cfg: DeviceConfig):
+    """jitted ``run_base(prog, key, op_limit) -> PrefixSnapshot``: run the
+    deterministic injection segment through the first ``op_limit``
+    external ops only (a traced scalar — one compile serves every limit)
+    and stop while the lane is still ST_INJECT. This is the sweep
+    driver's chunk-wide BASE trunk: every lane of a chunk shares the
+    program rows below the chunk's common-prefix/first-wait cap, so the
+    base runs once and each group trunk derives from it by resuming over
+    just its remaining injection rows (``make_explore_prefix_resume_runner``)
+    instead of replaying the whole shared segment per group."""
+    from .explore import make_any_step_fn
+
+    step = make_any_step_fn(app, cfg)
+
+    def run_base(prog, key, op_limit) -> PrefixSnapshot:
+        state = init_state(app, cfg, key)
+
+        def cond(carry):
+            s, i = carry
+            return (
+                (s.status == ST_INJECT)
+                & (s.ext_cursor < op_limit)
+                & (i < cfg.max_steps)
+            )
+
+        def body(carry):
+            s, i = carry
+            return step(s, prog), i + 1
+
+        state, steps = jax.lax.while_loop(
+            cond, body, (state, jnp.int32(0))
+        )
+        return PrefixSnapshot(
+            state=state, steps=steps, cursor=jnp.int32(0),
+            ignored=jnp.int32(0), peeked=jnp.int32(0),
+        )
+
+    return jax.jit(run_base)
+
+
+def make_explore_prefix_resume_runner(app: DSLApp, cfg: DeviceConfig):
+    """jitted ``resume_prefix(prog, snap) -> PrefixSnapshot``: continue a
+    base trunk's injection segment to the group boundary (the moment the
+    lane leaves ST_INJECT). Bit-exact vs a scratch group trunk: injection
+    is deterministic and never consumes rng, and the base stopped with
+    the lane still ST_INJECT below every member's first wait-like op, so
+    state(base) + remaining injections == state(full segment). A base
+    that overflowed mid-prefix resumes zero steps — exactly where the
+    scratch run would have stopped."""
+    from .explore import make_any_step_fn
+
+    step = make_any_step_fn(app, cfg)
+
+    def resume_prefix(prog, snap: PrefixSnapshot) -> PrefixSnapshot:
+        def cond(carry):
+            s, i = carry
+            return (s.status == ST_INJECT) & (i < cfg.max_steps)
+
+        def body(carry):
+            s, i = carry
+            return step(s, prog), i + 1
+
+        state, steps = jax.lax.while_loop(
+            cond, body, (snap.state, snap.steps)
+        )
+        return PrefixSnapshot(
+            state=state, steps=steps, cursor=jnp.int32(0),
+            ignored=jnp.int32(0), peeked=jnp.int32(0),
+        )
+
+    return jax.jit(resume_prefix)
+
+
+def _dpor_prefix_loop(app: DSLApp, cfg: DeviceConfig):
+    """The prescription-following trunk loop shared by the DPOR prefix
+    runner and its hierarchical resume twin: follow the prescription
+    (injection steps included) and FREEZE — a bit-exact no-op, state and
+    cursor untouched — the first time no remaining prescribed record
+    matches the pool. Returns ``run(prog, presc, state, cursor, steps)``
+    carrying the loop from any starting carry."""
     from .dpor_sweep import make_prescribed_dispatch
     from .explore import make_step_fn
 
@@ -278,9 +358,7 @@ def make_dpor_prefix_runner(app: DSLApp, cfg: DeviceConfig):
     base_step = make_step_fn(app, cfg)
     pdispatch = make_prescribed_dispatch(app, cfg)
 
-    def run_prefix(prog, presc, key) -> PrefixSnapshot:
-        state = init_state(app, cfg, key)
-
+    def run(prog, presc, state, cursor, steps):
         def cond(carry):
             s, _cur, i, frozen = carry
             return (s.status < ST_DONE) & ~frozen & (i < cfg.max_steps)
@@ -308,8 +386,27 @@ def make_dpor_prefix_runner(app: DSLApp, cfg: DeviceConfig):
             return ns, ncur, i + (~froze).astype(jnp.int32), froze
 
         state, cursor, steps, _ = jax.lax.while_loop(
-            cond, body,
-            (state, jnp.int32(0), jnp.int32(0), jnp.bool_(False)),
+            cond, body, (state, cursor, steps, jnp.bool_(False))
+        )
+        return state, cursor, steps
+
+    return run
+
+
+def make_dpor_prefix_runner(app: DSLApp, cfg: DeviceConfig):
+    """jitted ``run_prefix(prog, presc[R, recw], key) -> PrefixSnapshot``:
+    follow the prefix prescription (injection steps included) and FREEZE —
+    a bit-exact no-op, state and cursor untouched — the first time no
+    remaining prefix record matches the pool. A scratch lane would decide
+    that step by scanning the full prescription (and possibly falling back
+    to its rng); the fork lanes redo exactly that from the snapshot, so
+    stopping before the decision is what keeps parity exact."""
+    loop = _dpor_prefix_loop(app, cfg)
+
+    def run_prefix(prog, presc, key) -> PrefixSnapshot:
+        state = init_state(app, cfg, key)
+        state, cursor, steps = loop(
+            prog, presc, state, jnp.int32(0), jnp.int32(0)
         )
         return PrefixSnapshot(
             state=state, steps=steps, cursor=cursor,
@@ -317,6 +414,40 @@ def make_dpor_prefix_runner(app: DSLApp, cfg: DeviceConfig):
         )
 
     return jax.jit(run_prefix)
+
+
+def make_dpor_prefix_resume_runner(app: DSLApp, cfg: DeviceConfig):
+    """jitted ``resume_prefix(prog, presc[R, recw], snap) -> PrefixSnapshot``:
+    extend a cached ancestor DPOR trunk over the REMAINING prescribed
+    records — the prescribed-resume (hierarchical) trunk step. Unlike the
+    replay twin, the resume takes the FULL trunk prescription, not just
+    the suffix rows: the ancestor's committed cursor points into it, and
+    the prescribed-dispatch scan must restart from that cursor (records
+    between the cursor and the ancestor's prefix end were absent at the
+    freeze point, but the scan that decides the next delivery considers
+    them together with the new rows).
+
+    Bit-exact vs a scratch full-prefix trunk: the ancestor froze exactly
+    at the first step where none of ITS rows matched, with state/cursor
+    untouched by the freeze. A scratch trunk over the longer prescription
+    behaves identically up to that step (the scans agree wherever the
+    shorter prescription still had a match), and at it scans the extra
+    rows — which is exactly what re-entering the loop from the ancestor's
+    carry with the full prescription and a cleared freeze flag does. The
+    resume therefore costs O(remaining rows) device steps instead of
+    O(prefix)."""
+    loop = _dpor_prefix_loop(app, cfg)
+
+    def resume_prefix(prog, presc, snap: PrefixSnapshot) -> PrefixSnapshot:
+        state, cursor, steps = loop(
+            prog, presc, snap.state, snap.cursor, snap.steps
+        )
+        return PrefixSnapshot(
+            state=state, steps=steps, cursor=cursor,
+            ignored=snap.ignored, peeked=snap.peeked,
+        )
+
+    return jax.jit(resume_prefix)
 
 
 # ---------------------------------------------------------------------------
@@ -539,20 +670,68 @@ class PrefixForker:
             suffix[: prefix_len - q] = trunk_records[q:prefix_len]
             snapshot = self.resume_runner(suffix, parent[0])
             self.cache.put(key, snapshot, snapshot.steps)
-            # The full-key lookup genuinely missed; the ancestor hit is
-            # its own (cheaper) event.
-            self.stats["prefix_misses"] += 1
-            self.stats["parent_trunks"] += 1
-            obs.counter("fork.prefix_misses").inc(driver=self.driver)
-            obs.counter("fork.trunk_parent_hits").inc(driver=self.driver)
-            # note_group will charge this miss as a FULL trunk run
-            # (steps_saved term trunk_steps*(size-1)), but the
-            # derivation only paid the suffix — credit the parent's
-            # prefix steps so the evidence the fork tuner reads is not
-            # biased against deep hierarchical workloads.
-            self._deferred.append((parent[1], 1))
+            self._note_parent_trunk(parent)
             return snapshot, snapshot.steps, False
         return self.trunk(key, trunk_records, rng_key)
+
+    def trunk_hier_prescribed(
+        self, key: bytes, prog, trunk_records, rng_key, prefix_len: int
+    ) -> Tuple[PrefixSnapshot, object, bool]:
+        """``trunk_hier`` for prescription-following trunks (DeviceDPOR):
+        same ancestor walk, but the resume re-follows the FULL trunk
+        prescription from the ancestor's committed cursor (freeze
+        semantics — see ``make_dpor_prefix_resume_runner``) instead of a
+        compacted suffix, so the runner/resume argument shapes are
+        (prog, presc, key) / (prog, presc, snap)."""
+        if self.resume_runner is None or key in self.cache:
+            return self.trunk(key, prog, trunk_records, rng_key)
+        b = self.planner.bucket
+        for q in range(prefix_len - b, 0, -b):
+            parent = self.cache.peek(
+                prefix_digest(trunk_records[:q].tobytes())
+            )
+            if parent is None:
+                continue
+            snapshot = self.resume_runner(prog, trunk_records, parent[0])
+            self.cache.put(key, snapshot, snapshot.steps)
+            self._note_parent_trunk(parent)
+            return snapshot, snapshot.steps, False
+        return self.trunk(key, prog, trunk_records, rng_key)
+
+    def trunk_from(
+        self, key: bytes, parent: Tuple[PrefixSnapshot, object], *args
+    ) -> Tuple[PrefixSnapshot, object, bool]:
+        """Trunk derived from an EXPLICIT ancestor snapshot (the sweep
+        driver's chunk-wide base trunk, which is keyed outside the
+        group-digest scheme): cache contract matches ``trunk``; a miss
+        resumes the parent over the remaining rows instead of running
+        the full prefix."""
+        entry = self.cache.get(key)
+        if entry is not None:
+            self.stats["prefix_hits"] += 1
+            obs.counter("fork.prefix_hits").inc(driver=self.driver)
+            return entry[0], entry[1], True
+        snapshot = self.resume_runner(*args, parent[0])
+        self.cache.put(key, snapshot, snapshot.steps)
+        self._note_parent_trunk(parent)
+        return snapshot, snapshot.steps, False
+
+    def _note_parent_trunk(self, parent) -> None:
+        """Shared accounting for a trunk served by ancestor resume: the
+        full-key lookup genuinely missed, the ancestor hit is its own
+        (cheaper) event, and note_group's steps_saved term — which
+        charges the miss as a FULL trunk run — is credited the parent's
+        prefix steps so the evidence the fork tuner reads stays unbiased
+        for deep hierarchical workloads."""
+        self.stats["prefix_misses"] += 1
+        self.stats["parent_trunks"] += 1
+        obs.counter("fork.prefix_misses").inc(driver=self.driver)
+        obs.counter("fork.trunk_parent_hits").inc(driver=self.driver)
+        if self.driver == "dpor":
+            # The satellite counter report.py's Pipeline block renders
+            # next to dpor.inflight_rounds.
+            obs.counter("dpor.trunk_parent_hits").inc()
+        self._deferred.append((parent[1], 1))
 
     def note_group(self, size: int, trunk_steps, cache_hit: bool) -> None:
         """Account one fork-group launch: every member skipped the trunk's

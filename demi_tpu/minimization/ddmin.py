@@ -184,13 +184,18 @@ class BatchedDDMin(Minimizer):
         self.budget = budget or StageBudget()
         self.stats = stats or MinimizationStats()
         # Speculative level dispatch (DEMI_ASYNC_MIN=1): each level is
-        # dispatched with the PREDICTED next level's candidates (the
-        # no-reproduction branch: granularity doubling over the same dag)
-        # riding its idle padded lanes; a correct prediction turns the
-        # next level into verdict-cache hits and skips its launch.
-        # Verdicts alone pick the adopted branch, so the MCS is
+        # dispatched with the PREDICTED next level's candidates riding
+        # its idle padded lanes; a correct prediction turns the next
+        # level into verdict-cache hits and skips its launch. The branch
+        # predictor follows the last outcome: after a no-reproduction
+        # level, predict another (granularity doubling over the same
+        # dag); after an adoption, predict the SAME index adopts again
+        # (the last-adopted-index predictor the internal minimizer
+        # measures at ~60%) and speculate that candidate's follow-up
+        # level. Verdicts alone pick the adopted branch, so the MCS is
         # bit-identical to the synchronous path's.
         self.speculative = async_min_enabled(speculative)
+        self._pred_adopt: Optional[int] = None
         self.levels = 0
         self.verified_trace = None  # host-verified MCS execution (or None)
 
@@ -249,13 +254,29 @@ class BatchedDDMin(Minimizer):
                 "ddmin.level", granularity=n, candidates=len(candidates)
             ):
                 if use_async:
-                    # Predicted branch: no candidate reproduces, so the
-                    # next level is a granularity doubling of the SAME
-                    # dag — plannable before any verdict lands. Cap the
-                    # speculation at the lanes that can ride free.
+                    # Predicted branch, capped at the lanes that ride
+                    # free. After an adoption: the same index adopts
+                    # again, so speculate ITS follow-up level (restart
+                    # at 2 for a subset, refine for a complement).
+                    # Otherwise: no candidate reproduces and the next
+                    # level is a granularity doubling of the SAME dag.
                     spec = None
                     room = speculation_room(len(candidates))
-                    if n < len(atoms) and room:
+                    pred = self._pred_adopt
+                    if (
+                        room
+                        and pred is not None
+                        and pred < len(candidates)
+                        and len(
+                            candidates[pred].get_atomic_events()
+                        ) > 1
+                    ):
+                        nn = 2 if pred < n_subsets else max(n - 1, 2)
+                        spec_cands, _, _ = self._level(
+                            candidates[pred], nn, limit=room
+                        )
+                        spec = [c.get_all_events() for c in spec_cands]
+                    elif n < len(atoms) and room:
                         spec_cands, _, _ = self._level(
                             current, min(len(atoms), 2 * n), limit=room
                         )
@@ -274,6 +295,7 @@ class BatchedDDMin(Minimizer):
             adopted_idx = next(
                 (i for i, ok in enumerate(verdicts) if ok), None
             )
+            self._pred_adopt = adopted_idx
             if adopted_idx is not None:
                 current = candidates[adopted_idx]
                 # Subset adopted -> restart at coarse granularity;

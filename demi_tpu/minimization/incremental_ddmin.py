@@ -78,9 +78,13 @@ class IncrementalDDMin(Minimizer):
         self.max_max_distance = max_max_distance
         self.stats = stats or MinimizationStats()
         # Threaded into every per-distance DDMin: when the oracle carries
-        # the async replay surface (supports_async + test_window — the
-        # replay-backed oracles do, the DPOR oracles fall back cleanly),
-        # each recursion level's left/right probes batch into one launch.
+        # the async window surface (supports_async + test_window — the
+        # replay-backed oracles batch replay lanes; DeviceDPOROracle
+        # batches whole probes' frontier rounds via explore_window, with
+        # per-probe instance state committed only on consult), each
+        # recursion level's left/right probes batch into one launch.
+        # Oracles without the surface (host ResumableDPOR) fall back to
+        # sequential probes.
         from .pipeline import async_min_enabled
 
         self.speculative = async_min_enabled(speculative)

@@ -221,16 +221,22 @@ class BatchedInternalMinimizer:
         # make_batched_internal_check): each round dispatches with the
         # predicted NEXT round's candidates riding the idle padded lanes,
         # and the predicted adoption's host bookkeeping execution runs
-        # BETWEEN dispatch and harvest. The predictor is the LAST adopted
-        # verdict index: adoption positions drift slowly upward (a
-        # removal that failed once keeps failing), so "same index again"
-        # is right far more often than "the first removal" (measured on
-        # the bench fixture: ~60% vs ~2%). Verdicts alone pick the
+        # BETWEEN dispatch and harvest. The predictor (see ``_predict``)
+        # is digest-history first — the uid sequence that followed the
+        # last adopted delivery, matched against this round's removable
+        # uids, which survives the index drift STS absent-pruning causes
+        # — with the raw last-adopted index as fallback ("same index
+        # again" already beats "the first removal" ~60% vs ~2% on the
+        # bench fixture; the uid match recovers the rounds where pruning
+        # shifts positions by more than one). Verdicts alone pick the
         # adopted candidate, so results are bit-identical to the sync
         # round — mispredictions only waste idle lanes and a pure host
         # execution.
         self.speculative = async_min_enabled(speculative)
         self._pred_idx = 0
+        # Digest history: uids of the removable deliveries that FOLLOWED
+        # the last adopted one, in scan order. Empty until an adoption.
+        self._next_uids: Tuple[int, ...] = ()
         self.spec_exec_hits = 0
         self.spec_exec_waste = 0
 
@@ -251,7 +257,9 @@ class BatchedInternalMinimizer:
             candidates = [remove_delivery(last_failing, i) for i in indices]
             with obs.span("intmin.round", candidates=len(candidates)):
                 if use_async:
-                    adopted = self._async_round(last_failing, candidates)
+                    adopted = self._async_round(
+                        last_failing, candidates, indices
+                    )
                 else:
                     results = self.batch_check(candidates)
                     adopted = next(
@@ -273,15 +281,39 @@ class BatchedInternalMinimizer:
         self.stats.record_minimized_counts(deliveries, 0, 0)
         return last_failing
 
+    def _predict(self, last_failing: EventTrace, indices: List[int]) -> int:
+        """Predicted adopted-candidate index for this round. Primary: the
+        digest-history predictor — walk the uid sequence recorded after
+        the last adoption and return the position of the first uid still
+        removable. When the adoption's STS execution pruned extra absents,
+        raw indices shift by more than one, but the surviving uids still
+        name the scan position exactly (the candidates before it failed
+        last round and keep failing). Fallback (no history, or every
+        recorded uid pruned away): the last adopted index itself."""
+        if self._next_uids:
+            pos = {
+                last_failing.events[i].id: k for k, i in enumerate(indices)
+            }
+            for uid in self._next_uids:
+                k = pos.get(uid)
+                if k is not None:
+                    obs.counter("pipe.pred_digest").inc()
+                    return k
+        obs.counter("pipe.pred_index").inc()
+        return min(self._pred_idx, len(indices) - 1)
+
     def _async_round(
-        self, last_failing: EventTrace, candidates: List[EventTrace]
+        self,
+        last_failing: EventTrace,
+        candidates: List[EventTrace],
+        indices: List[int],
     ) -> Optional[EventTrace]:
         """One pipelined round: dispatch (with next-round speculation in
         the padding lanes), host-execute the predicted adoption while the
         device runs, harvest, then adopt exactly as the sync path would
         — the first verdict-true candidate whose host execution
         reproduces."""
-        p = min(self._pred_idx, len(candidates) - 1)
+        p = self._predict(last_failing, indices)
         spec: List[EventTrace] = []
         room = speculation_room(len(candidates))
         if room:
@@ -303,6 +335,13 @@ class BatchedInternalMinimizer:
         else:
             self.spec_exec_waste += 1
             obs.counter("pipe.spec_exec_waste").inc()
+        # The measured prediction quality, visible to the tuner in every
+        # snapshot (force_set — same contract as tune.* decisions):
+        # speculative host executions that matched the real adoption.
+        total = self.spec_exec_hits + self.spec_exec_waste
+        obs.REGISTRY.gauge("pipe.spec_exec_hit_rate").force_set(
+            round(self.spec_exec_hits / total, 3)
+        )
         for i, ok in enumerate(verdicts):
             if not ok:
                 continue
@@ -312,5 +351,8 @@ class BatchedInternalMinimizer:
             )
             if executed is not None:
                 self._pred_idx = i
+                self._next_uids = tuple(
+                    last_failing.events[j].id for j in indices[i + 1 :]
+                )
                 return executed
         return None

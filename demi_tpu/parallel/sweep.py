@@ -213,7 +213,12 @@ class SweepDriver:
 
         self._forker = None
         if prefix_fork_enabled(prefix_fork):
-            from ..device.fork import PrefixForker, make_explore_prefix_runner
+            from ..device.fork import (
+                PrefixForker,
+                make_explore_prefix_base_runner,
+                make_explore_prefix_resume_runner,
+                make_explore_prefix_runner,
+            )
 
             if self.impl == "pallas":
                 import sys
@@ -229,8 +234,13 @@ class SweepDriver:
                 else make_explore_kernel(app, self.cfg, start_state=True)
             )
             self._forker = PrefixForker(
-                make_explore_prefix_runner(app, self.cfg), driver="sweep"
+                make_explore_prefix_runner(app, self.cfg), driver="sweep",
+                # Prescribed-resume trunks, sweep flavor: group trunks
+                # derive from the chunk-wide BASE trunk (the injection
+                # rows every lane shares) over just their remaining rows.
+                resume_runner=make_explore_prefix_resume_runner(app, self.cfg),
             )
+            self._base_runner = make_explore_prefix_base_runner(app, self.cfg)
 
     @property
     def fork_stats(self) -> Optional[dict]:
@@ -285,6 +295,7 @@ class SweepDriver:
         a, b, msg = np.asarray(progs.a), np.asarray(progs.b), np.asarray(progs.msg)
         batch = op.shape[0]
         groups: dict = {}
+        min_j = op.shape[1]
         for i in range(batch):
             # The trunk's injection segment reads program rows up to the
             # first wait-like/END op, plus the NEXT op's kind (final_seg
@@ -293,12 +304,17 @@ class SweepDriver:
                 (op[i] == OP_WAIT) | (op[i] == OP_WAITCOND) | (op[i] == OP_END)
             )[0]
             j = int(boundary[0]) if len(boundary) else op.shape[1] - 1
+            min_j = min(min_j, j)
             end = min(j + 2, op.shape[1])
             digest = prefix_digest(
                 op[i, :end].tobytes(), a[i, :end].tobytes(),
                 b[i, :end].tobytes(), msg[i, :end].tobytes(),
             )
             groups.setdefault(digest, []).append(i)
+        # The chunk-wide base trunk is itself a single-lane kernel launch,
+        # so derive it lazily on the first group that actually amortizes —
+        # a fully-scratch chunk (all groups below min_group) pays nothing.
+        base = base_missing = object()
 
         def take(tree, idx):
             idx = np.asarray(idx)
@@ -310,11 +326,24 @@ class SweepDriver:
             if not self._forker.amortizes(len(idx), digest):
                 scratch.extend(idx)
                 continue
-            snap, trunk_steps, hit = self._forker.trunk(
-                digest,
-                jax.tree_util.tree_map(lambda x: np.asarray(x)[idx[0]], progs),
-                jax.random.PRNGKey(0),
+            if base is base_missing:
+                base = self._base_trunk(progs, op, a, b, msg, min_j)
+            group_prog = jax.tree_util.tree_map(
+                lambda x: np.asarray(x)[idx[0]], progs
             )
+            if base is not None:
+                # Prescribed-resume trunk, sweep flavor: the group trunk
+                # derives from the chunk-wide base snapshot over just its
+                # remaining injection rows (O(group suffix), not O(whole
+                # shared segment)) — bit-exact because the base stopped
+                # inside the rows every lane shares, still ST_INJECT.
+                snap, trunk_steps, hit = self._forker.trunk_from(
+                    digest, base, group_prog
+                )
+            else:
+                snap, trunk_steps, hit = self._forker.trunk(
+                    digest, group_prog, jax.random.PRNGKey(0)
+                )
             full = idx + [idx[0]] * (padded_size(len(idx), self.mesh) - len(idx))
             res = self._fork_kernel(take(progs, full), take(keys, full), snap)
             parts.append(
@@ -346,6 +375,53 @@ class SweepDriver:
                 for f in LaneResult._fields
             )
         )
+
+    def _base_trunk(self, progs, op, a, b, msg, min_j):
+        """The chunk-wide BASE trunk for hierarchical sweep trunks: run
+        the injection rows EVERY lane of the chunk shares (typically the
+        app's dsl start events plus any common fuzz prefix) once, cache
+        the snapshot, and let every group trunk derive from it via
+        ``trunk_from`` instead of replaying the whole shared segment.
+
+        The base must stop (a) inside the chunk-wide common region — row
+        i's injection reads row i+1's kind (the final_seg lookahead), so
+        the limit is one row short of the first divergence — and (b)
+        strictly before the chunk's earliest wait-like/END row, so every
+        lane is still ST_INJECT at the snapshot. Returns the cache entry
+        ``(snapshot, steps)`` or None when no shareable base exists."""
+        from ..device.fork import prefix_digest
+
+        if self._forker.resume_runner is None or op.shape[0] < 2:
+            return None
+        msg_same = (msg == msg[:1]).all(axis=0)
+        if msg_same.ndim > 1:
+            msg_same = msg_same.all(axis=-1)
+        same = (
+            (op == op[:1]).all(axis=0)
+            & (a == a[:1]).all(axis=0)
+            & (b == b[:1]).all(axis=0)
+            & msg_same
+        )
+        diverge = np.nonzero(~same)[0]
+        common = int(diverge[0]) if len(diverge) else op.shape[1]
+        op_limit = min(common - 1, min_j)
+        if op_limit < 1:
+            return None
+        end = op_limit + 1
+        bkey = prefix_digest(
+            op[0, :end].tobytes(), a[0, :end].tobytes(),
+            b[0, :end].tobytes(), msg[0, :end].tobytes(), b"base",
+        )
+        entry = self._forker.cache.peek(bkey)
+        if entry is None:
+            snap = self._base_runner(
+                jax.tree_util.tree_map(lambda x: np.asarray(x)[0], progs),
+                jax.random.PRNGKey(0),
+                jnp.int32(op_limit),
+            )
+            self._forker.cache.put(bkey, snap, snap.steps)
+            entry = (snap, snap.steps)
+        return entry
 
     def run_chunk(
         self, seeds: Sequence[int], slice_index: int = 0, base_key: int = 0
@@ -542,18 +618,35 @@ class SweepDriver:
         chunk_size: int,
         controller,
         base_key: int = 0,
+        mode: str = "chunked",
     ) -> SweepResult:
-        """Chunked sweep with the measurement-guided weight loop closed:
-        before each chunk the controller proposes fuzzer weights (the
-        chunk's programs are generated under them — ``_programs`` lowers
-        per chunk, so the swap takes effect immediately); on harvest the
-        chunk is scored by its NEW unique schedule fingerprints plus
-        violations (cross-chunk dedup lives in the controller).
+        """Autotuned sweep with the measurement-guided weight loop closed:
+        before each reward round the controller proposes fuzzer weights;
+        on harvest the round is scored by its NEW unique schedule
+        fingerprints plus violations (cross-round dedup lives in the
+        controller).
 
-        Chunked on purpose: continuous refill interleaves programs from
-        many proposals in one segment, destroying reward attribution.
-        The round-trip per chunk is the price of a clean bandit signal.
-        """
+        ``mode='chunked'`` (the original loop): one proposal per fixed
+        chunk — programs are generated under it (``_programs`` lowers per
+        chunk, so the swap takes effect immediately) and the whole chunk's
+        harvest is its reward. Clean attribution, but every chunk pays the
+        full-batch round trip the continuous driver exists to avoid.
+
+        ``mode='continuous'`` rides the lane-compacted continuous driver
+        with segment-boundary attribution: every seed is tagged with the
+        proposal epoch active when its program was GENERATED (the refill
+        wrapper below — generation is the only moment weights touch a
+        lane), retirements are bucketed by that tag as the driver streams
+        them back at segment boundaries, and the controller's
+        ``end_round`` fires once an epoch has ``chunk_size`` retired
+        lanes. Attribution is exact — a lane is only ever credited to the
+        proposal that generated it; epoch-k lanes still in flight when
+        its reward fires land in the sweep result but not the reward
+        signal (dropped, never mis-credited)."""
+        if mode == "continuous":
+            return self._sweep_autotuned_continuous(
+                total_lanes, chunk_size, controller, base_key
+            )
         result = SweepResult()
         t0 = time.perf_counter()
         seed = 0
@@ -575,6 +668,121 @@ class SweepDriver:
             result.chunks.append(chunk)
             seed += n
         result.wall_seconds = time.perf_counter() - t0
+        return result
+
+    def _sweep_autotuned_continuous(
+        self,
+        total_lanes: int,
+        chunk_size: int,
+        controller,
+        base_key: int = 0,
+    ) -> SweepResult:
+        """Continuous-mode autotuned sweep with segment-boundary reward
+        attribution (see ``sweep_autotuned``): lanes are tagged with the
+        proposal epoch active when their program was generated, rewards
+        are bucketed by tag as retirements stream back, and an epoch's
+        ``end_round`` fires once ``chunk_size`` of ITS lanes retired.
+        Nothing is ever mis-credited: a straggler whose epoch already
+        closed still counts in the sweep result but not in any reward."""
+        from ..device.continuous import ContinuousSweepDriver
+
+        epoch_of_seed: dict = {}
+        cur_epoch = [0]
+
+        def tagged_gen(seed: int):
+            # Generation is the ONLY moment fuzzer weights touch a lane
+            # (the program is fixed once lowered), so the tag taken here
+            # is exact attribution — not an approximation.
+            epoch_of_seed[seed] = cur_epoch[0]
+            return self.program_gen(seed)
+
+        batch = chunk_size
+        if self.mesh is not None:
+            batch = ((batch + self._align - 1) // self._align) * self._align
+        drv = ContinuousSweepDriver(
+            self.app, self.cfg, tagged_gen, batch=batch,
+            seg_steps=max(8, min(64, self.cfg.max_steps // 4)),
+            impl=self.impl,
+            mesh=self.mesh,
+            # run_chunk's key scheme => per-seed verdicts identical to the
+            # chunked autotuned loop under the same proposals.
+            key_fn=lambda s: jax.random.fold_in(
+                jax.random.PRNGKey(base_key), s
+            ),
+        )
+        codes: dict = {}
+        hashes: List[int] = []
+        lanes = violations = overflow = dropped = 0
+        first_seed = first_code = None
+        bucket_lanes = bucket_violations = 0
+        bucket_hashes: List[int] = []
+        t0 = time.perf_counter()
+        controller.begin_round()
+        for seed, st, code, h in drv._run(total_lanes):
+            lanes += 1
+            if st == ST_OVERFLOW:
+                overflow += 1
+            else:
+                hashes.append(h)
+            if code != 0:
+                violations += 1
+                codes[code] = codes.get(code, 0) + 1
+                if first_seed is None:
+                    first_seed = seed
+                    first_code = code
+            if epoch_of_seed.get(seed, cur_epoch[0]) != cur_epoch[0]:
+                # In-flight straggler from an epoch whose reward already
+                # fired: in the sweep result above, out of the signal.
+                dropped += 1
+                obs.counter("tune.continuous_dropped").inc()
+                continue
+            bucket_lanes += 1
+            if st != ST_OVERFLOW:
+                bucket_hashes.append(h)
+            if code != 0:
+                bucket_violations += 1
+            if bucket_lanes >= chunk_size:
+                controller.end_round(
+                    hashes=bucket_hashes,
+                    violations=bucket_violations,
+                    lanes=bucket_lanes,
+                )
+                obs.counter("tune.continuous_epochs").inc()
+                bucket_lanes = bucket_violations = 0
+                bucket_hashes = []
+                cur_epoch[0] += 1
+                # The next refill's programs generate under the new
+                # proposal; already-running lanes keep their old tag.
+                controller.begin_round()
+        # Close the final partial epoch — but only if it actually retired
+        # lanes: scoring an empty bucket would charge the last proposal a
+        # fabricated zero reward for lanes it never generated. Skipping
+        # the end_round leaves that proposal un-evaluated, which the
+        # WeightTuner handles (the next propose() discards the pending
+        # trial without adopting it).
+        if bucket_lanes:
+            controller.end_round(
+                hashes=bucket_hashes,
+                violations=bucket_violations,
+                lanes=bucket_lanes,
+            )
+            obs.counter("tune.continuous_epochs").inc()
+        obs.gauge("tune.continuous_attributed").set(lanes - dropped)
+        chunk = SweepChunkResult(
+            slice_index=0,
+            lanes=lanes,
+            violations=violations,
+            codes=codes,
+            first_violating_lane=None,
+            first_violation_code=first_code,
+            seconds=time.perf_counter() - t0,
+            overflow_lanes=overflow,
+            unique_hashes=np.unique(np.asarray(hashes, np.uint32)),
+            first_violating_seed=first_seed,
+        )
+        result = SweepResult(chunks=[chunk])
+        result.occupancy = drv.last_occupancy
+        result.wall_seconds = chunk.seconds
         return result
 
     def sweep_async(

@@ -153,7 +153,17 @@ def render_report(directory: str, app=None) -> str:
             for name, series in counters.items()
             if name.startswith("pipe.")
         }
-        if pipe:
+        # DPOR double-buffered frontier rounds (dpor.inflight_*) and
+        # prescribed-resume trunks (dpor.trunk_parent_hits) report here
+        # too: they are the exploration half of the same async pipeline,
+        # and a dpor-only run emits no pipe.* counters at all.
+        dpor_async = {
+            name: sum(series.values())
+            for name, series in counters.items()
+            if name.startswith("dpor.inflight_")
+            or name == "dpor.trunk_parent_hits"
+        }
+        if pipe or dpor_async:
             lines += ["### Pipeline", ""]
 
             def _ratio(num, den):
@@ -193,6 +203,23 @@ def render_report(directory: str, app=None) -> str:
                 f"hit rate ({gathers:g} gathers, {cached:g} cached, "
                 f"{full:g} full lowerings)"
             )
+            if dpor_async:
+                ifl = dpor_async.get("dpor.inflight_rounds", 0)
+                ifl_hits = dpor_async.get("dpor.inflight_hits", 0)
+                ifl_waste = dpor_async.get("dpor.inflight_waste", 0)
+                lines.append(
+                    f"- DPOR in-flight rounds: {ifl:g} dispatched, "
+                    f"{ifl_hits:g} became the next round / "
+                    f"{ifl_waste:g} discarded "
+                    f"({_ratio(ifl_hits, ifl_hits + ifl_waste)} useful)"
+                )
+                if "dpor.trunk_parent_hits" in dpor_async:
+                    lines.append(
+                        f"- DPOR resume trunks: "
+                        f"{dpor_async['dpor.trunk_parent_hits']:g} derived "
+                        f"from a cached ancestor instead of a full-prefix "
+                        f"replay"
+                    )
             lines.append("")
         if counters:
             lines += ["| counter | series | value |", "|---|---|---|"]

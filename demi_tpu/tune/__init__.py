@@ -21,14 +21,18 @@ are byte-identical to the untuned explorer.
 
 from .cache import TuningCache, default_cache_path, workload_key  # noqa: F401
 from .calibrate import (  # noqa: F401
+    DPOR_INFLIGHT_AXIS,
     FORK_BUCKET_AXIS,
     ForkDecision,
+    InflightDecision,
     SweepDecision,
+    calibrate_dpor_inflight,
     calibrate_fork,
     calibrate_sweep,
     coordinate_descent,
     depth_bucket,
     fork_signals,
+    make_dpor_inflight_measure,
     make_fork_measure,
     median_rate,
     sweep_axes,
@@ -42,20 +46,24 @@ from .controller import (  # noqa: F401
 )
 
 __all__ = [
+    "DPOR_INFLIGHT_AXIS",
     "DporBudgetTuner",
     "ExplorationController",
     "FORK_BUCKET_AXIS",
     "ForkDecision",
+    "InflightDecision",
     "SweepDecision",
     "TuningCache",
     "WeightTuner",
     "autotune_enabled",
+    "calibrate_dpor_inflight",
     "calibrate_fork",
     "calibrate_sweep",
     "coordinate_descent",
     "default_cache_path",
     "depth_bucket",
     "fork_signals",
+    "make_dpor_inflight_measure",
     "make_fork_measure",
     "median_rate",
     "record_decision",

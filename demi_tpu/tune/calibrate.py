@@ -453,6 +453,185 @@ def calibrate_fork(
     return decision
 
 
+# ---------------------------------------------------------------------------
+# DPOR in-flight (double-buffered frontier rounds) calibration
+# ---------------------------------------------------------------------------
+
+#: In-flight candidates: 0 = synchronous rounds, 1 = double-buffered
+#: (round N+1 dispatched as a full speculative launch before round N's
+#: harvest). On TPU speculation is free — host and device are disjoint —
+#: so DeviceDPOR defaults it on under DEMI_ASYNC_MIN there; on CPU the
+#: "device" lanes run on the host's own cores and a mispredicted launch
+#: burns real compute, so the decision must be measured per workload.
+DPOR_INFLIGHT_AXIS = (0, 1)
+
+
+@dataclass
+class InflightDecision:
+    """One in-flight calibration outcome for a workload shape: the
+    on/off decision plus the measured evidence (rounds/sec per point and
+    the winning run's speculation economy)."""
+
+    enabled: bool
+    rate: float  # frontier interleavings/sec of the chosen point
+    source: str  # "calibrated" | "cached" | "default"
+    rates: Dict[str, float] = field(default_factory=dict)
+    signals: Dict[str, Any] = field(default_factory=dict)
+    key: Optional[str] = None
+    calibration_seconds: float = 0.0
+
+    def to_json(self) -> Dict[str, Any]:
+        return {
+            "enabled": bool(self.enabled),
+            "rate": round(self.rate, 1),
+            "source": self.source,
+            "rates": {k: round(v, 1) for k, v in self.rates.items()},
+            "signals": dict(self.signals),
+            "key": self.key,
+            "calibration_seconds": round(self.calibration_seconds, 2),
+        }
+
+    @classmethod
+    def from_json(cls, obj: Dict[str, Any], source: str) -> "InflightDecision":
+        return cls(
+            enabled=bool(obj.get("enabled", False)),
+            rate=float(obj.get("rate", 0.0)),
+            source=source,
+            rates=dict(obj.get("rates", {})),
+            signals=dict(obj.get("signals", {})),
+            key=obj.get("key"),
+        )
+
+
+def make_dpor_inflight_measure(
+    app, device_cfg, program, *, batch: int = 16, rounds: int = 3,
+    reps: int = 2, target_code: Optional[int] = None,
+):
+    """Real measurement for one in-flight candidate: a fresh DeviceDPOR
+    per rep (exploration is stateful — reps must start from the same
+    frontier), one warm-up round (compiles the kernel and seeds the
+    frontier), then ``rounds`` timed frontier rounds; returns median
+    interleavings/sec. Kernels are shared across points/reps so the walk
+    compiles once. The winning run's in-flight economy lands in
+    ``measure.signals``."""
+    from ..device.dpor_sweep import DeviceDPOR, make_dpor_kernel
+    from ..device.fork import prefix_fork_enabled
+
+    kernel = make_dpor_kernel(app, device_cfg)
+    # Under DEMI_PREFIX_FORK each fresh DeviceDPOR would otherwise jit
+    # its own identical start_state kernel — (reps+1) x 2 candidates of
+    # redundant compiles polluting the timed rounds.
+    fork_kernel = (
+        make_dpor_kernel(app, device_cfg, start_state=True)
+        if prefix_fork_enabled(None)
+        else None
+    )
+
+    def measure(params: Dict[str, Any]) -> float:
+        on = bool(int(params["dpor_inflight"]))
+        rates = []
+        last = None
+        for _ in range(reps + 1):  # +1: the dropped warm-up rep
+            dpor = DeviceDPOR(
+                app, device_cfg, program, batch_size=batch,
+                double_buffer=on, kernel=kernel, fork_kernel=fork_kernel,
+            )
+            dpor.explore(target_code=target_code, max_rounds=1)
+            before = dpor.interleavings
+            t0 = time.perf_counter()
+            dpor.explore(target_code=target_code, max_rounds=rounds)
+            secs = time.perf_counter() - t0
+            rates.append((dpor.interleavings - before) / secs if secs else 0.0)
+            last = dpor
+        if last is not None:
+            measure.signals[f"inflight={int(on)}"] = dict(last.async_stats)
+        return median_rate(rates, drop_first=True)
+
+    measure.signals = {}
+    return measure
+
+
+def calibrate_dpor_inflight(
+    app,
+    cfg,
+    *,
+    batch: int,
+    platform: Optional[str] = None,
+    cache: Optional[TuningCache] = None,
+    measure: Optional[Callable[[Dict[str, Any]], float]] = None,
+    axis: Optional[Sequence[int]] = None,
+    extra_key: Optional[Dict[str, Any]] = None,
+) -> InflightDecision:
+    """Calibrate the DeviceDPOR double-buffer decision for one workload
+    shape + platform. Caching contract as ``calibrate_fork``: a cache hit
+    costs no measurements; a miss requires ``measure`` (a real one needs
+    the workload's program — ``make_dpor_inflight_measure``). On non-CPU
+    platforms with no measure given, the decision defaults to enabled
+    without measuring (host and device are disjoint there, so a wasted
+    in-flight launch costs the host nothing); on CPU the axis is walked
+    for real. Persisted to the TuningCache, recorded as
+    ``tune.dpor.inflight`` decisions."""
+    if platform is None:
+        import jax
+
+        platform = jax.devices()[0].platform
+    cache = cache or TuningCache()
+    key = workload_key(
+        app.name, app.num_actors, cfg, platform,
+        axis="dpor_inflight", batch=batch, **(extra_key or {}),
+    )
+    cached = cache.get(key)
+    if cached is not None:
+        decision = InflightDecision.from_json(cached, source="cached")
+        decision.key = key
+        _record_inflight_decision(decision)
+        return decision
+
+    if measure is None:
+        if platform != "cpu":
+            decision = InflightDecision(
+                enabled=True, rate=0.0, source="default", key=key,
+                signals={"reason": "non-cpu platform: speculation is free"},
+            )
+            _record_inflight_decision(decision)
+            cache.put(key, decision.to_json())
+            return decision
+        raise ValueError(
+            "calibrate_dpor_inflight: cache miss for %r on cpu and no "
+            "measure given — build one with make_dpor_inflight_measure("
+            "app, device_cfg, program)" % (key,)
+        )
+    candidates = list(axis) if axis is not None else list(DPOR_INFLIGHT_AXIS)
+    start = {"dpor_inflight": candidates[0]}
+    t0 = time.perf_counter()
+    params, rate, rates = coordinate_descent(
+        {"dpor_inflight": candidates}, measure, start,
+        order=("dpor_inflight",),
+    )
+    enabled = bool(int(params["dpor_inflight"]))
+    decision = InflightDecision(
+        enabled=enabled,
+        rate=rate,
+        source="calibrated",
+        rates=rates,
+        signals={
+            k: v for k, v in getattr(measure, "signals", {}).items()
+            if k == f"inflight={int(enabled)}"
+        },
+        key=key,
+        calibration_seconds=time.perf_counter() - t0,
+    )
+    _record_inflight_decision(decision)
+    cache.put(key, decision.to_json())
+    return decision
+
+
+def _record_inflight_decision(decision: InflightDecision) -> None:
+    record_decision("dpor.inflight", int(decision.enabled))
+    record_decision("dpor.inflight_rate", decision.rate)
+    record_decision("dpor.inflight_source", decision.source)
+
+
 def _record_fork_decision(decision: ForkDecision) -> None:
     record_decision("fork.bucket", int(decision.bucket))
     record_decision("fork.enabled", int(decision.enabled))

@@ -20,6 +20,7 @@ from demi_tpu.tune import (
     TuningCache,
     WeightTuner,
     autotune_enabled,
+    calibrate_dpor_inflight,
     calibrate_fork,
     calibrate_sweep,
     coordinate_descent,
@@ -269,6 +270,52 @@ def test_calibrate_fork_bucket_axis_and_off_decision(tmp_path):
         measure=lambda p: 100.0 if int(p["fork_bucket"]) == 0 else 60.0,
     )
     assert d3.bucket == 0 and not d3.enabled
+
+
+def test_calibrate_dpor_inflight_axis_and_platform_gate(tmp_path):
+    """calibrate_dpor_inflight walks the 0/1 in-flight axis on CPU with
+    an injected measure, persists the decision, and a second call is a
+    cache hit with no measurements; non-CPU platforms decide 'enabled'
+    without measuring (speculation is free there); a CPU cache miss with
+    no measure is a loud error, never a silent guess."""
+    cache = TuningCache(str(tmp_path / "cache.json"))
+    calls = []
+
+    def measure(p):
+        calls.append(int(p["dpor_inflight"]))
+        return {0: 100.0, 1: 140.0}[int(p["dpor_inflight"])]
+
+    d1 = calibrate_dpor_inflight(
+        _App(), _ShapeCfg(), batch=16, platform="cpu", cache=cache,
+        measure=measure,
+    )
+    assert d1.source == "calibrated" and d1.enabled and d1.rate == 140.0
+    assert set(calls) == {0, 1}
+
+    calls.clear()
+    d2 = calibrate_dpor_inflight(
+        _App(), _ShapeCfg(), batch=16, platform="cpu",
+        cache=TuningCache(str(tmp_path / "cache.json")), measure=measure,
+    )
+    assert d2.source == "cached" and d2.enabled and calls == []
+
+    # A workload where the misprediction waste loses calibrates it OFF.
+    d3 = calibrate_dpor_inflight(
+        _App(), _ShapeCfg(), batch=32, platform="cpu", cache=cache,
+        measure=lambda p: 100.0 if int(p["dpor_inflight"]) == 0 else 70.0,
+    )
+    assert not d3.enabled
+
+    # Non-CPU: enabled by default, no measure needed, still cached.
+    d4 = calibrate_dpor_inflight(
+        _App(), _ShapeCfg(), batch=16, platform="tpu", cache=cache,
+    )
+    assert d4.source == "default" and d4.enabled
+
+    with pytest.raises(ValueError):
+        calibrate_dpor_inflight(
+            _App(), _ShapeCfg(), batch=64, platform="cpu", cache=cache,
+        )
 
 
 @pytest.mark.slow
