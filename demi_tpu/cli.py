@@ -461,6 +461,10 @@ def cmd_sweep(args) -> int:
     }
     if result.occupancy is not None:
         summary["occupancy"] = round(result.occupancy, 3)
+    if driver.host_share is not None:
+        # Host-vs-device wall split (the vectorized-host-path health
+        # number; also the sweep.host_share gauge under DEMI_OBS).
+        summary["host_share"] = round(driver.host_share, 3)
     if autotune_summary is not None:
         summary["autotune"] = autotune_summary
     if driver.fork_stats is not None:
@@ -532,6 +536,10 @@ def cmd_dpor(args) -> int:
         "violation_found": trace is not None,
         "deliveries": len(trace.deliveries()) if trace is not None else None,
     }
+    if oracle.host_share() is not None:
+        # Host-vs-device wall split across the frontier rounds (also the
+        # dpor.host_share gauge under DEMI_OBS).
+        summary["host_share"] = round(oracle.host_share(), 3)
     if autotune:
         summary["autotune"] = oracle.tuner_summaries()
     if inflight_decision is not None:

@@ -625,6 +625,15 @@ class PendingVerdicts:
         stats["harvest_wait_seconds"] += wait
         stats["spec_dispatched"] += spec_count
         obs.counter("pipe.harvest_wait_seconds").inc(wait)
+        if obs.enabled():
+            # Host-vs-device split of the pipeline's round-trip time:
+            # overlap_seconds is host planning done UNDER device
+            # execution, harvest_wait is blocked on the device.
+            total = stats["overlap_seconds"] + stats["harvest_wait_seconds"]
+            if total > 0:
+                obs.gauge("pipe.host_share").set(
+                    stats["overlap_seconds"] / total
+                )
         if spec_count:
             obs.counter("pipe.spec_dispatched").inc(spec_count)
         if self.n and bool((self.codes == self.UNRESOLVED).any()):

@@ -411,6 +411,26 @@ class ContinuousSweepDriver:
         return statuses, violations
 
     def _run(self, total_lanes: int, seeds: Optional[Sequence[int]] = None):
+        """Per-lane view over ``_run_batches``: yields one
+        ``(seed, status, violation_code, sched_hash)`` tuple per finished
+        lane (the original surface; batch consumers use the arrays)."""
+        for seed_a, st_a, code_a, h_a in self._run_batches(
+            total_lanes, seeds=seeds
+        ):
+            for k in range(len(seed_a)):
+                yield (
+                    int(seed_a[k]), int(st_a[k]), int(code_a[k]),
+                    int(h_a[k]),
+                )
+
+    def _run_batches(
+        self, total_lanes: int, seeds: Optional[Sequence[int]] = None
+    ):
+        """The harvest loop, yielding one ``(seeds, statuses, codes,
+        hashes)`` array quadruple per segment round (only rounds that
+        retired lanes yield). Array-granular retirement is what lets the
+        SweepDriver's harvest accumulation stay vectorized — per-lane
+        Python tuples exist only for callers that ask (``_run``)."""
         seed_list = (
             list(range(total_lanes)) if seeds is None else list(seeds)
         )
@@ -489,7 +509,7 @@ class ContinuousSweepDriver:
                 state = self.refill(state, jnp.asarray(overdue), finalized)
                 status = np.asarray(state.status)
             finished = active & (status >= ST_DONE)
-            out = []
+            out = None
             if finished.any():
                 vio = np.asarray(state.violation)
                 sh = np.asarray(state.sched_hash)
@@ -498,14 +518,13 @@ class ContinuousSweepDriver:
                     # above is the round's one sync point; deliveries ride
                     # the same harvest (never per segment step).
                     self._record_round_stats(state, finished, vio)
-                for lane in np.flatnonzero(finished):
-                    out.append(
-                        (
-                            lane_seed[lane], int(status[lane]),
-                            int(vio[lane]), int(sh[lane]),
-                        )
-                    )
-                    done_count += 1
+                fin = np.flatnonzero(finished)
+                # Seeds gathered BEFORE refill rewrites lane_seed.
+                out = (
+                    np.asarray(lane_seed, np.int64)[fin],
+                    status[fin].copy(), vio[fin].copy(), sh[fin].copy(),
+                )
+                done_count += len(fin)
                 # Refill finished lanes with fresh seeds (or park them).
                 refill_lanes = set(
                     int(x) for x in np.flatnonzero(finished)[
@@ -540,5 +559,5 @@ class ContinuousSweepDriver:
             # consumer may do arbitrary work per item) never counts as
             # harvest overhead.
             self.last_harvest_seconds += time.perf_counter() - t_harvest
-            for item in out:
-                yield item
+            if out is not None:
+                yield out

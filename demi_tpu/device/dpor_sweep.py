@@ -10,11 +10,22 @@ and continues with random exploration; lanes record parent-tracked traces
 happens-before forest and the next round's racing pairs with no
 re-execution. SURVEY §7.2 step 7: the racing-pair scan is data-parallel
 bit math; only the frontier priority queue stays host-side.
+
+Host path: the default ``host_path='vectorized'`` derives a whole
+round's prescriptions in ONE batch-native call
+(``native.racing_prescriptions_batch`` — C++ when a compiler exists,
+NumPy otherwise) and dedups on vectorized content digests, so the
+per-round host share stays small instead of merely hiding under the
+double-buffered overlap; ``'legacy'`` keeps the per-lane scan as the
+parity baseline. Both are bit-identical (tests/test_host_path.py), and
+every DeviceDPOR tracks its ``host_seconds``/``device_seconds`` split
+(the ``dpor.host_share`` gauge, bench configs 2/8).
 """
 
 from __future__ import annotations
 
 import os
+import time
 from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 import numpy as np
@@ -244,9 +255,12 @@ def racing_prescriptions(
     happens-before path), j's message already created before i — the
     prescription is the delivery records before i plus j's record.
 
-    The O(n^2) pair scan runs in the native analyzer when available
-    (native/trace_analysis.cpp; pure-Python fallback is
-    semantics-identical)."""
+    This is the LEGACY per-lane surface (one scan call per lane, one
+    Python tuple loop per racing pair), kept for the ``host_path='legacy'``
+    parity baseline and the randomized parity suite
+    (tests/test_host_path.py). The frontier hot path uses
+    ``native.racing_prescriptions_batch`` — one call per ROUND — instead;
+    see ``DeviceDPOR._process_round``."""
     from ..native import racing_pair_scan
 
     # Slice to rec_width: the scan derives the parent column from the last
@@ -268,6 +282,22 @@ def racing_prescriptions(
         prefix.append(tuples[int(j)])
         out.append(tuple(prefix))
     return out
+
+
+def _resolve_host_path(explicit: Optional[str] = None) -> str:
+    """Resolve the frontier host-path switch: 'vectorized' (default —
+    batch-native racing analysis + digest-keyed dedup) or 'legacy' (the
+    per-lane scan + per-pair Python tuple loop, kept as the parity
+    baseline). An explicit constructor arg wins; ``DEMI_HOST_PATH``
+    otherwise (values ``legacy``/``python``/``py`` select the old path)."""
+    if explicit is None:
+        env = os.environ.get("DEMI_HOST_PATH", "").strip().lower()
+        explicit = "legacy" if env in ("legacy", "python", "py") else "vectorized"
+    if explicit not in ("vectorized", "legacy"):
+        raise ValueError(
+            f"host_path must be 'vectorized' or 'legacy', got {explicit!r}"
+        )
+    return explicit
 
 
 class DeviceDPOROracle:
@@ -311,6 +341,7 @@ class DeviceDPOROracle:
         prefix_fork: Optional[bool] = None,
         async_min: Optional[bool] = None,
         double_buffer: Optional[bool] = None,
+        host_path: Optional[str] = None,
     ):
         from ..minimization.pipeline import async_min_enabled
         from .fork import prefix_fork_enabled
@@ -323,6 +354,7 @@ class DeviceDPOROracle:
         self.last_interleavings = 0
         self.initial_trace = initial_trace
         self.prefix_fork = prefix_fork
+        self.host_path = host_path
         self.max_distance: Optional[int] = None
         # Measurement-guided budget control: each resumable DPOR instance
         # gets its own DporBudgetTuner (frontier dynamics are
@@ -391,6 +423,15 @@ class DeviceDPOROracle:
                 out[k] += inst.async_stats[k]
         return out
 
+    def host_share(self) -> Optional[float]:
+        """Host-vs-device wall-time split summed across the resumable
+        instances (None before any round ran) — the CLI summary's
+        host-share figure."""
+        host = sum(i.host_seconds for i in self._instances.values())
+        dev = sum(i.device_seconds for i in self._instances.values())
+        total = host + dev
+        return host / total if total > 0 else None
+
     def _instance(self, externals) -> DeviceDPOR:
         key = tuple(e.eid for e in externals)
         inst = self._instances.get(key)
@@ -401,6 +442,7 @@ class DeviceDPOROracle:
                 double_buffer=self._double_buffer,
                 kernel=self._kernel,
                 fork_kernel=self._fork_kernel,
+                host_path=self.host_path,
             )
             if self.initial_trace is not None:
                 inst.seed(
@@ -581,7 +623,8 @@ def _dpor_search_state(dpor: "DeviceDPOR") -> tuple:
     return (
         set(dpor.explored), list(dpor.frontier), dpor.original,
         dpor.max_distance, dpor.interleavings, dpor.round_batch,
-        dict(dpor.async_stats), tuner,
+        dict(dpor.async_stats), tuner, set(dpor._explored_digests),
+        dpor.host_seconds, dpor.device_seconds,
     )
 
 
@@ -589,9 +632,11 @@ def _dpor_restore_state(dpor: "DeviceDPOR", state: tuple) -> None:
     (
         dpor.explored, dpor.frontier, dpor.original, dpor.max_distance,
         dpor.interleavings, dpor.round_batch, async_stats, tuner,
+        dpor._explored_digests, dpor.host_seconds, dpor.device_seconds,
     ) = (
         set(state[0]), list(state[1]), state[2], state[3], state[4],
-        state[5], dict(state[6]), state[7],
+        state[5], dict(state[6]), state[7], set(state[8]),
+        state[9], state[10],
     )
     if tuner is not None and dpor.tuner is not None:
         (
@@ -620,11 +665,8 @@ def steering_prescription(
         .subsequence_intersection(list(externals))
     )
     recs = lower_expected_trace(app, cfg, projected, externals, cfg.max_steps)
-    return tuple(
-        tuple(int(x) for x in r)
-        for r in recs
-        if r[0] in (REC_DELIVERY, REC_TIMER)
-    )
+    keep = recs[np.isin(recs[:, 0], (REC_DELIVERY, REC_TIMER))]
+    return tuple(map(tuple, keep.tolist()))
 
 
 class DeviceDPOR:
@@ -663,6 +705,7 @@ class DeviceDPOR:
         double_buffer: Optional[bool] = None,
         kernel=None,
         fork_kernel=None,
+        host_path: Optional[str] = None,
     ):
         assert cfg.record_trace and cfg.record_parents
         self.app = app
@@ -740,15 +783,22 @@ class DeviceDPOR:
                     app, cfg, mesh, start_state=True
                 )
             if fork_min_group is None:
-                # Frontier racing prescriptions cluster in small sibling
-                # groups (children of one parent trace), and a trunk run
-                # is a SINGLE-lane O(prefix) execution: on CPU — where a
-                # vectorized lane costs nearly as much as a scalar one —
-                # a 2-lane group cannot amortize it, so require groups
-                # the trunk genuinely pays for. On accelerators the
-                # batched lanes are effectively free next to the trunk
-                # launch, so keep the planner's permissive default.
-                fork_min_group = 4 if jax.devices()[0].platform == "cpu" else 2
+                # A trunk run is a SINGLE-lane O(prefix) execution and a
+                # fork group is an extra kernel launch: on CPU — where a
+                # vectorized lane costs nearly as much as a scalar one
+                # and launches are not free — even the 4-7-lane sibling
+                # groups the bucketed selection now produces lose to one
+                # whole-batch launch when the trunk cache misses (round
+                # prefixes are round-unique, so misses dominate; measured
+                # on bench config 8). Require half a batch before a CPU
+                # trunk pays; on accelerators the batched lanes are
+                # effectively free next to the trunk launch, so keep the
+                # planner's permissive default.
+                fork_min_group = (
+                    max(8, batch_size // 2)
+                    if jax.devices()[0].platform == "cpu"
+                    else 2
+                )
             self._forker = PrefixForker(
                 make_dpor_prefix_runner(app, cfg),
                 bucket=fork_bucket,
@@ -771,9 +821,29 @@ class DeviceDPOR:
             "inflight_hits": 0,
             "inflight_waste": 0,
         }
+        # Frontier host path: 'vectorized' (batch-native racing analysis,
+        # digest-keyed dedup) or 'legacy' (per-lane scan + per-pair tuple
+        # loop). Both produce bit-identical explored/frontier/results —
+        # pinned by tests/test_host_path.py and bench config 8.
+        self.host_path = _resolve_host_path(host_path)
+        # Host-share accounting (always on — two perf_counter reads per
+        # round): wall time blocked harvesting device results vs
+        # everything else in the frontier loop. The dpor.host_share gauge
+        # (obs) and bench configs 2/8 read these.
+        self.host_seconds = 0.0
+        self.device_seconds = 0.0
         self.explored: Set[Tuple] = set()
         self.frontier: List[Tuple] = [tuple()]
         self.explored.add(tuple())
+        # Digest twin of the explored set (16-byte content keys over the
+        # packed prescription rows): the vectorized path's membership
+        # check, maintained in lockstep with ``explored`` so a redundant
+        # prescription never has to materialize a Python tuple.
+        from ..native import prescription_digest
+
+        self._explored_digests: Set[bytes] = {prescription_digest(tuple())}
+        # Adaptive (n_presc, n_rows) buffer hint for the batch scan.
+        self._batch_size_hint: Optional[Tuple[int, int]] = None
         self.original: Optional[Tuple] = None
         self.max_distance: Optional[int] = None
         self.interleavings = 0
@@ -791,17 +861,21 @@ class DeviceDPOR:
     def seed(self, prescription: Tuple[Tuple[int, ...], ...]) -> None:
         """Plant an initial prescription at the head of the frontier (and
         fix it as the edit-distance origin)."""
+        from ..native import prescription_digest
+
         self.original = prescription
         if prescription not in self.explored:
             self.explored.add(prescription)
+            self._explored_digests.add(prescription_digest(prescription))
             self.frontier.insert(0, prescription)
 
     def _pack(self, prescriptions: List[Tuple]) -> np.ndarray:
         r, w = self.cfg.max_steps, self.cfg.rec_width
         out = np.zeros((len(prescriptions), r, w), np.int32)
         for k, presc in enumerate(prescriptions):
-            for t, rec in enumerate(presc[:r]):
-                out[k, t] = rec
+            if presc:
+                m = min(len(presc), r)
+                out[k, :m] = np.asarray(presc[:m], np.int32)
         return out
 
     def _progs(self, b: int) -> ExtProgram:
@@ -822,7 +896,32 @@ class DeviceDPOR:
         round_batch): because rounds select from the FROZEN generation
         (fresh prescriptions join the next generation — see ``explore``),
         the double-buffered loop's in-flight round is the real next round
-        whenever this selection re-runs unchanged after the harvest."""
+        whenever this selection re-runs unchanged after the harvest.
+
+        Depth orders at BUCKET granularity (8 rows — the planner's
+        default trunk bucket) with lexicographic content order within a
+        bucket (fork-group growth): prescriptions sharing long prefixes
+        — same-lane racing families, equal-depth siblings from ANY
+        generation — cluster on the same side of the round cut instead
+        of scattering across rounds by exact depth. Measured on the
+        config-8 frontier this turns the structural 2-lane sibling
+        groups into 4-7-lane groups (the size a resume trunk pays for on
+        CPU) while staying within 7 rows of strict deepest-first. The
+        constant bucket keeps selection independent of any fork
+        configuration, so every host-path/async variant explores the
+        identical schedule space."""
+        frontier = self._ordered_frontier(frontier)
+        take = max(1, min(self.round_batch, self.batch_size))
+        batch, rest = frontier[:take], frontier[take:]
+        batch = batch + [tuple()] * (self.batch_size - len(batch))
+        return batch, rest
+
+    def _ordered_frontier(self, frontier: List[Tuple]) -> List[Tuple]:
+        """The ONE round-order rule (see ``_select_batch``): a seeded
+        original pinned at the head, then deepest-bucket-first with
+        lexicographic content order within a bucket. Bench config 8's
+        sibling-clustering measurement calls this too, so it can never
+        measure an ordering the frontier doesn't actually use."""
         frontier = list(frontier)
         head, rest = (
             ([frontier[0]], frontier[1:])
@@ -830,12 +929,27 @@ class DeviceDPOR:
             and frontier[0] == self.original
             else ([], frontier)
         )
-        rest.sort(key=len, reverse=True)
-        frontier = head + rest
+        rest.sort(key=lambda p: (-(len(p) // 8), p))
+        return head + rest
+
+    def _merge_generations(
+        self, gen: List[Tuple], pending: List[Tuple]
+    ) -> Tuple[List[Tuple], List[Tuple]]:
+        """Cross-generation round filling (fork-group growth): when the
+        frozen generation can no longer FILL a round, the next generation
+        joins it — so a round's batch carries equal-depth prescriptions
+        from both generations instead of padding with prescription-free
+        lanes, and the PrefixPlanner gets sibling groups worth a resume
+        trunk. Deterministic in (gen, pending, round_batch): both the
+        synchronous loop and the double-buffered speculation check derive
+        the same decision, so a merge at a generation boundary costs at
+        most one discarded in-flight launch, never a divergence."""
+        if not pending:
+            return gen, pending
         take = max(1, min(self.round_batch, self.batch_size))
-        batch, rest = frontier[:take], frontier[take:]
-        batch = batch + [tuple()] * (self.batch_size - len(batch))
-        return batch, rest
+        if len(gen) >= take:
+            return gen, pending
+        return gen + pending, []
 
     def _round_keys(self, n: int, base: int):
         """Per-lane keys for one round: position in the cumulative
@@ -944,7 +1058,16 @@ class DeviceDPOR:
         generation policy), and tuner feedback (``frontier_extra`` counts
         worklist entries outside the sink list — the frozen generation's
         remainder — so the tuner sees the full frontier size). Returns a
-        violating lane's (records, trace_len) or None."""
+        violating lane's (records, trace_len) or None.
+
+        The default ``host_path='vectorized'`` derives the whole round's
+        prescriptions in ONE batch-native call (packed int32 rows +
+        per-lane offsets — native/trace_analysis.cpp or the NumPy
+        fallback), dedups against the explored set on vectorized content
+        digests, and only materializes Python tuples for the FRESH
+        prescriptions that actually join the frontier. ``'legacy'`` keeps
+        the per-lane scan + per-pair tuple loop; outputs are bit-identical
+        (tests/test_host_path.py)."""
         self.interleavings += len(batch)
         if obs.enabled():
             # Device-lane totals for the round (one on-device
@@ -962,39 +1085,35 @@ class DeviceDPOR:
                 driver="dpor",
             )
             obs.counter("dpor.interleavings").inc(len(batch))
-        violations = np.asarray(res.violation)
+        violations = np.asarray(res.violation)[: len(batch)]
         traces = np.asarray(res.trace)
         lens = np.asarray(res.trace_len)
-        hit = None
-        for lane in range(len(batch)):
-            code = int(violations[lane])
-            if code != 0 and (target_code is None or code == target_code):
-                hit = (traces[lane], int(lens[lane]))
-                break
+        hit_mask = (
+            violations != 0
+            if target_code is None
+            else (violations != 0) & (violations == target_code)
+        )
+        hit_lanes = np.flatnonzero(hit_mask)
+        hit = (
+            (traces[hit_lanes[0]], int(lens[hit_lanes[0]]))
+            if len(hit_lanes)
+            else None
+        )
         # Local fresh/redundant/pruned counts: the tuner's per-round
         # signal, needed whether or not telemetry is on (the obs
         # counters still carry the cross-round totals).
-        fresh_n = redundant_n = pruned_n = 0
-        for lane in range(len(batch)):
-            for presc in racing_prescriptions(
-                traces[lane], int(lens[lane]), self.cfg.rec_width
-            ):
-                if presc in self.explored:
-                    redundant_n += 1
-                    obs.counter("dpor.prescriptions_redundant").inc()
-                    continue
-                if (
-                    self.max_distance is not None
-                    and self.original is not None
-                    and arvind_distance(presc, self.original)
-                    > self.max_distance
-                ):
-                    pruned_n += 1
-                    obs.counter("dpor.prescriptions_distance_pruned").inc()
-                    continue
-                fresh_n += 1
-                self.explored.add(presc)
-                frontier.append(presc)
+        if self.host_path == "vectorized":
+            fresh_n, redundant_n, pruned_n = self._derive_batch(
+                traces, lens, len(batch), frontier
+            )
+        else:
+            fresh_n, redundant_n, pruned_n = self._derive_legacy(
+                traces, lens, len(batch), frontier
+            )
+        if redundant_n:
+            obs.counter("dpor.prescriptions_redundant").inc(redundant_n)
+        if pruned_n:
+            obs.counter("dpor.prescriptions_distance_pruned").inc(pruned_n)
         obs.gauge("dpor.explored_set_size").set(len(self.explored))
         if self.tuner is not None:
             self.tuner.observe_round(
@@ -1006,9 +1125,133 @@ class DeviceDPOR:
                 self.max_distance = self.tuner.max_distance
         return hit
 
+    def _admit(
+        self, presc: Tuple, key: Optional[bytes], frontier: List[Tuple]
+    ) -> bool:
+        """Distance-gate + record one non-redundant prescription (shared
+        by both host paths). Returns True when the prescription joined
+        the frontier. ``key=None`` (the legacy path, which dedups on the
+        tuple set alone) skips the digest-set upkeep — the two sets only
+        need lockstep within one host path's lifetime."""
+        if (
+            self.max_distance is not None
+            and self.original is not None
+            and arvind_distance(presc, self.original) > self.max_distance
+        ):
+            return False
+        self.explored.add(presc)
+        if key is not None:
+            self._explored_digests.add(key)
+        frontier.append(presc)
+        return True
+
+    def _derive_batch(
+        self, traces, lens, n_lanes: int, frontier: List[Tuple]
+    ) -> Tuple[int, int, int]:
+        """Vectorized prescription derivation: one batch-native racing
+        call for the whole round, content-digest dedup over the packed
+        rows, tuples materialized only for admitted candidates. Returns
+        (fresh, redundant, pruned) counts."""
+        from ..native import digest_keys, racing_prescriptions_batch
+
+        recw = self.cfg.rec_width
+        rows, offsets, lanes, digests = racing_prescriptions_batch(
+            traces[:n_lanes], lens[:n_lanes], recw,
+            size_hint=self._batch_size_hint,
+        )
+        # Adaptive buffer sizing: the next round's scan allocates for
+        # this round's volume (+ slack) instead of a blind worst case.
+        self._batch_size_hint = (
+            max(64, (len(digests) * 5) // 4),
+            max(256, (len(rows) * 5) // 4),
+        )
+        keys = digest_keys(digests)
+        fresh_n = redundant_n = pruned_n = 0
+        explored_digests = self._explored_digests
+        offs = offsets.tolist()
+        lane_of = lanes.tolist()
+        # Fresh prescriptions materialize with SHARED per-lane row
+        # tuples: a prescription's prefix is by construction the first
+        # (mlen - 1) delivery rows of its lane in position order, so one
+        # tuple list per lane serves every fresh sibling — O(refs) per
+        # prescription instead of a fresh tuple per packed row.
+        lane_deliv: Dict[int, List[Tuple[int, ...]]] = {}
+
+        def deliveries_of(b: int) -> List[Tuple[int, ...]]:
+            cached = lane_deliv.get(b)
+            if cached is None:
+                recs = traces[b, : int(lens[b]), :recw]
+                pos = np.nonzero(
+                    np.isin(recs[:, 0], (REC_DELIVERY, REC_TIMER))
+                )[0]
+                cached = [tuple(r) for r in recs[pos].tolist()]
+                lane_deliv[b] = cached
+            return cached
+
+        for k, key in enumerate(keys):
+            if key in explored_digests:
+                redundant_n += 1
+                continue
+            lo, hi = offs[k], offs[k + 1]
+            flipped = tuple(rows[hi - 1].tolist())
+            presc = tuple(deliveries_of(lane_of[k])[: hi - lo - 1]) + (
+                flipped,
+            )
+            if self._admit(presc, key, frontier):
+                fresh_n += 1
+            else:
+                pruned_n += 1
+        return fresh_n, redundant_n, pruned_n
+
+    def _derive_legacy(
+        self, traces, lens, n_lanes: int, frontier: List[Tuple]
+    ) -> Tuple[int, int, int]:
+        """The pre-vectorization host path — per-lane scans, per-pair
+        tuple assembly, tuple-set membership — kept as the parity
+        baseline (bench config 8's host_path comparison and
+        tests/test_host_path.py pin bit-identical outputs)."""
+        fresh_n = redundant_n = pruned_n = 0
+        for lane in range(n_lanes):
+            for presc in racing_prescriptions(
+                traces[lane], int(lens[lane]), self.cfg.rec_width
+            ):
+                if presc in self.explored:
+                    redundant_n += 1
+                    continue
+                if self._admit(presc, None, frontier):
+                    fresh_n += 1
+                else:
+                    pruned_n += 1
+        return fresh_n, redundant_n, pruned_n
+
     def _note_inflight(self, outcome: str) -> None:
         self.async_stats[f"inflight_{outcome}"] += 1
         obs.counter(f"dpor.inflight_{outcome}").inc()
+
+    @property
+    def host_share(self) -> Optional[float]:
+        """Fraction of frontier wall time spent host-side (planning,
+        packing, racing analysis, dedup) vs blocked on device results —
+        the number the vectorized host path exists to shrink. None until
+        a round has run."""
+        total = self.host_seconds + self.device_seconds
+        return self.host_seconds / total if total > 0 else None
+
+    def _account_round(self, round_t0: float, device_secs: float) -> None:
+        """Fold one frontier round's wall time into the host/device
+        split: ``device_secs`` is the harvest-blocked span, the rest of
+        the iteration is host work (selection, packing, dispatch prep,
+        racing analysis, dedup). Always tracked (two clock reads); the
+        ``dpor.host_*`` obs series mirror it when telemetry is on."""
+        host_secs = max(0.0, time.perf_counter() - round_t0 - device_secs)
+        self.device_seconds += device_secs
+        self.host_seconds += host_secs
+        if obs.enabled():
+            obs.counter("dpor.host_seconds").inc(host_secs)
+            obs.counter("dpor.device_seconds").inc(device_secs)
+            share = self.host_share
+            if share is not None:
+                obs.gauge("dpor.host_share").set(share)
 
     def explore(
         self, target_code: Optional[int] = None, max_rounds: int = 20
@@ -1023,7 +1266,12 @@ class DeviceDPOR:
         breadth-style worklist processing — deepest-first within a
         generation — and it is what makes the next round plannable before
         the current round's codes ever leave the device: the harvest
-        cannot reorder the generation it was selected from.
+        cannot reorder the generation it was selected from. One
+        deterministic exception (fork-group growth): a generation too
+        small to fill a round pulls the next generation forward
+        (``_merge_generations``), so equal-depth prescriptions from both
+        generations batch together instead of padding the round with
+        prescription-free lanes.
 
         With ``double_buffer`` on, round N+1's batch is selected from the
         frozen-generation remainder and dispatched as a FULL in-flight
@@ -1041,6 +1289,7 @@ class DeviceDPOR:
         inflight = None  # (batch, parts, n_real) for the next round
         found = None
         for _ in range(max_rounds):
+            round_t0 = time.perf_counter()
             if inflight is not None:
                 batch, parts, _ = inflight
                 inflight = None
@@ -1050,8 +1299,10 @@ class DeviceDPOR:
                 # dispatched launch lands in exactly one bucket).
                 self._note_inflight("hits")
             else:
-                if not gen:
-                    gen, pending = pending, []
+                # Fork-group growth: a generation that can't fill a round
+                # pulls the next generation forward (see
+                # ``_merge_generations``).
+                gen, pending = self._merge_generations(gen, pending)
                 if not gen:
                     break
                 batch, gen = self._select_batch(gen)
@@ -1079,7 +1330,9 @@ class DeviceDPOR:
             with obs.span(
                 "dpor.round", batch=len(batch), frontier=len(gen)
             ):
+                t_harvest = time.perf_counter()
                 res = self._harvest_round(parts, len(batch))
+                dev_secs = time.perf_counter() - t_harvest
             hit = self._process_round(
                 res, batch, target_code, pending, frontier_extra=len(gen)
             )
@@ -1089,15 +1342,23 @@ class DeviceDPOR:
                     self._note_inflight("waste")
                 obs.counter("dpor.violations_found").inc()
                 found = hit
+                self._account_round(round_t0, dev_secs)
                 break
             if spec is not None:
                 sbatch, sparts, sreal = spec
-                abatch, arest = self._select_batch(gen)
+                # The speculative batch was selected from the UNMERGED
+                # remainder; validate against the merged pool the
+                # synchronous loop would select from at its next round
+                # top. A merge that changes the selection discards the
+                # in-flight launch — waste, never divergence.
+                mgen, mpending = self._merge_generations(gen, pending)
+                abatch, arest = self._select_batch(mgen)
                 if abatch == sbatch:
                     inflight = (sbatch, sparts, sreal)
-                    gen = arest
+                    gen, pending = arest, mpending
                 else:
                     self._note_inflight("waste")
+            self._account_round(round_t0, dev_secs)
         if inflight is not None:
             # The round budget expired with a speculative round still on
             # device: it was never harvested, so its prescriptions go
@@ -1141,8 +1402,9 @@ def explore_window(
         for i in range(n):
             if done[i]:
                 continue
-            if not frontiers[i]:
-                frontiers[i], pendings[i] = pendings[i], []
+            frontiers[i], pendings[i] = dpors[i]._merge_generations(
+                frontiers[i], pendings[i]
+            )
             if frontiers[i]:
                 live.append(i)
         if not live:
@@ -1165,6 +1427,7 @@ def explore_window(
             # under vmap, so concatenating the instances' (prog, presc,
             # key) rows yields exactly each instance's own round results.
             progs = [dpors[i]._progs(len(b)) for i, b, *_ in staged]
+            t_harvest = time.perf_counter()
             res = dpors[staged[0][0]].kernel(
                 ExtProgram(*(
                     np.concatenate([np.asarray(getattr(p, f)) for p in progs])
@@ -1174,6 +1437,11 @@ def explore_window(
                 np.concatenate([np.asarray(keys) for *_, keys in staged]),
             )
             jax.block_until_ready(res.violation)
+            # Window launches serve several instances at once: split the
+            # blocked span evenly for the per-instance host-share ledger.
+            dev_each = (time.perf_counter() - t_harvest) / len(staged)
+            for i, *_ in staged:
+                dpors[i].device_seconds += dev_each
             off = 0
             for i, batch, _prescs, _keys in staged:
                 results.append((i, batch, LaneResult(*(
@@ -1186,11 +1454,14 @@ def explore_window(
                 (i, batch, dpors[i]._dispatch_round(prescs, keys, batch))
                 for i, batch, prescs, keys in staged
             ]
-            results = [
-                (i, batch, dpors[i]._harvest_round(parts, len(batch)))
-                for i, batch, parts in handles
-            ]
+            results = []
+            for i, batch, parts in handles:
+                t_harvest = time.perf_counter()
+                harvested = dpors[i]._harvest_round(parts, len(batch))
+                dpors[i].device_seconds += time.perf_counter() - t_harvest
+                results.append((i, batch, harvested))
         for i, batch, res in results:
+            t_host = time.perf_counter()
             with obs.span(
                 "dpor.round", batch=len(batch), frontier=len(frontiers[i])
             ):
@@ -1198,6 +1469,7 @@ def explore_window(
                     res, batch, target_code, pendings[i],
                     frontier_extra=len(frontiers[i]),
                 )
+            dpors[i].host_seconds += time.perf_counter() - t_host
             if hit is not None:
                 obs.counter("dpor.violations_found").inc()
                 found[i] = hit

@@ -149,48 +149,58 @@ def _actor_or_external(app: DSLApp, name: str) -> int:
         return app.num_actors
 
 
-def lower_expected_rows(
+def lower_expected_matrix(
     app: DSLApp,
     cfg: DeviceConfig,
     trace: EventTrace,
     externals: Sequence[ExternalEvent],
-) -> List[Tuple[int, Optional[List[int]]]]:
-    """Per-event record rows for a projected/filtered EventTrace: one
-    ``(uid, row-or-None)`` pair per trace event, in trace order. A ``None``
-    row marks an event with no device meaning in replay (internal sends,
-    wait/quiescence markers). Each row is a pure function of the event
-    itself (plus its own external Send's re-bound payload), which is what
-    makes the ``CandidateLowerer``'s row-gather sound: a candidate that is
-    an event-subsequence of a base trace lowers to exactly the base's rows
+) -> Tuple[List[int], np.ndarray, np.ndarray]:
+    """Matrix form of the expected-trace lowering: ``(uids, rows, mask)``
+    where ``mask[k]`` marks trace event k as having a device row and
+    ``rows`` is the [mask.sum(), 3 + msg_width] int32 matrix of those
+    rows in trace order. The per-event dispatch writes straight into the
+    preallocated matrix — no per-row Python list building — and every
+    downstream consumer (``lower_expected_trace``, the
+    ``CandidateLowerer`` full path, ``steering_prescription``) packs or
+    filters it with array ops. A ``mask[k]=False`` event has no device
+    meaning in replay (internal sends, wait/quiescence markers).
+
+    Each row is a pure function of the event itself (plus its own
+    external Send's re-bound payload), which is what makes the
+    ``CandidateLowerer``'s row-gather sound: a candidate that is an
+    event-subsequence of a base trace lowers to exactly the base's rows
     for the surviving uids."""
     w = cfg.msg_width
     rebound = trace.recompute_external_msg_sends(externals)
-    rows: List[Tuple[int, Optional[List[int]]]] = []
+    n_events = len(trace.events)
+    rows = np.zeros((n_events, 3 + w), np.int32)
+    mask = np.zeros(n_events, bool)
+    uids: List[int] = []
     uid_payload = {}
+    k = 0
     for u, ev in zip(trace.events, rebound):
-        row: Optional[List[int]] = None
+        uids.append(u.id)
+        out = rows[k]
         if isinstance(ev, SpawnEvent):
-            row = [REC_EXT_BASE + OP_START, app.actor_id(ev.name), 0] + [0] * w
+            out[0], out[1] = REC_EXT_BASE + OP_START, app.actor_id(ev.name)
         elif isinstance(ev, KillEvent):
-            row = [REC_EXT_BASE + OP_KILL, app.actor_id(ev.name), 0] + [0] * w
+            out[0], out[1] = REC_EXT_BASE + OP_KILL, app.actor_id(ev.name)
         elif isinstance(ev, HardKillEvent):
-            row = [REC_EXT_BASE + OP_HARDKILL, app.actor_id(ev.name), 0] + [0] * w
+            out[0], out[1] = REC_EXT_BASE + OP_HARDKILL, app.actor_id(ev.name)
         elif isinstance(ev, PartitionEvent):
-            row = (
-                [REC_EXT_BASE + OP_PARTITION, app.actor_id(ev.a), app.actor_id(ev.b)]
-                + [0] * w
-            )
+            out[0] = REC_EXT_BASE + OP_PARTITION
+            out[1], out[2] = app.actor_id(ev.a), app.actor_id(ev.b)
         elif isinstance(ev, UnPartitionEvent):
-            row = (
-                [REC_EXT_BASE + OP_UNPARTITION, app.actor_id(ev.a), app.actor_id(ev.b)]
-                + [0] * w
-            )
+            out[0] = REC_EXT_BASE + OP_UNPARTITION
+            out[1], out[2] = app.actor_id(ev.a), app.actor_id(ev.b)
         elif isinstance(ev, MsgSend):
             if ev.is_external:
                 payload = _msg_row(app, ev.msg, w)
                 uid_payload[u.id] = payload
-                row = [REC_EXT_BASE + OP_SEND, app.actor_id(ev.rcv), 0] + payload
-            # internal sends re-occur as delivery side effects
+                out[0], out[1] = REC_EXT_BASE + OP_SEND, app.actor_id(ev.rcv)
+                out[3:] = payload
+            else:
+                continue  # internal sends re-occur as delivery side effects
         elif isinstance(ev, MsgEvent):
             if isinstance(ev.msg, WildCardMatch):
                 wc = ev.msg
@@ -204,45 +214,77 @@ def lower_expected_rows(
                         f"wildcard policy {wc.policy!r}/selector is not "
                         "lowerable to the device tier"
                     )
-                policy = 1 if wc.policy == "last" else 0
-                row = (
-                    [REC_WILDCARD, app.actor_id(ev.rcv), policy, wc.class_tag]
-                    + [0] * (w - 1)
-                )
+                out[0], out[1] = REC_WILDCARD, app.actor_id(ev.rcv)
+                out[2] = 1 if wc.policy == "last" else 0
+                out[3] = wc.class_tag
             else:
-                src = _actor_or_external(app, ev.snd)
                 payload = uid_payload.get(u.id, None)
                 if payload is None:
                     payload = _msg_row(app, ev.msg, w)
-                row = [REC_DELIVERY, src, app.actor_id(ev.rcv)] + payload
+                out[0] = REC_DELIVERY
+                out[1] = _actor_or_external(app, ev.snd)
+                out[2] = app.actor_id(ev.rcv)
+                out[3:] = payload
         elif isinstance(ev, TimerDelivery):
             rid = app.actor_id(ev.rcv)
-            row = [REC_TIMER, rid, rid] + _msg_row(app, ev.msg, w)
-        # Quiescence / wait markers have no device meaning in replay.
-        rows.append((u.id, row))
-    return rows
+            out[0], out[1], out[2] = REC_TIMER, rid, rid
+            out[3:] = _msg_row(app, ev.msg, w)
+        else:
+            continue  # Quiescence / wait markers: no device meaning
+        mask[len(uids) - 1] = True
+        k += 1
+    return uids, rows[:k], mask
+
+
+
+
+def _pack_matrix(
+    cfg: DeviceConfig, rows: np.ndarray, max_records: int
+) -> np.ndarray:
+    """Pad a compact [n, <=rec_width] int32 row matrix into the
+    [max_records, rec_width] array the replay kernels consume, with the
+    shared guards — the vectorized core of ``_pack_records``."""
+    n = len(rows)
+    if n > max_records:
+        raise ValueError(f"expected trace has {n} records > {max_records}")
+    # Records are compact (no mid-sequence REC_NONE holes): the replay
+    # kernel's early-exit path terminates at the first zero-kind record,
+    # which must therefore only ever be trailing padding. (ValueError, not
+    # assert: this guard must survive python -O.)
+    if n and (np.asarray(rows)[:, 0] == 0).any():
+        raise ValueError("REC_NONE hole in expected trace records")
+    # Rows are kind/a/b/msg; right-pad to the cfg's record width (a
+    # record_parents cfg has a trailing parent column, zero here).
+    out = np.zeros((max_records, cfg.rec_width), np.int32)
+    if n:
+        out[:n, : rows.shape[1]] = rows
+    _check_msg_range(cfg, out[:, 3 : 3 + cfg.msg_width])
+    return out
 
 
 def _pack_records(
     cfg: DeviceConfig, recs: Sequence[Sequence[int]], max_records: int
 ) -> np.ndarray:
     """Assemble compact record rows into the padded [max_records,
-    rec_width] array the replay kernels consume, with the shared guards."""
+    rec_width] array the replay kernels consume, with the shared guards.
+    Uniform-width rows (the lowering always emits 3 + msg_width) stack in
+    one array conversion; ragged inputs fall back to a per-row copy."""
     if len(recs) > max_records:
         raise ValueError(f"expected trace has {len(recs)} records > {max_records}")
-    # Records are compact (no mid-sequence REC_NONE holes): the replay
-    # kernel's early-exit path terminates at the first zero-kind record,
-    # which must therefore only ever be trailing padding. (ValueError, not
-    # assert: this guard must survive python -O.)
-    if any(r[0] == 0 for r in recs):
-        raise ValueError("REC_NONE hole in expected trace records")
-    # Rows are kind/a/b/msg; right-pad to the cfg's record width (a
-    # record_parents cfg has a trailing parent column, zero here).
-    out = np.zeros((max_records, cfg.rec_width), np.int32)
-    for i, r in enumerate(recs):
-        out[i, : len(r)] = r
-    _check_msg_range(cfg, out[:, 3 : 3 + cfg.msg_width])
-    return out
+    if not len(recs):
+        return _pack_matrix(cfg, np.zeros((0, 3), np.int32), max_records)
+    try:
+        rows = np.asarray(recs, np.int32)
+        assert rows.ndim == 2
+    except (ValueError, AssertionError):
+        if any(r[0] == 0 for r in recs):
+            raise ValueError("REC_NONE hole in expected trace records")
+        out = np.zeros((max_records, cfg.rec_width), np.int32)
+        for i, r in enumerate(recs):
+            out[i, : len(r)] = r
+        _check_msg_range(cfg, out[:, 3 : 3 + cfg.msg_width])
+        return out
+    return _pack_matrix(cfg, rows, max_records)
 
 
 def lower_expected_trace(
@@ -258,9 +300,8 @@ def lower_expected_trace(
     External Send payloads are re-bound via their constructors first, and
     the corresponding delivery records carry the re-bound payload (uid
     linkage), so payload shrinking composes with device replay."""
-    recs = [row for _uid, row in lower_expected_rows(app, cfg, trace, externals)
-            if row is not None]
-    return _pack_records(cfg, recs, max_records)
+    _uids, rows, _mask = lower_expected_matrix(app, cfg, trace, externals)
+    return _pack_matrix(cfg, rows, max_records)
 
 
 class CandidateLowerer:
@@ -269,7 +310,7 @@ class CandidateLowerer:
     candidates are event-subsequences of one base trace, so the base is
     lowered to per-event rows ONCE and each candidate materializes as a
     NumPy row-gather instead of a fresh ``lower_expected_trace`` Python
-    loop. Soundness rests on ``lower_expected_rows``: a surviving event's
+    loop. Soundness rests on ``lower_expected_matrix``: a surviving event's
     row depends only on the event (and its own Send's payload), and
     subsequence projection / delivery removal reuse the base trace's
     ``Unique`` objects, so gathered rows equal a from-scratch lowering
@@ -357,12 +398,12 @@ class CandidateLowerer:
                     break
                 r = row_of.get(k)
                 if r is not None:
-                    # Subsequence order check rides along: gathered row
-                    # indices must be strictly increasing.
-                    if idx and r <= idx[-1]:
-                        ok = False
-                        break
                     idx.append(r)
+            if ok and len(idx) > 1:
+                # Subsequence order check, one vectorized pass: gathered
+                # row indices must be strictly increasing.
+                arr = np.asarray(idx, np.intp)
+                ok = bool((arr[1:] > arr[:-1]).all())
             if not ok:
                 continue
             cand_key = (token, keys)
@@ -399,18 +440,19 @@ class CandidateLowerer:
 
         # No base covers this candidate: full lowering, registered as a
         # fresh base so the next round's subsequences gather.
-        pairs = lower_expected_rows(self.app, self.cfg, trace, externals)
-        recs = [row for _uid, row in pairs if row is not None]
-        out = _pack_records(self.cfg, recs, self.max_records)
+        _uids, rows, has_row = lower_expected_matrix(
+            self.app, self.cfg, trace, externals
+        )
+        out = _pack_matrix(self.cfg, rows, self.max_records)
         digest = hashlib.blake2b(out.tobytes(), digest_size=16).digest()
         self.stats["full"] += 1
         obs.counter("pipe.lower_full").inc()
         row_of: dict = {}
-        for u, (_uid, row) in zip(trace.events, pairs):
-            if row is not None:
+        for u, has in zip(trace.events, has_row):
+            if has:
                 row_of[id(u)] = len(row_of)
         token = self._register_base(
-            out[: len(recs)].copy(), row_of, {id(u): u for u in trace.events}
+            out[: len(rows)].copy(), row_of, {id(u): u for u in trace.events}
         )
         self._remember_candidate((token, keys), out, digest)
         return out, digest

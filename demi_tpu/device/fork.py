@@ -471,7 +471,15 @@ class PrefixPlanner:
     ``scratch``. Recursion only descends while a chunk-partition keeps at
     least ``min_group`` members together, so a ddmin level's candidates —
     identical up to the first removed index — come out as one group per
-    first-divergence bucket."""
+    first-divergence bucket.
+
+    ``plan`` partitions by ARRAY prefix-comparison: every bucket chunk of
+    the stacked row matrix is content-hashed in one vectorized pass (the
+    128-bit scheme of ``native.prescription_digests``' family), and each
+    recursion level is a lexsort + boundary scan over those hashes — no
+    per-trial ``tobytes`` in the loop. ``plan_reference`` keeps the
+    original per-chunk-bytes recursion as the parity baseline
+    (tests/test_host_path.py pins group-for-group equality)."""
 
     def __init__(self, bucket: int = 8, min_group: int = 2):
         if bucket < 1:
@@ -482,6 +490,88 @@ class PrefixPlanner:
     def plan(
         self, records: np.ndarray, lengths: Sequence[int]
     ) -> Tuple[List[PrefixGroup], List[int]]:
+        records = np.asarray(records)
+        lengths = np.asarray(lengths)
+        n, rmax = records.shape[0], records.shape[1]
+        groups: List[PrefixGroup] = []
+        scratch: List[int] = []
+        if n == 0:
+            return groups, scratch
+        depth_max = rmax // self.bucket
+        # Per-(trial, depth) 2x64-bit chunk content hashes, one
+        # vectorized pass over the raw bytes (dtype-agnostic, byte-exact
+        # like the reference's tobytes comparison, modulo 128-bit
+        # collision odds — the trust level of the blake2b-16 trunk keys).
+        if depth_max > 0:
+            from ..native.analysis import _mix64, _COL_MULT, _SALTS
+
+            flat = np.ascontiguousarray(records[:, : depth_max * self.bucket])
+            nbytes = self.bucket * int(
+                np.prod(flat.shape[2:], dtype=np.int64)
+            ) * flat.dtype.itemsize
+            chunks = flat.view(np.uint8).reshape(n, depth_max, nbytes)
+            col_pow = np.ones(nbytes, np.uint64)
+            if nbytes > 1:
+                col_pow[1:] = _COL_MULT
+            col_pow = np.cumprod(col_pow)[::-1]
+            cv = (chunks.astype(np.uint64) * col_pow[None, None, :]).sum(
+                axis=2, dtype=np.uint64
+            )
+            h1 = _mix64(cv ^ _SALTS[0])
+            h2 = _mix64(cv ^ _SALTS[1])
+        full_at = lengths[:, None] >= (
+            np.arange(1, depth_max + 1, dtype=np.int64) * self.bucket
+        )[None, :] if depth_max else np.zeros((n, 0), bool)
+
+        def emit(idx: np.ndarray, depth: int) -> None:
+            if depth == 0:
+                scratch.extend(int(i) for i in idx)
+                return
+            p = depth * self.bucket
+            groups.append(
+                PrefixGroup(
+                    prefix_len=p,
+                    indices=[int(i) for i in idx],
+                    key=prefix_digest(records[idx[0], :p].tobytes()),
+                )
+            )
+
+        def split(idx: np.ndarray, depth: int) -> None:
+            if depth >= depth_max:
+                emit(idx, depth)
+                return
+            full = full_at[idx, depth]
+            deeper, rest = idx[full], idx[~full]
+            small = [rest]
+            if deeper.size:
+                k1, k2 = h1[deeper, depth], h2[deeper, depth]
+                order = np.lexsort((k2, k1))
+                sd, s1, s2 = deeper[order], k1[order], k2[order]
+                breaks = np.flatnonzero(
+                    (s1[1:] != s1[:-1]) | (s2[1:] != s2[:-1])
+                ) + 1
+                bounds = np.concatenate(([0], breaks, [sd.size]))
+                for lo, hi in zip(bounds[:-1], bounds[1:]):
+                    sub = sd[lo:hi]
+                    if sub.size >= self.min_group:
+                        split(np.sort(sub), depth + 1)
+                    else:
+                        small.append(sub)
+            rest = np.concatenate(small) if len(small) > 1 else rest
+            if rest.size:
+                emit(np.sort(rest), depth)
+
+        split(np.arange(n, dtype=np.int64), 0)
+        return groups, scratch
+
+    def plan_reference(
+        self, records: np.ndarray, lengths: Sequence[int]
+    ) -> Tuple[List[PrefixGroup], List[int]]:
+        """The original per-chunk-bytes recursion — the parity baseline
+        for the vectorized ``plan`` (groups are compared as
+        (prefix_len, member-set, key) sets; member ORDER within a group
+        is load-free: fork results merge by batch index and per-lane
+        keys follow batch position)."""
         records = np.asarray(records)
         lengths = np.asarray(lengths)
         groups: List[PrefixGroup] = []

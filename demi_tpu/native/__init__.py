@@ -1,4 +1,11 @@
-from .analysis import analysis_native_available, racing_pair_scan
+from .analysis import (
+    analysis_native_available,
+    digest_keys,
+    prescription_digest,
+    prescription_digests,
+    racing_pair_scan,
+    racing_prescriptions_batch,
+)
 from .codec import (
     native_available,
     pack_records,
@@ -15,4 +22,8 @@ __all__ = [
     "read_record_log",
     "write_record_log",
     "racing_pair_scan",
+    "racing_prescriptions_batch",
+    "prescription_digests",
+    "prescription_digest",
+    "digest_keys",
 ]

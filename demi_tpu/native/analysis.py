@@ -1,20 +1,41 @@
-"""Racing-pair scan: ctypes binding to the C++ analyzer
-(native/trace_analysis.cpp) with a semantics-identical pure-Python
-fallback.
+"""Racing analysis: ctypes bindings to the C++ analyzer
+(native/trace_analysis.cpp) with semantics-identical NumPy fallbacks.
 
 This is the host-side hot loop of batched device DPOR: every round scans
 every lane's parent-tracked trace for co-enabled same-receiver pairs
 (reference: DPORwHeuristics.scala:1122-1139). At batch 32 x ~100-record
 traces the O(n^2) Python scan dominates frontier turnaround; the native
 path runs it over raw int32 buffers with per-record ancestor bitsets.
+
+Two tiers:
+
+- ``racing_pair_scan`` — one lane's (i, j) racing pairs (the original
+  per-lane surface, kept for the legacy host path and parity tests).
+- ``racing_prescriptions_batch`` — a whole round's stacked lane records
+  in ONE call, returning fully-assembled backtrack prescriptions as
+  packed int32 rows + per-prescription offsets + owning lanes. This is
+  the frontier hot path: one ctypes crossing (or one vectorized NumPy
+  pass) per round instead of a scan per lane and a Python tuple loop
+  per racing pair.
+- ``prescription_digests`` — order-sensitive 128-bit content digests
+  over the packed rows, computed in one vectorized NumPy pass; the
+  explored-set membership check dedups on these instead of
+  materializing a Python tuple per (mostly redundant) prescription.
+
+Build robustness: the compiler is ``$CXX`` when set, else the first of
+g++ / clang++ / cc that links. When no native library can be built the
+NumPy fallback is used and a ONE-TIME log line + ``native.analysis_fallback``
+obs counter fire, so a silent native-miss perf regression shows up in
+telemetry instead of only in wall clocks.
 """
 
 from __future__ import annotations
 
 import ctypes
+import logging
 import os
 import subprocess
-from typing import Optional
+from typing import Optional, Tuple
 
 import numpy as np
 
@@ -23,8 +44,11 @@ _SRC = os.path.join(_REPO_ROOT, "native", "trace_analysis.cpp")
 _BUILD_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "_build")
 _SO = os.path.join(_BUILD_DIR, "libdemi_analysis.so")
 
+_log = logging.getLogger("demi_tpu.native")
+
 _lib: Optional[ctypes.CDLL] = None
 _lib_tried = False
+_fallback_noted = False
 
 
 def _delivery_kinds():
@@ -33,6 +57,67 @@ def _delivery_kinds():
     from ..device.core import REC_DELIVERY, REC_TIMER
 
     return (REC_DELIVERY, REC_TIMER)
+
+
+def _compiler_candidates():
+    """$CXX first when set, then the conventional fallback chain."""
+    env = os.environ.get("CXX", "").strip()
+    out = [env] if env else []
+    for cxx in ("g++", "clang++", "cc"):
+        if cxx not in out:
+            out.append(cxx)
+    return out
+
+
+def _compile(src: str, dst: str) -> bool:
+    """Try each candidate compiler until one produces ``dst``. ``-x c++``
+    + ``-lstdc++`` keep a bare ``cc`` driver viable for the C++ source."""
+    for cxx in _compiler_candidates():
+        tmp = f"{dst}.{os.getpid()}.tmp"
+        try:
+            subprocess.run(
+                [cxx, "-O2", "-shared", "-fPIC", "-x", "c++", src,
+                 "-o", tmp, "-lstdc++"],
+                check=True, capture_output=True, timeout=120,
+            )
+        except Exception:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            continue
+        # Build to a per-pid temp path, then atomically replace:
+        # concurrent builders (parallel pytest) must never interleave
+        # writes into the loaded .so.
+        os.replace(tmp, dst)
+        return True
+    return False
+
+
+def note_fallback(reason: str) -> None:
+    """One-time marker that the Python/NumPy path is serving a hot loop
+    the native analyzer exists for: a log line (visible regardless of
+    telemetry) plus the ``native.analysis_fallback`` counter (visible in
+    every obs snapshot), so a silent native-miss regression is
+    diagnosable from either surface."""
+    global _fallback_noted
+    if _fallback_noted:
+        return
+    _fallback_noted = True
+    from .. import obs
+
+    # Direct series write (the Counter analog of Gauge.force_set): this
+    # rare, load-bearing fact must reach every snapshot even when the
+    # first fallback happens before obs.enable() — a gated inc would be
+    # silently dropped and the one-time latch never fires again.
+    counter = obs.counter("native.analysis_fallback")
+    key = f"reason={reason}"
+    counter.series[key] = counter.series.get(key, 0) + 1
+    _log.warning(
+        "demi_tpu native analysis unavailable (%s): racing analysis runs "
+        "on the NumPy fallback — correct, but slower per frontier round",
+        reason,
+    )
 
 
 def _load_native() -> Optional[ctypes.CDLL]:
@@ -46,25 +131,30 @@ def _load_native() -> Optional[ctypes.CDLL]:
             and os.path.getmtime(_SRC) > os.path.getmtime(_SO)
         ):
             if not os.path.exists(_SRC):
+                note_fallback("source missing")
                 return None
             os.makedirs(_BUILD_DIR, exist_ok=True)
-            # Build to a per-pid temp path, then atomically replace:
-            # concurrent builders (parallel pytest) must never interleave
-            # writes into the loaded .so.
-            tmp = f"{_SO}.{os.getpid()}.tmp"
-            subprocess.run(
-                ["g++", "-O2", "-shared", "-fPIC", _SRC, "-o", tmp],
-                check=True, capture_output=True, timeout=120,
-            )
-            os.replace(tmp, _SO)
+            if not _compile(_SRC, _SO):
+                note_fallback("no working C++ compiler")
+                return None
         lib = ctypes.CDLL(_SO)
         lib.demi_racing_pairs.restype = ctypes.c_int64
         lib.demi_racing_pairs.argtypes = [
             ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
             ctypes.c_void_p, ctypes.c_int64,
         ]
+        lib.demi_racing_prescriptions.restype = ctypes.c_int64
+        lib.demi_racing_prescriptions.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_void_p, ctypes.c_int64,
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+            ctypes.c_void_p,
+            ctypes.c_void_p,
+        ]
         _lib = lib
-    except Exception:
+    except Exception as exc:  # stale .so without the batch symbol included
+        note_fallback(f"load failed: {type(exc).__name__}")
         _lib = None
     return _lib
 
@@ -114,6 +204,8 @@ def racing_pair_scan(recs: np.ndarray) -> np.ndarray:
     n, w = recs.shape
     lib = _load_native()
     if lib is None or n == 0:
+        if lib is None:
+            note_fallback("no native library")
         return _py_racing_pairs(recs)
     cap = max(64, n * 4)
     while True:
@@ -124,3 +216,224 @@ def racing_pair_scan(recs: np.ndarray) -> np.ndarray:
         if count <= cap:
             return out[:count].copy()
         cap = int(count)
+
+
+# ---------------------------------------------------------------------------
+# Batch-native prescription assembly (one call per frontier round)
+# ---------------------------------------------------------------------------
+
+def racing_prescriptions_batch(
+    records: np.ndarray, lens: np.ndarray, rec_width: int,
+    size_hint: Optional[Tuple[int, int]] = None,
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """Batch racing analysis over one round's stacked lane records.
+
+    ``records`` is [batch, rmax, >=rec_width] int32 (trailing padding
+    columns are sliced off — the scan derives the parent/prev columns
+    from the LAST two of ``rec_width``); ``lens`` the per-lane trace
+    lengths. Returns ``(rows, offsets, lanes, digests)``:
+
+    - ``rows``    — [n_rows, rec_width] int32, every prescription's
+      records packed back to back (a VIEW over the scan buffer — no
+      copy of what can be megabytes per round);
+    - ``offsets`` — [n_presc + 1] int64, prescription k's rows are
+      ``rows[offsets[k]:offsets[k+1]]``;
+    - ``lanes``   — [n_presc] int32, the lane each prescription came
+      from;
+    - ``digests`` — [n_presc, 2] uint64 content digests of each block
+      (the ``prescription_digests`` key space; computed in C at O(1)
+      per pair via running prefix digests, or by the vectorized NumPy
+      pass on the fallback path).
+
+    Prescription k is a backtrack point of its lane: the delivery records
+    strictly before the race's first delivery, plus the flipped record —
+    exactly what the per-lane ``racing_prescriptions`` tuple loop used to
+    assemble, lane-major and in identical pair order (pinned by
+    tests/test_host_path.py). One native call (or one NumPy pass) serves
+    the whole round. ``size_hint=(n_presc, n_rows)`` (e.g. the previous
+    round's totals) sizes the output buffers; an overflow retries once
+    with exact sizes."""
+    records = np.ascontiguousarray(
+        np.asarray(records)[:, :, :rec_width], np.int32
+    )
+    batch, rmax, w = records.shape
+    lens = np.clip(np.asarray(lens, np.int32), 0, rmax)
+    if batch == 0 or rmax == 0:
+        return (
+            np.zeros((0, w), np.int32), np.zeros(1, np.int64),
+            np.zeros(0, np.int32), np.zeros((0, 2), np.uint64),
+        )
+    lib = _load_native()
+    if lib is None:
+        note_fallback("no native library")
+        rows, offsets, lanes = _np_racing_prescriptions(records, lens)
+        return rows, offsets, lanes, prescription_digests(rows, offsets)
+    lens = np.ascontiguousarray(lens)
+    if size_hint is not None:
+        cap_presc = max(64, int(size_hint[0]))
+        cap_rows = max(256, int(size_hint[1]))
+    else:
+        cap_presc = max(64, 4 * int(lens.sum()))
+        cap_rows = max(256, cap_presc * max(8, rmax // 4))
+    while True:
+        rows = np.empty((cap_rows, w), np.int32)
+        offsets = np.zeros(cap_presc + 1, np.int64)
+        lanes = np.empty(cap_presc, np.int32)
+        digests = np.empty((cap_presc, 2), np.uint64)
+        total_rows = ctypes.c_int64(0)
+        n = lib.demi_racing_prescriptions(
+            records.ctypes.data, lens.ctypes.data,
+            batch, rmax, w,
+            rows.ctypes.data, cap_rows,
+            offsets.ctypes.data, lanes.ctypes.data, cap_presc,
+            digests.ctypes.data,
+            ctypes.byref(total_rows),
+        )
+        if n <= cap_presc and total_rows.value <= cap_rows:
+            return (
+                rows[: total_rows.value],
+                offsets[: n + 1],
+                lanes[:n],
+                digests[:n],
+            )
+        cap_presc = max(cap_presc, int(n))
+        cap_rows = max(cap_rows, int(total_rows.value))
+
+
+def _np_racing_prescriptions(
+    records: np.ndarray, lens: np.ndarray
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Semantics-identical NumPy fallback for the batch entry point:
+    per-lane pair scans (native pair scan when only the batch symbol is
+    missing, pure Python otherwise) with prescription rows assembled by
+    array gathers — no per-record Python tuple loop."""
+    batch, rmax, w = records.shape
+    blocks = []
+    counts = [0]
+    lanes = []
+    for b in range(batch):
+        recs = records[b, : int(lens[b])]
+        pairs = racing_pair_scan(recs)
+        if len(pairs) == 0:
+            continue
+        is_delivery = np.isin(recs[:, 0], _delivery_kinds())
+        positions = np.nonzero(is_delivery)[0]
+        deliv_rows = recs[positions]
+        for i, j in pairs:
+            k = int(np.searchsorted(positions, i))
+            blocks.append(deliv_rows[:k])
+            blocks.append(recs[int(j)][None, :])
+            counts.append(k + 1)
+            lanes.append(b)
+    if not lanes:
+        return (
+            np.zeros((0, w), np.int32),
+            np.zeros(1, np.int64),
+            np.zeros(0, np.int32),
+        )
+    rows = np.concatenate(blocks, axis=0).astype(np.int32, copy=False)
+    offsets = np.cumsum(np.asarray(counts, np.int64))
+    return rows, offsets, np.asarray(lanes, np.int32)
+
+
+# ---------------------------------------------------------------------------
+# Vectorized prescription digests (explored-set membership keys)
+# ---------------------------------------------------------------------------
+
+# Order-sensitive polynomial digest over uint64 wraparound arithmetic,
+# two independent lanes => 128 bits. The block multiplier is ODD, hence
+# invertible mod 2^64: a block [s, e)'s hash
+#     h = OFF * P^(e-s) + sum_t mix(r[t]) * P^(e-1-t)
+# rewrites as OFF * P^(e-s) + P^(e-1) * (S[e] - S[s]) with
+# S = cumsum(mix(r) * Pinv^t), so every block of the packed stream is
+# digested from ONE pass of cumulative products/sums — no per-
+# prescription Python work.
+_COL_MULT = np.uint64(0x100000001B3)  # odd (FNV prime)
+_BLOCK_P = (np.uint64(0x9E3779B97F4A7C15), np.uint64(0xC2B2AE3D27D4EB4F))
+_BLOCK_OFF = (np.uint64(0xCBF29CE484222325), np.uint64(0x84222325CBF29CE4))
+_SALTS = (np.uint64(0xA0761D6478BD642F), np.uint64(0xE7037ED1A0B428DB))
+_BLOCK_PINV = tuple(
+    np.uint64(pow(int(p), -1, 1 << 64)) for p in _BLOCK_P
+)
+
+
+def _mix64(x: np.ndarray) -> np.ndarray:
+    """splitmix64 finalizer, vectorized (uint64 wraparound)."""
+    x = x + np.uint64(0x9E3779B97F4A7C15)
+    x = (x ^ (x >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
+    x = (x ^ (x >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
+    return x ^ (x >> np.uint64(31))
+
+
+def prescription_digests(rows: np.ndarray, offsets: np.ndarray) -> np.ndarray:
+    """[n_presc, 2] uint64 content digests of the packed prescription
+    stream (``rows``/``offsets`` as returned by
+    ``racing_prescriptions_batch``). Equal digests <=> equal row blocks
+    (up to 128-bit collision odds — the same trust level as the
+    blake2b-16 prefix digests that key the fork trunk cache). One
+    vectorized pass for the whole round."""
+    offsets = np.asarray(offsets, np.int64)
+    n_presc = len(offsets) - 1
+    out = np.empty((n_presc, 2), np.uint64)
+    if n_presc == 0:
+        return out
+    rows = np.asarray(rows)
+    n, w = rows.shape
+    # Per-row value: polynomial over the columns (uint64 wraparound).
+    if n:
+        col_pow = np.ones(w, np.uint64)
+        if w > 1:
+            col_pow[1:] = _COL_MULT
+        col_pow = np.cumprod(col_pow)[::-1]
+        r64 = rows.astype(np.uint32).astype(np.uint64)
+        rv = (r64 * col_pow[None, :]).sum(axis=1, dtype=np.uint64)
+    else:
+        rv = np.zeros(0, np.uint64)
+    starts, ends = offsets[:-1], offsets[1:]
+    mlen = ends - starts
+    for lane, (P, OFF, SALT, PINV) in enumerate(
+        zip(_BLOCK_P, _BLOCK_OFF, _SALTS, _BLOCK_PINV)
+    ):
+        m = _mix64(rv ^ SALT)
+        # P^t and Pinv^t for t in [0, n].
+        ppow = np.ones(n + 1, np.uint64)
+        pinv_pow = np.ones(n, np.uint64) if n else np.ones(0, np.uint64)
+        if n:
+            ppow[1:] = P
+            ppow = np.cumprod(ppow)
+            pinv_pow[1:] = PINV
+            pinv_pow = np.cumprod(pinv_pow)
+        csum = np.zeros(n + 1, np.uint64)
+        if n:
+            csum[1:] = np.cumsum(m * pinv_pow, dtype=np.uint64)
+        seg = csum[ends] - csum[starts]
+        h = OFF * ppow[mlen] + ppow[np.maximum(ends, 1) - 1] * seg
+        out[:, lane] = h
+    return out
+
+
+def prescription_digest(prescription) -> bytes:
+    """Digest of ONE prescription given as a tuple of record tuples (the
+    frontier's materialized form) — same key space as
+    ``prescription_digests`` over packed rows; used to key seeded and
+    root prescriptions into the explored-digest set."""
+    if len(prescription) == 0:
+        rows = np.zeros((0, 1), np.int32)
+    else:
+        rows = np.asarray(prescription, np.int32).reshape(
+            len(prescription), -1
+        )
+    offs = np.asarray([0, len(prescription)], np.int64)
+    return prescription_digests(rows, offs)[0].tobytes()
+
+
+def digest_keys(digests: np.ndarray) -> list:
+    """The [n, 2] uint64 digest matrix as a list of 16-byte keys — what
+    the explored-set membership check hashes on. One bulk ``tobytes``
+    plus fixed-width slicing (NOT a numpy 'S16' view, whose bytes_
+    conversion strips trailing NULs and would alias distinct digests)."""
+    n = len(digests)
+    if n == 0:
+        return []
+    buf = np.ascontiguousarray(digests, np.uint64).tobytes()
+    return [buf[i: i + 16] for i in range(0, 16 * n, 16)]

@@ -123,6 +123,147 @@ class SweepResult:
         return int(np.unique(np.concatenate(parts)).size)
 
 
+class _HarvestAccumulator:
+    """Vectorized retirement accumulation shared by the continuous sweep
+    paths (plain and autotuned): consumes per-round ``(seeds, statuses,
+    codes, hashes)`` arrays from ``ContinuousSweepDriver._run_batches``
+    and folds them with array ops — no per-lane Python loop on the
+    harvest path."""
+
+    def __init__(self):
+        self.lanes = 0
+        self.violations = 0
+        self.overflow = 0
+        self.codes: dict = {}
+        self.first_seed: Optional[int] = None
+        self.first_code: Optional[int] = None
+        self._hash_parts: List[np.ndarray] = []
+
+    def add(self, seeds, statuses, codes, hashes) -> None:
+        self.lanes += len(seeds)
+        self.overflow += int((statuses == ST_OVERFLOW).sum())
+        self._hash_parts.append(
+            np.asarray(hashes)[statuses != ST_OVERFLOW]
+        )
+        vio = codes != 0
+        if vio.any():
+            self.violations += int(vio.sum())
+            uniq, cnt = np.unique(codes[vio], return_counts=True)
+            for code, k in zip(uniq.tolist(), cnt.tolist()):
+                self.codes[int(code)] = self.codes.get(int(code), 0) + int(k)
+            if self.first_seed is None:
+                k = int(np.flatnonzero(vio)[0])
+                self.first_seed = int(seeds[k])
+                self.first_code = int(codes[k])
+
+    def unique_hashes(self) -> np.ndarray:
+        if not self._hash_parts:
+            return np.unique(np.asarray([], np.uint32))
+        return np.unique(
+            np.concatenate(self._hash_parts).astype(np.uint32, copy=False)
+        )
+
+    def chunk(self, slice_index: int, seconds: float) -> SweepChunkResult:
+        return SweepChunkResult(
+            slice_index=slice_index,
+            lanes=self.lanes,
+            violations=self.violations,
+            codes=self.codes,
+            first_violating_lane=None,  # continuous mode: no chunk-local index
+            first_violation_code=self.first_code,
+            seconds=seconds,
+            overflow_lanes=self.overflow,
+            unique_hashes=self.unique_hashes(),
+            first_violating_seed=self.first_seed,
+        )
+
+
+class _RewardBucket:
+    """Segment-boundary reward attribution for continuous autotuned
+    sweeps (the proposal-epoch bucketing that used to live in its own
+    driver copy): retirements arrive as arrays, are filtered to the
+    epoch that GENERATED them, and an epoch's ``end_round`` fires the
+    moment ``chunk_size`` of its own lanes retired — mid-array
+    boundaries split exactly where the per-item loop would have fired.
+    Nothing is ever mis-credited: a straggler whose epoch already closed
+    still counts in the sweep result but not in any reward."""
+
+    def __init__(self, controller, chunk_size: int, epoch_of_seed: dict,
+                 cur_epoch: List[int]):
+        self.controller = controller
+        self.chunk_size = chunk_size
+        self.epoch_of_seed = epoch_of_seed
+        self.cur_epoch = cur_epoch
+        self.lanes = 0
+        self.violations = 0
+        self.dropped = 0
+        self._hash_parts: List[np.ndarray] = []
+
+    def add(self, seeds, statuses, codes, hashes) -> None:
+        n = len(seeds)
+        # Generation is the ONLY moment fuzzer weights touch a lane, so
+        # the tag recorded then is exact attribution. A seed with no tag
+        # (never generated under this wrapper) defaults to the epoch
+        # current when it is PROCESSED — evaluated per split segment,
+        # exactly like the per-item loop's ``.get(seed, cur)``.
+        tags = np.fromiter(
+            (self.epoch_of_seed.get(int(s), -1) for s in seeds),
+            np.int64, n,
+        )
+        untagged = tags < 0
+        pos = 0
+        while pos < n:
+            cur = self.cur_epoch[0]
+            mine = (tags[pos:] == cur) | untagged[pos:]
+            idx_mine = np.flatnonzero(mine)
+            need = self.chunk_size - self.lanes
+            if len(idx_mine) < need:
+                take = n - pos  # bucket can't fill: consume the rest
+            else:
+                take = int(idx_mine[need - 1]) + 1  # through the filler
+            m = mine[:take]
+            sl = slice(pos, pos + take)
+            n_dropped = int((~m).sum())
+            if n_dropped:
+                self.dropped += n_dropped
+                obs.counter("tune.continuous_dropped").inc(n_dropped)
+            self.lanes += int(m.sum())
+            st, cd = statuses[sl][m], codes[sl][m]
+            self._hash_parts.append(
+                np.asarray(hashes[sl])[m][st != ST_OVERFLOW]
+            )
+            self.violations += int((cd != 0).sum())
+            if self.lanes >= self.chunk_size:
+                self._fire()
+                # The next refill's programs generate under the new
+                # proposal; already-running lanes keep their old tag.
+                self.cur_epoch[0] += 1
+                self.controller.begin_round()
+            pos += take
+
+    def _fire(self) -> None:
+        hashes = (
+            np.concatenate(self._hash_parts).tolist()
+            if self._hash_parts else []
+        )
+        self.controller.end_round(
+            hashes=hashes, violations=self.violations, lanes=self.lanes,
+        )
+        obs.counter("tune.continuous_epochs").inc()
+        self.lanes = self.violations = 0
+        self._hash_parts = []
+
+    def close(self) -> None:
+        """Close the final partial epoch — but only if it actually
+        retired lanes: scoring an empty bucket would charge the last
+        proposal a fabricated zero reward for lanes it never generated.
+        Skipping the end_round leaves that proposal un-evaluated, which
+        the WeightTuner handles (the next propose() discards the pending
+        trial without adopting it)."""
+        if self.lanes:
+            self._fire()
+
+
 class SweepDriver:
     def __init__(
         self,
@@ -209,6 +350,14 @@ class SweepDriver:
                 self.kernel = make_explore_kernel(app, cfg)
             self._align = 1
         self._cont_cache = None
+        # Host-share ledger (always on — a few clock reads per chunk):
+        # wall time on host planning/lowering/harvest accumulation vs
+        # device segments / blocked kernel waits. Continuous sweeps split
+        # exactly (the status pull is the sync point); chunked sweeps
+        # attribute the block_until_ready wait as device time. The
+        # sweep.host_share gauge and bench config 5 read this.
+        self.host_seconds = 0.0
+        self.device_seconds = 0.0
         from ..device.fork import prefix_fork_enabled
 
         self._forker = None
@@ -246,6 +395,23 @@ class SweepDriver:
     def fork_stats(self) -> Optional[dict]:
         """Prefix-fork statistics (None when forking is off)."""
         return None if self._forker is None else self._forker.stats_view()
+
+    @property
+    def host_share(self) -> Optional[float]:
+        """Fraction of sweep wall time spent host-side (None until a
+        sweep ran) — the vectorized-host-path health number."""
+        total = self.host_seconds + self.device_seconds
+        return self.host_seconds / total if total > 0 else None
+
+    def _note_share(self, host_secs: float, device_secs: float) -> None:
+        self.host_seconds += host_secs
+        self.device_seconds += device_secs
+        if obs.enabled():
+            obs.counter("sweep.host_seconds").inc(host_secs)
+            obs.counter("sweep.device_seconds").inc(device_secs)
+            share = self.host_share
+            if share is not None:
+                obs.gauge("sweep.host_share").set(share)
 
     def _programs(self, seeds: Sequence[int]):
         # Lowered per call: seeds are disjoint across chunks, so a
@@ -438,8 +604,14 @@ class SweepDriver:
     def _harvest_chunk(self, handle, slice_index: int = 0) -> SweepChunkResult:
         real, res, t0 = handle
         n_real = len(real)
+        t_block = time.perf_counter()
         jax.block_until_ready(res)
-        seconds = time.perf_counter() - t0
+        t_done = time.perf_counter()
+        seconds = t_done - t0
+        # Chunked-path host share: the blocked wait is device time, the
+        # rest of the dispatch->harvest span (lowering, fork planning,
+        # accumulation below is counted by the NEXT chunk's span) is host.
+        self._note_share(max(0.0, t_block - t0), t_done - t_block)
         lane_stats = None
         if obs.enabled():
             # Per-sweep device-lane telemetry: totals reduced ON-DEVICE
@@ -454,9 +626,9 @@ class SweepDriver:
         violations = np.asarray(res.violation)[:n_real]
         statuses = np.asarray(res.status)[:n_real]
         lanes = np.nonzero(statuses == ST_VIOLATION)[0]
+        uniq, cnt = np.unique(violations, return_counts=True)
         codes = {
-            int(c): int((violations == c).sum())
-            for c in np.unique(violations)
+            int(c): int(k) for c, k in zip(uniq.tolist(), cnt.tolist())
             if c != 0
         }
         chunk_uniq = np.unique(
@@ -543,7 +715,16 @@ class SweepDriver:
         result.wall_seconds = time.perf_counter() - t0
         return result
 
-    def _continuous_driver(self, batch: int, base_key: int = 0):
+    def _continuous_driver(
+        self, batch: int, base_key: int = 0, program_gen=None
+    ):
+        """The ONE continuous-driver constructor (batch alignment, seg
+        formula, per-seed key scheme): the plain and autotuned continuous
+        sweeps both build here, so the lane-key scheme that makes their
+        verdicts identical to ``run_chunk`` exists in exactly one copy.
+        ``program_gen`` overrides the driver's generator (the autotuned
+        path's epoch-tagging wrapper); overridden drivers bypass the
+        cache — the wrapper closes over live controller state."""
         from ..device.continuous import ContinuousSweepDriver
 
         if self.mesh is not None:
@@ -552,12 +733,13 @@ class SweepDriver:
             # costs nothing once the seed stream is longer than a batch).
             batch = ((batch + self._align - 1) // self._align) * self._align
         key = (batch, base_key)
-        if getattr(self, "_cont_cache", None) and self._cont_cache[0] == key:
-            return self._cont_cache[1]
-        seg = max(8, min(64, self.cfg.max_steps // 4))
+        if program_gen is None:
+            if getattr(self, "_cont_cache", None) and self._cont_cache[0] == key:
+                return self._cont_cache[1]
         drv = ContinuousSweepDriver(
-            self.app, self.cfg, self.program_gen, batch=batch,
-            seg_steps=seg,
+            self.app, self.cfg, program_gen or self.program_gen,
+            batch=batch,
+            seg_steps=max(8, min(64, self.cfg.max_steps // 4)),
             impl=self.impl,
             mesh=self.mesh,
             # Same per-seed key scheme as run_chunk => identical verdicts.
@@ -568,48 +750,55 @@ class SweepDriver:
                 jax.random.PRNGKey(base_key), s
             ),
         )
-        self._cont_cache = (key, drv)
+        if program_gen is None:
+            self._cont_cache = (key, drv)
         return drv
 
     def _sweep_continuous(
-        self, total_lanes: int, batch: int, stop_on_violation: bool
+        self,
+        total_lanes: int,
+        batch: int,
+        stop_on_violation: bool,
+        base_key: int = 0,
+        program_gen=None,
+        retire_hook=None,
     ) -> SweepResult:
-        drv = self._continuous_driver(batch)
-        codes: dict = {}
-        hashes: List[int] = []
-        lanes = violations = overflow = 0
-        first_seed = first_code = None
+        """Continuous sweep with vectorized harvest accumulation:
+        retirements stream back as per-round ARRAYS
+        (``_run_batches``) and fold into the result with array ops.
+        ``retire_hook(seeds, statuses, codes, hashes)`` observes every
+        accumulated retirement batch in order — the autotuned path's
+        reward attribution rides it."""
+        drv = self._continuous_driver(batch, base_key, program_gen)
+        acc = _HarvestAccumulator()
         t0 = time.perf_counter()
-        for seed, st, code, h in drv._run(total_lanes):
-            lanes += 1
-            if st == ST_OVERFLOW:
-                overflow += 1
-            else:
-                hashes.append(h)
-            if code != 0:
-                violations += 1
-                codes[code] = codes.get(code, 0) + 1
-                if first_seed is None:
-                    first_seed = seed
-                    first_code = code
-                if stop_on_violation:
+        for seeds, statuses, codes, hashes in drv._run_batches(total_lanes):
+            if stop_on_violation:
+                vio = np.flatnonzero(codes != 0)
+                if len(vio):
+                    # Stop AT the first violating retirement: lanes after
+                    # it in the same harvest round are uncounted, exactly
+                    # like the per-item loop's mid-round break.
+                    end = int(vio[0]) + 1
+                    seeds, statuses, codes, hashes = (
+                        seeds[:end], statuses[:end], codes[:end],
+                        hashes[:end],
+                    )
+                    acc.add(seeds, statuses, codes, hashes)
+                    if retire_hook is not None:
+                        retire_hook(seeds, statuses, codes, hashes)
                     break
-        chunk = SweepChunkResult(
-            slice_index=0,
-            lanes=lanes,
-            violations=violations,
-            codes=codes,
-            first_violating_lane=None,  # continuous mode has no chunk-local index
-            first_violation_code=first_code,
-            seconds=time.perf_counter() - t0,
-            overflow_lanes=overflow,
-            unique_hashes=np.unique(np.asarray(hashes, np.uint32)),
-            first_violating_seed=first_seed,
-        )
+            acc.add(seeds, statuses, codes, hashes)
+            if retire_hook is not None:
+                retire_hook(seeds, statuses, codes, hashes)
+        chunk = acc.chunk(slice_index=0, seconds=time.perf_counter() - t0)
         result = SweepResult(chunks=[chunk])
         result.occupancy = drv.last_occupancy
         # One chunk, harvested synchronously: its seconds ARE wall time.
         result.wall_seconds = chunk.seconds
+        # Host-share attribution: the driver's segment/harvest split is
+        # exact for continuous sweeps (the status pull is the sync point).
+        self._note_share(drv.last_harvest_seconds, drv.last_segment_seconds)
         return result
 
     def sweep_autotuned(
@@ -644,9 +833,34 @@ class SweepDriver:
         its reward fires land in the sweep result but not the reward
         signal (dropped, never mis-credited)."""
         if mode == "continuous":
-            return self._sweep_autotuned_continuous(
-                total_lanes, chunk_size, controller, base_key
+            # The epoch-tagged reward attribution rides the ONE shared
+            # continuous path: a generator wrapper tags each seed with
+            # the proposal epoch that generated it (generation is the
+            # only moment fuzzer weights touch a lane, so the tag is
+            # exact attribution — not an approximation), and a
+            # _RewardBucket consumes the retirement arrays via the
+            # retire_hook.
+            epoch_of_seed: dict = {}
+            cur_epoch = [0]
+
+            def tagged_gen(seed: int):
+                epoch_of_seed[seed] = cur_epoch[0]
+                return self.program_gen(seed)
+
+            bucket = _RewardBucket(
+                controller, chunk_size, epoch_of_seed, cur_epoch
             )
+            controller.begin_round()
+            result = self._sweep_continuous(
+                total_lanes, chunk_size, stop_on_violation=False,
+                base_key=base_key, program_gen=tagged_gen,
+                retire_hook=bucket.add,
+            )
+            bucket.close()
+            obs.gauge("tune.continuous_attributed").set(
+                result.lanes - bucket.dropped
+            )
+            return result
         result = SweepResult()
         t0 = time.perf_counter()
         seed = 0
@@ -668,121 +882,6 @@ class SweepDriver:
             result.chunks.append(chunk)
             seed += n
         result.wall_seconds = time.perf_counter() - t0
-        return result
-
-    def _sweep_autotuned_continuous(
-        self,
-        total_lanes: int,
-        chunk_size: int,
-        controller,
-        base_key: int = 0,
-    ) -> SweepResult:
-        """Continuous-mode autotuned sweep with segment-boundary reward
-        attribution (see ``sweep_autotuned``): lanes are tagged with the
-        proposal epoch active when their program was generated, rewards
-        are bucketed by tag as retirements stream back, and an epoch's
-        ``end_round`` fires once ``chunk_size`` of ITS lanes retired.
-        Nothing is ever mis-credited: a straggler whose epoch already
-        closed still counts in the sweep result but not in any reward."""
-        from ..device.continuous import ContinuousSweepDriver
-
-        epoch_of_seed: dict = {}
-        cur_epoch = [0]
-
-        def tagged_gen(seed: int):
-            # Generation is the ONLY moment fuzzer weights touch a lane
-            # (the program is fixed once lowered), so the tag taken here
-            # is exact attribution — not an approximation.
-            epoch_of_seed[seed] = cur_epoch[0]
-            return self.program_gen(seed)
-
-        batch = chunk_size
-        if self.mesh is not None:
-            batch = ((batch + self._align - 1) // self._align) * self._align
-        drv = ContinuousSweepDriver(
-            self.app, self.cfg, tagged_gen, batch=batch,
-            seg_steps=max(8, min(64, self.cfg.max_steps // 4)),
-            impl=self.impl,
-            mesh=self.mesh,
-            # run_chunk's key scheme => per-seed verdicts identical to the
-            # chunked autotuned loop under the same proposals.
-            key_fn=lambda s: jax.random.fold_in(
-                jax.random.PRNGKey(base_key), s
-            ),
-        )
-        codes: dict = {}
-        hashes: List[int] = []
-        lanes = violations = overflow = dropped = 0
-        first_seed = first_code = None
-        bucket_lanes = bucket_violations = 0
-        bucket_hashes: List[int] = []
-        t0 = time.perf_counter()
-        controller.begin_round()
-        for seed, st, code, h in drv._run(total_lanes):
-            lanes += 1
-            if st == ST_OVERFLOW:
-                overflow += 1
-            else:
-                hashes.append(h)
-            if code != 0:
-                violations += 1
-                codes[code] = codes.get(code, 0) + 1
-                if first_seed is None:
-                    first_seed = seed
-                    first_code = code
-            if epoch_of_seed.get(seed, cur_epoch[0]) != cur_epoch[0]:
-                # In-flight straggler from an epoch whose reward already
-                # fired: in the sweep result above, out of the signal.
-                dropped += 1
-                obs.counter("tune.continuous_dropped").inc()
-                continue
-            bucket_lanes += 1
-            if st != ST_OVERFLOW:
-                bucket_hashes.append(h)
-            if code != 0:
-                bucket_violations += 1
-            if bucket_lanes >= chunk_size:
-                controller.end_round(
-                    hashes=bucket_hashes,
-                    violations=bucket_violations,
-                    lanes=bucket_lanes,
-                )
-                obs.counter("tune.continuous_epochs").inc()
-                bucket_lanes = bucket_violations = 0
-                bucket_hashes = []
-                cur_epoch[0] += 1
-                # The next refill's programs generate under the new
-                # proposal; already-running lanes keep their old tag.
-                controller.begin_round()
-        # Close the final partial epoch — but only if it actually retired
-        # lanes: scoring an empty bucket would charge the last proposal a
-        # fabricated zero reward for lanes it never generated. Skipping
-        # the end_round leaves that proposal un-evaluated, which the
-        # WeightTuner handles (the next propose() discards the pending
-        # trial without adopting it).
-        if bucket_lanes:
-            controller.end_round(
-                hashes=bucket_hashes,
-                violations=bucket_violations,
-                lanes=bucket_lanes,
-            )
-            obs.counter("tune.continuous_epochs").inc()
-        obs.gauge("tune.continuous_attributed").set(lanes - dropped)
-        chunk = SweepChunkResult(
-            slice_index=0,
-            lanes=lanes,
-            violations=violations,
-            codes=codes,
-            first_violating_lane=None,
-            first_violation_code=first_code,
-            seconds=time.perf_counter() - t0,
-            overflow_lanes=overflow,
-            unique_hashes=np.unique(np.asarray(hashes, np.uint32)),
-            first_violating_seed=first_seed,
-        )
-        result = SweepResult(chunks=[chunk])
-        result.occupancy = drv.last_occupancy
-        result.wall_seconds = chunk.seconds
         return result
 
     def sweep_async(

@@ -163,46 +163,78 @@ def render_report(directory: str, app=None) -> str:
             if name.startswith("dpor.inflight_")
             or name == "dpor.trunk_parent_hits"
         }
-        if pipe or dpor_async:
+        # Host-share split (the vectorized host path's health number):
+        # per-driver host-vs-device seconds counters plus the *.host_share
+        # gauges set by the DPOR frontier, sweep drivers, and replay
+        # pipeline.
+        host_split = {
+            name: sum(series.values())
+            for name, series in counters.items()
+            if name in (
+                "dpor.host_seconds", "dpor.device_seconds",
+                "sweep.host_seconds", "sweep.device_seconds",
+            )
+        }
+        if pipe or dpor_async or host_split:
             lines += ["### Pipeline", ""]
 
             def _ratio(num, den):
                 return f"{num / den:.1%}" if den else "n/a"
 
-            overlap = pipe.get("pipe.overlap_seconds", 0.0)
-            wait = pipe.get("pipe.harvest_wait_seconds", 0.0)
-            lines.append(
-                f"- overlap fraction: {_ratio(overlap, overlap + wait)} "
-                f"({overlap:.2f}s planned under device execution, "
-                f"{wait:.2f}s blocked harvesting)"
-            )
-            spec_hits = pipe.get("pipe.spec_hits", 0)
-            spec_waste = pipe.get("pipe.spec_waste", 0)
-            lines.append(
-                f"- speculative lanes: {pipe.get('pipe.spec_dispatched', 0):g} "
-                f"dispatched, {spec_hits:g} hits / {spec_waste:g} wasted "
-                f"({_ratio(spec_hits, spec_hits + spec_waste)} useful)"
-            )
-            if "pipe.spec_exec_hits" in pipe or "pipe.spec_exec_waste" in pipe:
+            for driver in ("dpor", "sweep"):
+                host = host_split.get(f"{driver}.host_seconds")
+                dev = host_split.get(f"{driver}.device_seconds")
+                if host is None and dev is None:
+                    continue
+                host, dev = host or 0.0, dev or 0.0
                 lines.append(
-                    f"- speculative host executions: "
-                    f"{pipe.get('pipe.spec_exec_hits', 0):g} hits / "
-                    f"{pipe.get('pipe.spec_exec_waste', 0):g} wasted"
+                    f"- {driver} host share: {_ratio(host, host + dev)} "
+                    f"({host:.2f}s host, {dev:.2f}s device-blocked)"
                 )
-            if "pipe.window_hits" in pipe or "pipe.window_waste" in pipe:
+            pipe_share = obs_snap.get("gauges", {}).get("pipe.host_share")
+            if pipe_share:
+                for key, v in sorted(pipe_share.items()):
+                    label = f" {key}" if key else ""
+                    lines.append(
+                        f"- pipeline host share{label}: {v:.1%} (planning "
+                        f"under device execution vs blocked harvesting)"
+                    )
+
+            if pipe:
+                overlap = pipe.get("pipe.overlap_seconds", 0.0)
+                wait = pipe.get("pipe.harvest_wait_seconds", 0.0)
                 lines.append(
-                    f"- window speculation: {pipe.get('pipe.window_hits', 0):g} "
-                    f"batched trials saved a launch, "
-                    f"{pipe.get('pipe.window_waste', 0):g} discarded"
+                    f"- overlap fraction: {_ratio(overlap, overlap + wait)} "
+                    f"({overlap:.2f}s planned under device execution, "
+                    f"{wait:.2f}s blocked harvesting)"
                 )
-            gathers = pipe.get("pipe.lower_gather", 0)
-            cached = pipe.get("pipe.lower_cached", 0)
-            full = pipe.get("pipe.lower_full", 0)
-            lines.append(
-                f"- lowering cache: {_ratio(gathers + cached, gathers + cached + full)} "
-                f"hit rate ({gathers:g} gathers, {cached:g} cached, "
-                f"{full:g} full lowerings)"
-            )
+                spec_hits = pipe.get("pipe.spec_hits", 0)
+                spec_waste = pipe.get("pipe.spec_waste", 0)
+                lines.append(
+                    f"- speculative lanes: {pipe.get('pipe.spec_dispatched', 0):g} "
+                    f"dispatched, {spec_hits:g} hits / {spec_waste:g} wasted "
+                    f"({_ratio(spec_hits, spec_hits + spec_waste)} useful)"
+                )
+                if "pipe.spec_exec_hits" in pipe or "pipe.spec_exec_waste" in pipe:
+                    lines.append(
+                        f"- speculative host executions: "
+                        f"{pipe.get('pipe.spec_exec_hits', 0):g} hits / "
+                        f"{pipe.get('pipe.spec_exec_waste', 0):g} wasted"
+                    )
+                if "pipe.window_hits" in pipe or "pipe.window_waste" in pipe:
+                    lines.append(
+                        f"- window speculation: {pipe.get('pipe.window_hits', 0):g} "
+                        f"batched trials saved a launch, "
+                        f"{pipe.get('pipe.window_waste', 0):g} discarded"
+                    )
+                gathers = pipe.get("pipe.lower_gather", 0)
+                cached = pipe.get("pipe.lower_cached", 0)
+                full = pipe.get("pipe.lower_full", 0)
+                lines.append(
+                    f"- lowering cache: {_ratio(gathers + cached, gathers + cached + full)} "
+                    f"hit rate ({gathers:g} gathers, {cached:g} cached, "
+                    f"{full:g} full lowerings)"
+                )
             if dpor_async:
                 ifl = dpor_async.get("dpor.inflight_rounds", 0)
                 ifl_hits = dpor_async.get("dpor.inflight_hits", 0)

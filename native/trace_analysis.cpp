@@ -25,6 +25,14 @@
 //   past(p) = {parent, prev} ∪ past(parent) ∪ past(prev)
 //   U(p)    = past(parent) ∪ U(parent) ∪ past(prev) ∪ U(prev)
 // so the whole scan is O(n^2 / 64) words, no per-pair graph query.
+//
+// Two entry points share the scan:
+//   demi_racing_pairs          — one lane's (i, j) pairs (the original).
+//   demi_racing_prescriptions  — a whole ROUND's stacked lanes in one
+//     call, returning fully-assembled backtrack prescriptions as packed
+//     int32 rows plus per-prescription offsets (the batch-native host
+//     path: one ctypes crossing per frontier round instead of one scan
+//     per lane and one Python tuple loop per racing pair).
 
 #include <cstddef>
 #include <cstdint>
@@ -32,21 +40,46 @@
 
 namespace {
 inline bool is_delivery(int32_t kind) { return kind == 1 || kind == 2; }
+
+// 128-bit (2 x 64) content digests over prescription row blocks — the
+// explored-set membership keys. MUST match the NumPy spec in
+// demi_tpu/native/analysis.py (prescription_digests): row value is a
+// COL_MULT-base polynomial over the uint32-reinterpreted columns, mixed
+// with splitmix64 per salt lane, then folded into a P-base block
+// polynomial seeded at OFF. Parity is pinned by tests/test_host_path.py.
+constexpr uint64_t kColMult = 0x100000001B3ull;
+constexpr uint64_t kBlockP[2] = {0x9E3779B97F4A7C15ull, 0xC2B2AE3D27D4EB4Full};
+constexpr uint64_t kBlockOff[2] = {0xCBF29CE484222325ull, 0x84222325CBF29CE4ull};
+constexpr uint64_t kSalt[2] = {0xA0761D6478BD642Full, 0xE7037ED1A0B428DBull};
+
+inline uint64_t mix64(uint64_t x) {
+    x += 0x9E3779B97F4A7C15ull;
+    x = (x ^ (x >> 30)) * 0xBF58476D1CE4E5B9ull;
+    x = (x ^ (x >> 27)) * 0x94D049BB133111EBull;
+    return x ^ (x >> 31);
 }
 
-extern "C" {
+inline uint64_t row_value(const int32_t* row, int64_t w) {
+    uint64_t rv = 0;
+    for (int64_t c = 0; c < w; ++c) {
+        rv = rv * kColMult + static_cast<uint32_t>(row[c]);
+    }
+    return rv;
+}
 
-// Returns the number of racing pairs found (even if > max_pairs; only the
-// first max_pairs are written to out as (i, j) int32 pairs).
-int64_t demi_racing_pairs(const int32_t* recs, int64_t n, int64_t w,
-                          int32_t* out, int64_t max_pairs) {
-    if (n <= 0 || w < 5) return 0;
+// Happens-before bitsets for one lane: past[p] and the interposer union
+// U[p] over trace positions (see header comment). Also collects the
+// delivery-kind positions in trace order.
+void build_hb(const int32_t* recs, int64_t n, int64_t w,
+              std::vector<uint64_t>& past, std::vector<uint64_t>& interp,
+              std::vector<int64_t>& deliveries) {
     const int64_t parent_col = w - 2;
     const int64_t prev_col = w - 1;
     const int64_t words = (n + 63) / 64;
-    // past[p] and U[p] as bitsets over trace positions.
-    std::vector<uint64_t> past(static_cast<size_t>(n * words), 0);
-    std::vector<uint64_t> interp(static_cast<size_t>(n * words), 0);
+    past.assign(static_cast<size_t>(n * words), 0);
+    interp.assign(static_cast<size_t>(n * words), 0);
+    deliveries.clear();
+    deliveries.reserve(static_cast<size_t>(n));
     auto merge_edge = [&](int64_t p, int64_t q) {
         if (q < 0 || q >= p) return;
         uint64_t* pp = past.data() + p * words;
@@ -59,18 +92,30 @@ int64_t demi_racing_pairs(const int32_t* recs, int64_t n, int64_t w,
         }
         pp[q / 64] |= uint64_t(1) << (q % 64);
     };
-    std::vector<int64_t> deliveries;
-    deliveries.reserve(static_cast<size_t>(n));
     for (int64_t pos = 0; pos < n; ++pos) {
         merge_edge(pos, recs[pos * w + parent_col]);
         merge_edge(pos, recs[pos * w + prev_col]);
         if (is_delivery(recs[pos * w])) deliveries.push_back(pos);
     }
+}
+}  // namespace
+
+extern "C" {
+
+// Returns the number of racing pairs found (even if > max_pairs; only the
+// first max_pairs are written to out as (i, j) int32 pairs).
+int64_t demi_racing_pairs(const int32_t* recs, int64_t n, int64_t w,
+                          int32_t* out, int64_t max_pairs) {
+    if (n <= 0 || w < 5) return 0;
+    const int64_t words = (n + 63) / 64;
+    std::vector<uint64_t> past, interp;
+    std::vector<int64_t> deliveries;
+    build_hb(recs, n, w, past, interp, deliveries);
     int64_t count = 0;
     for (size_t jj = 0; jj < deliveries.size(); ++jj) {
         const int64_t j = deliveries[jj];
         const int32_t rcv_j = recs[j * w + 2];
-        const int64_t cj = recs[j * w + parent_col];
+        const int64_t cj = recs[j * w + (w - 2)];
         const uint64_t* uj = interp.data() + j * words;
         for (size_t ii = 0; ii < jj; ++ii) {
             const int64_t i = deliveries[ii];
@@ -85,6 +130,107 @@ int64_t demi_racing_pairs(const int32_t* recs, int64_t n, int64_t w,
         }
     }
     return count;
+}
+
+// Batch-native racing analysis: one call covers a whole frontier round.
+//
+//   recs  — [batch, rmax, w] int32 stacked lane records (row-major)
+//   lens  — [batch] int32 per-lane trace lengths
+//
+// For every lane, every racing pair (i, j) yields one backtrack
+// prescription: the lane's delivery rows strictly before i, followed by
+// row j — written as packed w-wide int32 rows into out_rows. Per-
+// prescription row extents land in out_offsets (cap_presc + 1 int64
+// entries, offsets[k]..offsets[k+1) are prescription k's rows), the
+// owning lane in out_lane, and the 2x64-bit content digest of the block
+// (the explored-set membership key — see the digest constants above) in
+// out_digests. Prescriptions are emitted lane-major, pairs in the scan
+// order of demi_racing_pairs, so the packed stream equals the per-lane
+// scans concatenated.
+//
+// Digests cost O(1) per pair: a lane's prescriptions all take prefixes
+// of the SAME position-sorted delivery list, so the per-salt running
+// prefix digests pre[k] = fold of the first k delivery rows are built
+// once per lane and a pair's block digest is pre[ii] folded with row j.
+//
+// Returns the TOTAL prescription count and writes the total row count to
+// *total_rows_out — both may exceed the caps, in which case only the
+// prescriptions that fit completely were written and the caller should
+// retry with the returned sizes.
+int64_t demi_racing_prescriptions(
+    const int32_t* recs, const int32_t* lens,
+    int64_t batch, int64_t rmax, int64_t w,
+    int32_t* out_rows, int64_t cap_rows,
+    int64_t* out_offsets, int32_t* out_lane, int64_t cap_presc,
+    uint64_t* out_digests,
+    int64_t* total_rows_out) {
+    int64_t n_presc = 0;
+    int64_t n_rows = 0;
+    if (cap_presc > 0) out_offsets[0] = 0;
+    if (w < 5) {
+        if (total_rows_out) *total_rows_out = 0;
+        return 0;
+    }
+    std::vector<uint64_t> past, interp;
+    std::vector<int64_t> deliveries;
+    std::vector<uint64_t> mix0, mix1, pre0, pre1;
+    for (int64_t b = 0; b < batch; ++b) {
+        int64_t n = lens[b];
+        if (n < 0) n = 0;
+        if (n > rmax) n = rmax;
+        if (n == 0) continue;
+        const int32_t* lane = recs + b * rmax * w;
+        const int64_t words = (n + 63) / 64;
+        build_hb(lane, n, w, past, interp, deliveries);
+        const size_t nd = deliveries.size();
+        // Per-delivery mixed row values and running prefix digests.
+        mix0.resize(nd);
+        mix1.resize(nd);
+        pre0.assign(nd + 1, kBlockOff[0]);
+        pre1.assign(nd + 1, kBlockOff[1]);
+        for (size_t t = 0; t < nd; ++t) {
+            const uint64_t rv = row_value(lane + deliveries[t] * w, w);
+            mix0[t] = mix64(rv ^ kSalt[0]);
+            mix1[t] = mix64(rv ^ kSalt[1]);
+            pre0[t + 1] = pre0[t] * kBlockP[0] + mix0[t];
+            pre1[t + 1] = pre1[t] * kBlockP[1] + mix1[t];
+        }
+        for (size_t jj = 0; jj < nd; ++jj) {
+            const int64_t j = deliveries[jj];
+            const int32_t rcv_j = lane[j * w + 2];
+            const int64_t cj = lane[j * w + (w - 2)];
+            const uint64_t* uj = interp.data() + j * words;
+            for (size_t ii = 0; ii < jj; ++ii) {
+                const int64_t i = deliveries[ii];
+                if (lane[i * w + 2] != rcv_j) continue;
+                if (cj >= i) continue;
+                if ((uj[i / 64] >> (i % 64)) & 1) continue;
+                // Prescription: deliveries[0..ii) (all deliveries before
+                // i — the list is position-sorted) plus row j.
+                const int64_t presc_rows = static_cast<int64_t>(ii) + 1;
+                if (n_presc < cap_presc && n_rows + presc_rows <= cap_rows) {
+                    int32_t* dst = out_rows + n_rows * w;
+                    for (size_t t = 0; t < ii; ++t) {
+                        const int32_t* src = lane + deliveries[t] * w;
+                        for (int64_t c = 0; c < w; ++c) dst[c] = src[c];
+                        dst += w;
+                    }
+                    const int32_t* src = lane + j * w;
+                    for (int64_t c = 0; c < w; ++c) dst[c] = src[c];
+                    out_offsets[n_presc + 1] = n_rows + presc_rows;
+                    out_lane[n_presc] = static_cast<int32_t>(b);
+                    out_digests[n_presc * 2] =
+                        pre0[ii] * kBlockP[0] + mix0[jj];
+                    out_digests[n_presc * 2 + 1] =
+                        pre1[ii] * kBlockP[1] + mix1[jj];
+                }
+                n_rows += presc_rows;
+                ++n_presc;
+            }
+        }
+    }
+    if (total_rows_out) *total_rows_out = n_rows;
+    return n_presc;
 }
 
 }  // extern "C"

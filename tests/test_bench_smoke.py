@@ -33,10 +33,31 @@ def test_bench_config2_smoke():
     assert record["metric"].startswith("interleavings/sec")
     section = record["config2"]
     for key in ("app", "batch", "rounds", "interleavings",
-                "interleavings_per_sec", "frontier", "explored", "seconds"):
+                "interleavings_per_sec", "frontier", "explored", "seconds",
+                "host_seconds", "device_seconds", "host_share",
+                "device_share"):
         assert key in section, key
     assert record["value"] == section["interleavings_per_sec"]
     assert section["interleavings"] > 0
+    if section["host_share"] is not None:
+        assert 0.0 <= section["host_share"] <= 1.0
+        assert abs(
+            section["host_share"] + section["device_share"] - 1.0
+        ) < 1e-6
+
+
+def test_bench_config5_smoke():
+    record = _run_bench("5", {"DEMI_BENCH_CONFIG5_LANES": "24"})
+    assert record["metric"].startswith("schedules/sec")
+    section = record["config5"]
+    for key in ("actors", "mode", "lanes", "schedules_per_sec",
+                "unique_schedules", "violations", "seconds",
+                "overflow_lanes", "host_seconds", "device_seconds",
+                "host_share", "device_share"):
+        assert key in section, key
+    assert section["lanes"] == 24
+    if section["host_share"] is not None:
+        assert 0.0 <= section["host_share"] <= 1.0
 
 
 def test_bench_config3_smoke():
@@ -125,16 +146,25 @@ def test_bench_config8_smoke():
                 "interleavings", "sync_seconds", "async_seconds", "speedup",
                 "sync_rounds_per_sec", "async_rounds_per_sec",
                 "explored_match", "frontier_match", "interleavings_match",
-                "explored", "frontier", "inflight", "fork"):
+                "explored", "frontier", "inflight", "fork",
+                "host_path", "host_share", "device_share"):
         assert key in section, key
     for key in ("inflight_rounds", "inflight_hits", "inflight_waste"):
         assert key in section["inflight"], key
-    for key in ("prefix_hit_rate", "parent_trunks", "steps_saved"):
+    for key in ("prefix_hit_rate", "parent_trunks", "steps_saved",
+                "mean_group_size"):
         assert key in section["fork"], key
-    # The acceptance-grade >=1.2x needs the DEEP saturated frontier
-    # (bench default); at smoke shapes only the equality contract — the
-    # async loop explores the EXACT same schedule space — is asserted.
+    for key in ("legacy_seconds", "vectorized_seconds", "speedup",
+                "wall_speedup", "legacy_host_seconds",
+                "vectorized_host_seconds", "match",
+                "legacy_host_share", "vectorized_host_share"):
+        assert key in section["host_path"], key
+    # The acceptance-grade >=1.2x (async) and >=1.3x (host path) need
+    # the DEEP saturated frontier (bench default); at smoke shapes only
+    # the equality contracts — the async loop AND the vectorized host
+    # path explore the EXACT same schedule space — are asserted.
     assert section["explored_match"] is True
     assert section["frontier_match"] is True
     assert section["interleavings_match"] is True
+    assert section["host_path"]["match"] is True
     assert section["interleavings"] > 0

@@ -296,6 +296,9 @@ def test_cli_fuzz_trace_out_and_stats(tmp_path, capsys):
     # The experiment dir carries the registry snapshot...
     snap = json.loads((exp / "obs_snapshot.json").read_text())
     assert snap["counters"]["device.lane.lanes"]["driver=sweep"] > 0
+    # ...including the host-share split of the confirm sweep.
+    assert "sweep.host_share" in snap["gauges"]
+    assert 0.0 <= snap["gauges"]["sweep.host_share"][""] <= 1.0
 
     # ...which `demi_tpu stats -e` prints...
     capsys.readouterr()  # drain the fuzz command's output
@@ -304,13 +307,16 @@ def test_cli_fuzz_trace_out_and_stats(tmp_path, capsys):
     printed = json.loads(capsys.readouterr().out)
     assert printed["counters"]["fuzz.programs_generated"][""] >= 1
     assert "device.lane.lanes" in printed["counters"]
+    assert "sweep.host_share" in printed["gauges"]
 
-    # ...and `demi_tpu report` renders as a Telemetry section.
+    # ...and `demi_tpu report` renders as a Telemetry section, host
+    # share included in the Pipeline block.
     from demi_tpu.tools.report import render_report
 
     text = render_report(str(exp))
     assert "## Telemetry" in text
     assert "device.lane.lanes" in text
+    assert "sweep host share" in text
 
 
 def test_cli_stats_merges_inputs(tmp_path, capsys):
