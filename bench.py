@@ -384,6 +384,61 @@ def bench_config4(jax):
     }
 
 
+def _static_prune_ab(app, cfg, program, batch, rounds, kernel, presc=None):
+    """Static-commutativity A/B on one DPOR fixture (configs 2/8): run
+    the identical frontier search with the static relation disabled vs
+    enabled (audit mode, so every pruned prescription is materialized)
+    and assert that pruning only removed true no-ops:
+
+      - interleavings bit-identical (pruned entries are leaves the
+        deepest-first selection never reached, so round batches match);
+      - the pruned run's explored set / frontier are the unpruned run's
+        MINUS exactly (a subset of) the audited no-op prescriptions —
+        nothing else may move.
+
+    Returns the static_pruned counts for the bench JSON, next to the
+    redundant/distance-pruned numbers the obs counters carry."""
+    from demi_tpu.analysis import StaticIndependence
+    from demi_tpu.device.dpor_sweep import DeviceDPOR
+
+    def run(rel):
+        d = DeviceDPOR(
+            app, cfg, program, batch_size=batch, prefix_fork=False,
+            double_buffer=False, kernel=kernel,
+            static_independence=rel if rel is not None else False,
+        )
+        if presc is not None:
+            d.seed(presc)
+        d.explore(max_rounds=rounds)
+        return d
+
+    base = run(None)
+    rel = StaticIndependence.for_app(app, audit=True)
+    pruned = run(rel)
+    pruned_set = set(rel.pruned_prescriptions)
+    assert base.interleavings == pruned.interleavings, (
+        base.interleavings, pruned.interleavings
+    )
+    extra = pruned.explored - base.explored
+    removed = base.explored - pruned.explored
+    assert not extra, f"static pruning ADDED {len(extra)} prescriptions"
+    assert removed <= pruned_set, (
+        "static pruning removed a prescription it cannot prove no-op"
+    )
+    f_removed = set(base.frontier) - set(pruned.frontier)
+    f_extra = set(pruned.frontier) - set(base.frontier)
+    assert f_removed <= pruned_set and not f_extra
+    return {
+        "static_pruned": dict(rel.pruned_total),
+        "explored_without": len(base.explored),
+        "explored_with": len(pruned.explored),
+        "removed_prescriptions": len(removed),
+        "interleavings_match": True,
+        "noop_only": True,
+        "commuting_tag_pairs": rel.summary().get("commuting_tag_pairs"),
+    }
+
+
 def bench_config2(jax):
     """BASELINE config 2: DeviceDPOR frontier search on a raft-class app —
     systematic batched backtracking, measured as interleavings/sec over
@@ -423,10 +478,18 @@ def bench_config2(jax):
     secs = time.perf_counter() - t0
     measured = dpor.interleavings - before
     share = dpor.host_share
+    # Static-commutativity A/B (disabled vs enabled, no-op-only
+    # asserted) on the same fixture + compiled kernel.
+    static = _static_prune_ab(
+        app, cfg, program, batch,
+        rounds=int(os.environ.get("DEMI_BENCH_STATIC_ROUNDS", 2)),
+        kernel=dpor.kernel,
+    )
     return {
         "app": "raft3",
         "batch": batch,
         "rounds": rounds,
+        "static": static,
         "interleavings": dpor.interleavings,
         "interleavings_per_sec": round(measured / secs, 1) if secs > 0 else None,
         "frontier": len(dpor.frontier),
@@ -1062,6 +1125,14 @@ def bench_config8(jax):
     a_share = (
         min(1.0, s_dpor.host_seconds / async_secs) if async_secs else None
     )
+    # Static-commutativity A/B on the SEEDED deep fixture (disabled vs
+    # enabled, no-op-only asserted) — static_pruned lands next to the
+    # redundant/distance-pruned counters the obs snapshot carries.
+    static = _static_prune_ab(
+        app, cfg, program, batch,
+        rounds=int(os.environ.get("DEMI_BENCH_STATIC_ROUNDS", 2)),
+        kernel=kernel, presc=presc,
+    )
     return {
         "app": f"raft{nodes}",
         "seed_deliveries": best,
@@ -1069,6 +1140,7 @@ def bench_config8(jax):
         "rounds": rounds,
         "warm_rounds": warm,
         "reps": reps,
+        "static": static,
         "interleavings": measured,
         "sync_seconds": round(sync_secs, 3),
         "async_seconds": round(async_secs, 3),

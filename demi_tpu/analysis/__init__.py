@@ -1,0 +1,65 @@
+"""Static analysis subsystem: determinism lint, static commutativity,
+and the runtime replay sanitizer.
+
+Three coupled passes over app code (the bundled zoo, bridge apps, and
+arbitrary user modules):
+
+  1. ``lint`` — an AST pass over actor handler functions flagging
+     replay-breakers (wall clocks, unseeded randomness, id()-keyed
+     ordering, set-iteration order, module-level mutable state, in-place
+     message mutation, thread spawning, blocking I/O), suppressible via
+     ``# demi: allow(<rule>)``. CLI: ``demi_tpu lint``.
+  2. ``effects`` / ``independence`` — per-(actor, message-tag)
+     read/write field-set extraction from handler ASTs, composed into
+     the conservative ``StaticIndependence`` may-commute relation that
+     DeviceDPOR, the host DPORScheduler, and the batch-native racing
+     scan consume to skip provably-no-op racing pairs
+     (``analysis.static_pruned`` counters; ``DEMI_STATIC_PRUNE=1``).
+  3. ``sanitize`` — the ``DEMI_SANITIZE=1`` runtime sanitizer wrapping
+     handler dispatch: message digests before/after delivery catch the
+     in-place mutation the lint only suspects; time/random traps reject
+     nondeterminism during strict replay.
+"""
+
+from .effects import (
+    ActorEffects,
+    AppEffects,
+    EffectSet,
+    analyze_actor_class,
+    analyze_dsl_app,
+    effects_commute,
+)
+from .independence import StaticIndependence, static_prune_enabled
+from .lint import (
+    DEFAULT_TARGETS,
+    LintFinding,
+    has_errors,
+    lint_file,
+    lint_source,
+    lint_targets,
+    render_json,
+    render_text,
+)
+from .rules import RULES
+from . import sanitize
+
+__all__ = [
+    "ActorEffects",
+    "AppEffects",
+    "DEFAULT_TARGETS",
+    "EffectSet",
+    "LintFinding",
+    "RULES",
+    "StaticIndependence",
+    "analyze_actor_class",
+    "analyze_dsl_app",
+    "effects_commute",
+    "has_errors",
+    "lint_file",
+    "lint_source",
+    "lint_targets",
+    "render_json",
+    "render_text",
+    "sanitize",
+    "static_prune_enabled",
+]

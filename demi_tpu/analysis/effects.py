@@ -1,0 +1,1231 @@
+"""Static read/write field-set extraction from handler ASTs.
+
+The commutativity input DPOR wants (Quasi-Optimal POR's independence
+relation, arXiv:1802.03950; the event-driven tailoring of
+arXiv:2307.15930) is *per (actor-class, message-type)*: which state
+fields may a handler read / write when dispatched on each message tag?
+Two deliveries to the same actor provably commute when neither's writes
+intersect the other's reads-or-writes — with one refinement: fields that
+both sides only ever |=-accumulate (monotone bitmask joins like raft's
+HEARD discovery mask) commute with each other even though both "write".
+
+Extraction is an abstract interpretation of the handler's Python source:
+
+  - DSL apps (jax-traced handlers): the actual function object's closure
+    cells + globals resolve the symbolic state-layout constants (ROLE,
+    NEXT = LOG_START + 2 * log_cap, ...), ``jax.lax.switch(tag, branches,
+    ...)`` splits the analysis per message tag, and the dual-tier index
+    helpers (vget/vset/vgather/seg_set) plus jnp.where/clip/... are
+    interpreted over a small domain: integer ranges, state-shaped values
+    carrying their accumulated writes, and opaque values carrying the
+    fields read to compute them. ``jnp.clip``-bounded dynamic indices
+    stay finite ranges, so a log-region gather reads the log region, not
+    the whole state vector.
+  - host Actor classes: attribute-level effects of ``receive``, split
+    per message type when the method body is a top-level dispatch chain
+    on ``msg[0] == <const>`` / ``isinstance(msg, T)``.
+
+Unsoundness is impossible by construction: any construct the interpreter
+does not understand degrades that component to UNKNOWN, and UNKNOWN
+conflicts with everything (unknown => dependent). An analysis that
+crashes entirely yields ``AppEffects.unknown()`` — a relation that never
+declares anything independent.
+"""
+
+from __future__ import annotations
+
+import ast
+import inspect
+import textwrap
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, FrozenSet, List, Optional, Tuple
+
+#: UNKNOWN field set — conflicts with everything.
+UNKNOWN = None
+
+FieldSet = Optional[FrozenSet]  # None = UNKNOWN (all fields)
+
+
+def fs_union(a: FieldSet, b: FieldSet) -> FieldSet:
+    if a is UNKNOWN or b is UNKNOWN:
+        return UNKNOWN
+    return a | b
+
+
+def fs_overlap(a: FieldSet, b: FieldSet) -> bool:
+    if a is UNKNOWN or b is UNKNOWN:
+        return bool(a) if b is UNKNOWN else bool(b) if a is UNKNOWN else True
+    return bool(a & b)
+
+
+@dataclass(frozen=True)
+class EffectSet:
+    """Per-(handler, message-type) field effects. ``or_writes`` are
+    fields ONLY ever written as ``f |= expr`` (with expr not reading f
+    beyond that self-term); they commute among themselves."""
+
+    reads: FieldSet = frozenset()
+    writes: FieldSet = frozenset()
+    or_writes: FrozenSet = frozenset()
+
+    @classmethod
+    def unknown(cls) -> "EffectSet":
+        return cls(reads=UNKNOWN, writes=UNKNOWN, or_writes=frozenset())
+
+    def is_unknown(self) -> bool:
+        return self.reads is UNKNOWN or self.writes is UNKNOWN
+
+    def union(self, other: "EffectSet") -> "EffectSet":
+        """Conservative merge of two control-flow branches. A field
+        or-written on one path and plainly written on the other must
+        degrade to a plain write."""
+        plain = fs_union(self.writes, other.writes)
+        orw = self.or_writes | other.or_writes
+        if plain is not UNKNOWN:
+            orw = orw - plain
+        else:
+            orw = frozenset()
+        return EffectSet(
+            reads=fs_union(self.reads, other.reads), writes=plain,
+            or_writes=orw,
+        )
+
+    def to_json(self) -> Dict:
+        return {
+            "reads": sorted(self.reads) if self.reads is not UNKNOWN else "unknown",
+            "writes": sorted(self.writes) if self.writes is not UNKNOWN else "unknown",
+            "or_writes": sorted(self.or_writes),
+        }
+
+
+def effects_commute(a: EffectSet, b: EffectSet) -> bool:
+    """May deliveries with effects ``a`` and ``b`` to the same actor be
+    flipped without changing the reachable state? Sound conservative
+    check: plain writes conflict with everything; or-accumulations
+    conflict with reads and plain writes but commute with each other."""
+    if a.is_unknown() or b.is_unknown():
+        return False
+    if fs_overlap(a.writes, fs_union(b.reads, fs_union(b.writes, b.or_writes))):
+        return False
+    if fs_overlap(b.writes, fs_union(a.reads, fs_union(a.writes, a.or_writes))):
+        return False
+    if fs_overlap(a.or_writes, b.reads) or fs_overlap(b.or_writes, a.reads):
+        return False
+    return True
+
+
+# ---------------------------------------------------------------------------
+# Abstract values
+# ---------------------------------------------------------------------------
+
+class AbsVal:
+    reads: FieldSet = frozenset()
+
+
+@dataclass(frozen=True)
+class Rng(AbsVal):
+    """Integer in [lo, hi] (inclusive), plus the state fields read to
+    compute it."""
+
+    lo: int
+    hi: int
+    reads: FieldSet = frozenset()
+
+    @property
+    def const(self) -> Optional[int]:
+        return self.lo if self.lo == self.hi else None
+
+
+@dataclass(frozen=True)
+class Opaque(AbsVal):
+    """Any non-state value; ``length`` tracks 1-D vector length when
+    statically known (seg_set write extents)."""
+
+    reads: FieldSet = frozenset()
+    length: Optional[int] = None
+
+
+@dataclass(frozen=True)
+class Py(AbsVal):
+    """A resolved Python constant/object from the closure environment
+    (bug-flag strings, layout ints, helper function objects, modules)."""
+
+    value: Any
+    reads: FieldSet = frozenset()
+
+
+@dataclass(frozen=True)
+class SVal(AbsVal):
+    """A state-shaped value: the original state vector with ``writes``
+    possibly modified (``or_writes`` only by |=), computed by reading
+    ``reads``."""
+
+    writes: FieldSet = frozenset()
+    or_writes: FrozenSet = frozenset()
+    reads: FieldSet = frozenset()
+    width: Optional[int] = None
+
+
+@dataclass(frozen=True)
+class TupleVal(AbsVal):
+    items: Tuple[AbsVal, ...] = ()
+
+    @property
+    def reads(self) -> FieldSet:  # type: ignore[override]
+        out: FieldSet = frozenset()
+        for it in self.items:
+            out = fs_union(out, it.reads)
+        return out
+
+
+def _reads_of(v: AbsVal) -> FieldSet:
+    return v.reads
+
+
+def _merge_vals(a: AbsVal, b: AbsVal, extra_reads: FieldSet) -> AbsVal:
+    """Control-flow join (jnp.where / unresolved `if`)."""
+    if isinstance(a, SVal) and isinstance(b, SVal):
+        eff = EffectSet(frozenset(), a.writes, a.or_writes).union(
+            EffectSet(frozenset(), b.writes, b.or_writes)
+        )
+        return SVal(
+            writes=eff.writes, or_writes=eff.or_writes,
+            reads=fs_union(extra_reads, fs_union(a.reads, b.reads)),
+            width=a.width if a.width == b.width else None,
+        )
+    if isinstance(a, SVal) or isinstance(b, SVal):
+        # One side replaces the state wholesale with a non-state value.
+        sv = a if isinstance(a, SVal) else b
+        other = b if isinstance(a, SVal) else a
+        return SVal(
+            writes=UNKNOWN, or_writes=frozenset(),
+            reads=fs_union(extra_reads, fs_union(sv.reads, other.reads)),
+            width=sv.width,
+        )
+    if isinstance(a, Rng) and isinstance(b, Rng):
+        return Rng(
+            min(a.lo, b.lo), max(a.hi, b.hi),
+            fs_union(extra_reads, fs_union(a.reads, b.reads)),
+        )
+    la = a.length if isinstance(a, Opaque) else None
+    lb = b.length if isinstance(b, Opaque) else None
+    return Opaque(
+        fs_union(extra_reads, fs_union(_reads_of(a), _reads_of(b))),
+        length=la if la == lb else None,
+    )
+
+
+class _Bail(Exception):
+    """Abort the whole analysis -> EffectSet.unknown()."""
+
+
+_MAX_DEPTH = 10
+_PURE_ARRAY_FNS = {
+    "where", "stack", "concatenate", "sum", "any", "all", "max", "min",
+    "maximum", "minimum", "abs", "arange", "reshape", "astype", "clip",
+    "full", "zeros", "ones", "zeros_like", "ones_like", "int32", "bool_",
+    "asarray", "array", "logical_and", "logical_or", "logical_not",
+    "equal", "not_equal", "eye", "argmax", "argmin", "cumsum", "prod",
+}
+
+
+class _Frame:
+    def __init__(self, env: Dict[str, Any], depth: int):
+        self.locals: Dict[str, AbsVal] = {}
+        self.ast_defs: Dict[str, ast.expr] = {}
+        self.env = env
+        self.depth = depth
+        self.returns: List[AbsVal] = []
+
+
+def _fn_env(fn: Callable) -> Dict[str, Any]:
+    env = dict(fn.__globals__)
+    code = fn.__code__
+    if fn.__closure__:
+        env.update(
+            {
+                name: cell.cell_contents
+                for name, cell in zip(code.co_freevars, fn.__closure__)
+            }
+        )
+    return env
+
+
+def _fn_ast(fn: Callable) -> ast.FunctionDef:
+    src = textwrap.dedent(inspect.getsource(fn))
+    tree = ast.parse(src)
+    node = tree.body[0]
+    if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        raise _Bail(f"not a function def: {fn!r}")
+    return node
+
+
+class _Interp:
+    """The per-function abstract interpreter (see module docstring)."""
+
+    def __init__(self):
+        self._stack: List[Callable] = []
+
+    # -- function-level entry ---------------------------------------------
+    def run_fn(self, fn: Callable, args: List[AbsVal],
+               kw: Optional[Dict[str, AbsVal]] = None) -> AbsVal:
+        if len(self._stack) >= _MAX_DEPTH or fn in self._stack:
+            raise _Bail("recursion/depth limit")
+        node = _fn_ast(fn)
+        frame = _Frame(_fn_env(fn), len(self._stack))
+        params = [a.arg for a in node.args.posonlyargs + node.args.args]
+        kw = dict(kw or {})
+        if any(k not in params for k in kw):
+            raise _Bail(f"unmatched keyword args calling {fn.__name__}")
+        defaults = node.args.defaults
+        for i, p in enumerate(params):
+            if i < len(args):
+                frame.locals[p] = args[i]
+            elif p in kw:
+                frame.locals[p] = kw[p]
+            else:
+                # Unfilled default -> evaluate it in the frame (constants
+                # like a=0) or degrade to opaque.
+                di = i - (len(params) - len(defaults))
+                if 0 <= di < len(defaults):
+                    frame.locals[p] = self.eval(defaults[di], frame)
+                else:
+                    frame.locals[p] = Opaque()
+        self._stack.append(fn)
+        try:
+            self.exec_block(node.body, frame)
+        finally:
+            self._stack.pop()
+        if not frame.returns:
+            return Opaque()
+        out = frame.returns[0]
+        for r in frame.returns[1:]:
+            out = _merge_vals(out, r, frozenset())
+        return out
+
+    # -- statements --------------------------------------------------------
+    def exec_block(self, stmts: List[ast.stmt], frame: _Frame) -> None:
+        for st in stmts:
+            self.exec_stmt(st, frame)
+
+    def exec_stmt(self, st: ast.stmt, frame: _Frame) -> None:
+        if isinstance(st, ast.Assign):
+            val = self.eval(st.value, frame)
+            for tgt in st.targets:
+                self._bind(tgt, val, st.value, frame)
+        elif isinstance(st, ast.AnnAssign):
+            if st.value is not None:
+                self._bind(st.target, self.eval(st.value, frame), st.value, frame)
+        elif isinstance(st, ast.AugAssign):
+            synth = ast.BinOp(left=st.target, op=st.op, right=st.value)
+            ast.copy_location(synth, st)
+            ast.fix_missing_locations(synth)
+            self._bind(st.target, self.eval(synth, frame), synth, frame)
+        elif isinstance(st, ast.Return):
+            if st.value is not None:
+                frame.returns.append(self.eval(st.value, frame))
+        elif isinstance(st, ast.If):
+            cond = self.eval(st.test, frame)
+            if isinstance(cond, Py) and isinstance(cond.value, bool):
+                self.exec_block(st.body if cond.value else st.orelse, frame)
+                return
+            before = dict(frame.locals)
+            self.exec_block(st.body, frame)
+            after_then = frame.locals
+            frame.locals = dict(before)
+            self.exec_block(st.orelse, frame)
+            merged: Dict[str, AbsVal] = {}
+            for name in set(after_then) | set(frame.locals):
+                a, b = after_then.get(name), frame.locals.get(name)
+                if a is None or b is None:
+                    merged[name] = a if a is not None else b  # type: ignore
+                else:
+                    merged[name] = _merge_vals(a, b, _reads_of(cond))
+            frame.locals = merged
+        elif isinstance(st, (ast.Expr, ast.Pass)):
+            if isinstance(st, ast.Expr):
+                self.eval(st.value, frame)
+        elif isinstance(st, (ast.For, ast.While)):
+            # Loops are outside the modeled subset: a single body pass
+            # misses writes through loop-carried index variables
+            # (`i = START; for _: vset(state, i, ..); i += 1` would
+            # analyze to writes={START} only), and a sound fixed point
+            # needs widening this domain doesn't have. Zoo handlers are
+            # loop-free jax dataflow; anything else degrades to UNKNOWN.
+            raise _Bail("loops are not modeled (unknown => dependent)")
+        elif isinstance(st, ast.Assert):
+            self.eval(st.test, frame)
+        elif isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # Nested defs used as values (rare in handlers) — opaque.
+            frame.locals[st.name] = Opaque()
+        else:
+            raise _Bail(f"unsupported statement {type(st).__name__}")
+
+    def _bind(self, tgt: ast.expr, val: AbsVal, src_ast: Optional[ast.expr],
+              frame: _Frame) -> None:
+        if isinstance(tgt, ast.Name):
+            frame.locals[tgt.id] = val
+            if src_ast is not None:
+                frame.ast_defs[tgt.id] = src_ast
+        elif isinstance(tgt, (ast.Tuple, ast.List)):
+            items = (
+                list(val.items)
+                if isinstance(val, TupleVal)
+                else [Opaque(_reads_of(val))] * len(tgt.elts)
+            )
+            if len(items) != len(tgt.elts):
+                items = [Opaque(_reads_of(val))] * len(tgt.elts)
+            for t, v in zip(tgt.elts, items):
+                self._bind(t, v, None, frame)
+        elif isinstance(tgt, ast.Starred):
+            self._bind(tgt.value, Opaque(_reads_of(val)), None, frame)
+        else:
+            raise _Bail(f"unsupported bind target {type(tgt).__name__}")
+
+    # -- expressions -------------------------------------------------------
+    def eval(self, node: ast.expr, frame: _Frame) -> AbsVal:
+        if isinstance(node, ast.Constant):
+            if isinstance(node.value, bool):
+                return Py(node.value)
+            if isinstance(node.value, int):
+                return Rng(node.value, node.value)
+            return Py(node.value)
+        if isinstance(node, ast.Name):
+            if node.id in frame.locals:
+                return frame.locals[node.id]
+            if node.id in frame.env:
+                return self._lift(frame.env[node.id])
+            return Opaque()
+        if isinstance(node, ast.Attribute):
+            base = self.eval(node.value, frame)
+            if isinstance(base, Py):
+                try:
+                    return self._lift(getattr(base.value, node.attr))
+                except AttributeError:
+                    return Opaque(base.reads)
+            return Opaque(_reads_of(base))
+        if isinstance(node, ast.Subscript):
+            return self._eval_subscript(node, frame)
+        if isinstance(node, ast.BinOp):
+            return self._eval_binop(node, frame)
+        if isinstance(node, ast.UnaryOp):
+            v = self.eval(node.operand, frame)
+            if isinstance(node.op, ast.USub) and isinstance(v, Rng):
+                return Rng(-v.hi, -v.lo, v.reads)
+            return Opaque(_reads_of(v))
+        if isinstance(node, ast.BoolOp):
+            reads: FieldSet = frozenset()
+            for sub in node.values:
+                reads = fs_union(reads, _reads_of(self.eval(sub, frame)))
+            return Opaque(reads)
+        if isinstance(node, ast.Compare):
+            left = self.eval(node.left, frame)
+            rights = [self.eval(c, frame) for c in node.comparators]
+            if (
+                isinstance(left, Py)
+                and len(rights) == 1
+                and isinstance(rights[0], Py)
+                and len(node.ops) == 1
+                and isinstance(node.ops[0], (ast.Eq, ast.NotEq, ast.Is, ast.IsNot))
+            ):
+                eq = left.value == rights[0].value if isinstance(
+                    node.ops[0], (ast.Eq, ast.Is)
+                ) else left.value != rights[0].value
+                return Py(bool(eq))
+            reads = _reads_of(left)
+            for r in rights:
+                reads = fs_union(reads, _reads_of(r))
+            return Opaque(reads)
+        if isinstance(node, (ast.Tuple, ast.List)):
+            return TupleVal(tuple(self.eval(e, frame) for e in node.elts))
+        if isinstance(node, ast.Call):
+            return self._eval_call(node, frame)
+        if isinstance(node, ast.IfExp):
+            cond = self.eval(node.test, frame)
+            if isinstance(cond, Py) and isinstance(cond.value, bool):
+                return self.eval(node.body if cond.value else node.orelse, frame)
+            return _merge_vals(
+                self.eval(node.body, frame), self.eval(node.orelse, frame),
+                _reads_of(cond),
+            )
+        if isinstance(node, ast.JoinedStr):
+            return Opaque()
+        if isinstance(node, ast.Lambda):
+            return Opaque()
+        if isinstance(node, ast.Slice):
+            reads: FieldSet = frozenset()
+            for part in (node.lower, node.upper, node.step):
+                if part is not None:
+                    reads = fs_union(reads, _reads_of(self.eval(part, frame)))
+            return Opaque(reads)
+        raise _Bail(f"unsupported expression {type(node).__name__}")
+
+    def _lift(self, value: Any) -> AbsVal:
+        if isinstance(value, bool):
+            return Py(value)
+        if isinstance(value, int):
+            return Rng(value, value)
+        return Py(value)
+
+    def _eval_subscript(self, node: ast.Subscript, frame: _Frame) -> AbsVal:
+        base = self.eval(node.value, frame)
+        sl = node.slice
+        if isinstance(base, SVal):
+            if isinstance(sl, ast.Slice):
+                lo = self.eval(sl.lower, frame) if sl.lower else Rng(0, 0)
+                hi = self.eval(sl.upper, frame) if sl.upper else None
+                if (
+                    isinstance(lo, Rng) and lo.const is not None
+                    and hi is not None and isinstance(hi, Rng)
+                    and hi.const is not None and sl.step is None
+                ):
+                    fields = frozenset(range(lo.const, hi.const))
+                    return Opaque(
+                        fs_union(base.reads, fields),
+                        length=hi.const - lo.const,
+                    )
+                return Opaque(UNKNOWN)
+            idx = self.eval(sl, frame)
+            if isinstance(idx, Rng):
+                fields = frozenset(range(idx.lo, idx.hi + 1))
+                return Opaque(
+                    fs_union(fs_union(base.reads, idx.reads), fields)
+                )
+            if isinstance(idx, TupleVal) or idx is None:
+                return Opaque(UNKNOWN)
+            # [None] reshape of a state-derived scalar etc.
+            if isinstance(sl, ast.Constant) and sl.value is None:
+                return Opaque(base.reads)
+            return Opaque(UNKNOWN)
+        if isinstance(base, Py):
+            idx = self.eval(sl, frame)
+            if isinstance(idx, Rng) and idx.const is not None:
+                try:
+                    return self._lift(base.value[idx.const])
+                except Exception:
+                    return Opaque(idx.reads)
+            return Opaque(fs_union(base.reads, _reads_of(idx)))
+        if isinstance(base, TupleVal):
+            idx = self.eval(sl, frame)
+            if isinstance(idx, Rng) and idx.const is not None and (
+                0 <= idx.const < len(base.items)
+            ):
+                return base.items[idx.const]
+            return Opaque(base.reads)
+        idx_reads: FieldSet = frozenset()
+        if not isinstance(sl, ast.Slice):
+            idx_reads = _reads_of(self.eval(sl, frame))
+        else:
+            for part in (sl.lower, sl.upper, sl.step):
+                if part is not None:
+                    idx_reads = fs_union(
+                        idx_reads, _reads_of(self.eval(part, frame))
+                    )
+        return Opaque(fs_union(_reads_of(base), idx_reads))
+
+    def _eval_binop(self, node: ast.BinOp, frame: _Frame) -> AbsVal:
+        left = self.eval(node.left, frame)
+        right = self.eval(node.right, frame)
+        if isinstance(left, Rng) and isinstance(right, Rng):
+            reads = fs_union(left.reads, right.reads)
+            if isinstance(node.op, ast.Add):
+                return Rng(left.lo + right.lo, left.hi + right.hi, reads)
+            if isinstance(node.op, ast.Sub):
+                return Rng(left.lo - right.hi, left.hi - right.lo, reads)
+            if isinstance(node.op, ast.Mult):
+                corners = [
+                    a * b
+                    for a in (left.lo, left.hi)
+                    for b in (right.lo, right.hi)
+                ]
+                return Rng(min(corners), max(corners), reads)
+        if isinstance(left, Py) and isinstance(right, Py):
+            try:
+                if isinstance(node.op, ast.Add):
+                    return self._lift(left.value + right.value)
+                if isinstance(node.op, ast.Mod):
+                    return self._lift(left.value % right.value)
+            except Exception:
+                pass
+        return Opaque(fs_union(_reads_of(left), _reads_of(right)))
+
+    # -- calls -------------------------------------------------------------
+    def _eval_call(self, node: ast.Call, frame: _Frame) -> AbsVal:
+        fname = self._func_name(node.func)
+        args = [self.eval(a, frame) for a in node.args]
+        kw = {k.arg: self.eval(k.value, frame) for k in node.keywords if k.arg}
+
+        if fname == "vset":
+            return self._do_vset(node, args, kw, frame)
+        if fname == "seg_set":
+            return self._do_seg_set(args)
+        if fname == "row_set":
+            return Opaque(self._args_reads(args, kw))
+        if fname in ("vget", "vgather"):
+            if args and isinstance(args[0], SVal):
+                base, idx = args[0], args[1] if len(args) > 1 else Opaque(UNKNOWN)
+                if isinstance(idx, Rng):
+                    fields = frozenset(range(idx.lo, idx.hi + 1))
+                    return Opaque(
+                        fs_union(fs_union(base.reads, idx.reads), fields)
+                    )
+                return Opaque(UNKNOWN)
+            return Opaque(self._args_reads(args, kw))
+        if fname == "clip":
+            return self._do_clip(args, kw)
+        if fname in ("maximum", "minimum", "max", "min") and len(args) == 2:
+            if isinstance(args[0], Rng) and isinstance(args[1], Rng):
+                a, b = args[0], args[1]
+                reads = fs_union(a.reads, b.reads)
+                if fname in ("maximum", "max"):
+                    return Rng(max(a.lo, b.lo), max(a.hi, b.hi), reads)
+                return Rng(min(a.lo, b.lo), min(a.hi, b.hi), reads)
+        if fname == "where" and len(args) == 3:
+            if isinstance(args[1], SVal) or isinstance(args[2], SVal):
+                return _merge_vals(args[1], args[2], _reads_of(args[0]))
+            la = args[1].length if isinstance(args[1], Opaque) else None
+            lb = args[2].length if isinstance(args[2], Opaque) else None
+            return Opaque(
+                self._args_reads(args, kw), length=la if la == lb else None
+            )
+        if fname in ("full", "zeros", "ones"):
+            length = self._shape_len(node.args[0] if node.args else None, frame)
+            return Opaque(self._args_reads(args, kw), length=length)
+        if fname in ("zeros_like", "ones_like"):
+            src = args[0] if args else Opaque()
+            length = src.length if isinstance(src, Opaque) else None
+            return Opaque(self._args_reads(args, kw), length=length)
+        if fname in ("int32", "bool_", "asarray", "array", "astype"):
+            if len(args) == 1:
+                return args[0]
+            return Opaque(self._args_reads(args, kw))
+        if fname in _PURE_ARRAY_FNS:
+            if any(isinstance(a, SVal) for a in args) or any(
+                isinstance(v, SVal) for v in kw.values()
+            ):
+                # A state vector flowing through an un-modeled array op:
+                # whatever comes out read everything we can't bound.
+                return Opaque(UNKNOWN)
+            return Opaque(self._args_reads(args, kw))
+
+        # Method-style calls on abstract values (x.astype(...), .sum()).
+        if isinstance(node.func, ast.Attribute):
+            base = self.eval(node.func.value, frame)
+            if not isinstance(base, Py):
+                if isinstance(base, SVal) and node.func.attr not in (
+                    "astype", "reshape", "copy",
+                ):
+                    return Opaque(UNKNOWN)
+                reads = fs_union(_reads_of(base), self._args_reads(args, kw))
+                return Opaque(reads)
+
+        # User helper resolved to a real function object: recurse.
+        target = self.eval(node.func, frame)
+        if isinstance(target, Py) and inspect.isfunction(target.value):
+            return self.run_fn(target.value, args, kw)
+
+        if any(isinstance(a, SVal) for a in args) or any(
+            isinstance(v, SVal) for v in kw.values()
+        ):
+            return Opaque(UNKNOWN)
+        return Opaque(self._args_reads(args, kw))
+
+    def _args_reads(self, args: List[AbsVal], kw: Dict[str, AbsVal]) -> FieldSet:
+        reads: FieldSet = frozenset()
+        for a in list(args) + list(kw.values()):
+            reads = fs_union(reads, _reads_of(a))
+        return reads
+
+    def _shape_len(self, shape_ast: Optional[ast.expr], frame: _Frame
+                   ) -> Optional[int]:
+        if shape_ast is None:
+            return None
+        v = self.eval(shape_ast, frame)
+        if isinstance(v, Rng):
+            return v.const
+        if isinstance(v, TupleVal) and len(v.items) == 1 and isinstance(
+            v.items[0], Rng
+        ):
+            return v.items[0].const
+        return None
+
+    def _do_clip(self, args: List[AbsVal], kw: Dict[str, AbsVal]) -> AbsVal:
+        vals = list(args) + [kw[k] for k in ("a_min", "a_max") if k in kw]
+        if len(vals) >= 3 and isinstance(vals[1], Rng) and isinstance(
+            vals[2], Rng
+        ):
+            lo, hi = vals[1], vals[2]
+            reads = self._args_reads(args, kw)
+            if isinstance(vals[0], Rng):
+                return Rng(
+                    max(vals[0].lo, lo.lo), min(vals[0].hi, hi.hi), reads
+                )
+            return Rng(lo.lo, hi.hi, reads)
+        return Opaque(self._args_reads(args, kw))
+
+    def _do_vset(self, node: ast.Call, args: List[AbsVal],
+                 kw: Dict[str, AbsVal], frame: _Frame) -> AbsVal:
+        if not args or not isinstance(args[0], SVal):
+            return Opaque(self._args_reads(args, kw))
+        base = args[0]
+        idx = args[1] if len(args) > 1 else Opaque(UNKNOWN)
+        val = args[2] if len(args) > 2 else Opaque(UNKNOWN)
+        en = args[3] if len(args) > 3 else kw.get("enabled")
+        extra = fs_union(_reads_of(val), _reads_of(en) if en else frozenset())
+        extra = fs_union(extra, _reads_of(idx))
+        if not isinstance(idx, Rng):
+            return SVal(
+                writes=UNKNOWN, or_writes=frozenset(),
+                reads=fs_union(base.reads, extra), width=base.width,
+            )
+        fields = frozenset(range(idx.lo, idx.hi + 1))
+        # Or-accumulate refinement: vset(X, C, X[C] | e1 | e2, ...) with a
+        # single constant field C whose value is a BitOr chain containing
+        # the self-read X[C] once, and no other read of C.
+        orw = frozenset()
+        if idx.const is not None and len(node.args) > 2:
+            c = idx.const
+            if self._is_or_accum(node.args[2], node.args[0], c, frame):
+                val_reads = _reads_of(val)
+                if val_reads is not UNKNOWN:
+                    val_reads = val_reads - {c}
+                    extra = fs_union(
+                        fs_union(val_reads, _reads_of(en) if en else frozenset()),
+                        idx.reads,
+                    )
+                    orw = frozenset({c})
+                    fields = frozenset()
+        plain = fs_union(base.writes, fields)
+        or_all = base.or_writes | orw
+        if plain is not UNKNOWN:
+            or_all = or_all - plain
+        else:
+            or_all = frozenset()
+        return SVal(
+            writes=plain, or_writes=or_all,
+            reads=fs_union(base.reads, extra), width=base.width,
+        )
+
+    def _is_or_accum(self, val_ast: ast.expr, base_ast: ast.expr, c: int,
+                     frame: _Frame) -> bool:
+        """Is ``val_ast`` a BitOr chain over exactly one self-read of
+        field ``c`` of the same state expression?"""
+        terms: List[ast.expr] = []
+
+        def flatten(n: ast.expr) -> None:
+            if isinstance(n, ast.BinOp) and isinstance(n.op, ast.BitOr):
+                flatten(n.left)
+                flatten(n.right)
+            else:
+                terms.append(n)
+
+        flatten(val_ast)
+        if len(terms) < 2:
+            return False
+        self_reads = 0
+        for t in terms:
+            if (
+                isinstance(t, ast.Subscript)
+                and ast.dump(t.value) == ast.dump(base_ast)
+            ):
+                idx = self.eval(t.slice, frame)
+                if isinstance(idx, Rng) and idx.const == c:
+                    self_reads += 1
+                    continue
+            v = self.eval(t, frame)
+            r = _reads_of(v)
+            if r is UNKNOWN or c in r:
+                return False
+        return self_reads == 1
+
+    def _do_seg_set(self, args: List[AbsVal]) -> AbsVal:
+        if not args or not isinstance(args[0], SVal):
+            return Opaque(self._args_reads(args, {}))
+        base = args[0]
+        start = args[1] if len(args) > 1 else Opaque(UNKNOWN)
+        seg = args[2] if len(args) > 2 else Opaque(UNKNOWN)
+        extra = fs_union(_reads_of(start), _reads_of(seg))
+        length = seg.length if isinstance(seg, Opaque) else None
+        if isinstance(start, Rng) and start.const is not None and length:
+            fields = frozenset(range(start.const, start.const + length))
+        else:
+            fields = UNKNOWN
+        plain = fs_union(base.writes, fields)
+        orw = base.or_writes - plain if plain is not UNKNOWN else frozenset()
+        return SVal(
+            writes=plain, or_writes=orw,
+            reads=fs_union(base.reads, extra), width=base.width,
+        )
+
+    @staticmethod
+    def _func_name(func: ast.expr) -> Optional[str]:
+        if isinstance(func, ast.Name):
+            return func.id
+        if isinstance(func, ast.Attribute):
+            return func.attr
+        return None
+
+
+# ---------------------------------------------------------------------------
+# DSL app analysis
+# ---------------------------------------------------------------------------
+
+@dataclass
+class AppEffects:
+    """Per-message-tag effects of one DSLApp's handler."""
+
+    per_tag: Dict[int, EffectSet] = field(default_factory=dict)
+    default: EffectSet = field(default_factory=EffectSet.unknown)
+    n_tags: int = 0
+    failure: Optional[str] = None
+
+    @classmethod
+    def unknown(cls, n_tags: int = 0, reason: str = "") -> "AppEffects":
+        return cls(per_tag={}, default=EffectSet.unknown(), n_tags=n_tags,
+                   failure=reason or None)
+
+    def effect_for(self, tag: int) -> EffectSet:
+        return self.per_tag.get(int(tag), self.default)
+
+    def to_json(self) -> Dict:
+        return {
+            "n_tags": self.n_tags,
+            "default": self.default.to_json(),
+            "per_tag": {str(t): e.to_json() for t, e in sorted(self.per_tag.items())},
+            "failure": self.failure,
+        }
+
+
+def _effect_from_result(val: AbsVal) -> EffectSet:
+    """EffectSet of a handler's returned (state', outbox) pair."""
+    if isinstance(val, TupleVal) and val.items:
+        sv = val.items[0]
+        out_reads: FieldSet = frozenset()
+        for other in val.items[1:]:
+            out_reads = fs_union(out_reads, _reads_of(other))
+    else:
+        sv, out_reads = val, frozenset()
+    if isinstance(sv, SVal):
+        return EffectSet(
+            reads=fs_union(sv.reads, out_reads), writes=sv.writes,
+            or_writes=sv.or_writes,
+        )
+    return EffectSet(reads=UNKNOWN, writes=UNKNOWN)
+
+
+def _find_switch(node: ast.AST) -> Optional[ast.Call]:
+    for sub in ast.walk(node):
+        if (
+            isinstance(sub, ast.Call)
+            and isinstance(sub.func, ast.Attribute)
+            and sub.func.attr == "switch"
+        ):
+            return sub
+    return None
+
+
+def _tag_index_fn(tag_ast: ast.expr, frame: _Frame, interp: _Interp,
+                  msg_name: str) -> Optional[Callable[[int], Optional[int]]]:
+    """Compile the switch selector expression into tag -> branch index,
+    understanding ``msg[0]``, +/- constants, and jnp.clip. Returns None
+    when the selector is not recognized (conservative: all branches)."""
+
+    def build(n: ast.expr) -> Optional[Callable[[int], Optional[int]]]:
+        if isinstance(n, ast.Name):
+            src = frame.ast_defs.get(n.id)
+            return build(src) if src is not None else None
+        if (
+            isinstance(n, ast.Subscript)
+            and isinstance(n.value, ast.Name)
+            and n.value.id == msg_name
+        ):
+            idx = interp.eval(n.slice, frame)
+            if isinstance(idx, Rng) and idx.const == 0:
+                return lambda t: t
+            return None
+        if isinstance(n, ast.BinOp) and isinstance(n.op, (ast.Add, ast.Sub)):
+            inner = build(n.left)
+            off = interp.eval(n.right, frame)
+            if inner is None or not (
+                isinstance(off, Rng) and off.const is not None
+            ):
+                return None
+            k = off.const if isinstance(n.op, ast.Add) else -off.const
+
+            def shifted(t, inner=inner, k=k):
+                v = inner(t)
+                return None if v is None else v + k
+
+            return shifted
+        if isinstance(n, ast.Call):
+            fname = _Interp._func_name(n.func)
+            if fname == "clip" and len(n.args) == 3:
+                inner = build(n.args[0])
+                lo = interp.eval(n.args[1], frame)
+                hi = interp.eval(n.args[2], frame)
+                if inner is None or not (
+                    isinstance(lo, Rng) and lo.const is not None
+                    and isinstance(hi, Rng) and hi.const is not None
+                ):
+                    return None
+
+                def clipped(t, inner=inner, lo=lo.const, hi=hi.const):
+                    v = inner(t)
+                    return None if v is None else max(lo, min(hi, v))
+
+                return clipped
+            if fname in ("int32", "asarray", "astype") and n.args:
+                return build(n.args[0])
+        return None
+
+    return build(tag_ast)
+
+
+def analyze_dsl_app(app) -> AppEffects:
+    """Per-tag effect extraction for a DSLApp (see module docstring).
+    Never raises: any failure returns ``AppEffects.unknown``."""
+    n_tags = max(
+        len(app.tag_names) - 1 if app.tag_names else 0,
+        max(app.timer_tags) if app.timer_tags else 0,
+    )
+    try:
+        return _analyze_dsl_handler(app.handler, n_tags)
+    except (_Bail, OSError, TypeError, SyntaxError, ValueError,
+            RecursionError) as exc:
+        return AppEffects.unknown(n_tags, f"{type(exc).__name__}: {exc}")
+
+
+def _analyze_dsl_handler(handler: Callable, n_tags: int) -> AppEffects:
+    node = _fn_ast(handler)
+    interp = _Interp()
+    frame = _Frame(_fn_env(handler), 0)
+    params = [a.arg for a in node.args.posonlyargs + node.args.args]
+    if len(params) < 4:
+        raise _Bail("handler does not take (actor_id, state, snd, msg)")
+    actor_p, state_p, snd_p, msg_p = params[:4]
+    frame.locals[actor_p] = Opaque()
+    frame.locals[state_p] = SVal()
+    frame.locals[snd_p] = Opaque()
+    frame.locals[msg_p] = Opaque()
+
+    switch = _find_switch(node)
+    if switch is None:
+        interp._stack.append(handler)
+        try:
+            interp.exec_block(node.body, frame)
+        finally:
+            interp._stack.pop()
+        if not frame.returns:
+            raise _Bail("handler has no return")
+        merged = frame.returns[0]
+        for r in frame.returns[1:]:
+            merged = _merge_vals(merged, r, frozenset())
+        eff = _effect_from_result(merged)
+        return AppEffects(
+            per_tag={t: eff for t in range(0, n_tags + 1)},
+            default=eff, n_tags=n_tags,
+        )
+
+    # Execute the preamble: every statement up to (excluding) the one
+    # containing the switch call. The switch is conventionally in the
+    # final return / assignment.
+    interp._stack.append(handler)
+    try:
+        for st in node.body:
+            if any(sub is switch for sub in ast.walk(st)):
+                break
+            interp.exec_stmt(st, frame)
+    finally:
+        interp._stack.pop()
+
+    pre_state = frame.locals.get(state_p)
+    if not isinstance(pre_state, SVal):
+        raise _Bail("preamble lost track of the state value")
+    pre_eff = EffectSet(
+        reads=pre_state.reads, writes=pre_state.writes,
+        or_writes=pre_state.or_writes,
+    )
+    if pre_eff.is_unknown():
+        raise _Bail("preamble effects unknown")
+
+    if len(switch.args) < 2:
+        raise _Bail("switch without branches")
+    branches_val = interp.eval(switch.args[1], frame)
+    branch_fns: List[Callable] = []
+    if isinstance(branches_val, TupleVal):
+        for item in branches_val.items:
+            if isinstance(item, Py) and inspect.isfunction(item.value):
+                branch_fns.append(item.value)
+            else:
+                raise _Bail("switch branch is not a resolvable function")
+    elif isinstance(branches_val, Py) and isinstance(
+        branches_val.value, (list, tuple)
+    ):
+        for f in branches_val.value:
+            if not inspect.isfunction(f):
+                raise _Bail("switch branch is not a function")
+            branch_fns.append(f)
+    else:
+        raise _Bail("switch branches not statically resolvable")
+
+    # Operands passed to each branch (positionally after the branch list).
+    operand_vals = [interp.eval(a, frame) for a in switch.args[2:]]
+
+    branch_effects: List[EffectSet] = []
+    for fn in branch_fns:
+        result = interp.run_fn(fn, list(operand_vals))
+        branch_effects.append(_effect_from_result(result))
+
+    union_all = branch_effects[0]
+    for be in branch_effects[1:]:
+        union_all = union_all.union(be)
+
+    tag_to_idx = _tag_index_fn(switch.args[0], frame, interp, msg_p)
+    per_tag: Dict[int, EffectSet] = {}
+    for t in range(0, n_tags + 1):
+        if tag_to_idx is None:
+            per_tag[t] = union_all
+            continue
+        idx = tag_to_idx(t)
+        if idx is None or not (0 <= idx < len(branch_effects)):
+            per_tag[t] = union_all
+        else:
+            per_tag[t] = branch_effects[idx]
+    return AppEffects(per_tag=per_tag, default=union_all, n_tags=n_tags)
+
+
+# ---------------------------------------------------------------------------
+# Host Actor-class analysis (attribute-level)
+# ---------------------------------------------------------------------------
+
+@dataclass
+class ActorEffects:
+    """Per-message-type attribute effects of a host Actor class.
+    ``per_type`` keys are the dispatch constants (``msg[0] == <const>``
+    values or isinstance class names); ``default`` covers everything
+    else."""
+
+    per_type: Dict[Any, EffectSet] = field(default_factory=dict)
+    default: EffectSet = field(default_factory=EffectSet.unknown)
+    failure: Optional[str] = None
+
+    @classmethod
+    def unknown(cls, reason: str = "") -> "ActorEffects":
+        return cls(failure=reason or None)
+
+    def effect_for(self, type_key: Any) -> EffectSet:
+        return self.per_type.get(type_key, self.default)
+
+
+class _AttrScan(ast.NodeVisitor):
+    """reads/writes over ``self.<attr>`` in one statement block;
+    anything dynamic — setattr/vars, self-method calls, or a
+    ``self.<attr>`` value ESCAPING into an alias or a call argument
+    (through which a container could be mutated without an attribute
+    store appearing here) — degrades the whole block to unknown."""
+
+    _PURE_RECEIVERS = {
+        "get", "keys", "values", "items", "count", "index", "copy",
+        "startswith", "endswith", "split", "join", "format",
+    }
+
+    def __init__(self):
+        self.reads: set = set()
+        self.writes: set = set()
+        self.unknown = False
+
+    def scan(self, stmts: List[ast.stmt]) -> EffectSet:
+        for st in stmts:
+            self.visit(st)
+        if self.unknown:
+            return EffectSet.unknown()
+        return EffectSet(
+            reads=frozenset(self.reads), writes=frozenset(self.writes)
+        )
+
+    def _self_attr(self, node: ast.expr) -> Optional[str]:
+        if (
+            isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"
+        ):
+            return node.attr
+        return None
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        attr = self._self_attr(node)
+        if attr is not None:
+            if isinstance(node.ctx, (ast.Store, ast.Del)):
+                self.writes.add(attr)
+            else:
+                self.reads.add(attr)
+        self.generic_visit(node)
+
+    def visit_Subscript(self, node: ast.Subscript) -> None:
+        attr = self._self_attr(node.value)
+        if attr is not None and isinstance(node.ctx, (ast.Store, ast.Del)):
+            self.writes.add(attr)
+            self.reads.add(attr)
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if isinstance(node.func, ast.Attribute):
+            attr = self._self_attr(node.func.value)
+            if attr is not None:
+                if node.func.attr in (
+                    "append", "extend", "insert", "pop", "remove", "clear",
+                    "update", "setdefault", "add", "discard", "sort",
+                    "reverse",
+                ):
+                    self.writes.add(attr)
+                    self.reads.add(attr)
+                elif node.func.attr not in self._PURE_RECEIVERS:
+                    # Unrecognized method on a self-attr container: it
+                    # may mutate in place.
+                    self.unknown = True
+            if (
+                isinstance(node.func.value, ast.Name)
+                and node.func.value.id == "self"
+                and node.func.attr not in ("checkpoint_state",)
+            ):
+                # A self-method call may touch anything.
+                self.unknown = True
+        if isinstance(node.func, ast.Name) and node.func.id in (
+            "setattr", "getattr", "delattr", "vars",
+        ):
+            self.unknown = True
+        # A self-attr value escaping as a call ARGUMENT may be mutated
+        # or retained by the callee (no attribute store appears in this
+        # block) — unless the callee is a known-pure builtin.
+        callee = node.func.id if isinstance(node.func, ast.Name) else None
+        if callee not in self._PURE_BUILTINS:
+            for arg in list(node.args) + [k.value for k in node.keywords]:
+                for sub in ast.walk(arg):
+                    if self._self_attr(sub) is not None:
+                        self.unknown = True
+        self.generic_visit(node)
+
+    _PURE_BUILTINS = {
+        "len", "list", "tuple", "set", "frozenset", "dict", "sorted",
+        "sum", "min", "max", "any", "all", "str", "repr", "int", "float",
+        "bool", "enumerate", "zip", "reversed", "abs", "isinstance",
+        "hash", "range",
+    }
+
+    def _escaping(self, node: ast.expr) -> bool:
+        """Could this assigned value alias a self-attr container (so a
+        later mutation through the alias bypasses this scan)? Direct
+        attrs, attribute/subscript chains off them, and containers
+        holding them escape; arithmetic/comparison results are consumed
+        by value."""
+        if self._self_attr(node) is not None:
+            return True
+        if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            return any(self._escaping(e) for e in node.elts)
+        if isinstance(node, ast.Dict):
+            return any(
+                self._escaping(e) for e in list(node.keys) + list(node.values)
+                if e is not None
+            )
+        if isinstance(node, ast.Starred):
+            return self._escaping(node.value)
+        if isinstance(node, (ast.Subscript, ast.Attribute)):
+            return self._escaping(node.value)
+        if isinstance(node, ast.IfExp):
+            return self._escaping(node.body) or self._escaping(node.orelse)
+        return False
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        # Aliasing: `q = self.queue` / `st = self.state[actor]` lets
+        # later statements mutate the container through the alias —
+        # degrade rather than track aliases. Consumed-by-value uses
+        # (`n = self.count + 1`) stay precise.
+        if self._escaping(node.value):
+            self.unknown = True
+        self.generic_visit(node)
+
+
+def _dispatch_key(test: ast.expr, msg_name: str) -> Optional[Any]:
+    """The dispatch constant of ``msg[0] == <const>`` or
+    ``isinstance(msg, T)`` tests."""
+    if (
+        isinstance(test, ast.Compare)
+        and len(test.ops) == 1
+        and isinstance(test.ops[0], ast.Eq)
+    ):
+        for a, b in ((test.left, test.comparators[0]),
+                     (test.comparators[0], test.left)):
+            if (
+                isinstance(a, ast.Subscript)
+                and isinstance(a.value, ast.Name)
+                and a.value.id == msg_name
+                and isinstance(a.slice, ast.Constant)
+                and a.slice.value == 0
+                and isinstance(b, ast.Constant)
+            ):
+                return b.value
+    if (
+        isinstance(test, ast.Call)
+        and isinstance(test.func, ast.Name)
+        and test.func.id == "isinstance"
+        and len(test.args) == 2
+        and isinstance(test.args[0], ast.Name)
+        and test.args[0].id == msg_name
+        and isinstance(test.args[1], ast.Name)
+    ):
+        return test.args[1].id
+    return None
+
+
+def analyze_actor_class(cls) -> ActorEffects:
+    """Attribute-level per-message-type effects of an Actor class's
+    ``receive``. Never raises."""
+    try:
+        receive = cls.__dict__.get("receive") or getattr(cls, "receive")
+        node = _fn_ast(receive)
+    except (OSError, TypeError, AttributeError, SyntaxError, _Bail) as exc:
+        return ActorEffects.unknown(f"{type(exc).__name__}: {exc}")
+    params = [a.arg for a in node.args.posonlyargs + node.args.args]
+    if len(params) < 4:
+        return ActorEffects.unknown("receive signature not (self, ctx, snd, msg)")
+    msg_name = params[3]
+
+    # Top-level dispatch chain: if <key-test>: ... elif ...: ... else ...
+    per_type: Dict[Any, EffectSet] = {}
+    residue: List[ast.stmt] = []
+    only_dispatch = True
+    for st in node.body:
+        if isinstance(st, ast.If):
+            chain_ok = True
+            cur: Optional[ast.stmt] = st
+            branches: List[Tuple[Any, List[ast.stmt]]] = []
+            while isinstance(cur, ast.If):
+                key = _dispatch_key(cur.test, msg_name)
+                if key is None:
+                    chain_ok = False
+                    break
+                branches.append((key, cur.body))
+                if len(cur.orelse) == 1 and isinstance(cur.orelse[0], ast.If):
+                    cur = cur.orelse[0]
+                else:
+                    residue.extend(cur.orelse)
+                    cur = None
+            if chain_ok:
+                for key, body in branches:
+                    eff = _AttrScan().scan(body)
+                    per_type[key] = (
+                        per_type[key].union(eff) if key in per_type else eff
+                    )
+                continue
+        only_dispatch = False
+        residue.append(st)
+
+    if not per_type:
+        return ActorEffects(per_type={}, default=_AttrScan().scan(node.body))
+    # Residue statements (shared pre/post code) apply to every type; an
+    # unrecognized message type gets the whole method's effects.
+    residue_eff = _AttrScan().scan(residue) if residue else EffectSet()
+    per_type = {k: e.union(residue_eff) for k, e in per_type.items()}
+    return ActorEffects(
+        per_type=per_type, default=_AttrScan().scan(node.body)
+    )

@@ -1,0 +1,551 @@
+"""Determinism linter: an AST pass over actor handler functions.
+
+Scope discovery (what counts as "handler code"):
+
+  - every method of a class that looks like an actor — a base name
+    containing ``Actor``, or a ``receive``/``handle`` method;
+  - any function (at any nesting depth) named ``handler``, ``receive``,
+    ``invariant``, ``init_state``, ``initial_msgs``, or ``on_*`` — the
+    dual-tier DSL surface (apps are closures built inside ``make_*_app``
+    factories, so nesting-blind discovery is what finds them).
+
+Everything else in a module (CLI glue, fuzzer generators, bridge serve
+loops) is deliberately out of scope: a seeded ``rng`` parameter in a
+message generator is framework-sanctioned randomness, not a
+replay-breaker.
+
+Findings carry (rule id, severity, file:line, message, fix hint) and are
+suppressible with ``# demi: allow(<rule-id>)`` on the flagged line or on
+the enclosing ``def`` line. ``demi_tpu lint`` renders them as text or
+JSON and exits non-zero on any error-level finding.
+"""
+
+from __future__ import annotations
+
+import ast
+import importlib.util
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .rules import ERROR, RULES, WARNING, severity_rank
+
+_ALLOW_RE = re.compile(r"#\s*demi:\s*allow\(([^)]*)\)")
+
+# -- nondeterminism source tables -------------------------------------------
+
+_WALL_CLOCK_CALLS = {
+    ("time", "time"), ("time", "time_ns"), ("time", "monotonic"),
+    ("time", "monotonic_ns"), ("time", "perf_counter"),
+    ("time", "perf_counter_ns"), ("time", "process_time"),
+    ("datetime", "now"), ("datetime", "utcnow"), ("datetime", "today"),
+    ("date", "today"),
+}
+
+_RANDOM_MODULE_FNS = {
+    "random", "randint", "randrange", "choice", "choices", "shuffle",
+    "sample", "uniform", "getrandbits", "gauss", "normalvariate",
+    "betavariate", "expovariate", "triangular", "vonmisesvariate",
+    "paretovariate", "weibullvariate", "lognormvariate", "randbytes",
+}
+
+_UUID_FNS = {"uuid1", "uuid4"}
+
+_THREAD_SPAWNS = {
+    ("threading", "Thread"), ("threading", "Timer"),
+    ("_thread", "start_new_thread"),
+    ("multiprocessing", "Process"), ("multiprocessing", "Pool"),
+    ("asyncio", "create_task"), ("asyncio", "ensure_future"),
+    ("asyncio", "run"), ("asyncio", "get_event_loop"),
+    ("asyncio", "new_event_loop"), ("asyncio", "run_coroutine_threadsafe"),
+    ("concurrent", "ThreadPoolExecutor"),
+    ("futures", "ThreadPoolExecutor"), ("futures", "ProcessPoolExecutor"),
+}
+
+_BLOCKING_CALLS = {
+    ("time", "sleep"), ("socket", "socket"), ("socket", "create_connection"),
+    ("subprocess", "run"), ("subprocess", "Popen"), ("subprocess", "call"),
+    ("subprocess", "check_output"), ("subprocess", "check_call"),
+    ("os", "system"), ("os", "popen"), ("requests", "get"),
+    ("requests", "post"), ("requests", "put"), ("requests", "delete"),
+    ("requests", "request"), ("urllib", "urlopen"), ("request", "urlopen"),
+}
+
+_BLOCKING_BARE = {"open", "input"}
+
+_MUTATING_METHODS = {
+    "append", "extend", "insert", "pop", "remove", "clear", "update",
+    "setdefault", "popitem", "sort", "reverse", "add", "discard",
+    "__setitem__",
+}
+
+_SET_CONSUMERS = {"list", "tuple", "join", "enumerate", "iter", "next", "zip"}
+
+_HANDLER_FN_NAMES = {
+    "handler", "receive", "invariant", "init_state", "initial_msgs",
+}
+
+
+@dataclass
+class LintFinding:
+    rule: str
+    severity: str
+    path: str
+    line: int
+    col: int
+    message: str
+    hint: str
+    handler: str = ""
+
+    def location(self) -> str:
+        return f"{self.path}:{self.line}"
+
+    def to_json(self) -> Dict:
+        return {
+            "rule": self.rule,
+            "severity": self.severity,
+            "path": self.path,
+            "line": self.line,
+            "col": self.col,
+            "message": self.message,
+            "hint": self.hint,
+            "handler": self.handler,
+        }
+
+
+def _call_name(node: ast.expr) -> Tuple[Optional[str], Optional[str]]:
+    """(base, attr) of a called name: ``time.time`` -> ('time', 'time'),
+    bare ``open`` -> (None, 'open'), ``a.b.c()`` -> ('b', 'c')."""
+    if isinstance(node, ast.Name):
+        return None, node.id
+    if isinstance(node, ast.Attribute):
+        base = node.value
+        if isinstance(base, ast.Name):
+            return base.id, node.attr
+        if isinstance(base, ast.Attribute):
+            return base.attr, node.attr
+        if isinstance(base, ast.Call):
+            # datetime.datetime.now().timestamp() chains: report the
+            # inner call separately; here just name the attr.
+            return None, node.attr
+    return None, None
+
+
+def _is_handler_class(node: ast.ClassDef) -> bool:
+    for base in node.bases:
+        name = base.id if isinstance(base, ast.Name) else (
+            base.attr if isinstance(base, ast.Attribute) else ""
+        )
+        if "Actor" in (name or ""):
+            return True
+    return any(
+        isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef))
+        and item.name in ("receive", "handle")
+        for item in node.body
+    )
+
+
+def _is_handler_fn(node) -> bool:
+    return node.name in _HANDLER_FN_NAMES or node.name.startswith("on_")
+
+
+def discover_handlers(tree: ast.Module) -> List[Tuple[str, ast.AST]]:
+    """(qualified-name, def-node) handler roots, outermost-first with
+    roots nested inside other roots removed (their subtree is already
+    covered)."""
+    roots: List[Tuple[str, ast.AST]] = []
+
+    def walk(node: ast.AST, qual: str, inside_root: bool) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                is_root = not inside_root and _is_handler_class(child)
+                name = f"{qual}{child.name}"
+                if is_root:
+                    roots.append((name, child))
+                walk(child, name + ".", inside_root or is_root)
+            elif isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                is_root = not inside_root and _is_handler_fn(child)
+                name = f"{qual}{child.name}"
+                if is_root:
+                    roots.append((name, child))
+                walk(child, name + ".", inside_root or is_root)
+            else:
+                walk(child, qual, inside_root)
+
+    walk(tree, "", False)
+    return roots
+
+
+class _HandlerLinter(ast.NodeVisitor):
+    """One handler root's rule pass. Collects raw findings; suppression
+    is applied by the caller (it owns the source lines)."""
+
+    def __init__(self, path: str, handler_name: str, root: ast.AST,
+                 module_names: Set[str]):
+        self.path = path
+        self.handler_name = handler_name
+        self.root = root
+        self.module_names = module_names
+        self.findings: List[LintFinding] = []
+        # Message parameter names of enclosing handler defs (msg-mutation
+        # targets): the canonical `msg`, plus the 4th positional of
+        # receive(self, ctx, snd, msg) whatever it is called.
+        self._msg_params: Set[str] = set()
+        # Names bound to set values in this subtree (set-iteration).
+        self._set_names: Set[str] = set()
+        # def-line numbers (suppression may sit on the def line).
+        self.def_lines: Dict[int, int] = {}
+
+    # -- helpers -----------------------------------------------------------
+    def _emit(self, rule_id: str, node: ast.AST, detail: str) -> None:
+        rule = RULES[rule_id]
+        self.findings.append(
+            LintFinding(
+                rule=rule.id, severity=rule.severity, path=self.path,
+                line=getattr(node, "lineno", 0),
+                col=getattr(node, "col_offset", 0),
+                message=f"{rule.summary}: {detail}",
+                hint=rule.hint, handler=self.handler_name,
+            )
+        )
+
+    def _is_set_expr(self, node: ast.expr) -> bool:
+        if isinstance(node, (ast.Set, ast.SetComp)):
+            return True
+        if isinstance(node, ast.Call):
+            base, attr = _call_name(node.func)
+            if base is None and attr in ("set", "frozenset"):
+                return True
+            if attr in ("keys", "values", "items") and isinstance(
+                node.func, ast.Attribute
+            ):
+                return False  # dicts preserve insertion order
+        if isinstance(node, ast.Name) and node.id in self._set_names:
+            return True
+        if isinstance(node, ast.BinOp) and isinstance(
+            node.op, (ast.BitOr, ast.BitAnd, ast.Sub, ast.BitXor)
+        ):
+            return self._is_set_expr(node.left) or self._is_set_expr(node.right)
+        return False
+
+    # -- visitors ----------------------------------------------------------
+    def visit_FunctionDef(self, node) -> None:
+        self.def_lines[node.lineno] = node.lineno
+        args = node.args.posonlyargs + node.args.args
+        names = [a.arg for a in args]
+        if "msg" in names:
+            self._msg_params.add("msg")
+        if node.name == "receive" and len(names) >= 4 and names[0] == "self":
+            self._msg_params.add(names[3])
+        if node.name == "handle" and len(names) >= 4 and names[0] == "self":
+            self._msg_params.add(names[3])
+        self.generic_visit(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Global(self, node: ast.Global) -> None:
+        self._emit(
+            "module-state", node,
+            f"`global {', '.join(node.names)}` inside a handler",
+        )
+
+    def visit_Call(self, node: ast.Call) -> None:
+        base, attr = _call_name(node.func)
+        key = (base, attr)
+        # numpy's module-level RNG parses to base='random' (the middle
+        # attr of np.random.<fn>); detect the full chain up front so it
+        # reports once, under its real name.
+        np_random = (
+            isinstance(node.func, ast.Attribute)
+            and isinstance(node.func.value, ast.Attribute)
+            and node.func.value.attr == "random"
+            and isinstance(node.func.value.value, ast.Name)
+            and node.func.value.value.id in ("np", "numpy")
+        )
+        if key in _WALL_CLOCK_CALLS:
+            self._emit("wall-clock", node, f"{base}.{attr}()")
+        elif np_random:
+            self._emit("unseeded-random", node, f"np.random.{attr}()")
+        elif base == "random" and attr in _RANDOM_MODULE_FNS:
+            self._emit("unseeded-random", node, f"{base}.{attr}()")
+        elif base == "uuid" and attr in _UUID_FNS:
+            self._emit("unseeded-random", node, f"uuid.{attr}()")
+        elif base == "os" and attr == "urandom":
+            self._emit("unseeded-random", node, "os.urandom()")
+        elif base == "secrets":
+            self._emit("unseeded-random", node, f"secrets.{attr}()")
+        elif base == "np.random" or (
+            base == "random" and attr == "default_rng"
+        ):
+            self._emit("unseeded-random", node, f"{base}.{attr}()")
+        elif key in _THREAD_SPAWNS or attr in (
+            "create_task", "ensure_future", "call_later", "call_soon",
+            "run_in_executor", "start_new_thread",
+        ) and base not in (None, "ctx"):
+            self._emit("thread-spawn", node, f"{base}.{attr}()")
+        elif key in _BLOCKING_CALLS:
+            self._emit("blocking-io", node, f"{base}.{attr}()")
+        elif base is None and attr in _BLOCKING_BARE:
+            self._emit("blocking-io", node, f"{attr}()")
+        elif base is None and attr in ("sorted", "min", "max"):
+            self._check_ordering_key(node)
+
+        # Mutating method on a received message object.
+        if (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr in _MUTATING_METHODS
+            and isinstance(node.func.value, ast.Name)
+            and node.func.value.id in self._msg_params
+        ):
+            self._emit(
+                "msg-mutation", node,
+                f"{node.func.value.id}.{node.func.attr}(...)",
+            )
+
+        # Mutating method on module-level mutable state.
+        if (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr in _MUTATING_METHODS
+            and isinstance(node.func.value, ast.Name)
+            and node.func.value.id in self.module_names
+        ):
+            self._emit(
+                "module-state", node,
+                f"{node.func.value.id}.{node.func.attr}(...) mutates "
+                "module-level state",
+            )
+
+        # Iteration-order-sensitive consumption of a set.
+        if base is None and attr in _SET_CONSUMERS and node.args:
+            if self._is_set_expr(node.args[0]):
+                self._emit(
+                    "set-iteration", node, f"{attr}(<set>) without sorted()"
+                )
+        if (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr == "join"
+            and node.args
+            and self._is_set_expr(node.args[0])
+        ):
+            self._emit("set-iteration", node, "str.join over a set")
+
+        self.generic_visit(node)
+
+    def _check_ordering_key(self, node: ast.Call) -> None:
+        """sorted/min/max with a key (or elements) that call id()."""
+        for sub in ast.walk(node):
+            if (
+                isinstance(sub, ast.Call)
+                and isinstance(sub.func, ast.Name)
+                and sub.func.id == "id"
+            ):
+                self._emit(
+                    "id-ordering", sub,
+                    "id() inside an ordering expression",
+                )
+                return
+
+    def visit_For(self, node: ast.For) -> None:
+        if self._is_set_expr(node.iter):
+            self._emit("set-iteration", node, "for-loop over a set")
+        self.generic_visit(node)
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        if self._is_set_expr(node.value):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    self._set_names.add(tgt.id)
+        for tgt in node.targets:
+            self._check_store(tgt)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        self._check_store(node.target)
+        self.generic_visit(node)
+
+    def visit_Delete(self, node: ast.Delete) -> None:
+        for tgt in node.targets:
+            self._check_store(tgt)
+        self.generic_visit(node)
+
+    def _check_store(self, tgt: ast.expr) -> None:
+        """Subscript/attribute stores onto received messages or
+        module-level names."""
+        if isinstance(tgt, (ast.Subscript, ast.Attribute)):
+            base = tgt.value
+            if isinstance(base, ast.Name):
+                if base.id in self._msg_params:
+                    self._emit(
+                        "msg-mutation", tgt,
+                        f"store into received message `{base.id}`",
+                    )
+                elif base.id in self.module_names:
+                    self._emit(
+                        "module-state", tgt,
+                        f"store into module-level `{base.id}`",
+                    )
+        elif isinstance(tgt, ast.Name) and tgt.id in self.module_names:
+            # Plain rebinding of a module-level name only matters with
+            # `global`, which visit_Global already flags.
+            pass
+
+
+def _module_level_mutables(tree: ast.Module) -> Set[str]:
+    """Names assigned mutable-looking values at module scope (the
+    module-state rule's write targets)."""
+    out: Set[str] = set()
+    for node in tree.body:
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets, value = node.targets, node.value
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets, value = [node.target], node.value
+        else:
+            continue
+        mutable = isinstance(
+            value, (ast.Dict, ast.List, ast.Set, ast.DictComp, ast.ListComp,
+                    ast.SetComp)
+        ) or (
+            isinstance(value, ast.Call)
+            and isinstance(value.func, ast.Name)
+            and value.func.id in ("dict", "list", "set", "defaultdict",
+                                  "OrderedDict", "deque", "Counter")
+        )
+        if not mutable:
+            continue
+        for tgt in targets:
+            if isinstance(tgt, ast.Name):
+                out.add(tgt.id)
+    return out
+
+
+def _allowed_rules(line: str) -> Set[str]:
+    m = _ALLOW_RE.search(line)
+    if not m:
+        return set()
+    return {part.strip() for part in m.group(1).split(",") if part.strip()}
+
+
+def lint_source(
+    source: str, path: str = "<string>"
+) -> List[LintFinding]:
+    """Lint one module's source text. Returns surviving findings
+    (suppressions already applied), sorted by (line, rule)."""
+    tree = ast.parse(source)
+    lines = source.splitlines()
+    module_names = _module_level_mutables(tree)
+    findings: List[LintFinding] = []
+    for name, root in discover_handlers(tree):
+        linter = _HandlerLinter(path, name, root, module_names)
+        linter.visit(root)
+        findings.extend(linter.findings)
+
+    # Suppression: `# demi: allow(rule)` on the flagged line or on the
+    # enclosing def line (nearest def at or above the finding).
+    def_lines = sorted(
+        node.lineno
+        for node in ast.walk(tree)
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+    )
+
+    def suppressed(f: LintFinding) -> bool:
+        if 0 < f.line <= len(lines) and f.rule in _allowed_rules(
+            lines[f.line - 1]
+        ):
+            return True
+        enclosing = [ln for ln in def_lines if ln <= f.line]
+        if enclosing and 0 < enclosing[-1] <= len(lines):
+            return f.rule in _allowed_rules(lines[enclosing[-1] - 1])
+        return False
+
+    out = [f for f in findings if not suppressed(f)]
+    out.sort(key=lambda f: (f.line, f.rule))
+    return out
+
+
+def lint_file(path: str) -> List[LintFinding]:
+    with open(path, encoding="utf-8") as f:
+        return lint_source(f.read(), path)
+
+
+def _module_files(name: str) -> List[str]:
+    """Resolve a dotted module/package name to .py files WITHOUT
+    importing it (linting must not execute target code)."""
+    spec = importlib.util.find_spec(name)
+    if spec is None or spec.origin is None:
+        raise FileNotFoundError(f"cannot resolve module {name!r}")
+    if spec.submodule_search_locations:
+        files = []
+        for loc in spec.submodule_search_locations:
+            for fn in sorted(os.listdir(loc)):
+                if fn.endswith(".py"):
+                    files.append(os.path.join(loc, fn))
+        return files
+    return [spec.origin]
+
+
+DEFAULT_TARGETS = ("demi_tpu.apps", "demi_tpu.bridge.demo_app")
+
+
+def lint_targets(
+    targets: Optional[Sequence[str]] = None,
+) -> List[LintFinding]:
+    """Lint files, directories, or dotted module names. With no targets,
+    lints the bundled app zoo (the shipped-clean baseline)."""
+    targets = list(targets) if targets else list(DEFAULT_TARGETS)
+    files: List[str] = []
+    for t in targets:
+        if os.path.isdir(t):
+            for root, _dirs, names in os.walk(t):
+                files.extend(
+                    os.path.join(root, n) for n in sorted(names)
+                    if n.endswith(".py")
+                )
+        elif os.path.isfile(t):
+            files.append(t)
+        else:
+            files.extend(_module_files(t))
+    findings: List[LintFinding] = []
+    for path in files:
+        findings.extend(lint_file(path))
+    return findings
+
+
+def render_text(findings: Sequence[LintFinding]) -> str:
+    if not findings:
+        return "clean: no findings\n"
+    lines = []
+    for f in findings:
+        lines.append(
+            f"{f.path}:{f.line}:{f.col}: {f.severity} [{f.rule}] "
+            f"{f.message}"
+        )
+        lines.append(f"    hint: {f.hint}")
+        if f.handler:
+            lines.append(f"    in: {f.handler}")
+    errors = sum(1 for f in findings if f.severity == ERROR)
+    warnings = sum(1 for f in findings if f.severity == WARNING)
+    lines.append(f"{len(findings)} finding(s): {errors} error(s), "
+                 f"{warnings} warning(s)")
+    return "\n".join(lines) + "\n"
+
+
+def render_json(findings: Sequence[LintFinding]) -> Dict:
+    errors = sum(1 for f in findings if f.severity == ERROR)
+    warnings = sum(1 for f in findings if f.severity == WARNING)
+    return {
+        "findings": [f.to_json() for f in findings],
+        "counts": {
+            "total": len(findings),
+            "error": errors,
+            "warning": warnings,
+            "info": len(findings) - errors - warnings,
+        },
+    }
+
+
+def has_errors(findings: Sequence[LintFinding]) -> bool:
+    return any(
+        severity_rank(f.severity) >= severity_rank(ERROR) for f in findings
+    )

@@ -1,0 +1,129 @@
+"""Determinism-lint rule registry.
+
+Every rule names one class of replay-breaker: a construct that makes an
+actor handler's behavior depend on something the scheduler does not
+control (wall clocks, process-global RNG, allocation addresses, hash
+ordering, out-of-band I/O). The reference framework copes with these
+AFTER the fact — wildcards and fungible clocks absorb nondeterministic
+replays (SURVEY.md §5; DEMi's "shrinking" semantics) — while this linter
+catches them BEFORE a soak spends hours recording schedules that will
+never replay bit-exactly.
+
+Severity contract:
+  error   — replay/racing-analysis soundness is at risk; ``demi_tpu
+            lint`` exits non-zero when any error-level finding survives
+            suppression.
+  warning — suspicious but not always wrong (e.g. iterating a set whose
+            order never escapes the handler).
+  info    — advisory.
+
+Suppression: append ``# demi: allow(<rule-id>)`` to the flagged line or
+to the enclosing ``def`` line (comma-separate several ids).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Tuple
+
+ERROR = "error"
+WARNING = "warning"
+INFO = "info"
+
+_SEVERITY_RANK = {INFO: 0, WARNING: 1, ERROR: 2}
+
+
+def severity_rank(severity: str) -> int:
+    return _SEVERITY_RANK[severity]
+
+
+@dataclass(frozen=True)
+class Rule:
+    id: str
+    severity: str
+    summary: str
+    hint: str
+
+
+RULES: Dict[str, Rule] = {
+    r.id: r
+    for r in (
+        Rule(
+            "wall-clock",
+            ERROR,
+            "wall-clock read in a handler",
+            "handlers must not read real time: model timing as "
+            "scheduler-controlled timers (ctx.set_timer) so the explorer "
+            "owns every clock",
+        ),
+        Rule(
+            "unseeded-random",
+            ERROR,
+            "process-global / unseeded randomness in a handler",
+            "draw from the harness instead (ctx.rng() is deterministic "
+            "per delivery and replay-stable), or thread an explicitly "
+            "seeded random.Random through the app",
+        ),
+        Rule(
+            "id-ordering",
+            ERROR,
+            "id()-keyed ordering or keying",
+            "id() is an allocation address — it differs across replays; "
+            "key by a stable field of the object instead",
+        ),
+        Rule(
+            "set-iteration",
+            WARNING,
+            "iteration-order-sensitive use of a set",
+            "set iteration order depends on insertion/hash history; wrap "
+            "in sorted(...) before iterating or serializing",
+        ),
+        Rule(
+            "module-state",
+            ERROR,
+            "module-level mutable state written from a handler",
+            "state shared across actors/executions breaks execution "
+            "isolation (STS peek rollbacks cannot restore it); keep all "
+            "state on the actor instance so checkpoint/restore sees it",
+        ),
+        Rule(
+            "msg-mutation",
+            ERROR,
+            "in-place mutation of a received message",
+            "messages are shared with the trace recorder and (under "
+            "peek) with rolled-back executions; copy before mutating "
+            "(the DEMI_SANITIZE=1 runtime digest check catches what "
+            "this rule only suspects)",
+        ),
+        Rule(
+            "thread-spawn",
+            ERROR,
+            "thread / task / process spawned inside a handler",
+            "concurrency outside the controlled event loop is invisible "
+            "to the scheduler; model it as actors + messages (the "
+            "asyncio bridge adapters run coroutine apps under harness "
+            "control)",
+        ),
+        Rule(
+            "blocking-io",
+            WARNING,
+            "blocking I/O or sleep inside a handler",
+            "handlers must be compute-only: I/O latency leaks real time "
+            "into the schedule and sleeps stall the whole (sequential) "
+            "event loop; route external effects through the bridge tier",
+        ),
+    )
+}
+
+
+def max_severity(findings) -> Tuple[int, int, int]:
+    """(errors, warnings, infos) counts over an iterable of findings."""
+    errors = warnings = infos = 0
+    for f in findings:
+        if f.severity == ERROR:
+            errors += 1
+        elif f.severity == WARNING:
+            warnings += 1
+        else:
+            infos += 1
+    return errors, warnings, infos

@@ -1,0 +1,334 @@
+"""Runtime replay sanitizer (``DEMI_SANITIZE=1`` / ``--sanitize``).
+
+The determinism lint (analysis/lint.py) is static and can only *suspect*
+some replay-breakers; this wraps the host tier's handler dispatch to
+catch them as they happen:
+
+  - **in-place message mutation** — every pending message is digested at
+    capture time and re-digested at delivery; the delivered message is
+    digested before and after the handler runs. A mismatch means some
+    handler mutated an object the trace recorder / peek rollback shares
+    (``analysis.sanitizer_mutations{where=pending|receive}``).
+  - **wall-clock / process-global randomness traps** — ``time.time``-
+    family and module-level ``random``/``uuid4``/``os.urandom`` calls
+    made *while a handler is executing* are counted
+    (``analysis.sanitizer_time_reads`` / ``analysis.sanitizer_random_draws``)
+    and, in strict mode, rejected.
+
+Modes: ``observe`` (count + one warning per site; the ``DEMI_SANITIZE=1``
+default) and ``strict`` (``DEMI_SANITIZE=strict`` or ``--sanitize`` on
+``demi_tpu replay``): a trap or mutation raises ``SanitizerError`` —
+a HarnessError subclass, so ``deliver()`` re-raises it instead of
+converting the nondeterminism into actor-crash semantics. Strict is the
+right mode for strict replay, where a nondeterministic handler silently
+invalidates the bit-exactness the whole pipeline rests on.
+
+The traps only patch while a handler is on the stack (the event loop is
+sequential by construction), so framework timing code — obs spans,
+host-share ledgers, kernel-compile internals — is never intercepted.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+import os
+import random as _random_mod
+import struct
+import time as _time_mod
+import uuid as _uuid_mod
+from typing import Any, Callable, Dict, Optional
+
+from ..runtime.system import HarnessError
+
+_log = logging.getLogger("demi_tpu.sanitize")
+
+
+class SanitizerError(HarnessError):
+    """Nondeterminism detected under strict sanitization. A HarnessError:
+    the run's results can no longer be trusted as deterministic, which is
+    infrastructure-level, not an application crash."""
+
+
+_mode: Optional[str] = None
+_mode_resolved = False
+
+
+def _env_mode() -> Optional[str]:
+    raw = os.environ.get("DEMI_SANITIZE", "").strip().lower()
+    if raw in ("strict", "2"):
+        return "strict"
+    if raw in ("1", "true", "yes", "on", "observe"):
+        return "observe"
+    return None
+
+
+def enable(strict: bool = False) -> None:
+    global _mode, _mode_resolved
+    _mode = "strict" if strict else "observe"
+    _mode_resolved = True
+
+
+def disable() -> None:
+    global _mode, _mode_resolved
+    _mode = None
+    _mode_resolved = True
+
+
+def reset() -> None:
+    """Forget any explicit enable()/disable(): resolution returns to the
+    DEMI_SANITIZE env var (test / CLI hygiene)."""
+    global _mode, _mode_resolved
+    _mode = None
+    _mode_resolved = False
+
+
+def mode() -> Optional[str]:
+    """'observe' / 'strict' / None. Explicit enable()/disable() wins;
+    otherwise the DEMI_SANITIZE env var is re-read (the CLI sets it)."""
+    if _mode_resolved:
+        return _mode
+    return _env_mode()
+
+
+def enabled() -> bool:
+    return mode() is not None
+
+
+# -- structural digests ------------------------------------------------------
+
+def digest(obj: Any) -> bytes:
+    """Stable structural digest of a message object: containers recurse,
+    numpy arrays hash their bytes, everything else falls back to a
+    scrubbed repr. Equal digests <=> structurally equal content (up to
+    blake2b-16 collisions), and crucially: MUTATION changes the digest
+    while object identity does not."""
+    h = hashlib.blake2b(digest_size=16)
+    _feed(h, obj, 0)
+    return h.digest()
+
+
+def _feed(h, obj: Any, depth: int) -> None:
+    if depth > 16:
+        h.update(b"<deep>")
+        return
+    if obj is None or isinstance(obj, (bool, int, str, bytes)):
+        h.update(f"{type(obj).__name__}:{obj!r};".encode())
+        return
+    if isinstance(obj, float):
+        h.update(b"f" + struct.pack("<d", obj))
+        return
+    if isinstance(obj, (tuple, list)):
+        h.update(f"{type(obj).__name__}[{len(obj)}](".encode())
+        for item in obj:
+            _feed(h, item, depth + 1)
+        h.update(b")")
+        return
+    if isinstance(obj, dict):
+        h.update(f"dict[{len(obj)}](".encode())
+        try:
+            items = sorted(obj.items(), key=lambda kv: repr(kv[0]))
+        except Exception:
+            items = list(obj.items())
+        for k, v in items:
+            _feed(h, k, depth + 1)
+            _feed(h, v, depth + 1)
+        h.update(b")")
+        return
+    if isinstance(obj, (set, frozenset)):
+        h.update(f"set[{len(obj)}](".encode())
+        for r in sorted(repr(x) for x in obj):
+            h.update(r.encode())
+        h.update(b")")
+        return
+    if hasattr(obj, "__dataclass_fields__"):
+        h.update(f"dc:{type(obj).__name__}(".encode())
+        for f in obj.__dataclass_fields__:
+            _feed(h, getattr(obj, f), depth + 1)
+        h.update(b")")
+        return
+    tobytes = getattr(obj, "tobytes", None)
+    if callable(tobytes):
+        try:
+            h.update(b"arr:" + tobytes())
+            return
+        except Exception:
+            pass
+    import re
+
+    h.update(re.sub(r"0x[0-9a-fA-F]+", "<addr>", repr(obj)).encode())
+
+
+# -- stats -------------------------------------------------------------------
+
+_stats: Dict[str, int] = {
+    "mutations_receive": 0,
+    "mutations_pending": 0,
+    "time_reads": 0,
+    "random_draws": 0,
+}
+_warned_sites: set = set()
+
+
+def stats() -> Dict[str, int]:
+    return dict(_stats)
+
+
+def reset_stats() -> None:
+    for k in _stats:
+        _stats[k] = 0
+    _warned_sites.clear()
+
+
+_in_note = False
+
+
+def _note(kind: str, site: str, detail: str, strict: bool) -> None:
+    global _in_note
+    from .. import obs
+
+    if kind.startswith("mutations"):
+        _stats[kind] += 1
+        where = kind.split("_", 1)[1]
+        obs.counter("analysis.sanitizer_mutations").inc(where=where)
+    elif kind == "time_reads":
+        _stats[kind] += 1
+        obs.counter("analysis.sanitizer_time_reads").inc(fn=site)
+    else:
+        _stats[kind] += 1
+        obs.counter("analysis.sanitizer_random_draws").inc(fn=site)
+    if strict:
+        raise SanitizerError(f"sanitizer ({kind}): {detail}")
+    if site not in _warned_sites:
+        _warned_sites.add(site)
+        # The logging machinery itself timestamps records with
+        # time.time(); _in_note keeps that internal read from counting
+        # as handler nondeterminism while the traps are armed.
+        _in_note = True
+        try:
+            _log.warning("demi_tpu sanitizer: %s (%s)", detail, site)
+        finally:
+            _in_note = False
+
+
+# -- handler-scope traps -----------------------------------------------------
+
+# Library internals whose clock/random reads are NOT app nondeterminism:
+# jax's dispatch/compile machinery timestamps every first-call compile
+# (20+ time.time() reads per jit), and logging stamps records. Trapped
+# calls whose immediate caller lives in these packages pass through
+# uncounted — otherwise strict replay of any DSL app would abort on its
+# first (compiling) delivery.
+_EXEMPT_CALLER_PKGS = {
+    "jax", "jaxlib", "logging", "importlib", "absl", "etils", "threading",
+}
+
+_TIME_FNS = ("time", "time_ns")
+_RANDOM_FNS = (
+    "random", "randint", "randrange", "choice", "choices", "shuffle",
+    "sample", "uniform", "getrandbits",
+)
+
+
+class _Traps:
+    """Patch wall-clock / global-random entry points for the duration of
+    one handler call; restore unconditionally."""
+
+    def __init__(self, rcv: str, strict: bool):
+        self.rcv = rcv
+        self.strict = strict
+        self._saved = []
+
+    def _wrap(self, module, name: str, kind: str):
+        original = getattr(module, name)
+        site = f"{module.__name__}.{name}"
+        rcv, strict = self.rcv, self.strict
+
+        def trapped(*args, **kwargs):
+            if _in_note:  # sanitizer-internal (logging timestamp) call
+                return original(*args, **kwargs)
+            import sys
+
+            caller = sys._getframe(1).f_globals.get("__name__", "")
+            if caller.partition(".")[0] in _EXEMPT_CALLER_PKGS:
+                return original(*args, **kwargs)
+            _note(
+                kind, site,
+                f"handler of {rcv!r} called {site}() — replay-breaking "
+                "nondeterminism (see `demi_tpu lint`)",
+                strict,
+            )
+            return original(*args, **kwargs)
+
+        self._saved.append((module, name, original))
+        setattr(module, name, trapped)
+
+    def __enter__(self):
+        for name in _TIME_FNS:
+            self._wrap(_time_mod, name, "time_reads")
+        for name in _RANDOM_FNS:
+            self._wrap(_random_mod, name, "random_draws")
+        self._wrap(_uuid_mod, "uuid4", "random_draws")
+        self._wrap(os, "urandom", "random_draws")
+        return self
+
+    def __exit__(self, *exc):
+        for module, name, original in reversed(self._saved):
+            setattr(module, name, original)
+        self._saved.clear()
+        return False
+
+
+# -- the dispatch wrapper (what runtime/system.py calls) --------------------
+
+class Sanitizer:
+    def __init__(self, strict: bool):
+        self.strict = strict
+
+    def seal(self, msg: Any) -> bytes:
+        return digest(msg)
+
+    def check_pending(self, entry) -> None:
+        """Capture-time vs delivery-time digest: catches a sender (or
+        anyone holding the reference) mutating a message while it sat in
+        the pending set."""
+        sealed = getattr(entry, "sent_digest", None)
+        if sealed is None:
+            return
+        if digest(entry.msg) != sealed:
+            _note(
+                "mutations_pending", f"pending:{entry.rcv}",
+                f"message {entry.snd!r}->{entry.rcv!r} changed while "
+                "pending (mutated after send)",
+                self.strict,
+            )
+
+    def run(self, handler: Callable, ctx, entry) -> Any:
+        """Execute one delivery's handler under the traps, then verify
+        the received message was not mutated in place."""
+        pre = digest(entry.msg)
+        try:
+            with _Traps(entry.rcv, self.strict):
+                return handler(ctx)
+        finally:
+            if digest(entry.msg) != pre:
+                _note(
+                    "mutations_receive", f"receive:{entry.rcv}",
+                    f"handler of {entry.rcv!r} mutated the received "
+                    "message in place",
+                    self.strict,
+                )
+
+
+_OBSERVE = Sanitizer(strict=False)
+_STRICT = Sanitizer(strict=True)
+
+
+def active() -> Optional[Sanitizer]:
+    """The process Sanitizer when enabled, else None. Singletons — the
+    runtime resolves this once per delivery / capture window, so the
+    disabled path costs one env read and no allocation."""
+    m = mode()
+    if m is None:
+        return None
+    return _STRICT if m == "strict" else _OBSERVE

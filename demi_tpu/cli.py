@@ -160,11 +160,61 @@ def _device_confirm_sweep(app, args, program, lanes: int = 32):
     return result
 
 
+def _sanitize_begin(args, strict: bool = False):
+    """Arm the runtime replay sanitizer for this run when ``--sanitize``
+    was passed (DEMI_SANITIZE=1/strict does the same without the flag).
+    Same env-switch contract as --prefix-fork/--async-min — the runtime
+    reads the env at each delivery, so the flag reaches every stage —
+    but the previous value is restored by ``_sanitize_end`` so one
+    --sanitize invocation cannot leak strictness into later ``main()``
+    calls in the same process (or into child processes)."""
+    prev = os.environ.get("DEMI_SANITIZE")
+    changed = False
+    if getattr(args, "sanitize", False):
+        os.environ["DEMI_SANITIZE"] = "strict" if strict else "1"
+        changed = True
+    from .analysis import sanitize
+
+    return (sanitize.enabled(), changed, prev)
+
+
+def _sanitize_end(token) -> None:
+    enabled, changed, prev = token
+    if changed:
+        if prev is None:
+            os.environ.pop("DEMI_SANITIZE", None)
+        else:
+            os.environ["DEMI_SANITIZE"] = prev
+    if not enabled:
+        return
+    from .analysis import sanitize
+
+    print(f"sanitizer: {json.dumps(sanitize.stats())}")
+
+
+def cmd_lint(args) -> int:
+    """Determinism lint over app modules/files (default: the bundled
+    zoo). Exit code 1 when any error-level finding survives
+    suppression — the CI contract."""
+    from .analysis import has_errors, lint_targets, render_json, render_text
+
+    try:
+        findings = lint_targets(args.targets or None)
+    except (FileNotFoundError, SyntaxError) as exc:
+        raise SystemExit(f"lint: {exc}")
+    if args.format == "json":
+        print(json.dumps(render_json(findings), indent=2, sort_keys=True))
+    else:
+        print(render_text(findings), end="")
+    return 1 if has_errors(findings) else 0
+
+
 def cmd_fuzz(args) -> int:
     from .runner import fuzz
     from .serialization import ExperimentSerializer
 
     _obs_begin(args)
+    sanitizing = _sanitize_begin(args)
     # The device sweep is extra WORK, not just bookkeeping: run it only
     # when this invocation explicitly asked for observability artifacts
     # (a global DEMI_OBS=1 must observe the run, not change it).
@@ -211,6 +261,7 @@ def cmd_fuzz(args) -> int:
                 }
             )
         )
+    _sanitize_end(sanitizing)
     if result is None:
         _obs_end(args)
         print("no violation found")
@@ -259,6 +310,7 @@ def cmd_minimize(args) -> int:
     from .serialization import ExperimentDeserializer, ExperimentSerializer
 
     _obs_begin(args)
+    sanitizing = _sanitize_begin(args)
     app = build_app(args)
     config = SchedulerConfig(invariant_check=make_host_invariant(app))
     de = ExperimentDeserializer(args.experiment, app)
@@ -290,6 +342,7 @@ def cmd_minimize(args) -> int:
         )
         kept = mcs.get_all_events()
         print(f"IncDDMin MCS: {len(externals)} -> {len(kept)} externals")
+        _sanitize_end(sanitizing)
         ExperimentSerializer.save(
             args.experiment, externals, trace, violation, app_name=args.app,
             mcs=kept,
@@ -314,6 +367,7 @@ def cmd_minimize(args) -> int:
             stage_budget_seconds=args.stage_budget,
         )
     print_minimization_stats(result)
+    _sanitize_end(sanitizing)
     ExperimentSerializer.save(
         args.experiment, externals, trace, violation, app_name=args.app,
         mcs=result.mcs_externals, minimized_trace=result.final_trace,
@@ -328,6 +382,11 @@ def cmd_replay(args) -> int:
     from .schedulers.replay import ReplayScheduler
     from .serialization import ExperimentDeserializer
 
+    # Strict replay is exactly where handler nondeterminism invalidates
+    # the run silently, so --sanitize here arms the STRICT mode: a
+    # wall-clock read / global random draw / message mutation raises
+    # instead of just counting.
+    sanitizing = _sanitize_begin(args, strict=True)
     app = build_app(args)
     config = SchedulerConfig(invariant_check=make_host_invariant(app))
     de = ExperimentDeserializer(args.experiment, app)
@@ -337,6 +396,7 @@ def cmd_replay(args) -> int:
     print(
         f"replayed {result.deliveries} deliveries; violation: {result.violation}"
     )
+    _sanitize_end(sanitizing)
     return 0 if result.violation is not None else 1
 
 
@@ -528,6 +588,9 @@ def cmd_dpor(args) -> int:
     oracle = DeviceDPOROracle(
         app, cfg, config, batch_size=args.batch, max_rounds=args.rounds,
         autotune=autotune, double_buffer=double_buffer,
+        static_independence=(
+            True if getattr(args, "static_prune", False) else None
+        ),
     )
     with obs.span("cli.dpor", app=args.app):
         trace = oracle.test(program, None)
@@ -549,6 +612,12 @@ def cmd_dpor(args) -> int:
     if oracle.supports_async:
         # In-flight round economics (speculative launches used/discarded).
         summary["async"] = oracle.async_stats()
+    if oracle.static_stats is not None:
+        # Racing pairs skipped as provably-no-op flips (static
+        # commutativity analysis; also the analysis.static_pruned
+        # counters under DEMI_OBS).
+        summary["static_pruned"] = oracle.static_stats
+        summary["static_relation"] = oracle.static_independence.summary()
     print(json.dumps(summary))
     _obs_end(args)
     return 0 if trace is not None else 1
@@ -886,10 +955,35 @@ def main(argv: Optional[list] = None) -> int:
                  "the same; off by default)",
         )
 
+    def sanitize_flags(p, strict: bool = False):
+        p.add_argument(
+            "--sanitize", action="store_true",
+            help="runtime replay sanitizer: digest messages before/after "
+                 "delivery (catches in-place mutation) and trap "
+                 "wall-clock/global-random calls in handlers "
+                 + ("— STRICT here: a trip aborts the replay "
+                    if strict else "(counts + warnings) ")
+                 + "(DEMI_SANITIZE=1/strict does the same; off by default)",
+        )
+
+    p = sub.add_parser(
+        "lint",
+        help="determinism lint over app modules/files (default: the "
+             "bundled zoo); exits 1 on error-level findings",
+    )
+    p.add_argument(
+        "targets", nargs="*",
+        help="dotted module names, files, or directories "
+             "(default: demi_tpu.apps + demi_tpu.bridge.demo_app)",
+    )
+    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.set_defaults(fn=cmd_lint)
+
     p = sub.add_parser("fuzz", help="random fuzzing until a violation")
     common(p)
     obs_flags(p)
     tune_flags(p)
+    sanitize_flags(p)
     p.add_argument("--max-executions", type=int, default=200, dest="max_executions")
     p.add_argument("-o", "--output", default=None)
     p.set_defaults(fn=cmd_fuzz)
@@ -903,6 +997,7 @@ def main(argv: Optional[list] = None) -> int:
     obs_flags(p)
     fork_flags(p)
     async_min_flags(p)
+    sanitize_flags(p)
     p.add_argument("-e", "--experiment", required=True)
     p.add_argument("--no-wildcards", action="store_true")
     p.add_argument(
@@ -939,6 +1034,7 @@ def main(argv: Optional[list] = None) -> int:
 
     p = sub.add_parser("replay", help="strict-replay an experiment")
     common(p)
+    sanitize_flags(p, strict=True)
     p.add_argument("-e", "--experiment", required=True)
     p.set_defaults(fn=cmd_replay)
 
@@ -983,6 +1079,13 @@ def main(argv: Optional[list] = None) -> int:
     p.add_argument("--batch", type=int, default=64)
     p.add_argument("--pool", type=int, default=256)
     p.add_argument("--rounds", type=int, default=10)
+    p.add_argument(
+        "--static-prune", action="store_true", dest="static_prune",
+        help="static commutativity pruning: skip racing pairs whose flip "
+             "is provably a no-op (content-identical records, or tags "
+             "the AST field-effect analysis proves commuting); "
+             "DEMI_STATIC_PRUNE=1 does the same; off by default",
+    )
     p.set_defaults(fn=cmd_dpor)
 
     p = sub.add_parser(

@@ -248,7 +248,8 @@ def make_dpor_kernel(app: DSLApp, cfg: DeviceConfig, start_state: bool = False):
 # ---------------------------------------------------------------------------
 
 def racing_prescriptions(
-    records: np.ndarray, trace_len: int, rec_width: int
+    records: np.ndarray, trace_len: int, rec_width: int,
+    independence=None,
 ) -> List[Tuple[Tuple[int, ...], ...]]:
     """From one lane's parent-tracked trace, derive backtrack prescriptions:
     for each racing pair (i, j) — same receiver, concurrent (no
@@ -276,12 +277,52 @@ def racing_prescriptions(
     tuples = {int(p): tuple(int(x) for x in recs[p]) for p in positions}
     ordered = [int(p) for p in positions]
     out: List[Tuple[Tuple[int, ...], ...]] = []
+    pruned_fungible = pruned_commute = 0
     for i, j in pairs:
+        if independence is not None:
+            # Same per-pair predicate + placement as the batch paths
+            # (analysis.StaticIndependence; fungible checked first), so
+            # legacy-vs-vectorized stays bit-identical with pruning on.
+            kind = independence.pair_pruned_kind(recs[i], recs[int(j)],
+                                                rec_width)
+            if kind is not None:
+                if kind == "fungible":
+                    pruned_fungible += 1
+                else:
+                    pruned_commute += 1
+                if independence.audit:
+                    k = np.searchsorted(positions, i)
+                    independence.note_pruned_prescription(
+                        tuple([tuples[p] for p in ordered[:k]]
+                              + [tuples[int(j)]])
+                    )
+                continue
         k = np.searchsorted(positions, i)
         prefix = [tuples[p] for p in ordered[:k]]
         prefix.append(tuples[int(j)])
         out.append(tuple(prefix))
+    if independence is not None:
+        independence.note_pruned(pruned_fungible, pruned_commute,
+                                 tier="device")
     return out
+
+
+def _resolve_static_independence(app: DSLApp, explicit=None):
+    """Resolve the static-pruning switch into a relation (or None).
+
+    ``explicit`` may be an analysis.StaticIndependence instance (used as
+    given — the bench passes audit-mode relations), True (build one from
+    the app's handler analysis), False (off), or None (the
+    ``DEMI_STATIC_PRUNE`` env flag decides). Off by default: static
+    pruning changes which backtracks are derived, so like every
+    schedule-space feature here it ships opt-in."""
+    from ..analysis import StaticIndependence, static_prune_enabled
+
+    if explicit is not None and not isinstance(explicit, bool):
+        return explicit
+    if static_prune_enabled(explicit):
+        return StaticIndependence.for_app(app)
+    return None
 
 
 def _resolve_host_path(explicit: Optional[str] = None) -> str:
@@ -342,6 +383,7 @@ class DeviceDPOROracle:
         async_min: Optional[bool] = None,
         double_buffer: Optional[bool] = None,
         host_path: Optional[str] = None,
+        static_independence=None,
     ):
         from ..minimization.pipeline import async_min_enabled
         from .fork import prefix_fork_enabled
@@ -355,6 +397,12 @@ class DeviceDPOROracle:
         self.initial_trace = initial_trace
         self.prefix_fork = prefix_fork
         self.host_path = host_path
+        # One static may-commute relation shared by every resumable
+        # instance (the relation is per-app; its prune ledger aggregates
+        # across instances — what static_stats reports).
+        self.static_independence = _resolve_static_independence(
+            app, static_independence
+        )
         self.max_distance: Optional[int] = None
         # Measurement-guided budget control: each resumable DPOR instance
         # gets its own DporBudgetTuner (frontier dynamics are
@@ -423,6 +471,15 @@ class DeviceDPOROracle:
                 out[k] += inst.async_stats[k]
         return out
 
+    @property
+    def static_stats(self) -> Optional[Dict[str, int]]:
+        """Static-pruning ledger (None when the relation is off) — what
+        the CLI summary and bench report: racing pairs skipped because
+        the flip was provably a no-op, by kind."""
+        if self.static_independence is None:
+            return None
+        return dict(self.static_independence.pruned_total)
+
     def host_share(self) -> Optional[float]:
         """Host-vs-device wall-time split summed across the resumable
         instances (None before any round ran) — the CLI summary's
@@ -443,6 +500,11 @@ class DeviceDPOROracle:
                 kernel=self._kernel,
                 fork_kernel=self._fork_kernel,
                 host_path=self.host_path,
+                static_independence=(
+                    self.static_independence
+                    if self.static_independence is not None
+                    else False
+                ),
             )
             if self.initial_trace is not None:
                 inst.seed(
@@ -706,6 +768,7 @@ class DeviceDPOR:
         kernel=None,
         fork_kernel=None,
         host_path: Optional[str] = None,
+        static_independence=None,
     ):
         assert cfg.record_trace and cfg.record_parents
         self.app = app
@@ -826,6 +889,14 @@ class DeviceDPOR:
         # loop). Both produce bit-identical explored/frontier/results —
         # pinned by tests/test_host_path.py and bench config 8.
         self.host_path = _resolve_host_path(host_path)
+        # Static may-commute relation (analysis.StaticIndependence; off
+        # by default / DEMI_STATIC_PRUNE=1): racing pairs whose flip is
+        # provably a no-op are skipped during prescription derivation —
+        # counted in analysis.static_pruned, never admitted. Both host
+        # paths consult the same relation with the same placement.
+        self.static_independence = _resolve_static_independence(
+            app, static_independence
+        )
         # Host-share accounting (always on — two perf_counter reads per
         # round): wall time blocked harvesting device results vs
         # everything else in the frontier loop. The dpor.host_share gauge
@@ -1158,6 +1229,7 @@ class DeviceDPOR:
         rows, offsets, lanes, digests = racing_prescriptions_batch(
             traces[:n_lanes], lens[:n_lanes], recw,
             size_hint=self._batch_size_hint,
+            independence=self.static_independence,
         )
         # Adaptive buffer sizing: the next round's scan allocates for
         # this round's volume (+ slack) instead of a blind worst case.
@@ -1213,7 +1285,8 @@ class DeviceDPOR:
         fresh_n = redundant_n = pruned_n = 0
         for lane in range(n_lanes):
             for presc in racing_prescriptions(
-                traces[lane], int(lens[lane]), self.cfg.rec_width
+                traces[lane], int(lens[lane]), self.cfg.rec_width,
+                independence=self.static_independence,
             ):
                 if presc in self.explored:
                     redundant_n += 1
@@ -1237,6 +1310,36 @@ class DeviceDPOR:
         total = self.host_seconds + self.device_seconds
         return self.host_seconds / total if total > 0 else None
 
+    @property
+    def static_stats(self) -> Optional[Dict[str, int]]:
+        """Static-pruning ledger by kind (None when the relation is
+        off) — reported by bench configs 2/8 next to the redundant /
+        distance-pruned counts."""
+        if self.static_independence is None:
+            return None
+        return dict(self.static_independence.pruned_total)
+
+    def _account_device(self, secs: float) -> None:
+        """Fold a device-blocked span into the ledger + obs series. The
+        windowed oracle path (``explore_window``) uses this directly, so
+        DPOR-oracle windows land in the report's host-share block just
+        like plain ``explore`` rounds."""
+        self.device_seconds += secs
+        if obs.enabled():
+            obs.counter("dpor.device_seconds").inc(secs)
+            share = self.host_share
+            if share is not None:
+                obs.gauge("dpor.host_share").set(share)
+
+    def _account_host(self, secs: float) -> None:
+        """Host-side twin of ``_account_device``."""
+        self.host_seconds += secs
+        if obs.enabled():
+            obs.counter("dpor.host_seconds").inc(secs)
+            share = self.host_share
+            if share is not None:
+                obs.gauge("dpor.host_share").set(share)
+
     def _account_round(self, round_t0: float, device_secs: float) -> None:
         """Fold one frontier round's wall time into the host/device
         split: ``device_secs`` is the harvest-blocked span, the rest of
@@ -1244,14 +1347,8 @@ class DeviceDPOR:
         racing analysis, dedup). Always tracked (two clock reads); the
         ``dpor.host_*`` obs series mirror it when telemetry is on."""
         host_secs = max(0.0, time.perf_counter() - round_t0 - device_secs)
-        self.device_seconds += device_secs
-        self.host_seconds += host_secs
-        if obs.enabled():
-            obs.counter("dpor.host_seconds").inc(host_secs)
-            obs.counter("dpor.device_seconds").inc(device_secs)
-            share = self.host_share
-            if share is not None:
-                obs.gauge("dpor.host_share").set(share)
+        self._account_device(device_secs)
+        self._account_host(host_secs)
 
     def explore(
         self, target_code: Optional[int] = None, max_rounds: int = 20
@@ -1438,10 +1535,12 @@ def explore_window(
             )
             jax.block_until_ready(res.violation)
             # Window launches serve several instances at once: split the
-            # blocked span evenly for the per-instance host-share ledger.
+            # blocked span evenly for the per-instance host-share ledger
+            # (through the accounting helper, so windowed oracle rounds
+            # reach the dpor.host_share gauge + seconds counters too).
             dev_each = (time.perf_counter() - t_harvest) / len(staged)
             for i, *_ in staged:
-                dpors[i].device_seconds += dev_each
+                dpors[i]._account_device(dev_each)
             off = 0
             for i, batch, _prescs, _keys in staged:
                 results.append((i, batch, LaneResult(*(
@@ -1458,7 +1557,7 @@ def explore_window(
             for i, batch, parts in handles:
                 t_harvest = time.perf_counter()
                 harvested = dpors[i]._harvest_round(parts, len(batch))
-                dpors[i].device_seconds += time.perf_counter() - t_harvest
+                dpors[i]._account_device(time.perf_counter() - t_harvest)
                 results.append((i, batch, harvested))
         for i, batch, res in results:
             t_host = time.perf_counter()
@@ -1469,7 +1568,7 @@ def explore_window(
                     res, batch, target_code, pendings[i],
                     frontier_extra=len(frontiers[i]),
                 )
-            dpors[i].host_seconds += time.perf_counter() - t_host
+            dpors[i]._account_host(time.perf_counter() - t_host)
             if hit is not None:
                 obs.counter("dpor.violations_found").inc()
                 found[i] = hit

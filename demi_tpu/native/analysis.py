@@ -152,6 +152,16 @@ def _load_native() -> Optional[ctypes.CDLL]:
             ctypes.c_void_p,
             ctypes.c_void_p,
         ]
+        lib.demi_racing_prescriptions_static.restype = ctypes.c_int64
+        lib.demi_racing_prescriptions_static.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_int32,
+            ctypes.c_void_p, ctypes.c_int64,
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+            ctypes.c_void_p,
+            ctypes.c_void_p, ctypes.c_void_p,
+        ]
         _lib = lib
     except Exception as exc:  # stale .so without the batch symbol included
         note_fallback(f"load failed: {type(exc).__name__}")
@@ -225,6 +235,7 @@ def racing_pair_scan(recs: np.ndarray) -> np.ndarray:
 def racing_prescriptions_batch(
     records: np.ndarray, lens: np.ndarray, rec_width: int,
     size_hint: Optional[Tuple[int, int]] = None,
+    independence=None,
 ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
     """Batch racing analysis over one round's stacked lane records.
 
@@ -252,7 +263,16 @@ def racing_prescriptions_batch(
     tests/test_host_path.py). One native call (or one NumPy pass) serves
     the whole round. ``size_hint=(n_presc, n_rows)`` (e.g. the previous
     round's totals) sizes the output buffers; an overflow retries once
-    with exact sizes."""
+    with exact sizes.
+
+    ``independence`` (an analysis.StaticIndependence or None) prunes
+    racing pairs whose flip is provably a no-op: content-identical
+    ("fungible") records, and tag pairs the static field-effect matrix
+    proves commuting. The native scan consults the fixed-shape matrix
+    per pair (``demi_racing_prescriptions_static``); the NumPy twin —
+    also used for ``independence.audit`` runs, which must materialize
+    what was pruned — post-filters with identical placement and counts.
+    Pruned counts report via ``independence.note_pruned``."""
     records = np.ascontiguousarray(
         np.asarray(records)[:, :, :rec_width], np.int32
     )
@@ -267,8 +287,23 @@ def racing_prescriptions_batch(
     if lib is None:
         note_fallback("no native library")
         rows, offsets, lanes = _np_racing_prescriptions(records, lens)
-        return rows, offsets, lanes, prescription_digests(rows, offsets)
+        out = (rows, offsets, lanes, prescription_digests(rows, offsets))
+        if independence is not None:
+            out = _apply_static_filter(records, lens, *out,
+                                       independence=independence)
+        return out
     lens = np.ascontiguousarray(lens)
+    # The native per-pair filter serves the hot path; audit runs (which
+    # must materialize every pruned prescription) post-filter the
+    # unfiltered native stream with the identically-placed NumPy twin.
+    native_filter = independence is not None and not independence.audit
+    matrix = fungible = None
+    if native_filter:
+        matrix = independence.device_matrix()
+        fungible = independence.fungible
+        if matrix is None and not fungible:
+            native_filter = False
+            independence = None  # nothing to prune
     if size_hint is not None:
         cap_presc = max(64, int(size_hint[0]))
         cap_rows = max(256, int(size_hint[1]))
@@ -281,23 +316,117 @@ def racing_prescriptions_batch(
         lanes = np.empty(cap_presc, np.int32)
         digests = np.empty((cap_presc, 2), np.uint64)
         total_rows = ctypes.c_int64(0)
-        n = lib.demi_racing_prescriptions(
-            records.ctypes.data, lens.ctypes.data,
-            batch, rmax, w,
-            rows.ctypes.data, cap_rows,
-            offsets.ctypes.data, lanes.ctypes.data, cap_presc,
-            digests.ctypes.data,
-            ctypes.byref(total_rows),
-        )
+        if native_filter:
+            pruned = np.zeros(2, np.int64)
+            n = lib.demi_racing_prescriptions_static(
+                records.ctypes.data, lens.ctypes.data,
+                batch, rmax, w,
+                matrix.ctypes.data if matrix is not None else None,
+                len(matrix) if matrix is not None else 0,
+                1 if fungible else 0,
+                rows.ctypes.data, cap_rows,
+                offsets.ctypes.data, lanes.ctypes.data, cap_presc,
+                digests.ctypes.data,
+                ctypes.byref(total_rows),
+                pruned.ctypes.data,
+            )
+        else:
+            n = lib.demi_racing_prescriptions(
+                records.ctypes.data, lens.ctypes.data,
+                batch, rmax, w,
+                rows.ctypes.data, cap_rows,
+                offsets.ctypes.data, lanes.ctypes.data, cap_presc,
+                digests.ctypes.data,
+                ctypes.byref(total_rows),
+            )
         if n <= cap_presc and total_rows.value <= cap_rows:
-            return (
+            out = (
                 rows[: total_rows.value],
                 offsets[: n + 1],
                 lanes[:n],
                 digests[:n],
             )
+            if native_filter:
+                independence.note_pruned(
+                    int(pruned[0]), int(pruned[1]), tier="device"
+                )
+            elif independence is not None:
+                out = _apply_static_filter(records, lens, *out,
+                                           independence=independence)
+            return out
         cap_presc = max(cap_presc, int(n))
         cap_rows = max(cap_rows, int(total_rows.value))
+
+
+def _apply_static_filter(
+    records: np.ndarray, lens: np.ndarray,
+    rows: np.ndarray, offsets: np.ndarray, lanes: np.ndarray,
+    digests: np.ndarray, independence,
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """NumPy twin of the native static-independence filter: drop
+    prescriptions whose racing pair is a provable no-op flip. Same
+    predicate, same ordering (fungible counted before commute), bit-
+    identical surviving stream — pinned by tests/test_lint.py. Under
+    ``independence.audit`` every pruned prescription is materialized
+    into ``independence.pruned_prescriptions`` (the bench's exact-no-op
+    assertion reads it)."""
+    n = len(lanes)
+    if n == 0:
+        return rows, offsets, lanes, digests
+    w = rows.shape[1]
+    offsets = np.asarray(offsets, np.int64)
+    lanes = np.asarray(lanes)
+    mlen = offsets[1:] - offsets[:-1]
+    rows_j = rows[offsets[1:] - 1]
+    # The flipped-past record: a prescription with m rows flips past its
+    # lane's (m-1)-th delivery (0-based, position order).
+    rows_i = np.empty_like(rows_j)
+    for b in np.unique(lanes):
+        recs = records[b, : int(lens[b])]
+        pos = np.nonzero(np.isin(recs[:, 0], _delivery_kinds()))[0]
+        sel = lanes == b
+        rows_i[sel] = recs[pos][mlen[sel] - 1]
+    fung = np.zeros(n, bool)
+    if independence.fungible:
+        rec_timer = _delivery_kinds()[1]
+        fung = (
+            (rows_i[:, 0] == rows_j[:, 0])
+            & (rows_i[:, 2] == rows_j[:, 2])
+            & np.all(rows_i[:, 3: w - 2] == rows_j[:, 3: w - 2], axis=1)
+            & ((rows_i[:, 0] == rec_timer) | (rows_i[:, 1] == rows_j[:, 1]))
+        )
+    comm = np.zeros(n, bool)
+    matrix = independence.device_matrix()
+    if matrix is not None:
+        m_sz = len(matrix)
+        ti = rows_i[:, 3].astype(np.int64)
+        tj = rows_j[:, 3].astype(np.int64)
+        ia = np.where((ti >= 0) & (ti < m_sz - 1), ti, m_sz - 1)
+        ib = np.where((tj >= 0) & (tj < m_sz - 1), tj, m_sz - 1)
+        comm = matrix[ia, ib].astype(bool) & ~fung
+    prune = fung | comm
+    independence.note_pruned(
+        int(fung.sum()), int(comm.sum()), tier="device"
+    )
+    if not prune.any():
+        return rows, offsets, lanes, digests
+    if independence.audit:
+        for k in np.flatnonzero(prune):
+            lo, hi = int(offsets[k]), int(offsets[k + 1])
+            independence.note_pruned_prescription(
+                tuple(tuple(int(x) for x in r) for r in rows[lo:hi])
+            )
+    keep = ~prune
+    row_keep = np.repeat(keep, mlen)
+    new_mlen = mlen[keep]
+    new_offsets = np.zeros(len(new_mlen) + 1, np.int64)
+    np.cumsum(new_mlen, out=new_offsets[1:])
+    return (
+        np.ascontiguousarray(rows[row_keep]),
+        new_offsets,
+        lanes[keep],
+        np.asarray(digests)[keep],
+    )
 
 
 def _np_racing_prescriptions(

@@ -72,6 +72,14 @@ class Context:
     def log(self, line: str) -> None:
         self._system._capture_log(self.name, line)
 
+    def rng(self):
+        """Deterministic per-delivery ``random.Random`` — the
+        harness-sanctioned replacement for module-level random (lint
+        rule ``unseeded-random``, ``demi_tpu lint``). Seeded by (actor,
+        delivery uid), so every re-execution and strict replay draws the
+        identical stream; the DEMI_SANITIZE traps never fire on it."""
+        return self._system.delivery_rng(self.name)
+
 
 class Actor:
     """Base class for host-tier (rich Python) application actors."""

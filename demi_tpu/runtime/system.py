@@ -21,13 +21,24 @@ network, vector clocks, and crash state.
 from __future__ import annotations
 
 import copy
+import hashlib
 import itertools
+import random as _random
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Set, Tuple
 
 from .. import obs
 from ..events import EXTERNAL, FAILURE_DETECTOR, IdGenerator
 from .actor import Actor, Context
+
+
+def _sanitizer():
+    """The active replay sanitizer (None when DEMI_SANITIZE is off).
+    Imported lazily: analysis.sanitize imports this module for the
+    HarnessError base."""
+    from ..analysis import sanitize
+
+    return sanitize.active()
 
 
 class HarnessError(Exception):
@@ -52,6 +63,9 @@ class PendingEntry:
     is_timer: bool = False
     # Sender's vector clock snapshot at send time (for ShiViz export).
     vc: Optional[Dict[str, int]] = field(default=None, compare=False, repr=False)
+    # Capture-time content digest (DEMI_SANITIZE only): deliver()
+    # re-digests and flags messages mutated while pending.
+    sent_digest: Optional[bytes] = field(default=None, compare=False, repr=False)
 
     @property
     def is_external(self) -> bool:
@@ -116,6 +130,13 @@ class ControlledActorSystem:
         self.log_listener: Optional[Callable[[str, str], None]] = None
         # Send-capture buffer, active only inside deliver()/spawn().
         self._capturing: Optional[List[PendingEntry]] = None
+        # uid of the entry currently being delivered (None outside
+        # deliver / during on_start) — seeds Context.rng() so handler
+        # randomness is deterministic per delivery and replay-stable.
+        self._current_uid: Optional[int] = None
+        # Sanitizer resolved once per capture window (_with_capture), so
+        # per-send digest sealing costs no env read when disabled.
+        self._active_sanitizer = None
         # Last completed (or aborted) capture buffer — the crash path reads
         # this, since _with_capture's finally clears _capturing before the
         # exception propagates.
@@ -250,6 +271,18 @@ class ControlledActorSystem:
             handler = lambda ctx: ask[1](ctx, entry.msg)  # noqa: E731
         else:
             handler = lambda ctx: actor.receive(ctx, entry.snd, entry.msg)  # noqa: E731
+        san = _sanitizer()
+        if san is not None:
+            # Replay sanitizer (DEMI_SANITIZE): pending-mutation check,
+            # receive-mutation digests, and time/random traps around the
+            # handler. A strict-mode trip raises SanitizerError — a
+            # HarnessError, so it propagates instead of reading as an
+            # application crash.
+            san.check_pending(entry)
+            handler = (
+                lambda ctx, h=handler, e=entry: san.run(h, ctx, e)  # noqa: E731
+            )
+        self._current_uid = entry.uid
         try:
             return self._with_capture(entry.rcv, handler)
         except HarnessError:
@@ -282,6 +315,7 @@ class ControlledActorSystem:
         self._last_capture = []
         assert self._capturing is None, "re-entrant delivery"
         self._capturing = []
+        self._active_sanitizer = _sanitizer()
         ctx = Context(self, name)
         try:
             fn(ctx)
@@ -289,19 +323,29 @@ class ControlledActorSystem:
             captured = self._capturing
             self._capturing = None
             self._last_capture = captured
+            self._current_uid = None
+            self._active_sanitizer = None
         return captured
 
     def _capture_send(self, snd: str, rcv: str, msg: Any) -> None:
         assert self._capturing is not None, "send outside a delivery"
         vc = dict(self.vector_clocks.get(snd, {}))
+        san = self._active_sanitizer
         self._capturing.append(
-            PendingEntry(self.id_gen.next(), snd, rcv, msg, vc=vc)
+            PendingEntry(
+                self.id_gen.next(), snd, rcv, msg, vc=vc,
+                sent_digest=san.seal(msg) if san is not None else None,
+            )
         )
 
     def _capture_timer(self, name: str, msg: Any) -> None:
         assert self._capturing is not None, "timer armed outside a delivery"
+        san = self._active_sanitizer
         self._capturing.append(
-            PendingEntry(self.id_gen.next(), name, name, msg, is_timer=True)
+            PendingEntry(
+                self.id_gen.next(), name, name, msg, is_timer=True,
+                sent_digest=san.seal(msg) if san is not None else None,
+            )
         )
 
     def _cancel_timer(self, name: str, msg: Any) -> None:
@@ -324,6 +368,22 @@ class ControlledActorSystem:
     def _capture_log(self, name: str, line: str) -> None:
         if self.log_listener is not None:
             self.log_listener(name, line)
+
+    # -- harness-sanctioned randomness (Context.rng) ----------------------
+    def delivery_rng(self, name: str) -> _random.Random:
+        """Deterministic PRNG scoped to the current delivery: seeded by
+        (actor, delivered entry uid), both stable across re-executions
+        (uids come from the checkpointed IdGenerator), so replays draw
+        identical streams. This is the fix the `unseeded-random` lint
+        rule points at."""
+        tag = "start" if self._current_uid is None else str(self._current_uid)
+        seed = int.from_bytes(
+            hashlib.blake2b(
+                f"{name}:{tag}".encode(), digest_size=8
+            ).digest(),
+            "big",
+        )
+        return _random.Random(seed)
 
     # -- vector clocks (ShiViz export; reference: Util.scala:202-233) ------
     def _merge_vector_clock(self, entry: PendingEntry) -> None:

@@ -129,7 +129,9 @@ class DepTracker:
         return not self.is_ancestor(a, b) and not self.is_ancestor(b, a)
 
     # -- the racing-pair scan (vectorized) --------------------------------
-    def racing_pairs(self, trace: List[int]) -> List[Tuple[int, int]]:
+    def racing_pairs(
+        self, trace: List[int], independence=None
+    ) -> List[Tuple[int, int]]:
         """All (i, j) index pairs in ``trace`` (i < j) whose events race:
         same receiver, j's message already created at i, and the race is
         IMMEDIATE under the happens-before closure over creation edges
@@ -143,7 +145,13 @@ class DepTracker:
         HB), which only inflate the backtrack frontier: a non-immediate
         flip is reachable by composing the immediate ones, each exposed by
         the rescan of the flipped execution (source-set DPOR's race
-        relation). Device twin: native/trace_analysis.cpp."""
+        relation). Device twin: native/trace_analysis.cpp.
+
+        ``independence`` (an analysis.StaticIndependence or None) drops
+        pairs whose flip is provably a no-op — fungible (identical
+        fingerprint/sender) events, or message types the static handler
+        analysis proves commuting — counted into
+        ``analysis.static_pruned{tier=host}``."""
         n = len(trace)
         if n < 2:
             return []
@@ -164,6 +172,7 @@ class DepTracker:
                     past[p] |= past[q]
                     past[p, q // 64] |= np.uint64(1) << np.uint64(q % 64)
         out = []
+        pruned = {"fungible": 0, "commute": 0}
         for j in range(1, n):
             for i in range(j):
                 if rcvs[i] != rcvs[j]:
@@ -172,5 +181,16 @@ class DepTracker:
                     continue  # j's message didn't exist yet at i
                 if (interp[j, i // 64] >> np.uint64(i % 64)) & np.uint64(1):
                     continue  # interposed: not an immediate race
+                if independence is not None:
+                    kind = independence.host_commutes_kind(
+                        self.events[trace[i]], self.events[trace[j]]
+                    )
+                    if kind is not None:
+                        pruned[kind] += 1
+                        continue
                 out.append((i, j))
+        if independence is not None:
+            independence.note_pruned(
+                pruned["fungible"], pruned["commute"], tier="host"
+            )
         return out

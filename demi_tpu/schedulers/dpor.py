@@ -235,11 +235,18 @@ class DPORScheduler(TestOracle):
         max_distance: Optional[int] = None,
         stop_after_next_trace: bool = False,
         arvind_ordering: bool = False,
+        static_independence=None,
     ):
         self.config = config
         self.max_messages = max_messages
         self.max_interleavings = max_interleavings
         self.budget_seconds = budget_seconds
+        # Static may-commute relation (analysis.StaticIndependence or
+        # None): racing pairs whose flip is provably a no-op produce no
+        # backtrack point (analysis.static_pruned{tier=host}). Explicit
+        # only — the host tier has no app object to analyze from an env
+        # flag alone.
+        self.static_independence = static_independence
         self.ordering = ordering or DefaultBacktrackOrdering()
         # Switch to ArvindDistanceOrdering once the first execution fixes
         # the original trace (it can't exist before then).
@@ -326,7 +333,9 @@ class DPORScheduler(TestOracle):
     def _enqueue_backtracks(self, execution: _DporExecution) -> None:
         trace = execution.delivered_ids
         pending_sets = execution.pending_sets
-        for i, j in self.tracker.racing_pairs(trace):
+        for i, j in self.tracker.racing_pairs(
+            trace, independence=self.static_independence
+        ):
             flipped = trace[j]
             if i >= len(pending_sets) or flipped not in pending_sets[i]:
                 continue  # not actually deliverable at the branch point

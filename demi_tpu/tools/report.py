@@ -144,6 +144,40 @@ def render_report(directory: str, app=None) -> str:
                     else:
                         lines.append(f"- `{name}`{label}: 0 groups")
             lines.append("")
+        # Static analysis (analysis.* counters): schedule-space pruned by
+        # the static commutativity relation, and what the DEMI_SANITIZE
+        # runtime sanitizer caught — replay-soundness facts that belong
+        # next to the exploration-efficiency numbers, not buried in the
+        # generic counter table.
+        analysis_counters = {
+            name: series
+            for name, series in counters.items()
+            if name.startswith("analysis.")
+        }
+        if analysis_counters:
+            lines += ["### Static analysis", ""]
+            sp = analysis_counters.get("analysis.static_pruned")
+            if sp:
+                total = sum(sp.values())
+                lines.append(
+                    f"- static-pruned racing pairs: {total:g} (provably "
+                    "no-op flips skipped before backtrack derivation)"
+                )
+                for key, v in sorted(sp.items()):
+                    lines.append(f"  - {key or '—'}: {v:g}")
+            for name, label in (
+                ("analysis.sanitizer_mutations", "message mutations"),
+                ("analysis.sanitizer_time_reads", "wall-clock reads"),
+                ("analysis.sanitizer_random_draws", "global random draws"),
+            ):
+                series = analysis_counters.get(name)
+                if series:
+                    lines.append(
+                        f"- sanitizer {label}: {sum(series.values()):g}"
+                    )
+                    for key, v in sorted(series.items()):
+                        lines.append(f"  - {key or '—'}: {v:g}")
+            lines.append("")
         # Async-minimization pipeline summary (pipe.* counters): how much
         # host planning hid under device execution, what speculation paid
         # off, and how often candidate lowering was a gather instead of a

@@ -26,13 +26,20 @@
 //   U(p)    = past(parent) ∪ U(parent) ∪ past(prev) ∪ U(prev)
 // so the whole scan is O(n^2 / 64) words, no per-pair graph query.
 //
-// Two entry points share the scan:
+// Three entry points share the scan:
 //   demi_racing_pairs          — one lane's (i, j) pairs (the original).
 //   demi_racing_prescriptions  — a whole ROUND's stacked lanes in one
 //     call, returning fully-assembled backtrack prescriptions as packed
 //     int32 rows plus per-prescription offsets (the batch-native host
 //     path: one ctypes crossing per frontier round instead of one scan
 //     per lane and one Python tuple loop per racing pair).
+//   demi_racing_prescriptions_static — the same batch scan consulting a
+//     fixed-shape static-independence matrix per pair: racing pairs
+//     whose flip is provably a no-op (content-identical "fungible"
+//     records, or message tags the AST field-effect analysis proves
+//     commuting — demi_tpu/analysis/) are counted into pruned_out and
+//     never packed. The filter sits after the immediacy checks so its
+//     counts equal the NumPy fallback's bit-for-bit.
 
 #include <cstddef>
 #include <cstdint>
@@ -40,6 +47,25 @@
 
 namespace {
 inline bool is_delivery(int32_t kind) { return kind == 1 || kind == 2; }
+constexpr int32_t kRecTimer = 2;
+
+// Content-identity over the matchable record columns (kind, dst,
+// payload; src only for non-timers) — parent/prev, the last two
+// columns, are happens-before bookkeeping, not content. MUST mirror
+// demi_tpu/analysis/independence.py::_rows_fungible.
+inline bool rows_fungible(const int32_t* ri, const int32_t* rj, int64_t w) {
+    if (ri[0] != rj[0] || ri[2] != rj[2]) return false;
+    for (int64_t c = 3; c < w - 2; ++c) {
+        if (ri[c] != rj[c]) return false;
+    }
+    return ri[0] == kRecTimer || ri[1] == rj[1];
+}
+
+// Tag -> commute-matrix row: tags outside [0, m-2] land on the all-False
+// catch-all row m-1 (unknown => dependent).
+inline int64_t tag_index(int32_t tag, int64_t m) {
+    return (tag >= 0 && tag < m - 1) ? tag : m - 1;
+}
 
 // 128-bit (2 x 64) content digests over prescription row blocks — the
 // explored-set membership keys. MUST match the NumPy spec in
@@ -157,13 +183,15 @@ int64_t demi_racing_pairs(const int32_t* recs, int64_t n, int64_t w,
 // *total_rows_out — both may exceed the caps, in which case only the
 // prescriptions that fit completely were written and the caller should
 // retry with the returned sizes.
-int64_t demi_racing_prescriptions(
+static int64_t racing_prescriptions_impl(
     const int32_t* recs, const int32_t* lens,
     int64_t batch, int64_t rmax, int64_t w,
+    const uint8_t* commute, int64_t commute_m, int32_t fungible,
     int32_t* out_rows, int64_t cap_rows,
     int64_t* out_offsets, int32_t* out_lane, int64_t cap_presc,
     uint64_t* out_digests,
-    int64_t* total_rows_out) {
+    int64_t* total_rows_out, int64_t* pruned_out) {
+    if (pruned_out) pruned_out[0] = pruned_out[1] = 0;
     int64_t n_presc = 0;
     int64_t n_rows = 0;
     if (cap_presc > 0) out_offsets[0] = 0;
@@ -205,6 +233,21 @@ int64_t demi_racing_prescriptions(
                 if (lane[i * w + 2] != rcv_j) continue;
                 if (cj >= i) continue;
                 if ((uj[i / 64] >> (i % 64)) & 1) continue;
+                // Static independence: a racing pair whose flip is
+                // provably a no-op produces no backtrack prescription
+                // (fungible first — the counter contract shared with
+                // the NumPy twin).
+                if (fungible &&
+                    rows_fungible(lane + i * w, lane + j * w, w)) {
+                    if (pruned_out) ++pruned_out[0];
+                    continue;
+                }
+                if (commute != nullptr &&
+                    commute[tag_index(lane[i * w + 3], commute_m) * commute_m
+                            + tag_index(lane[j * w + 3], commute_m)]) {
+                    if (pruned_out) ++pruned_out[1];
+                    continue;
+                }
                 // Prescription: deliveries[0..ii) (all deliveries before
                 // i — the list is position-sorted) plus row j.
                 const int64_t presc_rows = static_cast<int64_t>(ii) + 1;
@@ -231,6 +274,38 @@ int64_t demi_racing_prescriptions(
     }
     if (total_rows_out) *total_rows_out = n_rows;
     return n_presc;
+}
+
+int64_t demi_racing_prescriptions(
+    const int32_t* recs, const int32_t* lens,
+    int64_t batch, int64_t rmax, int64_t w,
+    int32_t* out_rows, int64_t cap_rows,
+    int64_t* out_offsets, int32_t* out_lane, int64_t cap_presc,
+    uint64_t* out_digests,
+    int64_t* total_rows_out) {
+    return racing_prescriptions_impl(
+        recs, lens, batch, rmax, w, nullptr, 0, 0,
+        out_rows, cap_rows, out_offsets, out_lane, cap_presc,
+        out_digests, total_rows_out, nullptr);
+}
+
+// The static-independence variant (see header comment). ``commute`` is
+// a row-major uint8 [commute_m, commute_m] may-commute matrix over
+// message tags (record column 3), last row/column the all-False
+// catch-all — or NULL for fungible-only filtering. ``pruned_out`` (may
+// be NULL) receives {fungible_pruned, commute_pruned} counts.
+int64_t demi_racing_prescriptions_static(
+    const int32_t* recs, const int32_t* lens,
+    int64_t batch, int64_t rmax, int64_t w,
+    const uint8_t* commute, int64_t commute_m, int32_t fungible,
+    int32_t* out_rows, int64_t cap_rows,
+    int64_t* out_offsets, int32_t* out_lane, int64_t cap_presc,
+    uint64_t* out_digests,
+    int64_t* total_rows_out, int64_t* pruned_out) {
+    return racing_prescriptions_impl(
+        recs, lens, batch, rmax, w, commute, commute_m, fungible,
+        out_rows, cap_rows, out_offsets, out_lane, cap_presc,
+        out_digests, total_rows_out, pruned_out);
 }
 
 }  // extern "C"

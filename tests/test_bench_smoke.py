@@ -15,7 +15,8 @@ def _run_bench(config: str, env_extra: dict) -> dict:
     # The smoke must measure the DEFAULT paths: strip switches that would
     # change kernels or output keys.
     for var in ("DEMI_OBS", "DEMI_AUTOTUNE", "DEMI_PREFIX_FORK",
-                "DEMI_ASYNC_MIN", "DEMI_DEVICE_IMPL", "DEMI_BENCH_IMPL"):
+                "DEMI_ASYNC_MIN", "DEMI_DEVICE_IMPL", "DEMI_BENCH_IMPL",
+                "DEMI_STATIC_PRUNE", "DEMI_SANITIZE"):
         env.pop(var, None)
     out = subprocess.run(
         [sys.executable, os.path.join(REPO, "bench.py"), "--config", config],
@@ -44,6 +45,21 @@ def test_bench_config2_smoke():
         assert abs(
             section["host_share"] + section["device_share"] - 1.0
         ) < 1e-6
+    # Static-commutativity A/B: pruning must have removed ONLY no-op
+    # flips (bench asserts it internally; the keys + invariants are the
+    # smoke contract) and must actually prune on the raft fixture.
+    static = section["static"]
+    for key in ("static_pruned", "explored_without", "explored_with",
+                "removed_prescriptions", "interleavings_match",
+                "noop_only", "commuting_tag_pairs"):
+        assert key in static, key
+    assert static["noop_only"] is True
+    assert static["interleavings_match"] is True
+    assert sum(static["static_pruned"].values()) > 0
+    assert (
+        static["explored_without"] - static["explored_with"]
+        == static["removed_prescriptions"]
+    )
 
 
 def test_bench_config5_smoke():
@@ -168,3 +184,10 @@ def test_bench_config8_smoke():
     assert section["interleavings_match"] is True
     assert section["host_path"]["match"] is True
     assert section["interleavings"] > 0
+    # Static-pruning A/B on the seeded deep fixture: no-op-only removal
+    # with static_pruned > 0 (the deep raft frontier always carries
+    # fungible timer/heartbeat races).
+    static = section["static"]
+    assert static["noop_only"] is True
+    assert static["interleavings_match"] is True
+    assert sum(static["static_pruned"].values()) > 0

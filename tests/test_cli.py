@@ -129,3 +129,38 @@ def test_cli_bridge_fuzz_stream_app_with_invariant(capsys, monkeypatch):
     assert rc == 0
     out = capsys.readouterr().out
     assert "violation" in out and "MCS verified" in out
+
+
+def test_cli_lint_zoo_clean_json(capsys):
+    """CI contract: `demi_tpu lint demi_tpu.apps --format json` exits 0
+    with zero error-level findings on the bundled zoo."""
+    rc = main(["lint", "demi_tpu.apps", "demi_tpu.bridge.demo_app",
+               "--format", "json"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    data = json.loads(out)
+    assert data["counts"]["error"] == 0
+    assert set(data["counts"]) == {"total", "error", "warning", "info"}
+
+
+def test_cli_lint_flags_seeded_fixture(tmp_path, capsys):
+    bad = tmp_path / "bad_app.py"
+    bad.write_text(
+        "import time\n"
+        "def handler(actor_id, state, snd, msg):\n"
+        "    return state, time.time()\n"
+    )
+    rc = main(["lint", str(bad)])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "wall-clock" in out
+    assert f"{bad}:3" in out
+    assert "hint:" in out
+
+    # JSON mode carries rule/severity/location for tooling.
+    rc = main(["lint", str(bad), "--format", "json"])
+    data = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert data["counts"]["error"] == 1
+    f = data["findings"][0]
+    assert f["rule"] == "wall-clock" and f["line"] == 3

@@ -329,3 +329,63 @@ def test_cli_stats_merges_inputs(tmp_path, capsys):
     assert rc == 0
     merged = json.loads(capsys.readouterr().out)
     assert merged["counters"]["x"][""] == 4
+
+
+def test_analysis_counters_and_report_section(telemetry, tmp_path):
+    """analysis.* counters: static pruning and the sanitizer both report
+    into the registry, and report.py renders them as a 'Static analysis'
+    section above the raw counter tables."""
+    import time as _time
+
+    from demi_tpu.analysis import StaticIndependence, sanitize
+    from demi_tpu.native.analysis import racing_prescriptions_batch
+    from demi_tpu.runtime.actor import Actor
+    from demi_tpu.runtime.system import ControlledActorSystem
+
+    # Device-tier static pruning on a hand-built fungible race: two
+    # identical timer records at one receiver, concurrent and immediate.
+    w = 8
+    recs = np.zeros((1, 4, w), np.int32)
+    recs[0, 0] = [2, 1, 1, 5, 0, -1, -1, -1]
+    recs[0, 1] = [2, 1, 1, 5, 0, -1, -1, 0]
+    lens = np.asarray([2], np.int32)
+    rel = StaticIndependence(app_effects=None, fungible=True)
+    rows, offsets, lanes, digests = racing_prescriptions_batch(
+        recs, lens, w, independence=rel
+    )
+    assert rel.pruned_total["fungible"] == 1
+    assert len(lanes) == 0
+
+    # Runtime sanitizer counters.
+    class Clocky(Actor):
+        def receive(self, ctx, snd, msg):
+            _time.time()
+
+    sanitize.enable(strict=False)
+    sanitize.reset_stats()
+    try:
+        sys_ = ControlledActorSystem()
+        sys_.spawn("a", Clocky)
+        sys_.deliver(sys_.inject("a", ("tick",)))
+    finally:
+        sanitize.reset()
+        sanitize.reset_stats()
+
+    snap = obs.REGISTRY.snapshot()
+    assert snap["counters"]["analysis.static_pruned"][
+        "kind=fungible,tier=device"
+    ] == 1
+    assert snap["counters"]["analysis.sanitizer_time_reads"][
+        "fn=time.time"
+    ] == 1
+
+    # The report renders a Static analysis block from the snapshot.
+    from demi_tpu.tools.report import render_report
+
+    exp = tmp_path / "exp"
+    exp.mkdir()
+    (exp / "obs_snapshot.json").write_text(json.dumps(snap))
+    text = render_report(str(exp))
+    assert "### Static analysis" in text
+    assert "static-pruned racing pairs: 1" in text
+    assert "sanitizer wall-clock reads: 1" in text
