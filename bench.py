@@ -35,6 +35,12 @@ Extra keys reported for the record:
     same schedule space). Also measures the vectorized vs legacy-Python
     HOST path with async off (host_path.speedup — the unhidden win) and
     the host-vs-device wall split (host_share target < 25% async-on).
+  - config9: redundancy-ratio A/B — sleep-set + race-reversal DPOR
+    (wakeup-sequence guides, device-encoded sleep rows, Mazurkiewicz
+    class dedup) vs the observe-only baseline on the config-8 deep
+    seeded raft frontier: explored schedules vs. the distinct-class
+    optimal lower bound (redundancy ratio), violation set and first
+    found records asserted bit-identical, rounds/sec for both sides.
   - config5: BASELINE config 5 — 64-actor reliable broadcast sweep
     (schedules/sec + lanes swept; 1M lanes on TPU, smaller on CPU
     fallback; override with DEMI_BENCH_CONFIG5_LANES). Runs in
@@ -46,8 +52,8 @@ Extra keys reported for the record:
 
 Modes: `python bench.py` runs everything; `--config 2` / `--config 3` /
 `--config 4` / `--config 5` / `--config 6` / `--config 7` /
-`--config 8` / `--config rehearsal` run a single section (same one-line
-JSON with that key populated).
+`--config 8` / `--config 9` / `--config rehearsal` run a single section
+(same one-line JSON with that key populated).
 
 DEMI_AUTOTUNE=1 lets the measurement-guided tuner (demi_tpu/tune) pick
 the rehearsal drive's (kernel variant, batch, segment) from short
@@ -406,6 +412,7 @@ def _static_prune_ab(app, cfg, program, batch, rounds, kernel, presc=None):
             app, cfg, program, batch_size=batch, prefix_fork=False,
             double_buffer=False, kernel=kernel,
             static_independence=rel if rel is not None else False,
+            sleep_sets=False,  # the shared kernel is a plain one
         )
         if presc is not None:
             d.seed(presc)
@@ -1037,16 +1044,24 @@ def bench_config8(jax):
         # 'async'   — vectorized + double-buffered rounds + prefix
         #             forking with prescribed-resume trunks.
         if variant == "async":
+            # DEMI_BENCH_CONFIG8_MIN_GROUP overrides the platform fork
+            # gate (CPU default: half a batch — which zeroes the fork
+            # economy at CPU smoke shapes); a permissive value measures
+            # the trunk/anchor hit rates the gate normally hides.
+            min_group = os.environ.get("DEMI_BENCH_CONFIG8_MIN_GROUP")
             dpor = DeviceDPOR(
                 app, cfg, program, batch_size=batch,
                 prefix_fork=True, fork_bucket=bucket,
+                fork_min_group=int(min_group) if min_group else None,
                 double_buffer=True, kernel=kernel, fork_kernel=fork_kernel,
+                sleep_sets=False,  # the shared kernels are plain ones
             )
         else:
             dpor = DeviceDPOR(
                 app, cfg, program, batch_size=batch,
                 prefix_fork=False, double_buffer=False, kernel=kernel,
                 host_path="legacy" if variant == "legacy" else "vectorized",
+                sleep_sets=False,
             )
         dpor.seed(presc)
         dpor.explore(max_rounds=warm)
@@ -1213,6 +1228,11 @@ def bench_config8(jax):
                 3,
             ),
             "parent_trunks": fork["parent_trunks"],
+            # Cross-round trunk reuse (the PR 6 ~0%-hit debt): anchors
+            # cached at sub-bucket stride boundaries while building
+            # trunks, so later rounds' round-unique prefixes resume the
+            # deepest shared ancestor (DEMI_FORK_ANCHOR_STRIDE).
+            "anchor_trunks": fork.get("anchor_trunks", 0),
             "steps_saved": fork["steps_saved"],
             # Fork-group growth: mean forked-group size (the
             # dpor.prefix_group_size shift the cross-generation merge +
@@ -1229,6 +1249,158 @@ def bench_config8(jax):
         # dpor.prefix_group_size shift the bucketed selection produces,
         # independent of whether the platform cost model forks them).
         "sibling_groups": sibling_clustering(s_dpor),
+    }
+
+
+def bench_config9(jax):
+    """Redundancy-ratio bench: explored schedules vs. the per-fixture
+    optimal lower bound (distinct Mazurkiewicz classes among admitted
+    prescriptions), A/B'd with sleep-set + race-reversal pruning OFF
+    (observe mode — classes tracked, nothing suppressed) vs ON, on the
+    config-8 deep seeded raft frontier. Both sides run identically-
+    guided wakeup sequences with content-derived lane keys, so a
+    prescription explores the same suffix wherever pruning shifts it —
+    the property the identity assertions rest on:
+
+      - the FIRST found violating lane's records are bit-identical;
+      - the distinct violation-code set over every lane of every round
+        is identical;
+      - the pruned run admits no more schedules than the baseline
+        (STRICTLY fewer at the default depth — DEMI_BENCH_CONFIG9_STRICT=0
+        relaxes for tiny smoke shapes), and its redundancy ratio is <=
+        the baseline's, with the gap reported.
+
+    Knobs: DEMI_BENCH_CONFIG9_ROUNDS / _BATCH / _BUDGET / _SEEDS /
+    _DEPTH_CAP / _STRICT."""
+    from demi_tpu.analysis import SleepSets, StaticIndependence, sleep_cap
+    from demi_tpu.apps.common import dsl_start_events, make_host_invariant
+    from demi_tpu.apps.raft import T_CLIENT, make_raft_app
+    from demi_tpu.config import SchedulerConfig
+    from demi_tpu.device.batch_oracle import default_device_config
+    from demi_tpu.device.dpor_sweep import (
+        DeviceDPOR,
+        make_dpor_kernel,
+        steering_prescription,
+    )
+    from demi_tpu.external_events import MessageConstructor, Send, WaitQuiescence
+    from demi_tpu.schedulers import RandomScheduler
+
+    nodes, commands = 3, 3
+    budget = int(os.environ.get("DEMI_BENCH_CONFIG9_BUDGET", 240))
+    seeds = int(os.environ.get("DEMI_BENCH_CONFIG9_SEEDS", 40))
+    depth_cap = int(os.environ.get("DEMI_BENCH_CONFIG9_DEPTH_CAP", 120))
+    strict = os.environ.get("DEMI_BENCH_CONFIG9_STRICT", "1") != "0"
+    app = make_raft_app(nodes, bug="multivote")
+    config = SchedulerConfig(invariant_check=make_host_invariant(app))
+    program = dsl_start_events(app) + [
+        Send(
+            app.actor_name(i % nodes),
+            MessageConstructor(lambda v=10 + i: (T_CLIENT, 0, v, 0, 0, 0, 0)),
+        )
+        for i in range(commands)
+    ] + [WaitQuiescence()]
+    fr = None
+    best = -1
+    for seed in range(seeds):
+        r = RandomScheduler(
+            config, seed=seed, max_messages=budget,
+            invariant_check_interval=1,
+        ).execute(program)
+        if r.violation is None:
+            continue
+        depth = len(r.trace.deliveries())
+        if depth <= depth_cap and depth > best:
+            fr, best = r, depth
+    if fr is None:  # pragma: no cover - multivote violates reliably
+        return {"error": "no violation found to seed the frontier"}
+    trace = fr.trace
+    trace.set_original_externals(list(program))
+    cfg = default_device_config(
+        app, trace, program, record_trace=True, record_parents=True,
+    )
+    presc = steering_prescription(app, cfg, trace, program)
+
+    platform = jax.devices()[0].platform
+    batch = int(os.environ.get(
+        "DEMI_BENCH_CONFIG9_BATCH", 64 if platform not in ("cpu",) else 16
+    ))
+    rounds = int(os.environ.get("DEMI_BENCH_CONFIG9_ROUNDS", 16))
+    cap = sleep_cap()
+    rel = StaticIndependence.for_app(app)
+    kernel = make_dpor_kernel(
+        app, cfg, sleep_cap=cap, commute_matrix=rel.device_matrix()
+    )
+
+    def run(prune):
+        d = DeviceDPOR(
+            app, cfg, program, batch_size=batch, kernel=kernel,
+            prefix_fork=False, double_buffer=False,
+            sleep_sets=SleepSets(independence=rel, prune=prune, cap=cap),
+        )
+        d.seed(presc)
+        founds = []
+        secs = 0.0
+        done = 0
+        for r in range(rounds):
+            if not d.frontier:
+                break
+            t0 = time.perf_counter()
+            f = d.explore(max_rounds=1)
+            dt = time.perf_counter() - t0
+            if r > 0:  # round 0 carries kernel compilation
+                secs += dt
+                done += 1
+            if f is not None:
+                founds.append((f[0][: f[1]].tobytes(), int(f[1])))
+        return d, founds, (done / secs if secs > 0 else None)
+
+    base, founds_base, rps_base = run(False)
+    pruned, founds_pruned, rps_pruned = run(True)
+
+    ratio_base = base.sleep.redundancy_ratio(len(base.explored)) or 1.0
+    ratio_pruned = (
+        pruned.sleep.redundancy_ratio(len(pruned.explored)) or 1.0
+    )
+    first_base = founds_base[0] if founds_base else None
+    first_pruned = founds_pruned[0] if founds_pruned else None
+    # The A/B identity contracts: same violations, same first find,
+    # never MORE schedules, never a WORSE ratio.
+    assert base.violation_codes == pruned.violation_codes, (
+        base.violation_codes, pruned.violation_codes
+    )
+    assert first_base == first_pruned
+    assert len(pruned.explored) <= len(base.explored)
+    assert ratio_pruned <= ratio_base + 1e-9
+    if strict:
+        # The headline: at the default depth the deep raft frontier
+        # always carries already-reversed races, so pruning must bite.
+        assert len(pruned.explored) < len(base.explored), (
+            len(pruned.explored), len(base.explored)
+        )
+    return {
+        "app": f"raft{nodes}",
+        "seed_deliveries": best,
+        "batch": batch,
+        "rounds": rounds,
+        "sleep_cap": cap,
+        "explored_base": len(base.explored),
+        "explored_pruned": len(pruned.explored),
+        "explored_reduction": len(base.explored) - len(pruned.explored),
+        "classes_base": len(base.sleep.classes),
+        "classes_pruned": len(pruned.sleep.classes),
+        "redundancy_ratio_base": round(ratio_base, 4),
+        "redundancy_ratio_pruned": round(ratio_pruned, 4),
+        "ratio_gap": round(ratio_base - ratio_pruned, 4),
+        "sleep_pruned": dict(pruned.sleep.pruned_total),
+        "violations_match": True,
+        "found_match": True,
+        "violation_codes": sorted(base.violation_codes),
+        "rounds_per_sec_base": (
+            round(rps_base, 2) if rps_base is not None else None
+        ),
+        "rounds_per_sec_pruned": (
+            round(rps_pruned, 2) if rps_pruned is not None else None
+        ),
     }
 
 
@@ -1410,7 +1582,7 @@ def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--config", default=None,
                         help="run only one section: 2, 3, 4, 5, 6, 7, 8, "
-                             "or 'rehearsal'")
+                             "9, or 'rehearsal'")
     args = parser.parse_args()
     if args.config is not None and args.config != "rehearsal":
         args.config = int(args.config)
@@ -1518,6 +1690,22 @@ def main():
         out["vs_baseline"] = round((out["config8"].get("speedup") or 0) / 1.2, 3)
         emit(out)
         return
+    if args.config == 9:
+        out["metric"] = (
+            "redundancy ratio (explored/classes, sleep-set DPOR A/B, "
+            "3-node raft)"
+        )
+        out["unit"] = "ratio"
+        out["config9"] = bench_config9(jax)
+        out["value"] = out["config9"].get("redundancy_ratio_pruned")
+        # Target: the pruned run sits at the class lower bound (1.0)
+        # while the unpruned baseline drifts above it.
+        base_ratio = out["config9"].get("redundancy_ratio_base") or 0
+        out["vs_baseline"] = (
+            round(base_ratio / out["value"], 3) if out["value"] else None
+        )
+        emit(out)
+        return
     if args.config == "rehearsal":
         out["metric"] = (
             "schedules/sec (config-5 machinery rehearsal, >=1e5 lanes)"
@@ -1543,6 +1731,7 @@ def main():
     config6 = bench_config6(jax)
     config7 = bench_config7(jax)
     config8 = bench_config8(jax)
+    config9 = bench_config9(jax)
     rehearsal = bench_config5_rehearsal(jax)
     out.update(
         {
@@ -1571,6 +1760,7 @@ def main():
             "config6": config6,
             "config7": config7,
             "config8": config8,
+            "config9": config9,
             "config5_rehearsal": rehearsal,
         }
     )

@@ -30,6 +30,16 @@ from .effects import (
     effects_commute,
 )
 from .independence import StaticIndependence, static_prune_enabled
+from .sleep import (
+    BIG_ORDINAL,
+    SleepSets,
+    canonical_class_key,
+    np_wake_ordinals,
+    rows_content_equal,
+    rows_independent,
+    sleep_cap,
+    sleep_sets_enabled,
+)
 from .lint import (
     DEFAULT_TARGETS,
     LintFinding,
@@ -46,11 +56,19 @@ from . import sanitize
 __all__ = [
     "ActorEffects",
     "AppEffects",
+    "BIG_ORDINAL",
     "DEFAULT_TARGETS",
     "EffectSet",
     "LintFinding",
     "RULES",
+    "SleepSets",
     "StaticIndependence",
+    "canonical_class_key",
+    "np_wake_ordinals",
+    "rows_content_equal",
+    "rows_independent",
+    "sleep_cap",
+    "sleep_sets_enabled",
     "analyze_actor_class",
     "analyze_dsl_app",
     "effects_commute",

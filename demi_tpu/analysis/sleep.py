@@ -1,0 +1,357 @@
+"""Sleep sets & race-reversal bookkeeping: the optimal-DPOR half of
+``demi_tpu/analysis`` (PR 8 built the static independence relation this
+consumes).
+
+Classic DPOR re-visits interleavings that differ only in already-reversed
+races: two independent races reversed in either order reach the same
+Mazurkiewicz class through tuple-distinct prescriptions, and a race
+re-derived under a sibling's subtree re-enqueues a flip an earlier
+sibling already explored. Parsimonious Optimal DPOR (arxiv 2405.11128)
+eliminates both with sleep sets and wakeup trees; this module ports the
+two mechanisms onto the repo's prescription-based frontier:
+
+- **Sleep sets** (``SleepSets`` + the per-lane wake tracking in
+  ``device/dpor_sweep.py``): when a reversal ``prefix + (f,)`` is
+  admitted at a node, earlier-admitted sibling flips that are
+  *independent* of ``f`` go to sleep in the new exploration — delivering
+  them first would only commute into a sibling's already-scheduled
+  subtree. Sleep rows ride the frontier as bounded packed int32 arrays
+  (``[B, sleep_cap, rec_width]``); each device lane tracks, per sleeping
+  row, the free-region delivery ordinal that woke it (a dependent or
+  content-identical delivery) plus the first ordinal at which the lane
+  itself delivered a still-sleeping row (the redundant-suffix marker).
+  The racing scan then refuses reversals whose flip is asleep at the
+  branch, and reversals branched beyond the redundant point.
+
+- **Race-reversal (Mazurkiewicz class) dedup** (``canonical_class_key``):
+  every admitted prescription is normalized to the lexicographically
+  least linearization of its partial order — commuting adjacent records
+  (different receivers, or tags the static matrix proves commuting, with
+  creation edges kept) sort into a canonical order, and intra-
+  prescription creation links are relabeled to canonical indices. Two
+  reversal orders of independent races normalize to the SAME key, so the
+  explored-set dedup — which only catches byte-equal prescriptions —
+  is lifted to equivalence classes. The distinct-class count is also the
+  per-fixture *optimal lower bound* the redundancy-ratio bench
+  (``bench.py --config 9``) reports explored schedules against.
+
+Soundness posture: pruning is conservative — unknown tags are dependent
+(the PR 8 contract), creation edges always order, and a sleep row is
+only consulted at branch points at/after the node it was attached to.
+Everything is opt-in (``DEMI_SLEEP_SETS=1`` / ``--sleep-sets``) with the
+unpruned path kept as the pinned A/B baseline; prune counts land in
+``analysis.sleep_pruned{kind=sleep|class, tier=device|host}``.
+"""
+
+from __future__ import annotations
+
+import heapq
+import os
+from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
+
+import numpy as np
+
+from .independence import REC_TIMER, StaticIndependence, _rows_fungible
+
+#: Wake/slept sentinel shared with the device kernels: "never" is any
+#: ordinal >= BIG (int32-safe, far above any trace length).
+BIG_ORDINAL = 2 ** 30
+
+#: Own-position sentinel for rows whose trace position is unknown (seeded
+#: prescriptions, flip rows): never equals a real parent column value, so
+#: no creation edge can target such a row.
+_POS_UNKNOWN = 1 << 40
+
+
+def sleep_sets_enabled(explicit: Optional[bool] = None) -> bool:
+    """Resolve the sleep-set switch: explicit arg wins, else the
+    ``DEMI_SLEEP_SETS`` env flag. Off by default — like every
+    schedule-space feature here, pruning ships opt-in with the unpruned
+    path as the pinned A/B baseline."""
+    if explicit is not None:
+        return bool(explicit)
+    return os.environ.get("DEMI_SLEEP_SETS", "").strip().lower() in (
+        "1", "true", "yes", "on"
+    )
+
+
+def sleep_cap() -> int:
+    """Bounded sleep-set width (rows per lane; fixed shape on device).
+    Overflow drops the newest candidates — less pruning, never
+    unsoundness."""
+    return max(1, int(os.environ.get("DEMI_SLEEP_CAP", "8")))
+
+
+def _tag_index(tag: int, m: int) -> int:
+    return tag if 0 <= tag < m - 1 else m - 1
+
+
+def rows_independent(row_a, row_b, rec_width: int, matrix=None) -> bool:
+    """May two delivery records commute? Different receivers always do
+    (handlers touch only their own actor's state; co-enabled rows cannot
+    create each other); same-receiver pairs only when the static
+    field-effect matrix proves their tags commute. Conservative in the
+    PR 8 sense: no matrix => same receiver => dependent."""
+    if int(row_a[2]) != int(row_b[2]):
+        return True
+    if matrix is not None:
+        m = len(matrix)
+        ia = _tag_index(int(row_a[3]), m)
+        ib = _tag_index(int(row_b[3]), m)
+        return bool(matrix[ia, ib])
+    return False
+
+
+def rows_content_equal(row_a, row_b, rec_width: int) -> bool:
+    """Content identity over the matchable columns (the fungible-flip
+    comparison: kind, dst, payload; src only for non-timers) — the ONE
+    Python predicate, shared with the static-pruning tier so the
+    native/vectorized mirrors have a single spec to match."""
+    return _rows_fungible(row_a, row_b, rec_width)
+
+
+def canonical_class_key(
+    rows, own_pos: Optional[Sequence[int]], rec_width: int, matrix=None
+) -> tuple:
+    """Mazurkiewicz-canonical key of one prescription.
+
+    ``rows`` is the prescription's records ([m, >=rec_width] int-like);
+    ``own_pos`` gives each row's own trace position in its source lane
+    (None / ``_POS_UNKNOWN`` entries mean unknown — creation edges onto
+    that row then never fire, which splits classes it could have merged:
+    strictly less dedup, never a false merge). The key is the
+    lexicographically least linearization of the prescription's partial
+    order — ordering constraints are kept between every pair that is
+    creation-linked (a row's ``parent`` column naming another row's
+    trace position) or receiver-dependent (same ``dst`` and not proven
+    commuting by ``matrix``) — with each row reduced to its matchable
+    content plus its creation link relabeled to a canonical index.
+
+    Two valid linearizations of the same partial order greedily
+    topo-sort to the same minimal sequence, so equivalent reversal
+    orders of independent races collide here even though their packed
+    bytes differ."""
+    rows = np.asarray(rows)[:, :rec_width].astype(np.int64, copy=False)
+    m = len(rows)
+    if m == 0:
+        return ()
+    w = rec_width
+    if own_pos is None:
+        pos = np.arange(m, dtype=np.int64) + _POS_UNKNOWN
+    else:
+        pos = np.asarray(
+            [(_POS_UNKNOWN + k) if p is None else int(p)
+             for k, p in enumerate(own_pos)],
+            np.int64,
+        )
+    kind = rows[:, 0]
+    dst = rows[:, 2]
+    tag = rows[:, 3]
+    src_eff = np.where(kind == REC_TIMER, 0, rows[:, 1])
+    parent = rows[:, w - 2]
+    content = [
+        (int(kind[t]), int(dst[t]))
+        + tuple(int(x) for x in rows[t, 3: w - 2])
+        + (int(src_eff[t]),)
+        for t in range(m)
+    ]
+    same_dst = dst[:, None] == dst[None, :]
+    if matrix is not None:
+        msz = len(matrix)
+        idx = np.where((tag >= 0) & (tag < msz - 1), tag, msz - 1)
+        comm = np.asarray(matrix)[idx[:, None], idx[None, :]].astype(bool)
+        dep = same_dst & ~comm
+    else:
+        dep = same_dst
+    creation = parent[None, :] == pos[:, None]  # [i, j]: i created j
+    dep = dep | creation | creation.T
+    order_lt = np.arange(m)[:, None] < np.arange(m)[None, :]
+    edges = dep & order_lt  # i must precede j
+    indeg = edges.sum(axis=0)
+    heap = [(content[t], t) for t in range(m) if indeg[t] == 0]
+    heapq.heapify(heap)
+    order: List[int] = []
+    while heap:
+        _, t = heapq.heappop(heap)
+        order.append(t)
+        for u in np.flatnonzero(edges[t]):
+            u = int(u)
+            indeg[u] -= 1
+            if indeg[u] == 0:
+                heapq.heappush(heap, (content[u], u))
+    new_index = {t: k for k, t in enumerate(order)}
+    pos_to_new = {int(pos[t]): new_index[t] for t in range(m)}
+    return tuple(
+        content[t] + (pos_to_new.get(int(parent[t]), -1),)
+        for t in order
+    )
+
+
+class SleepSets:
+    """Sleep-set + class-dedup state for ONE exploration (a DeviceDPOR
+    or DPORScheduler instance). DeviceDPOROracle builds one PER
+    resumable instance — class/wakeup state is per-subsequence, so it
+    refuses a shared instance — and aggregates the ledgers in its
+    ``sleep_stats``.
+
+    ``prune=False`` is OBSERVE mode: canonical classes are tracked (the
+    redundancy-ratio denominator) but nothing is suppressed — the
+    unpruned baseline of the bench A/B runs with this so both sides
+    report explored-vs-classes on identical schedule spaces."""
+
+    def __init__(
+        self,
+        independence: Optional[StaticIndependence] = None,
+        cap: Optional[int] = None,
+        prune: bool = True,
+        audit: bool = False,
+    ):
+        self.independence = independence
+        self.matrix = (
+            independence.device_matrix() if independence is not None else None
+        )
+        self.cap = sleep_cap() if cap is None else int(cap)
+        self.prune = bool(prune)
+        self.audit = bool(audit)
+        # Distinct Mazurkiewicz classes among admitted prescriptions —
+        # the optimal-DPOR lower bound `bench --config 9` reports
+        # explored counts against.
+        self.classes: Set[tuple] = set()
+        self.pruned_total: Dict[str, int] = {"sleep": 0, "class": 0}
+        self.pruned_prescriptions: List[Tuple[Tuple[int, ...], ...]] = []
+        # Wakeup ledger: per branch node (exact prefix bytes), the flip
+        # rows already admitted there — the "explored children" whose
+        # independent successors sleep in later siblings.
+        self._node_flips: Dict[bytes, List[Tuple[int, ...]]] = {}
+
+    @classmethod
+    def for_app(cls, app, **kw) -> "SleepSets":
+        """Build with the app's static independence relation as the
+        dependence oracle (analysis failure degrades to receiver-only
+        dependence — less pruning, still sound)."""
+        return cls(independence=StaticIndependence.for_app(app), **kw)
+
+    # -- class dedup -------------------------------------------------------
+    def class_key(
+        self, rows, own_pos: Optional[Sequence[int]], rec_width: int
+    ) -> tuple:
+        return canonical_class_key(rows, own_pos, rec_width, self.matrix)
+
+    def class_seen(self, key: tuple) -> bool:
+        return key in self.classes
+
+    def note_class(self, key: tuple) -> None:
+        self.classes.add(key)
+
+    # -- wakeup ledger / sleep assignment ---------------------------------
+    def node_flips(self, node_key: bytes) -> List[Tuple[int, ...]]:
+        return self._node_flips.get(node_key, [])
+
+    def note_admitted_flip(self, node_key: bytes, flip: Tuple[int, ...]) -> None:
+        self._node_flips.setdefault(node_key, []).append(tuple(flip))
+
+    def child_sleep_rows(
+        self,
+        node_key: bytes,
+        flip,
+        rec_width: int,
+        inherited: Sequence[Tuple[int, ...]] = (),
+    ) -> Tuple[Tuple[int, ...], ...]:
+        """Sleep rows for a freshly admitted ``prefix + (flip,)``:
+        earlier-admitted sibling flips at the node plus the source
+        lane's still-asleep rows, each kept only when independent of
+        ``flip`` (delivering ``flip`` wakes its dependents — classic
+        sleep-set inheritance), capped at ``cap`` (drop newest)."""
+        out: List[Tuple[int, ...]] = []
+        for row in list(self._node_flips.get(node_key, ())) + list(inherited):
+            if len(out) >= self.cap:
+                break
+            if rows_independent(row, flip, rec_width, self.matrix):
+                t = tuple(int(x) for x in row)
+                if t not in out:
+                    out.append(t)
+        return tuple(out)
+
+    # -- ledger ------------------------------------------------------------
+    def note_pruned(
+        self, sleep: int = 0, klass: int = 0, tier: str = "device"
+    ) -> None:
+        from .. import obs
+
+        if sleep:
+            self.pruned_total["sleep"] += int(sleep)
+            obs.counter("analysis.sleep_pruned").inc(
+                int(sleep), kind="sleep", tier=tier
+            )
+        if klass:
+            self.pruned_total["class"] += int(klass)
+            obs.counter("analysis.sleep_pruned").inc(
+                int(klass), kind="class", tier=tier
+            )
+
+    def note_pruned_prescription(self, prescription) -> None:
+        if self.audit:
+            self.pruned_prescriptions.append(tuple(map(tuple, prescription)))
+
+    @property
+    def pruned(self) -> int:
+        return sum(self.pruned_total.values())
+
+    def redundancy_ratio(self, explored: int) -> Optional[float]:
+        """Explored schedules over the distinct-class lower bound (>= 1;
+        1.0 = optimal, every explored schedule its own class)."""
+        if not self.classes:
+            return None
+        return explored / len(self.classes)
+
+    def summary(self) -> Dict[str, Any]:
+        return {
+            "cap": self.cap,
+            "prune": self.prune,
+            "classes": len(self.classes),
+            "pruned": dict(self.pruned_total),
+        }
+
+
+def np_wake_ordinals(
+    deliveries: np.ndarray,
+    sleep_from: int,
+    sleep_rows: np.ndarray,
+    rec_width: int,
+    matrix=None,
+) -> Tuple[np.ndarray, int]:
+    """NumPy twin of the device kernel's per-lane wake tracking (the
+    parity oracle for tests/test_sleep_sets.py): given one lane's
+    delivered records in order (``deliveries`` [n, >=rec_width]), the
+    lane's node ordinal ``sleep_from`` (tracking applies to deliveries
+    at ordinals >= it), and the lane's sleep rows ([S, rec_width],
+    kind 0 = empty slot), returns
+
+      - ``wake``      [S] int64: first tracked delivery ordinal whose
+        record is dependent with (or content-identical to) the sleeping
+        row; ``BIG_ORDINAL`` if never;
+      - ``slept_hit`` int: first tracked ordinal whose record is
+        content-identical to a still-asleep row (the redundant-suffix
+        marker); ``BIG_ORDINAL`` if never.
+    """
+    S = len(sleep_rows)
+    wake = np.full(S, BIG_ORDINAL, np.int64)
+    slept_hit = BIG_ORDINAL
+    for o, row in enumerate(np.asarray(deliveries)):
+        if o < sleep_from:
+            continue
+        hit = False
+        for s in range(S):
+            srow = sleep_rows[s]
+            if int(srow[0]) == 0:
+                continue
+            asleep = wake[s] >= BIG_ORDINAL
+            ceq = rows_content_equal(row, srow, rec_width)
+            dep = ceq or not rows_independent(row, srow, rec_width, matrix)
+            if asleep and ceq:
+                hit = True
+            if asleep and dep:
+                wake[s] = o
+        if hit and slept_hit >= BIG_ORDINAL:
+            slept_hit = o
+    return wake, slept_hit

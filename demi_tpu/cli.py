@@ -591,6 +591,9 @@ def cmd_dpor(args) -> int:
         static_independence=(
             True if getattr(args, "static_prune", False) else None
         ),
+        sleep_sets=(
+            True if getattr(args, "sleep_sets", False) else None
+        ),
     )
     with obs.span("cli.dpor", app=args.app):
         trace = oracle.test(program, None)
@@ -618,6 +621,12 @@ def cmd_dpor(args) -> int:
         # counters under DEMI_OBS).
         summary["static_pruned"] = oracle.static_stats
         summary["static_relation"] = oracle.static_independence.summary()
+    if oracle.sleep_stats is not None:
+        # Sleep-set / race-reversal pruning ledger + the redundancy
+        # ratio (explored over the Mazurkiewicz-class lower bound; also
+        # the analysis.sleep_pruned counters and the
+        # dpor.redundancy_ratio gauge under DEMI_OBS).
+        summary["sleep_sets"] = oracle.sleep_stats
     print(json.dumps(summary))
     _obs_end(args)
     return 0 if trace is not None else 1
@@ -1085,6 +1094,14 @@ def main(argv: Optional[list] = None) -> int:
              "is provably a no-op (content-identical records, or tags "
              "the AST field-effect analysis proves commuting); "
              "DEMI_STATIC_PRUNE=1 does the same; off by default",
+    )
+    p.add_argument(
+        "--sleep-sets", action="store_true", dest="sleep_sets",
+        help="sleep-set + race-reversal pruning (optimal DPOR): admitted "
+             "reversals follow wakeup-sequence guides, carry device-"
+             "encoded sleep rows, and dedup on Mazurkiewicz class keys "
+             "so already-reversed races are not re-explored; "
+             "DEMI_SLEEP_SETS=1 does the same; off by default",
     )
     p.set_defaults(fn=cmd_dpor)
 

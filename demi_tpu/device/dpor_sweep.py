@@ -26,7 +26,7 @@ from __future__ import annotations
 
 import os
 import time
-from typing import Dict, List, Optional, Sequence, Set, Tuple
+from typing import Dict, List, NamedTuple, Optional, Sequence, Set, Tuple
 
 import numpy as np
 
@@ -227,11 +227,220 @@ def make_dpor_run_lane(app: DSLApp, cfg: DeviceConfig):
     return run_lane
 
 
-def make_dpor_kernel(app: DSLApp, cfg: DeviceConfig, start_state: bool = False):
+class DporSleepResult(NamedTuple):
+    """LaneResult plus the device-encoded sleep-set observations (the
+    sleep-kernel return type; leading fields mirror LaneResult so every
+    existing consumer reads it unchanged)."""
+
+    status: jnp.ndarray
+    violation: jnp.ndarray
+    deliveries: jnp.ndarray
+    trace: jnp.ndarray
+    trace_len: jnp.ndarray
+    sched_hash: jnp.ndarray
+    # Per sleeping row: first at-or-after-node delivery ordinal whose
+    # record was dependent with (or content-identical to) it —
+    # BIG_ORDINAL = still asleep at lane end.
+    sleep_wake: jnp.ndarray  # [sleep_cap] int32
+    # First at-or-after-node ordinal that delivered a still-sleeping
+    # row (the redundant-suffix marker; BIG_ORDINAL = never).
+    sleep_slept: jnp.ndarray  # int32
+
+
+def make_dpor_sleep_run_lane(
+    app: DSLApp, cfg: DeviceConfig, sleep_cap: int, commute_matrix=None
+):
+    """The sleep-set twin of ``make_dpor_run_lane``: same lane semantics
+    bit-for-bit (state, cursor, and rng math are shared — LaneResult
+    fields are identical to the plain kernel's), plus per-step wake
+    tracking over a bounded block of sleeping records.
+
+    ``run_lane(prog, presc, key, sleep_rows[S, recw], sleep_from,
+    start_state=None) -> DporSleepResult``. Tracking applies to
+    deliveries at ordinals >= ``sleep_from`` — the NODE ordinal, i.e.
+    the length of the lane's identity prescription (prefix + flip);
+    rows before it are the path TO the node the sleep rows attach at,
+    so they neither wake nor trip them, while the wakeup-sequence guide
+    rows beyond it are ordinary tracked deliveries. A tracked delivery
+    wakes every sleeping row it is dependent with — same receiver and
+    not proven commuting by ``commute_matrix`` (the
+    ``StaticIndependence.device_matrix()`` baked in as a kernel
+    constant), or content-identical — and a tracked delivery content-
+    identical to a still-sleeping row marks the redundant suffix.
+    Forked lanes resume with wake state intact because ordinals are
+    absolute (``state.deliveries`` rides the snapshot) and the fork
+    planner clamps trunk prefixes below every member's node under
+    sleep mode, so the pre-fork segment is entirely untracked.
+
+    Why the fixed ``sleep_from`` ordinal is safe against divergence
+    (prescribed rows skipped would otherwise shift the real node
+    earlier and leave a wake window untracked — unsound over-pruning):
+    a DERIVED prescription's identity is its source lane's own
+    delivered records plus a co-enabled flip, both of which replay
+    deterministically from init (prescribed dispatch never consumes
+    rng, injections are deterministic, and the matcher's lowest-seq
+    pick is a function of state alone) — so the first ``sleep_from``
+    deliveries cannot diverge. The only divergence-prone prescriptions
+    are host-lowered SEEDS and post-node guide rows; seeds carry no
+    sleep rows, and guide rows sit at ordinals >= ``sleep_from`` where
+    tracking is already on."""
+    from ..analysis.sleep import BIG_ORDINAL
+
+    assert cfg.record_trace and cfg.record_parents
+    base_step = make_step_fn(app, cfg)
+    prescribed_dispatch = make_prescribed_dispatch(app, cfg)
+    r_max = cfg.max_steps
+    recw = cfg.rec_width
+    oh = cfg.use_onehot
+    big = jnp.int32(BIG_ORDINAL)
+    mat = (
+        None
+        if commute_matrix is None
+        else jnp.asarray(np.asarray(commute_matrix), jnp.int32)
+    )
+
+    def wake_update(old_state, new_state, sleep_from, sleep_rows, wake,
+                    slept):
+        delivered = new_state.deliveries > old_state.deliveries
+        ordv = old_state.deliveries  # this delivery's absolute ordinal
+        row = ops.get_row(
+            new_state.trace, jnp.maximum(new_state.trace_len - 1, 0), oh
+        )
+        valid = sleep_rows[:, 0] != 0
+        same_dst = sleep_rows[:, 2] == row[2]
+        content_eq = (
+            (sleep_rows[:, 0] == row[0])
+            & same_dst
+            & jnp.all(
+                sleep_rows[:, 3: recw - 2] == row[3: recw - 2][None, :],
+                axis=1,
+            )
+            & ((row[0] == REC_TIMER) | (sleep_rows[:, 1] == row[1]))
+        )
+        if mat is None:
+            dep = same_dst
+        else:
+            m = mat.shape[0]
+            tr, ts = row[3], sleep_rows[:, 3]
+            ir = jnp.where((tr >= 0) & (tr < m - 1), tr, m - 1)
+            isx = jnp.where((ts >= 0) & (ts < m - 1), ts, m - 1)
+            dep = same_dst & (mat[ir, isx] == 0)
+        dep = dep | content_eq
+        asleep = wake >= big
+        tracked = delivered & (ordv >= sleep_from)
+        wake = jnp.where(tracked & valid & asleep & dep, ordv, wake)
+        hit = tracked & jnp.any(valid & asleep & content_eq)
+        slept = jnp.where(hit & (slept >= big), ordv, slept)
+        return wake, slept
+
+    def step(carry, presc, prog, sleep_rows, sleep_from):
+        state, cursor, wake, slept = carry
+        in_dispatch = state.status == ST_DISPATCH
+        rec_kind = ops.get_scalar(
+            presc[:, 0], jnp.minimum(cursor, r_max - 1), oh
+        )
+        presc_active = in_dispatch & (cursor < r_max) & (
+            (rec_kind == REC_DELIVERY) | (rec_kind == REC_TIMER)
+        )
+
+        def with_prescription(args):
+            state, cursor = args
+            new_state, new_cursor, found = prescribed_dispatch(
+                state, presc, cursor
+            )
+            fell_back = ~found
+            rnd = base_step(state, prog)
+            out = jax.tree_util.tree_map(
+                lambda a, b: jnp.where(fell_back, a, b), rnd, new_state
+            )
+            return out, new_cursor
+
+        def without(args):
+            state, cursor = args
+            return base_step(state, prog), cursor
+
+        new_state, new_cursor = jax.lax.cond(
+            presc_active, with_prescription, without, (state, cursor)
+        )
+        wake, slept = wake_update(
+            state, new_state, sleep_from, sleep_rows, wake, slept
+        )
+        return (new_state, new_cursor, wake, slept)
+
+    def run_lane(prog, presc, key, sleep_rows, sleep_from, start_state=None):
+        wake0 = jnp.full((sleep_cap,), BIG_ORDINAL, jnp.int32)
+        if start_state is None:
+            carry = (init_state(app, cfg, key), jnp.int32(0), wake0, big)
+            carry, _ = jax.lax.scan(
+                lambda c, _: (
+                    step(c, presc, prog, sleep_rows, sleep_from), None
+                ),
+                carry, None, length=cfg.max_steps,
+            )
+            state, _cursor, wake, slept = carry
+        else:
+            state0 = start_state.state._replace(rng=key)
+
+            def cond(c2):
+                (s, *_rest), i = c2
+                return (s.status < ST_DONE) & (i < cfg.max_steps)
+
+            def body(c2):
+                c, i = c2
+                return step(c, presc, prog, sleep_rows, sleep_from), i + 1
+
+            carry, _ = jax.lax.while_loop(
+                cond, body,
+                ((state0, start_state.cursor, wake0, big),
+                 start_state.steps),
+            )
+            state, _cursor, wake, slept = carry
+        state = jax.lax.cond(
+            state.status < ST_DONE,
+            lambda s: _finalize(s, app, cfg), lambda s: s, state,
+        )
+        return DporSleepResult(
+            status=state.status,
+            violation=state.violation,
+            deliveries=state.deliveries,
+            trace=state.trace,
+            trace_len=state.trace_len,
+            sched_hash=state.sched_hash,
+            sleep_wake=wake,
+            sleep_slept=slept,
+        )
+
+    return run_lane
+
+
+def make_dpor_kernel(
+    app: DSLApp, cfg: DeviceConfig, start_state: bool = False,
+    sleep_cap: int = 0, commute_matrix=None,
+):
     """jitted ``kernel(progs[B], prescriptions[B, R, recw], keys[B]) ->
     LaneResult[B]`` (see make_dpor_run_lane). ``start_state=True`` adds a
     fourth argument — a device/fork.py PrefixSnapshot broadcast across the
-    lane axis — resuming the whole batch from one trunk's state."""
+    lane axis — resuming the whole batch from one trunk's state.
+    ``sleep_cap > 0`` builds the sleep-set variant instead: the kernel
+    takes an extra ``sleep_rows[B, sleep_cap, recw]`` input and returns
+    ``DporSleepResult`` (LaneResult fields are bit-identical to the
+    plain kernel's — the wake tracking is observation-only)."""
+    if sleep_cap > 0:
+        run_sleep = make_dpor_sleep_run_lane(
+            app, cfg, sleep_cap, commute_matrix
+        )
+        if not start_state:
+            return jax.jit(
+                jax.vmap(run_sleep, in_axes=(0, 0, 0, 0, 0))
+            )
+        return jax.jit(
+            jax.vmap(
+                lambda prog, presc, key, srows, sfrom, snap: run_sleep(
+                    prog, presc, key, srows, sfrom, snap
+                ),
+                in_axes=(0, 0, 0, 0, 0, None),
+            )
+        )
     run_lane = make_dpor_run_lane(app, cfg)
     if not start_state:
         return jax.jit(jax.vmap(run_lane))
@@ -262,21 +471,40 @@ def racing_prescriptions(
     (tests/test_host_path.py). The frontier hot path uses
     ``native.racing_prescriptions_batch`` — one call per ROUND — instead;
     see ``DeviceDPOR._process_round``."""
+    out, _positions = racing_prescriptions_meta(
+        records, trace_len, rec_width, independence=independence
+    )
+    return [presc for presc, _branch, _flip_ord in out]
+
+
+def racing_prescriptions_meta(
+    records: np.ndarray, trace_len: int, rec_width: int,
+    independence=None,
+) -> Tuple[List[Tuple[Tuple[Tuple[int, ...], ...], int, int]], np.ndarray]:
+    """``racing_prescriptions`` plus the derivation metadata the sleep-
+    set admission needs: returns ``([(prescription, branch_ordinal,
+    flip_ordinal)], positions)`` where ``branch_ordinal`` is the count
+    of deliveries strictly before the race's first delivery
+    (== len(prescription) - 1), ``flip_ordinal`` the flipped delivery's
+    ordinal in the lane (the wakeup-sequence guide drops it from the
+    suffix), and ``positions`` the lane's delivery trace positions
+    (prescription prefix row t sits at ``positions[t]`` — the
+    own-position input of the canonical class key)."""
     from ..native import racing_pair_scan
 
     # Slice to rec_width: the scan derives the parent column from the last
     # column, so trailing padding must never reach it.
     recs = records[:trace_len, :rec_width]
     pairs = racing_pair_scan(recs)
-    if len(pairs) == 0:
-        return []
     is_delivery = np.isin(recs[:, 0], (REC_DELIVERY, REC_TIMER))
     positions = np.nonzero(is_delivery)[0]
+    if len(pairs) == 0:
+        return [], positions
     # Record tuples materialized once; prefix for branch index i is the
     # delivery tuples strictly before i.
     tuples = {int(p): tuple(int(x) for x in recs[p]) for p in positions}
     ordered = [int(p) for p in positions]
-    out: List[Tuple[Tuple[int, ...], ...]] = []
+    out: List[Tuple[Tuple[Tuple[int, ...], ...], int]] = []
     pruned_fungible = pruned_commute = 0
     for i, j in pairs:
         if independence is not None:
@@ -297,14 +525,15 @@ def racing_prescriptions(
                               + [tuples[int(j)]])
                     )
                 continue
-        k = np.searchsorted(positions, i)
+        k = int(np.searchsorted(positions, i))
+        jj = int(np.searchsorted(positions, int(j)))
         prefix = [tuples[p] for p in ordered[:k]]
         prefix.append(tuples[int(j)])
-        out.append(tuple(prefix))
+        out.append((tuple(prefix), k, jj))
     if independence is not None:
         independence.note_pruned(pruned_fungible, pruned_commute,
                                  tier="device")
-    return out
+    return out, positions
 
 
 def _resolve_static_independence(app: DSLApp, explicit=None):
@@ -322,6 +551,32 @@ def _resolve_static_independence(app: DSLApp, explicit=None):
         return explicit
     if static_prune_enabled(explicit):
         return StaticIndependence.for_app(app)
+    return None
+
+
+def _resolve_sleep_sets(app: DSLApp, explicit=None, independence=None):
+    """Resolve the sleep-set switch into an analysis.SleepSets (or None).
+
+    ``explicit`` may be a SleepSets instance (used as given — the bench
+    passes observe-/audit-mode objects), True (build one from the app),
+    False (off), or None (the ``DEMI_SLEEP_SETS`` env flag decides).
+    ``independence`` (a StaticIndependence, when static pruning is also
+    on) doubles as the dependence oracle; otherwise one is derived from
+    the app purely for dependence — its prune ledger is never consulted.
+    Off by default: sleep-set pruning removes whole explored schedules,
+    so like every schedule-space feature here it ships opt-in with the
+    unpruned path as the pinned A/B baseline."""
+    from ..analysis import SleepSets, StaticIndependence, sleep_sets_enabled
+
+    if explicit is not None and not isinstance(explicit, bool):
+        return explicit
+    if sleep_sets_enabled(explicit):
+        rel = (
+            independence
+            if independence is not None
+            else StaticIndependence.for_app(app)
+        )
+        return SleepSets(independence=rel)
     return None
 
 
@@ -384,6 +639,7 @@ class DeviceDPOROracle:
         double_buffer: Optional[bool] = None,
         host_path: Optional[str] = None,
         static_independence=None,
+        sleep_sets=None,
     ):
         from ..minimization.pipeline import async_min_enabled
         from .fork import prefix_fork_enabled
@@ -403,6 +659,44 @@ class DeviceDPOROracle:
         self.static_independence = _resolve_static_independence(
             app, static_independence
         )
+        # Sleep sets: resolved per INSTANCE (class/wakeup state is
+        # per-subsequence — prescriptions from different external
+        # programs must never class-merge), but the on/off decision and
+        # the shared sleep kernels are resolved once here.
+        from ..analysis import sleep_cap as _sleep_cap
+        from ..analysis import sleep_sets_enabled
+
+        if sleep_sets is not None and not isinstance(sleep_sets, bool):
+            # Class/wakeup state is per-subsequence: a single caller
+            # SleepSets shared across resumable instances would merge
+            # class spaces from different external programs. Refuse
+            # loudly instead of silently substituting.
+            raise TypeError(
+                "DeviceDPOROracle takes sleep_sets as bool/None; "
+                "per-instance SleepSets are built internally"
+            )
+        self.sleep_sets = (
+            sleep_sets
+            if isinstance(sleep_sets, bool)
+            else sleep_sets_enabled(None)
+        )
+        sleep_matrix = None
+        if self.sleep_sets:
+            rel = (
+                self.static_independence
+                if self.static_independence is not None
+                else None
+            )
+            if rel is None:
+                from ..analysis import StaticIndependence
+
+                rel = StaticIndependence.for_app(app)
+            self._sleep_dependence = rel
+            sleep_matrix = rel.device_matrix()
+        else:
+            self._sleep_dependence = None
+        self._sleep_kernel_cap = _sleep_cap() if self.sleep_sets else 0
+        self._sleep_matrix = sleep_matrix
         self.max_distance: Optional[int] = None
         # Measurement-guided budget control: each resumable DPOR instance
         # gets its own DporBudgetTuner (frontier dynamics are
@@ -414,10 +708,19 @@ class DeviceDPOROracle:
         # mesh sharding isn't an oracle concern).
         impl = os.environ.get("DEMI_DEVICE_IMPL", "xla")
         self._kernel = (
-            make_dpor_kernel(app, cfg) if impl != "pallas" else None
+            make_dpor_kernel(
+                app, cfg, sleep_cap=self._sleep_kernel_cap,
+                commute_matrix=self._sleep_matrix,
+            )
+            if impl != "pallas"
+            else None
         )
         self._fork_kernel = (
-            make_dpor_kernel(app, cfg, start_state=True)
+            make_dpor_kernel(
+                app, cfg, start_state=True,
+                sleep_cap=self._sleep_kernel_cap,
+                commute_matrix=self._sleep_matrix,
+            )
             if impl != "pallas" and prefix_fork_enabled(prefix_fork)
             else None
         )
@@ -480,6 +783,32 @@ class DeviceDPOROracle:
             return None
         return dict(self.static_independence.pruned_total)
 
+    @property
+    def sleep_stats(self) -> Optional[Dict[str, object]]:
+        """Sleep-set ledger summed across the resumable instances (None
+        when sleep sets are off) — what the CLI summary reports: prune
+        counts by kind, distinct classes, and the aggregate redundancy
+        ratio."""
+        if not self.sleep_sets:
+            return None
+        pruned = {"sleep": 0, "class": 0}
+        classes = explored = 0
+        for inst in self._instances.values():
+            if inst.sleep is None:
+                continue
+            for k, v in inst.sleep.pruned_total.items():
+                pruned[k] = pruned.get(k, 0) + v
+            classes += len(inst.sleep.classes)
+            explored += len(inst.explored)
+        return {
+            "pruned": pruned,
+            "classes": classes,
+            "explored": explored,
+            "redundancy_ratio": (
+                round(explored / classes, 4) if classes else None
+            ),
+        }
+
     def host_share(self) -> Optional[float]:
         """Host-vs-device wall-time split summed across the resumable
         instances (None before any round ran) — the CLI summary's
@@ -493,6 +822,8 @@ class DeviceDPOROracle:
         key = tuple(e.eid for e in externals)
         inst = self._instances.get(key)
         if inst is None:
+            from ..analysis import SleepSets
+
             inst = DeviceDPOR(
                 self.app, self.cfg, externals, self.batch_size,
                 prefix_fork=self.prefix_fork,
@@ -503,6 +834,14 @@ class DeviceDPOROracle:
                 static_independence=(
                     self.static_independence
                     if self.static_independence is not None
+                    else False
+                ),
+                sleep_sets=(
+                    SleepSets(
+                        independence=self._sleep_dependence,
+                        cap=self._sleep_kernel_cap,
+                    )
+                    if self.sleep_sets
                     else False
                 ),
             )
@@ -682,11 +1021,21 @@ def _dpor_search_state(dpor: "DeviceDPOR") -> tuple:
             dpor.tuner.rounds, dpor.tuner.round_batch,
             dpor.tuner.max_distance,
         )
+    sleep_state = None
+    if dpor.sleep is not None:
+        sleep_state = (
+            set(dpor.sleep.classes),
+            {k: list(v) for k, v in dpor.sleep._node_flips.items()},
+            dict(dpor.sleep.pruned_total),
+        )
     return (
         set(dpor.explored), list(dpor.frontier), dpor.original,
         dpor.max_distance, dpor.interleavings, dpor.round_batch,
         dict(dpor.async_stats), tuner, set(dpor._explored_digests),
         dpor.host_seconds, dpor.device_seconds,
+        dict(dpor._sleep_rows), set(dpor._suppressed),
+        set(dpor._suppressed_digests), set(dpor.violation_codes),
+        sleep_state, dict(dpor._guides),
     )
 
 
@@ -700,6 +1049,17 @@ def _dpor_restore_state(dpor: "DeviceDPOR", state: tuple) -> None:
         state[5], dict(state[6]), state[7], set(state[8]),
         state[9], state[10],
     )
+    dpor._sleep_rows = dict(state[11])
+    dpor._suppressed = set(state[12])
+    dpor._suppressed_digests = set(state[13])
+    dpor.violation_codes = set(state[14])
+    dpor._guides = dict(state[16])
+    if state[15] is not None and dpor.sleep is not None:
+        dpor.sleep.classes = set(state[15][0])
+        dpor.sleep._node_flips = {
+            k: list(v) for k, v in state[15][1].items()
+        }
+        dpor.sleep.pruned_total = dict(state[15][2])
     if tuner is not None and dpor.tuner is not None:
         (
             dpor.tuner.rounds, dpor.tuner.round_batch,
@@ -769,11 +1129,52 @@ class DeviceDPOR:
         fork_kernel=None,
         host_path: Optional[str] = None,
         static_independence=None,
+        sleep_sets=None,
+        key_mode: Optional[str] = None,
     ):
         assert cfg.record_trace and cfg.record_parents
         self.app = app
         self.cfg = cfg
+        # Static may-commute relation resolved FIRST: the sleep-set
+        # machinery reuses it as its dependence oracle when both are on.
+        self.static_independence = _resolve_static_independence(
+            app, static_independence
+        )
+        # Sleep sets + race-reversal class dedup (analysis/sleep.py; off
+        # by default / DEMI_SLEEP_SETS=1): frontier prescriptions carry
+        # bounded sleep rows the device kernel tracks wake ordinals for,
+        # the racing scan refuses reversals asleep at their branch, and
+        # admitted prescriptions dedup on Mazurkiewicz-canonical class
+        # keys — counted in analysis.sleep_pruned, never admitted.
+        self.sleep = _resolve_sleep_sets(
+            app, sleep_sets, self.static_independence
+        )
+        # Per-lane rng keys: 'position' (the default — key = cumulative
+        # batch position) or 'content' (key derived from the
+        # prescription's content digest, so a prescription explores the
+        # SAME suffix regardless of where pruning shifts it in the
+        # round order). Sleep mode defaults to content keys: the A/B
+        # contract (pruned explored ⊆ unpruned, violations preserved)
+        # only holds when pruning cannot reshuffle every surviving
+        # lane's randomness. Padding lanes all share the empty
+        # prescription's key under content mode — determinism traded
+        # for pad diversification, exactly the redundancy-measurement
+        # trade.
+        if key_mode is None:
+            key_mode = "content" if self.sleep is not None else "position"
+        if key_mode not in ("position", "content"):
+            raise ValueError(
+                f"key_mode must be 'position' or 'content', got {key_mode!r}"
+            )
+        self.key_mode = key_mode
         impl = impl or os.environ.get("DEMI_DEVICE_IMPL", "xla")
+        if self.sleep is not None and (
+            mesh is not None or impl == "pallas"
+        ):
+            raise ValueError(
+                "sleep sets run on the XLA DPOR kernel (mesh sharding and "
+                "the pallas twin do not carry the sleep inputs yet)"
+            )
         if mesh is not None:
             # Frontier rounds sharded over the device mesh (SURVEY.md
             # §2.8: the batch axis covers EVERY batched workload, the
@@ -806,8 +1207,15 @@ class DeviceDPOR:
             # A caller-shared kernel (DeviceDPOROracle keeps one per
             # app/cfg): every fresh DeviceDPOR otherwise jits its own
             # closure, so a DDMin run probing many subsequences would
-            # recompile the identical kernel per subsequence.
+            # recompile the identical kernel per subsequence. With sleep
+            # sets on the caller must share a SLEEP kernel (same
+            # sleep_cap/matrix) — the oracle does.
             self.kernel = kernel
+        elif self.sleep is not None:
+            self.kernel = make_dpor_kernel(
+                app, cfg, sleep_cap=self.sleep.cap,
+                commute_matrix=self.sleep.matrix,
+            )
         else:
             self.kernel = make_dpor_kernel(app, cfg)
         self.prog = lower_program(app, cfg, list(program))
@@ -837,7 +1245,9 @@ class DeviceDPOR:
                 )
             if mesh is None:
                 self._fork_kernel = fork_kernel or make_dpor_kernel(
-                    app, cfg, start_state=True
+                    app, cfg, start_state=True,
+                    sleep_cap=self.sleep.cap if self.sleep else 0,
+                    commute_matrix=self.sleep.matrix if self.sleep else None,
                 )
             else:
                 from ..parallel.mesh import shard_dpor_kernel
@@ -873,6 +1283,34 @@ class DeviceDPOR:
                 # the full prefix (O(p)) — the DPOR twin of the replay
                 # checker's hierarchical trunks.
                 resume_runner=make_dpor_prefix_resume_runner(app, cfg),
+                # Cross-round trunk reuse (the PR 6 ~0%-hit debt):
+                # DEMI_FORK_ANCHOR_STRIDE=N caches anchor snapshots
+                # every N buckets while building a trunk, so a later
+                # round's round-unique prefix resumes the deepest
+                # shared anchor instead of starting over. Keys are
+                # match-normalized (see _dispatch_round), which is what
+                # makes cross-round sharing possible at all. Measured
+                # on the config-8 sequential frontier: trunk hit rate
+                # 0.13 -> 0.64 by round 6 (parent + anchor resumes);
+                # under the double-buffered round composition the
+                # anchors cost extra launches without hits on CPU —
+                # so, like every fork feature, opt-in until measured
+                # where launches are cheap.
+                anchor_stride=int(
+                    os.environ.get("DEMI_FORK_ANCHOR_STRIDE", "0")
+                ) or None,
+                # Anchors live or die by LRU headroom: a chain caches
+                # one snapshot per stride boundary, and the SHALLOW
+                # boundaries — the ones every racing family shares —
+                # are also the least-recently-used entries, so a tight
+                # cache evicts exactly the reusable ones first. One
+                # snapshot is a single lane's state (tens of KB), so
+                # hundreds stay cheap.
+                capacity=(
+                    512
+                    if os.environ.get("DEMI_FORK_ANCHOR_STRIDE", "0") != "0"
+                    else 32
+                ),
             )
         self._mesh = mesh
         self._double_buffer = _resolve_double_buffer(double_buffer)
@@ -889,14 +1327,6 @@ class DeviceDPOR:
         # loop). Both produce bit-identical explored/frontier/results —
         # pinned by tests/test_host_path.py and bench config 8.
         self.host_path = _resolve_host_path(host_path)
-        # Static may-commute relation (analysis.StaticIndependence; off
-        # by default / DEMI_STATIC_PRUNE=1): racing pairs whose flip is
-        # provably a no-op are skipped during prescription derivation —
-        # counted in analysis.static_pruned, never admitted. Both host
-        # paths consult the same relation with the same placement.
-        self.static_independence = _resolve_static_independence(
-            app, static_independence
-        )
         # Host-share accounting (always on — two perf_counter reads per
         # round): wall time blocked harvesting device results vs
         # everything else in the frontier loop. The dpor.host_share gauge
@@ -918,6 +1348,28 @@ class DeviceDPOR:
         self.original: Optional[Tuple] = None
         self.max_distance: Optional[int] = None
         self.interleavings = 0
+        # Sleep-set side state: per-prescription sleep rows (frontier
+        # entries stay plain tuples — selection, dedup, and every parity
+        # surface are untouched), plus the class-suppressed sets kept in
+        # the same tuple/digest lockstep as explored/_explored_digests.
+        self._sleep_rows: Dict[Tuple, Tuple[Tuple[int, ...], ...]] = {}
+        self._suppressed: Set[Tuple] = set()
+        self._suppressed_digests: Set[bytes] = set()
+        # Wakeup-sequence guides (sleep mode only): a reversal's
+        # EXECUTION follows the full bounded wakeup sequence — prefix,
+        # flipped record, then the source lane's remaining deliveries in
+        # order (divergence-tolerant) — while its frontier IDENTITY
+        # stays ``prefix + flip`` (wakeup-tree node identity: suffix
+        # reorderings collapse into the same node, which is what turns
+        # classic DPOR's re-derivations into raw-redundant hits). Keyed
+        # by the identity tuple; ``_pack`` substitutes the guide rows.
+        self._guides: Dict[Tuple, np.ndarray] = {}
+        if self.sleep is not None:
+            self.sleep.note_class(())  # the root schedule's class
+        # Distinct violation codes observed across all lanes of all
+        # rounds (always tracked — one np.unique per round): the
+        # violation-set preservation surface the sleep-set A/B asserts.
+        self.violation_codes: Set[int] = set()
         # Measurement-guided budget control (demi_tpu/tune): when set, the
         # tuner sees each round's fresh/redundant/pruned prescription
         # counts and adjusts max_distance and round_batch online. The
@@ -939,15 +1391,38 @@ class DeviceDPOR:
             self.explored.add(prescription)
             self._explored_digests.add(prescription_digest(prescription))
             self.frontier.insert(0, prescription)
+            if self.sleep is not None and prescription:
+                # Seeded rows carry no source-lane positions: creation
+                # edges onto them never fire (class splits, never
+                # falsely merges — see canonical_class_key).
+                self.sleep.note_class(
+                    self.sleep.class_key(
+                        np.asarray(prescription, np.int32), None,
+                        self.cfg.rec_width,
+                    )
+                )
 
     def _pack(self, prescriptions: List[Tuple]) -> np.ndarray:
         r, w = self.cfg.max_steps, self.cfg.rec_width
         out = np.zeros((len(prescriptions), r, w), np.int32)
         for k, presc in enumerate(prescriptions):
-            if presc:
+            guide = (
+                self._guides.get(presc) if self.sleep is not None else None
+            )
+            if guide is not None:
+                m = min(len(guide), r)
+                out[k, :m] = guide[:m]
+            elif presc:
                 m = min(len(presc), r)
                 out[k, :m] = np.asarray(presc[:m], np.int32)
         return out
+
+    def _sleep_from(self, batch: List[Tuple]) -> np.ndarray:
+        """Per-lane node ordinal (sleep mode): the delivery count of the
+        lane's IDENTITY prescription (prefix + flip) — wake tracking and
+        sleep-membership checks apply at/after it. Guide rows beyond the
+        identity are ordinary prescribed deliveries and ARE tracked."""
+        return np.asarray([len(p) for p in batch], np.int32)
 
     def _progs(self, b: int) -> ExtProgram:
         return ExtProgram(
@@ -1022,12 +1497,30 @@ class DeviceDPOR:
             return gen, pending
         return gen + pending, []
 
-    def _round_keys(self, n: int, base: int):
-        """Per-lane keys for one round: position in the cumulative
-        interleaving count. Every round is padded to ``batch_size``, so
-        ``base`` advances deterministically — a speculative round N+1
-        dispatched before round N's harvest derives the exact keys the
-        synchronous loop would."""
+    def _round_keys(self, n: int, base: int, batch: Optional[List[Tuple]] = None):
+        """Per-lane keys for one round. ``key_mode='position'`` (the
+        default): position in the cumulative interleaving count — every
+        round is padded to ``batch_size``, so ``base`` advances
+        deterministically and a speculative round N+1 dispatched before
+        round N's harvest derives the exact keys the synchronous loop
+        would. ``key_mode='content'`` (sleep-set mode): each lane's key
+        derives from its prescription's content digest, so a
+        prescription explores the identical suffix no matter where
+        pruning shifts it in the round order — the property the sleep
+        A/B's explored-subset/violation-preservation contract rests on."""
+        if self.key_mode == "content" and batch is not None:
+            from ..native import prescription_digest
+
+            seeds = np.asarray(
+                [
+                    int.from_bytes(prescription_digest(p)[:4], "little")
+                    for p in batch
+                ],
+                np.uint32,
+            )
+            return jax.vmap(
+                lambda s: jax.random.fold_in(jax.random.PRNGKey(0), s)
+            )(seeds)
         return jax.vmap(
             lambda s: jax.random.fold_in(jax.random.PRNGKey(0), s)
         )(np.arange(base, base + n, dtype=np.uint32))
@@ -1048,21 +1541,73 @@ class DeviceDPOR:
         (prescription-free pads included) runs the scratch kernel.
         Per-lane keys follow batch position on both paths, so per-lane
         results are bit-identical."""
+        sleeps = self._pack_sleep(batch) if self.sleep is not None else None
+        sfrom = self._sleep_from(batch) if sleeps is not None else None
         if self._forker is None or len(batch) < 2:
-            return [(None, self.kernel(self._progs(len(batch)), prescs, keys))]
-        from .fork import padded_size
+            if sleeps is None:
+                return [
+                    (None, self.kernel(self._progs(len(batch)), prescs, keys))
+                ]
+            return [(
+                None,
+                self.kernel(
+                    self._progs(len(batch)), prescs, keys, sleeps, sfrom
+                ),
+            )]
+        from .fork import padded_size, prefix_digest
 
         keys = np.asarray(keys)
-        lengths = np.asarray([len(p) for p in batch])
-        groups, scratch = self._forker.plan(prescs, lengths)
+        lengths = np.asarray(
+            [len(self._guides.get(p, p)) for p in batch]
+            if self.sleep is not None
+            else [len(p) for p in batch]
+        )
+        # Plan and key trunks over MATCH-NORMALIZED rows: the
+        # prescribed-dispatch matcher never reads the parent/prev
+        # bookkeeping columns, so two prescriptions identical in the
+        # matchable columns execute to bit-identical trunk states even
+        # when their source lanes recorded different trace positions.
+        # Keying on raw bytes was why cross-round reuse measured ~0%
+        # (a re-derived prefix differs from its ancestor only in the
+        # flip row's prev column); normalized keys let round N+1's
+        # trunks resume round N's. Lanes still receive the ORIGINAL
+        # rows — only grouping/caching identity changes.
+        plan_rows = prescs.copy()
+        plan_rows[:, :, self.cfg.rec_width - 2:] = 0
+        groups, scratch = self._forker.plan(plan_rows, lengths)
+        if sleeps is not None:
+            # Sleep mode: trunk prefixes stop BELOW every member's node
+            # ordinal, so the shared (untracked) trunk segment never
+            # enters the region the per-lane wake tracking must cover.
+            bucket = self._forker.planner.bucket
+            adjusted = []
+            for g in groups:
+                cap = (min(int(sfrom[i]) for i in g.indices) // bucket) * bucket
+                if cap <= 0:
+                    scratch.extend(g.indices)
+                    continue
+                if g.prefix_len > cap:
+                    g = g._replace(
+                        prefix_len=cap,
+                        key=prefix_digest(
+                            plan_rows[g.indices[0], :cap].tobytes()
+                        ),
+                    )
+                adjusted.append(g)
+            groups = adjusted
         parts: List[Tuple[Optional[List[int]], LaneResult]] = []
 
         for g in groups:
             if not self._forker.should_fork(g):
                 scratch.extend(g.indices)
                 continue
-            trunk_presc = np.zeros_like(prescs[0])
-            trunk_presc[: g.prefix_len] = prescs[g.indices[0], : g.prefix_len]
+            # Trunk follows the normalized rows (execution-identical —
+            # the matcher ignores the zeroed columns — and the key space
+            # the ancestor walk + anchors live in).
+            trunk_presc = np.zeros_like(plan_rows[0])
+            trunk_presc[: g.prefix_len] = plan_rows[
+                g.indices[0], : g.prefix_len
+            ]
             snap, trunk_steps, hit = self._forker.trunk_hier_prescribed(
                 g.key,
                 ExtProgram(*(np.asarray(x) for x in self.prog)),
@@ -1073,9 +1618,15 @@ class DeviceDPOR:
             full = g.indices + [g.indices[0]] * (
                 padded_size(len(g.indices), self._mesh) - len(g.indices)
             )
-            res_g = self._fork_kernel(
-                self._progs(len(full)), prescs[full], keys[full], snap
-            )
+            if sleeps is None:
+                res_g = self._fork_kernel(
+                    self._progs(len(full)), prescs[full], keys[full], snap
+                )
+            else:
+                res_g = self._fork_kernel(
+                    self._progs(len(full)), prescs[full], keys[full],
+                    sleeps[full], sfrom[full], snap,
+                )
             parts.append((g.indices, res_g))
             self._forker.note_group(len(g.indices), trunk_steps, hit)
             obs.histogram("dpor.prefix_group_size").observe(len(g.indices))
@@ -1083,30 +1634,53 @@ class DeviceDPOR:
             full = scratch + [scratch[0]] * (
                 padded_size(len(scratch), self._mesh) - len(scratch)
             )
-            res_s = self.kernel(self._progs(len(full)), prescs[full], keys[full])
+            if sleeps is None:
+                res_s = self.kernel(
+                    self._progs(len(full)), prescs[full], keys[full]
+                )
+            else:
+                res_s = self.kernel(
+                    self._progs(len(full)), prescs[full], keys[full],
+                    sleeps[full], sfrom[full],
+                )
             parts.append((scratch, res_s))
             self._forker.note_scratch(len(scratch))
         return parts
 
+    def _pack_sleep(self, batch: List[Tuple]) -> np.ndarray:
+        """Fixed-shape sleep input for one round: each lane's sleep rows
+        ([B, sleep_cap, recw] int32, kind 0 = empty slot) looked up from
+        the frontier side-table — prescription-free padding lanes carry
+        none."""
+        S, w = self.sleep.cap, self.cfg.rec_width
+        out = np.zeros((len(batch), S, w), np.int32)
+        for k, presc in enumerate(batch):
+            rows = self._sleep_rows.get(presc)
+            if rows:
+                for s, row in enumerate(rows[:S]):
+                    out[k, s, : len(row)] = row
+        return out
+
     def _harvest_round(self, parts, batch_len: int) -> LaneResult:
         """Block on a dispatched round's parts and merge them back into
-        batch order (np arrays quack like the LaneResult the harvesting
-        loops read)."""
+        batch order (np arrays quack like the LaneResult — or
+        DporSleepResult — the harvesting loops read)."""
         if len(parts) == 1 and parts[0][0] is None:
             res = parts[0][1]
             jax.block_until_ready(res.violation)
             return res
+        res_type = type(parts[0][1])
         merged = {}
-        for field in LaneResult._fields:
+        for field in res_type._fields:
             ref = np.asarray(getattr(parts[0][1], field))
             merged[field] = np.zeros((batch_len,) + ref.shape[1:], ref.dtype)
         for idx, res in parts:
             jax.block_until_ready(res.violation)
-            for field in LaneResult._fields:
+            for field in res_type._fields:
                 merged[field][np.asarray(idx)] = np.asarray(
                     getattr(res, field)
                 )[: len(idx)]
-        return LaneResult(**merged)
+        return res_type(**merged)
 
     def _launch_round(self, prescs: np.ndarray, keys, batch: List[Tuple]):
         """One frontier round's lane work, harvested to LaneResult arrays
@@ -1159,6 +1733,12 @@ class DeviceDPOR:
         violations = np.asarray(res.violation)[: len(batch)]
         traces = np.asarray(res.trace)
         lens = np.asarray(res.trace_len)
+        # Violation-set ledger (always on — one np.unique per round):
+        # every distinct nonzero code any lane of any round produced,
+        # the preservation surface the sleep-set A/B asserts against.
+        for code in np.unique(violations):
+            if code != 0:
+                self.violation_codes.add(int(code))
         hit_mask = (
             violations != 0
             if target_code is None
@@ -1175,17 +1755,21 @@ class DeviceDPOR:
         # counters still carry the cross-round totals).
         if self.host_path == "vectorized":
             fresh_n, redundant_n, pruned_n = self._derive_batch(
-                traces, lens, len(batch), frontier
+                traces, lens, len(batch), frontier, batch=batch, res=res
             )
         else:
             fresh_n, redundant_n, pruned_n = self._derive_legacy(
-                traces, lens, len(batch), frontier
+                traces, lens, len(batch), frontier, batch=batch, res=res
             )
         if redundant_n:
             obs.counter("dpor.prescriptions_redundant").inc(redundant_n)
         if pruned_n:
             obs.counter("dpor.prescriptions_distance_pruned").inc(pruned_n)
         obs.gauge("dpor.explored_set_size").set(len(self.explored))
+        if self.sleep is not None:
+            ratio = self.sleep.redundancy_ratio(len(self.explored))
+            if ratio is not None:
+                obs.gauge("dpor.redundancy_ratio").set(round(ratio, 4))
         if self.tuner is not None:
             self.tuner.observe_round(
                 fresh=fresh_n, redundant=redundant_n, pruned=pruned_n,
@@ -1194,6 +1778,16 @@ class DeviceDPOR:
             self.round_batch = self.tuner.round_batch
             if self.tuner.max_distance is not None:
                 self.max_distance = self.tuner.max_distance
+        if self.sleep is not None:
+            # A harvested prescription never re-enters the worklist
+            # (explored-set membership), so its guide and sleep rows are
+            # dead — drop them, bounding the side tables to the live
+            # frontier instead of the whole explored history. (An
+            # unharvested in-flight round that gets requeued was never
+            # processed here, so its entries survive for re-dispatch.)
+            for p in batch:
+                self._guides.pop(p, None)
+                self._sleep_rows.pop(p, None)
         return hit
 
     def _admit(
@@ -1216,8 +1810,99 @@ class DeviceDPOR:
         frontier.append(presc)
         return True
 
+    def _sleep_class_check(
+        self, presc: Tuple, rows, own_pos, flip, branch: int,
+        lane_presc: Tuple, wake_row,
+    ):
+        """The class-dedup half of sleep-set admission for ONE fresh
+        candidate (shared by both host paths — parity by construction).
+        Returns ``(verdict, commit)``: verdict 'class' means the
+        candidate's Mazurkiewicz class was already scheduled (suppress);
+        verdict None means admit-eligible, and ``commit()`` — called
+        after ``_admit`` accepts — registers the class, assigns the
+        child's sleep rows (earlier siblings at the node + the source
+        lane's still-asleep rows, filtered by independence with the
+        flip), and appends the flip to the node's wakeup ledger."""
+        sleep = self.sleep
+        recw = self.cfg.rec_width
+        ckey = sleep.class_key(rows, own_pos, recw)
+        if sleep.prune and sleep.class_seen(ckey):
+            sleep.note_pruned(klass=1, tier="device")
+            if sleep.audit:
+                sleep.note_pruned_prescription(presc)
+            return "class", None
+
+        def commit():
+            sleep.note_class(ckey)
+            node_key = np.ascontiguousarray(
+                np.asarray(presc[:-1], np.int32).reshape(len(presc) - 1, -1)
+            ).tobytes() if len(presc) > 1 else b""
+            inherited: List[Tuple[int, ...]] = []
+            if wake_row is not None:
+                lane_sleep = self._sleep_rows.get(lane_presc, ())
+                presc_deliv = int(wake_row[1])
+                if branch >= presc_deliv:
+                    for s, srow in enumerate(lane_sleep):
+                        if s < len(wake_row[0]) and int(wake_row[0][s]) >= branch:
+                            inherited.append(srow)
+            child = sleep.child_sleep_rows(node_key, flip, recw, inherited)
+            if child:
+                self._sleep_rows[presc] = child
+            sleep.note_admitted_flip(node_key, flip)
+
+        return None, commit
+
+    def _make_guide(
+        self, deliv: List[Tuple[int, ...]], branch: int,
+        flip: Tuple[int, ...], flip_ord: Optional[int],
+    ) -> np.ndarray:
+        """Bounded wakeup sequence for one admitted reversal (sleep
+        mode): the source lane's deliveries before the branch, the
+        flipped record, then the lane's remaining deliveries in order
+        with the flipped one removed — so the reversal's subtree
+        revisits the source schedule modulo exactly the reversed race
+        (divergence tolerance skips rows the flip invalidated), instead
+        of diverging into fresh randomness at the node.
+
+        ``flip_ord=None`` locates the flip by FULL-row equality past
+        the branch — exact, not approximate: same-receiver deliveries
+        always differ in the ``prev`` column (the per-receiver
+        program-order chain is strictly increasing), so a full-row
+        match identifies the flipped delivery uniquely. Both host
+        paths use this one rule so their guides are bit-identical by
+        construction."""
+        if flip_ord is None:
+            flip_ord = next(
+                (
+                    t
+                    for t in range(branch + 1, len(deliv))
+                    if deliv[t] == flip
+                ),
+                None,
+            )
+        rows = list(deliv[:branch]) + [flip]
+        if flip_ord is not None:
+            rows += list(deliv[branch:flip_ord]) + list(deliv[flip_ord + 1:])
+        return np.asarray(rows[: self.cfg.max_steps], np.int32)
+
+    def _sleep_ctx(self, batch: List[Tuple], res) -> Optional[tuple]:
+        """The racing scan's per-lane sleep inputs for one harvested
+        round: the packed sleep rows the kernel consumed (a pure
+        function of the batch — identical to what was dispatched) plus
+        the device-tracked wake/slept/prescribed-count observations."""
+        if self.sleep is None or not hasattr(res, "sleep_wake"):
+            return None
+        n = len(batch)
+        return (
+            self._pack_sleep(batch),
+            np.asarray(res.sleep_wake)[:n],
+            np.asarray(res.sleep_slept)[:n],
+            self._sleep_from(batch),
+        )
+
     def _derive_batch(
-        self, traces, lens, n_lanes: int, frontier: List[Tuple]
+        self, traces, lens, n_lanes: int, frontier: List[Tuple],
+        batch: Optional[List[Tuple]] = None, res=None,
     ) -> Tuple[int, int, int]:
         """Vectorized prescription derivation: one batch-native racing
         call for the whole round, content-digest dedup over the packed
@@ -1226,10 +1911,16 @@ class DeviceDPOR:
         from ..native import digest_keys, racing_prescriptions_batch
 
         recw = self.cfg.rec_width
+        sleep_ctx = (
+            self._sleep_ctx(batch, res)
+            if batch is not None and res is not None
+            else None
+        )
         rows, offsets, lanes, digests = racing_prescriptions_batch(
             traces[:n_lanes], lens[:n_lanes], recw,
             size_hint=self._batch_size_hint,
             independence=self.static_independence,
+            sleep=self.sleep, sleep_ctx=sleep_ctx,
         )
         # Adaptive buffer sizing: the next round's scan allocates for
         # this round's volume (+ slack) instead of a blind worst case.
@@ -1247,16 +1938,16 @@ class DeviceDPOR:
         # (mlen - 1) delivery rows of its lane in position order, so one
         # tuple list per lane serves every fresh sibling — O(refs) per
         # prescription instead of a fresh tuple per packed row.
-        lane_deliv: Dict[int, List[Tuple[int, ...]]] = {}
+        lane_deliv: Dict[int, Tuple[List[Tuple[int, ...]], np.ndarray]] = {}
 
-        def deliveries_of(b: int) -> List[Tuple[int, ...]]:
+        def deliveries_of(b: int) -> Tuple[List[Tuple[int, ...]], np.ndarray]:
             cached = lane_deliv.get(b)
             if cached is None:
                 recs = traces[b, : int(lens[b]), :recw]
                 pos = np.nonzero(
                     np.isin(recs[:, 0], (REC_DELIVERY, REC_TIMER))
                 )[0]
-                cached = [tuple(r) for r in recs[pos].tolist()]
+                cached = ([tuple(r) for r in recs[pos].tolist()], pos)
                 lane_deliv[b] = cached
             return cached
 
@@ -1264,37 +1955,142 @@ class DeviceDPOR:
             if key in explored_digests:
                 redundant_n += 1
                 continue
+            if key in self._suppressed_digests:
+                redundant_n += 1
+                continue
             lo, hi = offs[k], offs[k + 1]
+            b = lane_of[k]
             flipped = tuple(rows[hi - 1].tolist())
-            presc = tuple(deliveries_of(lane_of[k])[: hi - lo - 1]) + (
-                flipped,
-            )
+            deliv, pos = deliveries_of(b)
+            m = hi - lo
+            presc = tuple(deliv[: m - 1]) + (flipped,)
+            commit = None
+            if self.sleep is not None:
+                wake_row = (
+                    (sleep_ctx[1][b], sleep_ctx[3][b])
+                    if sleep_ctx is not None
+                    else None
+                )
+                verdict, commit = self._sleep_class_check(
+                    presc, rows[lo:hi],
+                    list(pos[: m - 1]) + [None], flipped, m - 1,
+                    batch[b] if batch is not None else tuple(),
+                    wake_row,
+                )
+                if verdict == "class":
+                    self._suppressed_digests.add(key)
+                    redundant_n += 1
+                    continue
             if self._admit(presc, key, frontier):
                 fresh_n += 1
+                if commit is not None:
+                    commit()
+                if self.sleep is not None:
+                    self._guides[presc] = self._make_guide(
+                        deliv, m - 1, flipped, None
+                    )
             else:
                 pruned_n += 1
         return fresh_n, redundant_n, pruned_n
 
     def _derive_legacy(
-        self, traces, lens, n_lanes: int, frontier: List[Tuple]
+        self, traces, lens, n_lanes: int, frontier: List[Tuple],
+        batch: Optional[List[Tuple]] = None, res=None,
     ) -> Tuple[int, int, int]:
         """The pre-vectorization host path — per-lane scans, per-pair
         tuple assembly, tuple-set membership — kept as the parity
         baseline (bench config 8's host_path comparison and
-        tests/test_host_path.py pin bit-identical outputs)."""
+        tests/test_host_path.py pin bit-identical outputs). With sleep
+        sets on, applies the identical per-pair sleep filter (branch
+        beyond the redundant marker, flip asleep at the branch) and
+        class dedup in the same order as the batch path."""
+        from ..analysis.sleep import BIG_ORDINAL, rows_content_equal
+
+        recw = self.cfg.rec_width
+        sleep_ctx = (
+            self._sleep_ctx(batch, res)
+            if batch is not None and res is not None
+            else None
+        )
         fresh_n = redundant_n = pruned_n = 0
+        sleep_pruned = 0
         for lane in range(n_lanes):
-            for presc in racing_prescriptions(
-                traces[lane], int(lens[lane]), self.cfg.rec_width,
+            metas, positions = racing_prescriptions_meta(
+                traces[lane], int(lens[lane]), recw,
                 independence=self.static_independence,
-            ):
+            )
+            lane_deliv: Optional[List[Tuple[int, ...]]] = None
+            for presc, branch, flip_ord in metas:
+                if (
+                    self.sleep is not None
+                    and self.sleep.prune
+                    and sleep_ctx is not None
+                ):
+                    # Per-pair sleep filter, identically placed to the
+                    # batch scan's (after static, before dedup).
+                    _srows, wake, slept, presc_deliv = sleep_ctx
+                    flip = presc[-1]
+                    asleep = branch > int(slept[lane])
+                    if not asleep and branch >= int(presc_deliv[lane]):
+                        lane_sleep = self._sleep_rows.get(
+                            batch[lane] if batch is not None else tuple(), ()
+                        )
+                        for s, srow in enumerate(lane_sleep):
+                            if int(wake[lane][s]) < branch:
+                                continue
+                            if rows_content_equal(flip, srow, recw):
+                                asleep = True
+                                break
+                    if asleep:
+                        sleep_pruned += 1
+                        if self.sleep.audit:
+                            self.sleep.note_pruned_prescription(presc)
+                        continue
                 if presc in self.explored:
                     redundant_n += 1
                     continue
+                if presc in self._suppressed:
+                    redundant_n += 1
+                    continue
+                commit = None
+                if self.sleep is not None:
+                    wake_row = (
+                        (sleep_ctx[1][lane], sleep_ctx[3][lane])
+                        if sleep_ctx is not None
+                        else None
+                    )
+                    m = len(presc)
+                    verdict, commit = self._sleep_class_check(
+                        presc, np.asarray(presc, np.int32),
+                        list(positions[: m - 1]) + [None], presc[-1],
+                        branch,
+                        batch[lane] if batch is not None else tuple(),
+                        wake_row,
+                    )
+                    if verdict == "class":
+                        self._suppressed.add(presc)
+                        redundant_n += 1
+                        continue
                 if self._admit(presc, None, frontier):
                     fresh_n += 1
+                    if commit is not None:
+                        commit()
+                    if self.sleep is not None:
+                        if lane_deliv is None:
+                            recs = traces[lane, : int(lens[lane]), :recw]
+                            lane_deliv = [
+                                tuple(r) for r in recs[positions].tolist()
+                            ]
+                        # flip_ord=None: the one guide rule both host
+                        # paths share (see _make_guide) — the meta's
+                        # exact ordinal resolves to the same row.
+                        self._guides[presc] = self._make_guide(
+                            lane_deliv, branch, presc[-1], None
+                        )
                 else:
                     pruned_n += 1
+        if sleep_pruned:
+            self.sleep.note_pruned(sleep=sleep_pruned, tier="device")
         return fresh_n, redundant_n, pruned_n
 
     def _note_inflight(self, outcome: str) -> None:
@@ -1318,6 +2114,22 @@ class DeviceDPOR:
         if self.static_independence is None:
             return None
         return dict(self.static_independence.pruned_total)
+
+    @property
+    def sleep_stats(self) -> Optional[Dict[str, object]]:
+        """Sleep-set ledger (None when sleep sets are off): prune counts
+        by kind, distinct Mazurkiewicz classes among admitted
+        prescriptions, and the redundancy ratio (explored over the
+        class lower bound — the `bench --config 9` headline)."""
+        if self.sleep is None:
+            return None
+        ratio = self.sleep.redundancy_ratio(len(self.explored))
+        return {
+            "pruned": dict(self.sleep.pruned_total),
+            "classes": len(self.sleep.classes),
+            "explored": len(self.explored),
+            "redundancy_ratio": round(ratio, 4) if ratio else None,
+        }
 
     def _account_device(self, secs: float) -> None:
         """Fold a device-blocked span into the ledger + obs series. The
@@ -1405,7 +2217,9 @@ class DeviceDPOR:
                 batch, gen = self._select_batch(gen)
                 parts = self._dispatch_round(
                     self._pack(batch),
-                    self._round_keys(len(batch), self.interleavings),
+                    self._round_keys(
+                        len(batch), self.interleavings, batch=batch
+                    ),
                     batch,
                 )
             spec = None
@@ -1414,7 +2228,8 @@ class DeviceDPOR:
                 sparts = self._dispatch_round(
                     self._pack(sbatch),
                     self._round_keys(
-                        len(sbatch), self.interleavings + len(batch)
+                        len(sbatch), self.interleavings + len(batch),
+                        batch=sbatch,
                     ),
                     sbatch,
                 )
@@ -1511,11 +2326,14 @@ def explore_window(
             batch, frontiers[i] = dpors[i]._select_batch(frontiers[i])
             staged.append(
                 (i, batch, dpors[i]._pack(batch),
-                 dpors[i]._round_keys(len(batch), dpors[i].interleavings))
+                 dpors[i]._round_keys(
+                     len(batch), dpors[i].interleavings, batch=batch
+                 ))
             )
         combined = (
             len(staged) > 1
             and all(dpors[i]._forker is None for i, *_ in staged)
+            and all(dpors[i].sleep is None for i, *_ in staged)
             and len({id(dpors[i].kernel) for i, *_ in staged}) == 1
         )
         results: List[Tuple[int, List[Tuple], LaneResult]] = []

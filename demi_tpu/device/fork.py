@@ -679,6 +679,7 @@ class PrefixForker:
         min_group: int = 2,
         driver: str = "replay",
         resume_runner: Optional[Callable[..., PrefixSnapshot]] = None,
+        anchor_stride: Optional[int] = None,
     ):
         self.planner = PrefixPlanner(bucket=bucket, min_group=min_group)
         self.cache = PrefixCache(capacity)
@@ -688,6 +689,17 @@ class PrefixForker:
         # it unset, every cache miss replays its full prefix (the pre-
         # hierarchical behavior, still used by the DPOR/sweep drivers).
         self.resume_runner = resume_runner
+        # Anchor-chained trunk building (DPOR cross-round reuse): with
+        # ``anchor_stride`` set (in planner buckets), a full-prefix miss
+        # is built as a CHAIN of resumes that caches a snapshot at every
+        # stride boundary along the way. Round prefixes are round-unique
+        # at full length (the PR 6 ~0% reuse finding), but consecutive
+        # rounds' racing families share long ancestors — the anchors are
+        # exactly the sub-bucket keys those ancestors hit, so a later
+        # round's trunk derives in O(remaining rows past the shared
+        # anchor) instead of O(prefix). Same total prefix steps as one
+        # straight run, plus one launch per stride boundary.
+        self.anchor_stride = anchor_stride
         self.driver = driver
         self.stats = {
             "groups": 0,
@@ -772,21 +784,82 @@ class PrefixForker:
         prescription from the ancestor's committed cursor (freeze
         semantics — see ``make_dpor_prefix_resume_runner``) instead of a
         compacted suffix, so the runner/resume argument shapes are
-        (prog, presc, key) / (prog, presc, snap)."""
+        (prog, presc, key) / (prog, presc, snap).
+
+        With ``anchor_stride`` set, the build additionally CACHES
+        intermediate snapshots at every stride boundary between the
+        found ancestor (or scratch) and the full prefix — truncating the
+        prescription at a boundary freezes the trunk loop exactly there,
+        and resuming the truncation's snapshot with a longer truncation
+        is the documented prescribed-resume semantics, so the chain is
+        bit-exact vs one straight run (tests/test_fork.py pins it)."""
         if self.resume_runner is None or key in self.cache:
             return self.trunk(key, prog, trunk_records, rng_key)
         b = self.planner.bucket
+        parent = None
+        parent_q = 0
         for q in range(prefix_len - b, 0, -b):
-            parent = self.cache.peek(
+            entry = self.cache.peek(
                 prefix_digest(trunk_records[:q].tobytes())
             )
-            if parent is None:
-                continue
+            if entry is not None:
+                parent, parent_q = entry, q
+                break
+        if self.anchor_stride:
+            return self._trunk_anchor_chain(
+                key, prog, trunk_records, rng_key, prefix_len,
+                parent, parent_q,
+            )
+        if parent is not None:
             snapshot = self.resume_runner(prog, trunk_records, parent[0])
             self.cache.put(key, snapshot, snapshot.steps)
             self._note_parent_trunk(parent)
             return snapshot, snapshot.steps, False
         return self.trunk(key, prog, trunk_records, rng_key)
+
+    def _trunk_anchor_chain(
+        self, key: bytes, prog, trunk_records, rng_key, prefix_len: int,
+        parent, parent_q: int,
+    ) -> Tuple[PrefixSnapshot, object, bool]:
+        """Build a missing trunk as a chain of prescribed resumes,
+        caching an anchor snapshot at every ``anchor_stride``-bucket
+        boundary (see ``trunk_hier_prescribed``). Starts from the found
+        ancestor (``parent`` at ``parent_q`` rows) or scratch."""
+        stride = self.planner.bucket * int(self.anchor_stride)
+        snap = parent[0] if parent is not None else None
+        boundary = (parent_q // stride + 1) * stride
+        anchors = 0
+        while boundary < prefix_len:
+            trunc = np.zeros_like(trunk_records)
+            trunc[:boundary] = trunk_records[:boundary]
+            akey = prefix_digest(trunk_records[:boundary].tobytes())
+            if akey not in self.cache:
+                asnap = (
+                    self.runner(prog, trunc, rng_key)
+                    if snap is None
+                    else self.resume_runner(prog, trunc, snap)
+                )
+                self.cache.put(akey, asnap, asnap.steps)
+                snap = asnap
+                anchors += 1
+            else:
+                snap = self.cache.peek(akey)[0]
+            boundary += stride
+        if anchors:
+            self.stats["anchor_trunks"] = (
+                self.stats.get("anchor_trunks", 0) + anchors
+            )
+            obs.counter("fork.anchor_trunks").inc(anchors, driver=self.driver)
+        if snap is None:
+            return self.trunk(key, prog, trunk_records, rng_key)
+        snapshot = self.resume_runner(prog, trunk_records, snap)
+        self.cache.put(key, snapshot, snapshot.steps)
+        if parent is not None:
+            self._note_parent_trunk(parent)
+        else:
+            self.stats["prefix_misses"] += 1
+            obs.counter("fork.prefix_misses").inc(driver=self.driver)
+        return snapshot, snapshot.steps, False
 
     def trunk_from(
         self, key: bytes, parent: Tuple[PrefixSnapshot, object], *args

@@ -162,6 +162,18 @@ def _load_native() -> Optional[ctypes.CDLL]:
             ctypes.c_void_p,
             ctypes.c_void_p, ctypes.c_void_p,
         ]
+        lib.demi_racing_prescriptions_sleep.restype = ctypes.c_int64
+        lib.demi_racing_prescriptions_sleep.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_int32,
+            ctypes.c_void_p, ctypes.c_int64,
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_void_p, ctypes.c_int64,
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+            ctypes.c_void_p,
+            ctypes.c_void_p, ctypes.c_void_p,
+        ]
         _lib = lib
     except Exception as exc:  # stale .so without the batch symbol included
         note_fallback(f"load failed: {type(exc).__name__}")
@@ -236,6 +248,8 @@ def racing_prescriptions_batch(
     records: np.ndarray, lens: np.ndarray, rec_width: int,
     size_hint: Optional[Tuple[int, int]] = None,
     independence=None,
+    sleep=None,
+    sleep_ctx: Optional[Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]] = None,
 ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
     """Batch racing analysis over one round's stacked lane records.
 
@@ -272,7 +286,18 @@ def racing_prescriptions_batch(
     per pair (``demi_racing_prescriptions_static``); the NumPy twin —
     also used for ``independence.audit`` runs, which must materialize
     what was pruned — post-filters with identical placement and counts.
-    Pruned counts report via ``independence.note_pruned``."""
+    Pruned counts report via ``independence.note_pruned``.
+
+    ``sleep`` (an analysis.SleepSets or None) + ``sleep_ctx`` =
+    ``(sleep_rows [B, S, w] int32, wake [B, S] int32, slept [B] int32,
+    presc_deliv [B] int32)`` additionally refuse reversals whose flip is
+    asleep at the branch (sleep-set membership — the reversal's subtree
+    is covered by an earlier-admitted sibling's) or whose branch lies
+    beyond the lane's redundant-suffix marker. Native entry
+    ``demi_racing_prescriptions_sleep``; the NumPy twin
+    (``_apply_sleep_filter``) is bit-identical and serves audit runs.
+    Applied AFTER the static filter (the shared counter contract);
+    counts report via ``sleep.note_pruned``."""
     records = np.ascontiguousarray(
         np.asarray(records)[:, :, :rec_width], np.int32
     )
@@ -283,6 +308,9 @@ def racing_prescriptions_batch(
             np.zeros((0, w), np.int32), np.zeros(1, np.int64),
             np.zeros(0, np.int32), np.zeros((0, 2), np.uint64),
         )
+    sleep_on = (
+        sleep is not None and sleep.prune and sleep_ctx is not None
+    )
     lib = _load_native()
     if lib is None:
         note_fallback("no native library")
@@ -291,6 +319,8 @@ def racing_prescriptions_batch(
         if independence is not None:
             out = _apply_static_filter(records, lens, *out,
                                        independence=independence)
+        if sleep_on:
+            out = _apply_sleep_filter(*out, sleep=sleep, sleep_ctx=sleep_ctx)
         return out
     lens = np.ascontiguousarray(lens)
     # The native per-pair filter serves the hot path; audit runs (which
@@ -304,6 +334,22 @@ def racing_prescriptions_batch(
         if matrix is None and not fungible:
             native_filter = False
             independence = None  # nothing to prune
+    # The native sleep filter composes with the static one in a single
+    # scan; an audit-mode SleepSets (which must materialize what it
+    # pruned) or an audit-mode independence falls back to the NumPy
+    # twins so both filters stay identically placed.
+    native_sleep = (
+        sleep_on and not sleep.audit
+        and (independence is None or native_filter)
+    )
+    if native_sleep:
+        s_rows = np.ascontiguousarray(sleep_ctx[0], np.int32)
+        s_wake = np.ascontiguousarray(sleep_ctx[1], np.int32)
+        s_slept = np.ascontiguousarray(sleep_ctx[2], np.int32)
+        s_presc = np.ascontiguousarray(sleep_ctx[3], np.int32)
+        scap = s_rows.shape[1] if s_rows.ndim == 3 else 0
+        if scap == 0:
+            native_sleep = False
     if size_hint is not None:
         cap_presc = max(64, int(size_hint[0]))
         cap_rows = max(256, int(size_hint[1]))
@@ -316,7 +362,24 @@ def racing_prescriptions_batch(
         lanes = np.empty(cap_presc, np.int32)
         digests = np.empty((cap_presc, 2), np.uint64)
         total_rows = ctypes.c_int64(0)
-        if native_filter:
+        if native_sleep:
+            pruned = np.zeros(3, np.int64)
+            n = lib.demi_racing_prescriptions_sleep(
+                records.ctypes.data, lens.ctypes.data,
+                batch, rmax, w,
+                matrix.ctypes.data if matrix is not None else None,
+                len(matrix) if matrix is not None else 0,
+                1 if fungible else 0,
+                s_rows.ctypes.data, scap,
+                s_wake.ctypes.data, s_slept.ctypes.data,
+                s_presc.ctypes.data,
+                rows.ctypes.data, cap_rows,
+                offsets.ctypes.data, lanes.ctypes.data, cap_presc,
+                digests.ctypes.data,
+                ctypes.byref(total_rows),
+                pruned.ctypes.data,
+            )
+        elif native_filter:
             pruned = np.zeros(2, np.int64)
             n = lib.demi_racing_prescriptions_static(
                 records.ctypes.data, lens.ctypes.data,
@@ -347,12 +410,19 @@ def racing_prescriptions_batch(
                 digests[:n],
             )
             if native_filter:
-                independence.note_pruned(
-                    int(pruned[0]), int(pruned[1]), tier="device"
-                )
+                if independence is not None:
+                    independence.note_pruned(
+                        int(pruned[0]), int(pruned[1]), tier="device"
+                    )
             elif independence is not None:
                 out = _apply_static_filter(records, lens, *out,
                                            independence=independence)
+            if native_sleep:
+                sleep.note_pruned(sleep=int(pruned[2]), tier="device")
+            elif sleep_on:
+                out = _apply_sleep_filter(
+                    *out, sleep=sleep, sleep_ctx=sleep_ctx
+                )
             return out
         cap_presc = max(cap_presc, int(n))
         cap_rows = max(cap_rows, int(total_rows.value))
@@ -414,6 +484,71 @@ def _apply_static_filter(
         for k in np.flatnonzero(prune):
             lo, hi = int(offsets[k]), int(offsets[k + 1])
             independence.note_pruned_prescription(
+                tuple(tuple(int(x) for x in r) for r in rows[lo:hi])
+            )
+    keep = ~prune
+    row_keep = np.repeat(keep, mlen)
+    new_mlen = mlen[keep]
+    new_offsets = np.zeros(len(new_mlen) + 1, np.int64)
+    np.cumsum(new_mlen, out=new_offsets[1:])
+    return (
+        np.ascontiguousarray(rows[row_keep]),
+        new_offsets,
+        lanes[keep],
+        np.asarray(digests)[keep],
+    )
+
+
+def _apply_sleep_filter(
+    rows: np.ndarray, offsets: np.ndarray, lanes: np.ndarray,
+    digests: np.ndarray, sleep, sleep_ctx,
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """NumPy twin of the native sleep-set filter (placement: AFTER the
+    static filter — the shared counter contract): drop prescriptions
+    whose flip is content-identical to a sleeping row still asleep at
+    the branch ordinal (``mlen - 1``, at/after the lane's node), or
+    whose branch lies beyond the lane's redundant-suffix marker. Bit-
+    identical surviving stream vs ``demi_racing_prescriptions_sleep``
+    (tests/test_sleep_sets.py); under ``sleep.audit`` every pruned
+    prescription is materialized into ``sleep.pruned_prescriptions``."""
+    n = len(lanes)
+    if n == 0:
+        return rows, offsets, lanes, digests
+    sleep_rows, wake, slept, presc_deliv = (
+        np.asarray(x) for x in sleep_ctx
+    )
+    w = rows.shape[1]
+    offsets = np.asarray(offsets, np.int64)
+    lanes = np.asarray(lanes)
+    mlen = offsets[1:] - offsets[:-1]
+    branch = mlen - 1  # deliveries strictly before the flipped race
+    flips = rows[offsets[1:] - 1]
+    scap = sleep_rows.shape[1] if sleep_rows.ndim == 3 else 0
+    prune = branch > slept[lanes]
+    if scap:
+        s = sleep_rows[lanes]  # [n, scap, w]
+        valid = s[:, :, 0] != 0
+        rec_timer = _delivery_kinds()[1]
+        fung = (
+            (s[:, :, 0] == flips[:, None, 0])
+            & (s[:, :, 2] == flips[:, None, 2])
+            & np.all(s[:, :, 3: w - 2] == flips[:, None, 3: w - 2], axis=2)
+            & ((flips[:, None, 0] == rec_timer)
+               | (s[:, :, 1] == flips[:, None, 1]))
+        )
+        asleep = wake[lanes] >= branch[:, None]
+        at_node = branch >= presc_deliv[lanes]
+        prune = prune | (
+            at_node & ~prune
+            & np.any(valid & fung & asleep, axis=1)
+        )
+    sleep.note_pruned(sleep=int(prune.sum()), tier="device")
+    if not prune.any():
+        return rows, offsets, lanes, digests
+    if sleep.audit:
+        for k in np.flatnonzero(prune):
+            lo, hi = int(offsets[k]), int(offsets[k + 1])
+            sleep.note_pruned_prescription(
                 tuple(tuple(int(x) for x in r) for r in rows[lo:hi])
             )
     keep = ~prune

@@ -122,7 +122,9 @@ class _DporExecution(BaseScheduler):
 
     def __init__(self, config: SchedulerConfig, tracker: DepTracker,
                  prescription: Tuple[int, ...], max_messages: int,
-                 initial_keys: Optional[List[Tuple]] = None):
+                 initial_keys: Optional[List[Tuple]] = None,
+                 sleep_ids: Optional[Set[int]] = None,
+                 dep=None):
         super().__init__(config, max_messages)
         self.tracker = tracker
         self.prescription = list(prescription)
@@ -132,6 +134,25 @@ class _DporExecution(BaseScheduler):
         self.delivered_ids: List[int] = []
         self.pending_sets: List[Set[int]] = []
         self.divergences = 0
+        # Sleep sets (the same observe-and-filter semantics as the
+        # device tier — execution itself is untouched, so violations
+        # are trivially preserved; pruning happens at backtrack
+        # admission): ``sleep_ids`` attaches at the node — the state
+        # where the prescribed prefix is exhausted; afterwards each
+        # delivery wakes its dependents (``dep(u, e) -> bool``).
+        # ``sleep_log[t]`` records the active sleep set before delivery
+        # t (None while the prescription is still being followed);
+        # ``slept_step`` marks the first delivery of a still-sleeping
+        # event (the redundant suffix — every branch beyond it is
+        # covered by the sibling that put the event to sleep).
+        self._sleep_pending: Optional[Set[int]] = (
+            set(sleep_ids) if sleep_ids is not None and dep is not None
+            else None
+        )
+        self._dep = dep
+        self._sleeping: Optional[Set[int]] = None  # active once at node
+        self.sleep_log: List[Optional[Set[int]]] = []
+        self.slept_step: Optional[int] = None
 
     # -- policy hooks ------------------------------------------------------
     def reset_pending(self) -> None:
@@ -206,6 +227,17 @@ class _DporExecution(BaseScheduler):
                 chosen = match
                 break
             self.divergences += 1  # prescribed event absent; skip it
+        # Sleep sets activate at the node — the state where the
+        # prescription (and initial-trace steering) is exhausted.
+        if (
+            self._sleep_pending is not None
+            and self._sleeping is None
+            and chosen is None
+        ):
+            self._sleeping = set(self._sleep_pending)
+        self.sleep_log.append(
+            set(self._sleeping) if self._sleeping is not None else None
+        )
         if chosen is None:
             # Default deterministic order: lowest event id (depth-first
             # canonical; fully reproducible).
@@ -213,6 +245,17 @@ class _DporExecution(BaseScheduler):
         entry, event = chosen
         self._pending.remove(chosen)
         self._current_parent = event.id
+        if self._sleeping:
+            if event.id in self._sleeping and self.slept_step is None:
+                # Delivered a still-sleeping event: the continuation is
+                # redundant (the sibling that put it to sleep covers
+                # it); branches beyond this step derive nothing.
+                self.slept_step = len(self.delivered_ids)
+            # Wake dependents: delivering `event` re-arms every sleeping
+            # event that does not commute with it.
+            self._sleeping = {
+                u for u in self._sleeping if not self._dep(u, event.id)
+            }
         self.delivered_ids.append(event.id)
         return entry
 
@@ -236,6 +279,8 @@ class DPORScheduler(TestOracle):
         stop_after_next_trace: bool = False,
         arvind_ordering: bool = False,
         static_independence=None,
+        sleep_sets: Optional[bool] = None,
+        sleep_dependence=None,
     ):
         self.config = config
         self.max_messages = max_messages
@@ -247,6 +292,29 @@ class DPORScheduler(TestOracle):
         # only — the host tier has no app object to analyze from an env
         # flag alone.
         self.static_independence = static_independence
+        # Sleep sets (same admission semantics as the device tier —
+        # analysis/sleep.py; DEMI_SLEEP_SETS=1 or explicit): each
+        # backtrack point carries the sleep set classic DPOR would give
+        # it (earlier-admitted sibling flips independent of its own,
+        # plus inherited still-asleep events), executions log the wake
+        # evolution, and the racing derivation refuses flips asleep at
+        # their branch — counted in analysis.sleep_pruned{tier=host}.
+        from ..analysis import sleep_sets_enabled
+
+        self.sleep_sets = sleep_sets_enabled(sleep_sets)
+        # Dependence oracle for wake/sleep decisions — by default the
+        # static relation doubles as it (the device-tier arrangement),
+        # but it can be given separately so sleep-set pruning runs with
+        # static pruning off (two tags may commute for WAKE purposes
+        # while their races are still explored).
+        self._sleep_dependence = (
+            sleep_dependence
+            if sleep_dependence is not None
+            else static_independence
+        )
+        self.sleep_pruned = 0
+        self._sleep: Dict[Tuple[int, ...], Set[int]] = {}
+        self._node_children: Dict[Tuple[int, ...], List[int]] = {}
         self.ordering = ordering or DefaultBacktrackOrdering()
         # Switch to ArvindDistanceOrdering once the first execution fixes
         # the original trace (it can't exist before then).
@@ -303,6 +371,12 @@ class DPORScheduler(TestOracle):
             execution = _DporExecution(
                 self.config, self.tracker, prescription, self.max_messages,
                 initial_keys=steering,
+                sleep_ids=(
+                    self._sleep.get(prescription, set())
+                    if self.sleep_sets
+                    else None
+                ),
+                dep=self._dep if self.sleep_sets else None,
             )
             steering = None  # only the first execution is trace-steered
             self.tracker.begin_execution()
@@ -330,15 +404,53 @@ class DPORScheduler(TestOracle):
             prescription = nxt
         return None
 
+    def _dep(self, u: int, e: int) -> bool:
+        """Host-tier dependence between two event ids (the wake/sleep
+        oracle): same receiver => dependent unless the static relation
+        proves the pair commuting; different receivers commute. Unknown
+        ids are dependent (conservative)."""
+        ev_u = self.tracker.events.get(u)
+        ev_e = self.tracker.events.get(e)
+        if ev_u is None or ev_e is None:
+            return True
+        if ev_u.rcv != ev_e.rcv:
+            return False
+        if self._sleep_dependence is not None:
+            if self._sleep_dependence.host_commutes_kind(ev_u, ev_e) == (
+                "commute"
+            ):
+                return False
+        return True
+
     def _enqueue_backtracks(self, execution: _DporExecution) -> None:
         trace = execution.delivered_ids
         pending_sets = execution.pending_sets
+        sleep_pruned = 0
         for i, j in self.tracker.racing_pairs(
             trace, independence=self.static_independence
         ):
             flipped = trace[j]
             if i >= len(pending_sets) or flipped not in pending_sets[i]:
                 continue  # not actually deliverable at the branch point
+            branch_sleep: Optional[Set[int]] = None
+            if self.sleep_sets:
+                # Sleep-membership filter (same placement as the device
+                # tier's): a branch beyond the redundant suffix — the
+                # execution re-delivered a still-sleeping event there,
+                # so the continuation is a sibling's subtree — derives
+                # nothing, and a flip asleep at its branch was already
+                # explored from an equivalent node.
+                if (
+                    execution.slept_step is not None
+                    and i > execution.slept_step
+                ):
+                    sleep_pruned += 1
+                    continue
+                if i < len(execution.sleep_log):
+                    branch_sleep = execution.sleep_log[i]
+                if branch_sleep is not None and flipped in branch_sleep:
+                    sleep_pruned += 1
+                    continue
             prefix = tuple(trace[:i]) + (flipped,)
             if prefix in self._explored:
                 continue
@@ -346,9 +458,34 @@ class DPORScheduler(TestOracle):
             if self.max_distance is not None and self.original_trace_ids:
                 if arvind_distance(prefix, self.original_trace_ids) > self.max_distance:
                     continue
+            if self.sleep_sets:
+                # Classic sleep inheritance: earlier-admitted sibling
+                # flips at this node plus the execution's still-asleep
+                # events, kept only when independent of the new flip
+                # (delivering it wakes its dependents).
+                node = tuple(trace[:i])
+                inherited = {
+                    u
+                    for u in (branch_sleep or set())
+                    if not self._dep(u, flipped)
+                }
+                siblings = {
+                    u
+                    for u in self._node_children.get(node, ())
+                    if not self._dep(u, flipped)
+                }
+                self._sleep[prefix] = siblings | inherited
+                self._node_children.setdefault(node, []).append(flipped)
             prio = self.ordering.priority(prefix, self.original_trace_ids or [])
             self._push_counter += 1
             heapq.heappush(self._backtracks, (prio, self._push_counter, prefix))
+        if sleep_pruned:
+            from .. import obs
+
+            self.sleep_pruned += sleep_pruned
+            obs.counter("analysis.sleep_pruned").inc(
+                sleep_pruned, kind="sleep", tier="host"
+            )
 
     def _pop_backtrack(self) -> Optional[Tuple[int, ...]]:
         if not self._backtracks:

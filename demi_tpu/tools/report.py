@@ -154,7 +154,12 @@ def render_report(directory: str, app=None) -> str:
             for name, series in counters.items()
             if name.startswith("analysis.")
         }
-        if analysis_counters:
+        # The redundancy-ratio gauge belongs in this block too: a
+        # dpor-only --sleep-sets run may prune nothing (no analysis.*
+        # counters) yet still carry the ratio — mirroring the PR 5
+        # guard, the block must not depend on any pipe.* series either.
+        redundancy = obs_snap.get("gauges", {}).get("dpor.redundancy_ratio")
+        if analysis_counters or redundancy:
             lines += ["### Static analysis", ""]
             sp = analysis_counters.get("analysis.static_pruned")
             if sp:
@@ -165,6 +170,25 @@ def render_report(directory: str, app=None) -> str:
                 )
                 for key, v in sorted(sp.items()):
                     lines.append(f"  - {key or '—'}: {v:g}")
+            slp = analysis_counters.get("analysis.sleep_pruned")
+            if slp:
+                total = sum(slp.values())
+                lines.append(
+                    f"- sleep-pruned reversals: {total:g} (already-"
+                    "reversed races: flips asleep at their branch, "
+                    "redundant suffixes, and Mazurkiewicz-class "
+                    "duplicates)"
+                )
+                for key, v in sorted(slp.items()):
+                    lines.append(f"  - {key or '—'}: {v:g}")
+            if redundancy:
+                for key, v in sorted(redundancy.items()):
+                    label = f" {key}" if key else ""
+                    lines.append(
+                        f"- redundancy ratio{label}: {v:g} (explored "
+                        "schedules over the distinct-class lower bound; "
+                        "1.0 = optimal)"
+                    )
             for name, label in (
                 ("analysis.sanitizer_mutations", "message mutations"),
                 ("analysis.sanitizer_time_reads", "wall-clock reads"),

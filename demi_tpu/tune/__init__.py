@@ -23,15 +23,21 @@ from .cache import TuningCache, default_cache_path, workload_key  # noqa: F401
 from .calibrate import (  # noqa: F401
     DPOR_INFLIGHT_AXIS,
     FORK_BUCKET_AXIS,
+    VIOLATION_BONUS_AXIS,
+    VIOLATION_BONUS_DEFAULT_KEY,
+    BonusDecision,
     ForkDecision,
     InflightDecision,
     SweepDecision,
     calibrate_dpor_inflight,
     calibrate_fork,
     calibrate_sweep,
+    calibrate_weight_bonus,
     coordinate_descent,
+    default_violation_bonus,
     depth_bucket,
     fork_signals,
+    make_bonus_measure,
     make_dpor_inflight_measure,
     make_fork_measure,
     median_rate,
@@ -46,6 +52,7 @@ from .controller import (  # noqa: F401
 )
 
 __all__ = [
+    "BonusDecision",
     "DPOR_INFLIGHT_AXIS",
     "DporBudgetTuner",
     "ExplorationController",
@@ -54,15 +61,20 @@ __all__ = [
     "InflightDecision",
     "SweepDecision",
     "TuningCache",
+    "VIOLATION_BONUS_AXIS",
+    "VIOLATION_BONUS_DEFAULT_KEY",
     "WeightTuner",
     "autotune_enabled",
     "calibrate_dpor_inflight",
     "calibrate_fork",
     "calibrate_sweep",
+    "calibrate_weight_bonus",
     "coordinate_descent",
     "default_cache_path",
+    "default_violation_bonus",
     "depth_bucket",
     "fork_signals",
+    "make_bonus_measure",
     "make_dpor_inflight_measure",
     "make_fork_measure",
     "median_rate",

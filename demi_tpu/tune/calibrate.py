@@ -535,6 +535,9 @@ def make_dpor_inflight_measure(
             dpor = DeviceDPOR(
                 app, device_cfg, program, batch_size=batch,
                 double_buffer=on, kernel=kernel, fork_kernel=fork_kernel,
+                # The shared kernels are plain ones; pin sleep mode off
+                # so an ambient DEMI_SLEEP_SETS cannot mismatch them.
+                sleep_sets=False,
             )
             dpor.explore(target_code=target_code, max_rounds=1)
             before = dpor.interleavings
@@ -623,6 +626,184 @@ def calibrate_dpor_inflight(
     )
     _record_inflight_decision(decision)
     cache.put(key, decision.to_json())
+    return decision
+
+
+#: Candidate violation-bonus weights (the ExplorationController reward's
+#: "one violating lane is worth this many fresh schedules" knob — 10.0
+#: was hand-set in PR 2; the ROADMAP debt is measuring it).
+VIOLATION_BONUS_AXIS = (2.0, 5.0, 10.0, 20.0)
+
+#: Global TuningCache key for the measured default (workload-specific
+#: keys coexist; the controller falls back to this one, then to 10.0).
+VIOLATION_BONUS_DEFAULT_KEY = "axis=violation_bonus,scope=default"
+
+
+@dataclass
+class BonusDecision:
+    """One violation-bonus calibration outcome: the chosen bonus plus
+    the measured evidence (per-candidate rates — distinct violations
+    per second, i.e. the inverse of time-to-Nth-distinct-violation)."""
+
+    bonus: float
+    rate: float  # distinct violations/sec of the chosen point
+    source: str  # "calibrated" | "cached" | "default"
+    rates: Dict[str, float] = field(default_factory=dict)
+    key: Optional[str] = None
+    calibration_seconds: float = 0.0
+
+    def to_json(self) -> Dict[str, Any]:
+        return {
+            "bonus": float(self.bonus),
+            "rate": round(self.rate, 4),
+            "source": self.source,
+            "rates": {k: round(v, 4) for k, v in self.rates.items()},
+            "key": self.key,
+            "calibration_seconds": round(self.calibration_seconds, 2),
+        }
+
+    @classmethod
+    def from_json(cls, obj: Dict[str, Any], source: str) -> "BonusDecision":
+        return cls(
+            bonus=float(obj.get("bonus", 10.0)),
+            rate=float(obj.get("rate", 0.0)),
+            source=source,
+            rates=dict(obj.get("rates", {})),
+            key=obj.get("key"),
+        )
+
+
+def default_violation_bonus(cache: Optional[TuningCache] = None) -> float:
+    """The persisted violation-bonus default (10.0 when never measured)
+    — what ExplorationController reads when built without an explicit
+    bonus. One cached-file read; corrupt/absent caches degrade to the
+    hand-set PR 2 value."""
+    cache = cache or TuningCache()
+    cached = cache.get(VIOLATION_BONUS_DEFAULT_KEY)
+    if cached is not None:
+        try:
+            return float(cached.get("bonus", 10.0))
+        except (TypeError, ValueError):
+            return 10.0
+    return 10.0
+
+
+def make_bonus_measure(
+    fuzzer_factory: Callable[[int], Any],
+    config_factory: Callable[[], Any],
+    *, seeds: int = 3, target_distinct: int = 2,
+    max_executions: int = 120, max_messages: int = 300,
+    timeout_seconds: float = 60.0,
+):
+    """Real measurement for one violation-bonus candidate: run the
+    autotuned host fuzzer (WeightTuner-driven, reward shaped by the
+    candidate bonus) until ``target_distinct`` DISTINCT violations are
+    found (by violation identity), per seed; score = distinct
+    violations per second, medianed across seeds with the warm-up seed
+    dropped. The time-to-Nth-distinct-violation metric the ROADMAP
+    names is exactly the reciprocal of the reported rate.
+    ``fuzzer_factory(seed)`` builds a fresh Fuzzer (weights reset per
+    candidate — the tuner must re-learn under each bonus);
+    ``config_factory()`` a fresh SchedulerConfig."""
+    import time as _time
+
+    def measure(params: Dict[str, Any]) -> float:
+        bonus = float(params["violation_bonus"])
+        from ..schedulers import RandomScheduler
+        from .controller import ExplorationController, WeightTuner
+
+        rates = []
+        for seed in range(seeds):
+            fuzzer = fuzzer_factory(seed)
+            config = config_factory()
+            controller = ExplorationController(
+                fuzzer=fuzzer,
+                weight_tuner=WeightTuner(fuzzer.weights.as_dict()),
+                violation_bonus=bonus,
+            )
+            distinct = set()
+            t0 = _time.perf_counter()
+            rng_seed = seed * 1000
+            for i in range(max_executions):
+                if _time.perf_counter() - t0 > timeout_seconds:
+                    break
+                controller.begin_round()
+                program = fuzzer.generate_fuzz_test(seed=rng_seed + i)
+                result = RandomScheduler(
+                    config, seed=rng_seed + i, max_messages=max_messages,
+                    invariant_check_interval=1,
+                ).execute(program)
+                violations = 0
+                if result.violation is not None:
+                    violations = 1
+                    distinct.add(repr(result.violation))
+                controller.end_round(
+                    hashes=[hash(tuple(
+                        (u.event.__class__.__name__, getattr(u.event, "rcv", ""))
+                        for u in result.trace.events[:64]
+                    ))],
+                    violations=violations,
+                    lanes=1,
+                )
+                if len(distinct) >= target_distinct:
+                    break
+            secs = _time.perf_counter() - t0
+            rates.append(len(distinct) / secs if secs > 0 else 0.0)
+        return median_rate(rates, drop_first=True)
+
+    return measure
+
+
+def calibrate_weight_bonus(
+    *,
+    cache: Optional[TuningCache] = None,
+    measure: Optional[Callable[[Dict[str, Any]], float]] = None,
+    axis: Optional[Sequence[float]] = None,
+    key: Optional[str] = None,
+    persist_default: bool = True,
+) -> BonusDecision:
+    """Calibrate the WeightTuner reward's violation bonus against
+    time-to-Nth-distinct-violation (ROADMAP debt: the 10x was hand-set).
+    Caching contract as the other axes: a cache hit costs no
+    measurements; a miss walks ``VIOLATION_BONUS_AXIS`` with the
+    injectable ``measure`` (``make_bonus_measure`` builds a real one
+    over the raft/broadcast fixtures; tests inject synthetic tables).
+    The winner persists under ``key`` (default: the global default key
+    the ExplorationController reads) and — with ``persist_default`` —
+    under ``VIOLATION_BONUS_DEFAULT_KEY`` too, recorded as
+    ``tune.fuzz.violation_bonus``."""
+    cache = cache or TuningCache()
+    key = key or VIOLATION_BONUS_DEFAULT_KEY
+    cached = cache.get(key)
+    if cached is not None:
+        decision = BonusDecision.from_json(cached, source="cached")
+        decision.key = key
+        record_decision("fuzz.violation_bonus", decision.bonus)
+        return decision
+    if measure is None:
+        raise ValueError(
+            "calibrate_weight_bonus: cache miss for %r and no measure "
+            "given — build one with make_bonus_measure(...)" % (key,)
+        )
+    candidates = list(axis) if axis is not None else list(VIOLATION_BONUS_AXIS)
+    start = {"violation_bonus": candidates[0]}
+    t0 = time.perf_counter()
+    params, rate, rates = coordinate_descent(
+        {"violation_bonus": candidates}, measure, start,
+        order=("violation_bonus",),
+    )
+    decision = BonusDecision(
+        bonus=float(params["violation_bonus"]),
+        rate=rate,
+        source="calibrated",
+        rates=rates,
+        key=key,
+        calibration_seconds=time.perf_counter() - t0,
+    )
+    record_decision("fuzz.violation_bonus", decision.bonus)
+    cache.put(key, decision.to_json())
+    if persist_default and key != VIOLATION_BONUS_DEFAULT_KEY:
+        cache.put(VIOLATION_BONUS_DEFAULT_KEY, decision.to_json())
     return decision
 
 

@@ -267,14 +267,27 @@ class ExplorationController:
 
     #: Reward weight of a violating lane vs one new unique schedule —
     #: violations are the point of exploring, weigh them like a cluster
-    #: of new schedules.
+    #: of new schedules. Class fallback; construction prefers the
+    #: measured TuningCache default (tune.calibrate_weight_bonus swept
+    #: this against time-to-Nth-distinct-violation — the PR 2 hand-set
+    #: value was the ROADMAP debt).
     VIOLATION_BONUS = 10.0
 
-    def __init__(self, fuzzer=None, weight_tuner: Optional[WeightTuner] = None):
+    def __init__(
+        self,
+        fuzzer=None,
+        weight_tuner: Optional[WeightTuner] = None,
+        violation_bonus: Optional[float] = None,
+    ):
         self.fuzzer = fuzzer
         if weight_tuner is None and fuzzer is not None:
             weight_tuner = WeightTuner(fuzzer.weights.as_dict())
         self.weight_tuner = weight_tuner
+        if violation_bonus is None:
+            from .calibrate import default_violation_bonus
+
+            violation_bonus = default_violation_bonus()
+        self.violation_bonus = float(violation_bonus)
         self.seen_hashes: set = set()
         self.rounds = 0
         self.last_reward: Optional[float] = None
@@ -300,7 +313,7 @@ class ExplorationController:
             if h not in self.seen_hashes:
                 self.seen_hashes.add(h)
                 fresh += 1
-        reward = (fresh + self.VIOLATION_BONUS * violations) / max(lanes, 1)
+        reward = (fresh + self.violation_bonus * violations) / max(lanes, 1)
         self.rounds += 1
         self.last_reward = reward
         if self.weight_tuner is not None:

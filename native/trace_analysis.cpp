@@ -40,6 +40,21 @@
 //     commuting — demi_tpu/analysis/) are counted into pruned_out and
 //     never packed. The filter sits after the immediacy checks so its
 //     counts equal the NumPy fallback's bit-for-bit.
+//   demi_racing_prescriptions_sleep — the static scan plus the sleep-set
+//     filter (demi_tpu/analysis/sleep.py): per lane, a bounded block of
+//     sleeping records ([scap, w], kind 0 = empty slot) with the wake
+//     ordinal the device kernel tracked for each ([scap] int32, >= 2^30
+//     = never woken), the lane's redundant-suffix marker (first free
+//     delivery ordinal that re-delivered a still-sleeping record), and
+//     the lane's prescribed-delivery count (the node ordinal; sleep
+//     rows attach at the END of the lane's prescription, so the filter
+//     only applies at branch ordinals at/after it). A reversal is
+//     refused when its branch lies beyond the redundant marker, or when
+//     its flipped record is content-identical to a row still asleep at
+//     the branch — both mean the reversal's subtree is already covered
+//     by an earlier-admitted sibling's. Counted into pruned_out[2]
+//     after the fungible/commute slots (counter-contract order shared
+//     with the NumPy twin).
 
 #include <cstddef>
 #include <cstdint>
@@ -190,8 +205,13 @@ static int64_t racing_prescriptions_impl(
     int32_t* out_rows, int64_t cap_rows,
     int64_t* out_offsets, int32_t* out_lane, int64_t cap_presc,
     uint64_t* out_digests,
-    int64_t* total_rows_out, int64_t* pruned_out) {
+    int64_t* total_rows_out, int64_t* pruned_out,
+    const int32_t* sleep_recs = nullptr, int64_t scap = 0,
+    const int32_t* sleep_wake = nullptr,
+    const int32_t* sleep_slept = nullptr,
+    const int32_t* sleep_presc = nullptr) {
     if (pruned_out) pruned_out[0] = pruned_out[1] = 0;
+    if (pruned_out && sleep_recs) pruned_out[2] = 0;
     int64_t n_presc = 0;
     int64_t n_rows = 0;
     if (cap_presc > 0) out_offsets[0] = 0;
@@ -247,6 +267,34 @@ static int64_t racing_prescriptions_impl(
                             + tag_index(lane[j * w + 3], commute_m)]) {
                     if (pruned_out) ++pruned_out[1];
                     continue;
+                }
+                // Sleep-set filter (demi_tpu/analysis/sleep.py): branch
+                // ordinal is ii (deliveries strictly before i). Applies
+                // only at/after the lane's node (prescribed-delivery
+                // count) — sleep rows attach at the end of the lane's
+                // prescription, so interior branches are out of scope.
+                if (sleep_recs != nullptr) {
+                    const int64_t ord = static_cast<int64_t>(ii);
+                    bool asleep_flip = false;
+                    if (sleep_slept && ord > sleep_slept[b]) {
+                        asleep_flip = true;  // redundant suffix
+                    } else if (!sleep_presc || ord >= sleep_presc[b]) {
+                        const int32_t* srows = sleep_recs + b * scap * w;
+                        const int32_t* swake = sleep_wake + b * scap;
+                        for (int64_t s = 0; s < scap; ++s) {
+                            if (srows[s * w] == 0) continue;
+                            if (swake[s] < ord) continue;  // woken earlier
+                            if (rows_fungible(lane + j * w, srows + s * w,
+                                              w)) {
+                                asleep_flip = true;
+                                break;
+                            }
+                        }
+                    }
+                    if (asleep_flip) {
+                        if (pruned_out) ++pruned_out[2];
+                        continue;
+                    }
                 }
                 // Prescription: deliveries[0..ii) (all deliveries before
                 // i — the list is position-sorted) plus row j.
@@ -306,6 +354,31 @@ int64_t demi_racing_prescriptions_static(
         recs, lens, batch, rmax, w, commute, commute_m, fungible,
         out_rows, cap_rows, out_offsets, out_lane, cap_presc,
         out_digests, total_rows_out, pruned_out);
+}
+
+// The sleep-set variant (see header comment): composes the static
+// filter (commute may be NULL, fungible 0) with per-lane sleep blocks.
+//   sleep_recs  — [batch, scap, w] int32 sleeping records (kind 0 empty)
+//   sleep_wake  — [batch, scap] int32 wake ordinals (>= 2^30 = asleep)
+//   sleep_slept — [batch] int32 redundant-suffix marker ordinals
+//   sleep_presc — [batch] int32 prescribed-delivery counts (node ordinal)
+//   pruned_out  — int64[3]: {fungible, commute, sleep} (may be NULL)
+int64_t demi_racing_prescriptions_sleep(
+    const int32_t* recs, const int32_t* lens,
+    int64_t batch, int64_t rmax, int64_t w,
+    const uint8_t* commute, int64_t commute_m, int32_t fungible,
+    const int32_t* sleep_recs, int64_t scap,
+    const int32_t* sleep_wake, const int32_t* sleep_slept,
+    const int32_t* sleep_presc,
+    int32_t* out_rows, int64_t cap_rows,
+    int64_t* out_offsets, int32_t* out_lane, int64_t cap_presc,
+    uint64_t* out_digests,
+    int64_t* total_rows_out, int64_t* pruned_out) {
+    return racing_prescriptions_impl(
+        recs, lens, batch, rmax, w, commute, commute_m, fungible,
+        out_rows, cap_rows, out_offsets, out_lane, cap_presc,
+        out_digests, total_rows_out, pruned_out,
+        sleep_recs, scap, sleep_wake, sleep_slept, sleep_presc);
 }
 
 }  // extern "C"

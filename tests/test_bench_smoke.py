@@ -16,7 +16,7 @@ def _run_bench(config: str, env_extra: dict) -> dict:
     # change kernels or output keys.
     for var in ("DEMI_OBS", "DEMI_AUTOTUNE", "DEMI_PREFIX_FORK",
                 "DEMI_ASYNC_MIN", "DEMI_DEVICE_IMPL", "DEMI_BENCH_IMPL",
-                "DEMI_STATIC_PRUNE", "DEMI_SANITIZE"):
+                "DEMI_STATIC_PRUNE", "DEMI_SANITIZE", "DEMI_SLEEP_SETS"):
         env.pop(var, None)
     out = subprocess.run(
         [sys.executable, os.path.join(REPO, "bench.py"), "--config", config],
@@ -142,6 +142,61 @@ def test_bench_config7_smoke():
     assert section["mcs_match"] is True
 
 
+def test_bench_config9_smoke():
+    record = _run_bench(
+        "9",
+        {
+            # Tiny A/B: shallow seed scan, few rounds, no strict-
+            # reduction requirement (the class duplicates that make the
+            # reduction strict need the deep default frontier).
+            "DEMI_BENCH_CONFIG9_BUDGET": "120",
+            "DEMI_BENCH_CONFIG9_SEEDS": "10",
+            "DEMI_BENCH_CONFIG9_BATCH": "8",
+            "DEMI_BENCH_CONFIG9_ROUNDS": "3",
+            "DEMI_BENCH_CONFIG9_STRICT": "0",
+        },
+    )
+    assert record["metric"].startswith("redundancy ratio")
+    section = record["config9"]
+    assert "error" not in section, section
+    for key in ("app", "seed_deliveries", "batch", "rounds", "sleep_cap",
+                "explored_base", "explored_pruned", "explored_reduction",
+                "classes_base", "classes_pruned",
+                "redundancy_ratio_base", "redundancy_ratio_pruned",
+                "ratio_gap", "sleep_pruned", "violations_match",
+                "found_match", "violation_codes",
+                "rounds_per_sec_base", "rounds_per_sec_pruned"):
+        assert key in section, key
+    # The A/B identity contracts the bench asserts internally, echoed
+    # into the JSON: violations and first-found records bit-identical,
+    # and pruning never admits MORE schedules or a WORSE ratio.
+    assert section["violations_match"] is True
+    assert section["found_match"] is True
+    assert section["explored_pruned"] <= section["explored_base"]
+    assert (
+        section["redundancy_ratio_pruned"]
+        <= section["redundancy_ratio_base"]
+    )
+    for key in ("sleep", "class"):
+        assert key in section["sleep_pruned"], key
+    assert record["value"] == section["redundancy_ratio_pruned"]
+
+
+def test_cli_lint_zoo_clean_subprocess():
+    """Tier-1 CI contract at the real entry point: `demi_tpu lint` over
+    the bundled zoo exits 0 with zero findings — run as a subprocess so
+    entry-point or import-time rot cannot hide behind in-process test
+    shortcuts."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [sys.executable, "-m", "demi_tpu", "lint", "--format", "json"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=180,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    findings = json.loads(out.stdout)
+    assert findings["findings"] == [], findings
+
+
 def test_bench_config8_smoke():
     record = _run_bench(
         "8",
@@ -167,8 +222,8 @@ def test_bench_config8_smoke():
         assert key in section, key
     for key in ("inflight_rounds", "inflight_hits", "inflight_waste"):
         assert key in section["inflight"], key
-    for key in ("prefix_hit_rate", "parent_trunks", "steps_saved",
-                "mean_group_size"):
+    for key in ("prefix_hit_rate", "parent_trunks", "anchor_trunks",
+                "steps_saved", "mean_group_size"):
         assert key in section["fork"], key
     for key in ("legacy_seconds", "vectorized_seconds", "speedup",
                 "wall_speedup", "legacy_host_seconds",

@@ -164,3 +164,23 @@ def test_cli_lint_flags_seeded_fixture(tmp_path, capsys):
     assert data["counts"]["error"] == 1
     f = data["findings"][0]
     assert f["rule"] == "wall-clock" and f["line"] == 3
+
+
+def test_cli_dpor_sleep_sets(capsys):
+    """`demi_tpu dpor --sleep-sets`: the summary JSON carries the
+    sleep-set ledger (prune counts by kind, classes, redundancy ratio)
+    next to the interleaving count."""
+    rc = main([
+        "dpor", "--app", "broadcast", "--nodes", "3", "--bug", "unreliable",
+        "--batch", "8", "--rounds", "2", "--pool", "32",
+        "--max-messages", "48", "--sleep-sets",
+    ])
+    out = capsys.readouterr().out
+    summary = json.loads(out.strip().splitlines()[-1])
+    assert rc in (0, 1)  # found / exhausted are both valid outcomes
+    assert summary["interleavings"] > 0
+    sleep = summary["sleep_sets"]
+    for key in ("pruned", "classes", "explored", "redundancy_ratio"):
+        assert key in sleep, key
+    for kind in ("sleep", "class"):
+        assert kind in sleep["pruned"], kind

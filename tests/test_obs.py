@@ -389,3 +389,37 @@ def test_analysis_counters_and_report_section(telemetry, tmp_path):
     assert "### Static analysis" in text
     assert "static-pruned racing pairs: 1" in text
     assert "sanitizer wall-clock reads: 1" in text
+
+
+def test_sleep_counters_and_report_section(telemetry, tmp_path):
+    """analysis.sleep_pruned counters + the dpor.redundancy_ratio gauge
+    render in the Static-analysis block — including for a dpor-only
+    snapshot with NO pipe.* series and no other analysis counters (the
+    PR 5 guard mirrored), so `demi_tpu dpor --stats-out` reports never
+    drop the pruning ledger."""
+    from demi_tpu.tools.report import render_report
+
+    obs.counter("analysis.sleep_pruned").inc(3, kind="sleep", tier="device")
+    obs.counter("analysis.sleep_pruned").inc(2, kind="class", tier="device")
+    obs.gauge("dpor.redundancy_ratio").set(1.05)
+    snap = obs.REGISTRY.snapshot()
+    assert "pipe.overlap_seconds" not in snap["counters"]  # dpor-only
+    exp = tmp_path / "exp"
+    exp.mkdir()
+    (exp / "obs_snapshot.json").write_text(json.dumps(snap))
+    text = render_report(str(exp))
+    assert "### Static analysis" in text
+    assert "sleep-pruned reversals: 5" in text
+    assert "redundancy ratio" in text and "1.05" in text
+
+    # Ratio-only snapshot (sleep on, nothing pruned): the block still
+    # renders from the gauge alone.
+    exp2 = tmp_path / "exp2"
+    exp2.mkdir()
+    (exp2 / "obs_snapshot.json").write_text(json.dumps({
+        "gauges": {"dpor.redundancy_ratio": {"": 1.0}},
+        "counters": {}, "histograms": {},
+    }))
+    text2 = render_report(str(exp2))
+    assert "### Static analysis" in text2
+    assert "redundancy ratio" in text2

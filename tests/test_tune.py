@@ -364,6 +364,97 @@ def test_calibrate_fork_real_measure(tmp_path):
     assert d2.source == "cached"
 
 
+def test_calibrate_weight_bonus_synthetic_and_default(tmp_path):
+    """calibrate_weight_bonus walks the bonus axis with an injected
+    measure (distinct violations/sec), persists the winner as the
+    TuningCache default the ExplorationController reads, and a second
+    call is a cache hit with no measurements; a cache miss with no
+    measure is a loud error."""
+    from demi_tpu.tune import (
+        VIOLATION_BONUS_AXIS,
+        VIOLATION_BONUS_DEFAULT_KEY,
+        ExplorationController,
+        calibrate_weight_bonus,
+        default_violation_bonus,
+    )
+
+    cache = TuningCache(str(tmp_path / "cache.json"))
+    calls = []
+    table = {2.0: 0.5, 5.0: 0.9, 10.0: 0.7, 20.0: 0.4}
+
+    def measure(p):
+        calls.append(float(p["violation_bonus"]))
+        return table[float(p["violation_bonus"])]
+
+    d1 = calibrate_weight_bonus(cache=cache, measure=measure)
+    assert d1.source == "calibrated"
+    assert d1.bonus == 5.0 and d1.rate == 0.9
+    assert set(calls) == set(VIOLATION_BONUS_AXIS)
+
+    calls.clear()
+    d2 = calibrate_weight_bonus(
+        cache=TuningCache(str(tmp_path / "cache.json")), measure=measure
+    )
+    assert d2.source == "cached" and d2.bonus == 5.0 and calls == []
+
+    # The persisted winner becomes the controller's reward shape.
+    assert default_violation_bonus(cache) == 5.0
+    ctl = ExplorationController(violation_bonus=default_violation_bonus(cache))
+    assert ctl.violation_bonus == 5.0
+    # And an explicit bonus always wins.
+    assert ExplorationController(violation_bonus=3.0).violation_bonus == 3.0
+    # Never-calibrated caches fall back to the hand-set 10x.
+    assert default_violation_bonus(
+        TuningCache(str(tmp_path / "empty.json"))
+    ) == 10.0
+
+    with pytest.raises(ValueError):
+        calibrate_weight_bonus(
+            cache=TuningCache(str(tmp_path / "other.json")), key="axis=x"
+        )
+
+
+@pytest.mark.slow
+def test_calibrate_weight_bonus_real_measure(tmp_path):
+    """Real bonus calibration (slow): make_bonus_measure drives actual
+    host fuzz executions on the unreliable-broadcast fixture and
+    calibrate_weight_bonus persists a winner from the measured axis."""
+    from demi_tpu.apps.broadcast import (
+        broadcast_send_generator,
+        make_broadcast_app,
+    )
+    from demi_tpu.apps.common import dsl_start_events, make_host_invariant
+    from demi_tpu.config import SchedulerConfig
+    from demi_tpu.fuzzing import Fuzzer, FuzzerWeights
+    from demi_tpu.tune import calibrate_weight_bonus, make_bonus_measure
+
+    app = make_broadcast_app(3, reliable=False)
+
+    def fuzzer_factory(seed):
+        return Fuzzer(
+            num_events=10,
+            weights=FuzzerWeights(kill=0.05, send=0.6, wait_quiescence=0.15),
+            message_gen=broadcast_send_generator(app),
+            prefix=dsl_start_events(app),
+            max_kills=1,
+        )
+
+    def config_factory():
+        return SchedulerConfig(invariant_check=make_host_invariant(app))
+
+    measure = make_bonus_measure(
+        fuzzer_factory, config_factory, seeds=2, target_distinct=1,
+        max_executions=40, timeout_seconds=20.0,
+    )
+    cache = TuningCache(str(tmp_path / "cache.json"))
+    d = calibrate_weight_bonus(
+        cache=cache, measure=measure, axis=(5.0, 10.0)
+    )
+    assert d.source == "calibrated"
+    assert d.bonus in (5.0, 10.0)
+    assert len(d.rates) == 2
+
+
 def test_tuning_cache_survives_corrupt_file(tmp_path):
     path = tmp_path / "cache.json"
     path.write_text("{not json")
