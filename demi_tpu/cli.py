@@ -192,6 +192,498 @@ def _sanitize_end(token) -> None:
     print(f"sanitizer: {json.dumps(sanitize.stats())}")
 
 
+def _strict_io_begin(args) -> None:
+    """``--strict-io``: degradations (native analyzer → NumPy twin,
+    exhausted launch retries) become hard errors. Same env-switch
+    contract as --prefix-fork — the launch supervisor reads the env at
+    each failure, so the flag reaches every wrapped surface."""
+    if getattr(args, "strict_io", False):
+        os.environ["DEMI_STRICT_IO"] = "1"
+
+
+#: Argparse fields a resumed run must reconstruct, per command (the
+#: checkpoint manifest stores their values; `demi_tpu resume` rebuilds
+#: the namespace from them — keep in sync with what each cmd_* reads).
+_RESUME_COMMON = (
+    "app", "nodes", "bug", "seed", "num_events", "max_messages",
+    "timer_weight", "kill_weight", "partition_weight",
+    "trace_out", "stats_out", "checkpoint_every", "strict_io",
+)
+_RESUME_FIELDS = {
+    "dpor": _RESUME_COMMON + (
+        "batch", "pool", "rounds", "impl", "static_prune", "sleep_sets",
+        "prefix_fork", "async_min", "autotune",
+    ),
+    "sweep": _RESUME_COMMON + (
+        "batch", "pool", "chunk", "sweep_mode", "impl", "processes",
+        "prefix_fork", "autotune",
+    ),
+    "fuzz": _RESUME_COMMON + ("max_executions", "output", "autotune",
+                              "sanitize"),
+}
+
+
+def _resume_args(args, command: str) -> dict:
+    return {
+        f: getattr(args, f, None) for f in _RESUME_FIELDS[command]
+    }
+
+
+def _restore_obs(ckpt) -> None:
+    """Merge the dead run's obs registry into this process (counters
+    add, gauges last-write-win) so cumulative telemetry spans the kill."""
+    snap = ckpt.sections.get("obs")
+    if snap:
+        obs.REGISTRY.load(snap)
+
+
+def _restore_or_exit(restore_fn, ckpt) -> None:
+    """Apply a digest-valid checkpoint payload, turning a schema-level
+    failure (a payload written by an incompatible build) into a clear
+    SystemExit instead of a raw traceback — the store's digests catch
+    corruption; this catches staleness."""
+    try:
+        restore_fn(ckpt)
+    except Exception as exc:
+        raise SystemExit(
+            f"resume: checkpoint at {ckpt.path!r} is valid but not "
+            f"restorable by this build ({type(exc).__name__}: {exc}); "
+            "delete the directory to start fresh"
+        )
+
+
+def _report_completed(ckpt, args) -> int:
+    """A resumed run whose checkpoint records terminal status reports
+    the saved summary instead of re-exploring past the recorded
+    result."""
+    summary = dict(ckpt.meta.get("summary", {}))
+    summary.update({"resumed": True, "already_complete": True})
+    print(json.dumps(summary))
+    _obs_end(args)
+    return 0 if summary.get("violation_found") else 1
+
+
+def _preempted_exit(args, store, extra: dict) -> int:
+    print(
+        json.dumps(
+            {
+                "preempted": True,
+                "checkpoint_dir": args.checkpoint_dir,
+                "generations": store.generations(),
+                "resume": f"python -m demi_tpu resume {args.checkpoint_dir}",
+                **extra,
+            }
+        )
+    )
+    _obs_end(args)
+    return 3
+
+
+def _dpor_checkpoint_run(args, app, cfg) -> int:
+    """Durable DPOR search: a single-round frontier loop (rounds are
+    generation-frozen and deterministic, so every loop iteration is a
+    valid resume point) with periodic atomic checkpoints, SIGTERM/SIGINT
+    checkpointing at the next round boundary (exit code 3), and
+    bit-identical resume via ``demi_tpu resume`` — the kill-and-resume
+    parity tests/test_persist.py pins ride exactly this loop."""
+    import hashlib
+
+    from .device.dpor_sweep import DeviceDPOR
+    from .persist import CheckpointStore, PreemptionGuard
+
+    store = CheckpointStore(args.checkpoint_dir)
+    program = dsl_start_events(app) + [WaitQuiescence()]
+    ckpt = getattr(args, "_resume_checkpoint", None)
+    # On a FRESH run the flags resolve as usual (flag wins, else env);
+    # a RESUMED run pins the RESOLVED booleans recorded at save time
+    # (below) so the checkpoint restores regardless of the new
+    # environment's DEMI_SLEEP_SETS/DEMI_STATIC_PRUNE — same contract
+    # as host_path.
+    dpor = DeviceDPOR(
+        app, cfg, program, batch_size=args.batch,
+        static_independence=(
+            bool(getattr(args, "static_prune", False))
+            if ckpt is not None
+            else (True if getattr(args, "static_prune", False) else None)
+        ),
+        sleep_sets=(
+            bool(getattr(args, "sleep_sets", False))
+            if ckpt is not None
+            else (True if getattr(args, "sleep_sets", False) else None)
+        ),
+        # Single-round explore() calls make every speculative in-flight
+        # launch expire unharvested (pure waste, ~2x launches under
+        # --async-min on non-CPU platforms) — same reason bench
+        # config 10's loop pins it off.
+        double_buffer=False,
+        # A resumed run pins the RESOLVED host path recorded at save
+        # time (below): the legacy path never maintains the digest
+        # dedup set, so crossing paths over a resume would re-admit
+        # explored work (the workload discriminator refuses it too).
+        host_path=getattr(args, "host_path", None),
+    )
+    autotune_on = (
+        bool(getattr(args, "autotune", False))
+        if ckpt is not None
+        else _autotune_requested(args)
+    )
+    if autotune_on:
+        from .tune import DporBudgetTuner
+
+        dpor.tuner = DporBudgetTuner(batch=args.batch)
+    rounds_done = 0
+    resumed = False
+    if ckpt is not None:
+        if ckpt.meta.get("completed"):
+            return _report_completed(ckpt, args)
+        _restore_or_exit(
+            lambda c: dpor.restore_state(c.sections["dpor"]), ckpt
+        )
+        rounds_done = int(ckpt.meta.get("rounds_done", 0))
+        _restore_obs(ckpt)
+        resumed = True
+    every = max(1, getattr(args, "checkpoint_every", None) or 5)
+
+    def save_ckpt(extra_meta=None) -> None:
+        store.save(
+            {"dpor": dpor.checkpoint_state(),
+             "obs": obs.REGISTRY.snapshot()},
+            meta={
+                "command": "dpor",
+                "cli_args": {
+                    **_resume_args(args, "dpor"),
+                    # RESOLVED values (flag-or-env at save time), so a
+                    # resume in a fresh environment reconstructs the
+                    # identical explorer shape.
+                    "host_path": dpor.host_path,
+                    "sleep_sets": dpor.sleep is not None,
+                    "static_prune": dpor.static_independence is not None,
+                    "autotune": dpor.tuner is not None,
+                },
+                "rounds_done": rounds_done,
+                "checkpoint_every": every,
+                **(extra_meta or {}),
+            },
+        )
+
+    found = None
+    print(
+        f"dpor: checkpointing to {args.checkpoint_dir} every {every} "
+        "round(s)"
+        + (f"; resumed at round {rounds_done}" if resumed else ""),
+        flush=True,
+    )
+    with PreemptionGuard() as guard:
+        while rounds_done < args.rounds and dpor.frontier and found is None:
+            found = dpor.explore(max_rounds=1)
+            rounds_done += 1
+            done = (
+                found is not None
+                or rounds_done >= args.rounds
+                or not dpor.frontier
+            )
+            # Work completed in the very round the signal interrupted
+            # — a found violation, the last budgeted round, a drained
+            # frontier — still reports normally (the terminal
+            # generation below records the final state + summary;
+            # there is nothing to resume). Only a mid-search
+            # preemption checkpoints and exits early.
+            if guard.requested and not done:
+                save_ckpt()
+                return _preempted_exit(
+                    args, store,
+                    {"rounds_done": rounds_done,
+                     "interleavings": dpor.interleavings},
+                )
+            if not done and rounds_done % every == 0:
+                save_ckpt()
+    summary = {
+        "rounds_done": rounds_done,
+        "interleavings": dpor.interleavings,
+        "explored": len(dpor.explored),
+        "frontier": len(dpor.frontier),
+        "violation_found": found is not None,
+        "violation_codes": sorted(dpor.violation_codes),
+        "resumed": resumed,
+    }
+    if found is not None:
+        recs, n = found
+        # Content digest of the first-found violating lane — the
+        # kill-and-resume parity surface (resumed == uninterrupted).
+        summary["first_found"] = [
+            hashlib.sha256(recs[:n].tobytes()).hexdigest(), int(n)
+        ]
+    if dpor.host_share is not None:
+        summary["host_share"] = round(dpor.host_share, 3)
+    if dpor.sleep_stats is not None:
+        summary["sleep_sets"] = dpor.sleep_stats
+    # Terminal generation: final state + summary + completed marker, so
+    # a resume of a finished run reports instead of re-exploring.
+    save_ckpt({"completed": True, "summary": summary})
+    summary["checkpoints"] = dict(store.stats)
+    print(json.dumps(summary))
+    _obs_end(args)
+    return 0 if found is not None else 1
+
+
+def _sweep_checkpoint_run(args, app, cfg, fuzzer) -> int:
+    """Durable fuzz sweep: chunked rounds (each chunk a pure function of
+    its seed range) with the merged codes / dedup set / seed cursor
+    checkpointed every N chunks; SIGTERM checkpoints at the next chunk
+    boundary and ``demi_tpu resume`` continues at the next seed."""
+    from .parallel.sweep import SweepDriver
+    from .persist import CheckpointStore, PreemptionGuard
+
+    if _autotune_requested(args):
+        raise SystemExit(
+            "--checkpoint-dir does not compose with --autotune on sweep "
+            "yet (the fuzz command checkpoints its controller)"
+        )
+    if getattr(args, "sweep_mode", None) == "continuous":
+        raise SystemExit(
+            "--checkpoint-dir sweeps run chunked rounds (chunk "
+            "boundaries are the snapshot points); drop --sweep-mode "
+            "continuous"
+        )
+    store = CheckpointStore(args.checkpoint_dir)
+    gen = lambda s: fuzzer.generate_fuzz_test(seed=args.seed + s)  # noqa: E731
+    driver = SweepDriver(app, cfg, gen)
+    chunk = min(args.batch, getattr(args, "chunk", None) or args.batch)
+    state = {
+        "seeds_done": 0, "chunks": 0, "violations": 0, "codes": {},
+        "overflow_lanes": 0, "first_violating_seed": None,
+        "unique_hashes": [],
+    }
+    resumed = False
+    ckpt = getattr(args, "_resume_checkpoint", None)
+    if ckpt is not None:
+        def _apply(c):
+            state.update(c.sections["sweep"])
+            fuzzer.restore_state(c.sections["fuzzer"])
+
+        _restore_or_exit(_apply, ckpt)
+        _restore_obs(ckpt)
+        resumed = True
+    hashes = set(int(h) for h in state["unique_hashes"])
+    every = max(1, getattr(args, "checkpoint_every", None) or 5)
+
+    def save_ckpt() -> None:
+        state["unique_hashes"] = sorted(hashes)
+        store.save(
+            {"sweep": state, "fuzzer": fuzzer.checkpoint_state(),
+             "obs": obs.REGISTRY.snapshot()},
+            meta={
+                "command": "sweep",
+                "cli_args": _resume_args(args, "sweep"),
+                "seeds_done": state["seeds_done"],
+                "checkpoint_every": every,
+            },
+        )
+
+    print(
+        f"sweep: checkpointing to {args.checkpoint_dir} every {every} "
+        "chunk(s) (chunked rounds)"
+        + (f"; resumed at seed {state['seeds_done']}" if resumed else ""),
+        flush=True,
+    )
+    with PreemptionGuard() as guard:
+        while state["seeds_done"] < args.batch:
+            n = min(chunk, args.batch - state["seeds_done"])
+            c = driver.run_chunk(
+                range(state["seeds_done"], state["seeds_done"] + n)
+            )
+            state["seeds_done"] += n
+            state["chunks"] += 1
+            state["violations"] += c.violations
+            for code, k in c.codes.items():
+                key = str(code)
+                state["codes"][key] = state["codes"].get(key, 0) + k
+            state["overflow_lanes"] += c.overflow_lanes
+            if (
+                state["first_violating_seed"] is None
+                and c.first_violating_seed is not None
+            ):
+                state["first_violating_seed"] = c.first_violating_seed
+            if c.unique_hashes is not None:
+                hashes.update(int(h) for h in c.unique_hashes)
+            done = state["seeds_done"] >= args.batch
+            if guard.requested or done or state["chunks"] % every == 0:
+                save_ckpt()
+            # A signal during the FINAL chunk leaves nothing to resume:
+            # report the completed sweep normally.
+            if guard.requested and not done:
+                return _preempted_exit(
+                    args, store, {"seeds_done": state["seeds_done"]}
+                )
+    summary = {
+        "lanes": state["seeds_done"],
+        "unique_schedules": len(hashes),
+        "violations": state["violations"],
+        "codes": dict(state["codes"]),
+        "first_violating_seed": state["first_violating_seed"],
+        "overflow_lanes": state["overflow_lanes"],
+        "resumed": resumed,
+        "checkpoints": dict(store.stats),
+    }
+    if driver.host_share is not None:
+        summary["host_share"] = round(driver.host_share, 3)
+    print(json.dumps(summary))
+    _obs_end(args)
+    return 0
+
+
+def _fuzz_checkpoint_run(args, app, config, fuzzer, controller) -> int:
+    """Durable host fuzz: executions are pure functions of (seed, i)
+    plus the controller's restored tuner state, so the checkpoint is
+    just the execution cursor + controller/fuzzer weights; SIGTERM
+    checkpoints after the in-flight execution."""
+    from .persist import CheckpointStore, PreemptionGuard
+    from .runner import fuzz
+    from .serialization import ExperimentSerializer
+
+    store = CheckpointStore(args.checkpoint_dir)
+    start = 0
+    resumed = False
+    ckpt = getattr(args, "_resume_checkpoint", None)
+    if ckpt is not None:
+        if ckpt.meta.get("completed"):
+            return _report_completed(ckpt, args)
+        def _apply(c):
+            nonlocal start
+            sec = c.sections["fuzz"]
+            start = int(sec["executions_done"])
+            fuzzer.restore_state(sec["fuzzer"])
+            if controller is not None and sec.get("controller") is not None:
+                controller.restore_state(sec["controller"])
+
+        _restore_or_exit(_apply, ckpt)
+        _restore_obs(ckpt)
+        resumed = True
+    every = max(1, getattr(args, "checkpoint_every", None) or 25)
+
+    def save_ckpt(done: int, extra_meta=None) -> None:
+        store.save(
+            {
+                "fuzz": {
+                    "executions_done": done,
+                    "fuzzer": fuzzer.checkpoint_state(),
+                    "controller": (
+                        controller.checkpoint_state()
+                        if controller is not None
+                        else None
+                    ),
+                },
+                "obs": obs.REGISTRY.snapshot(),
+            },
+            meta={
+                "command": "fuzz",
+                "cli_args": _resume_args(args, "fuzz"),
+                "executions_done": done,
+                "checkpoint_every": every,
+                **(extra_meta or {}),
+            },
+        )
+
+    print(
+        f"fuzz: checkpointing to {args.checkpoint_dir} every {every} "
+        "execution(s)"
+        + (f"; resumed at execution {start}" if resumed else ""),
+        flush=True,
+    )
+    executions_done = start
+    with PreemptionGuard() as guard:
+
+        def hook(done: int) -> bool:
+            nonlocal executions_done
+            executions_done = done
+            if guard.requested or done % every == 0:
+                save_ckpt(done)
+            return guard.requested
+
+        result = fuzz(
+            config, fuzzer,
+            max_executions=args.max_executions,
+            seed=args.seed, max_messages=args.max_messages,
+            invariant_check_interval=1, timer_weight=args.timer_weight,
+            validate_replay=True, controller=controller,
+            start_execution=start, round_hook=hook,
+        )
+        # A violation found in the interrupted execution, or a budget
+        # exhausted during it, is completed work — report it normally;
+        # only a mid-search preemption exits early.
+        if (
+            guard.requested and result is None
+            and executions_done < args.max_executions
+        ):
+            return _preempted_exit(
+                args, store, {"executions_done": executions_done}
+            )
+    if result is None:
+        summary = {
+            "violation_found": False,
+            "executions": args.max_executions,
+            "resumed": resumed,
+        }
+        save_ckpt(
+            args.max_executions,
+            {"completed": True, "summary": summary},
+        )
+        print(json.dumps({**summary, "checkpoints": dict(store.stats)}))
+        _obs_end(args)
+        return 1
+    print(
+        f"violation {result.violation} after {result.executions} "
+        f"executions; {len(result.program)} externals, "
+        f"{len(result.trace.deliveries())} deliveries"
+    )
+    save_ckpt(
+        result.executions,
+        {"completed": True,
+         "summary": {"violation_found": True,
+                     "executions": result.executions,
+                     "violation": repr(result.violation),
+                     "resumed": resumed}},
+    )
+    if args.output:
+        ExperimentSerializer.save(
+            args.output, result.program, result.trace, result.violation,
+            app_name=args.app,
+        )
+        print(f"experiment saved to {args.output}")
+    _obs_end(args, args.output)
+    return 0
+
+
+def cmd_resume(args) -> int:
+    """Resume a checkpointed dpor/sweep/fuzz run: load the newest valid
+    snapshot generation (corrupt ones degrade to the previous good one),
+    rebuild the original command's arguments from the manifest, and
+    continue at the recorded boundary."""
+    from .persist import CheckpointStore
+
+    store = CheckpointStore(args.dir)
+    ckpt = store.load_latest()
+    if ckpt is None:
+        raise SystemExit(
+            f"resume: no loadable checkpoint under {args.dir!r}"
+        )
+    command = ckpt.meta.get("command")
+    fns = {"dpor": cmd_dpor, "sweep": cmd_sweep, "fuzz": cmd_fuzz}
+    if command not in fns:
+        raise SystemExit(
+            f"resume: checkpoint names unknown command {command!r}"
+        )
+    ns = argparse.Namespace(**dict(ckpt.meta.get("cli_args", {})))
+    ns.checkpoint_dir = args.dir
+    ns._resume_checkpoint = ckpt
+    print(
+        f"resuming {command} from {ckpt.path} "
+        f"(generation {ckpt.generation})",
+        flush=True,
+    )
+    return fns[command](ns)
+
+
 def cmd_lint(args) -> int:
     """Determinism lint over app modules/files (default: the bundled
     zoo). Exit code 1 when any error-level finding survives
@@ -214,6 +706,7 @@ def cmd_fuzz(args) -> int:
     from .serialization import ExperimentSerializer
 
     _obs_begin(args)
+    _strict_io_begin(args)
     sanitizing = _sanitize_begin(args)
     # The device sweep is extra WORK, not just bookkeeping: run it only
     # when this invocation explicitly asked for observability artifacts
@@ -227,6 +720,10 @@ def cmd_fuzz(args) -> int:
         from .tune import ExplorationController
 
         controller = ExplorationController(fuzzer)
+    if getattr(args, "checkpoint_dir", None):
+        rc = _fuzz_checkpoint_run(args, app, config, fuzzer, controller)
+        _sanitize_end(sanitizing)
+        return rc
     with obs.span("cli.fuzz", app=args.app, seed=args.seed):
         result = fuzz(
             config,
@@ -298,6 +795,7 @@ def cmd_minimize(args) -> int:
     # The flag is authoritative: it must also override a pre-set
     # DEMI_DEVICE_IMPL in the caller's environment.
     os.environ["DEMI_DEVICE_IMPL"] = getattr(args, "impl", "xla")
+    _strict_io_begin(args)
     if getattr(args, "prefix_fork", False):
         # Same contract as --impl: the env switch is what the checker /
         # DPOR constructors read, so the flag reaches every stage.
@@ -403,6 +901,14 @@ def cmd_replay(args) -> int:
 def cmd_sweep(args) -> int:
     _obs_begin(args)
     if args.processes > 1:
+        if getattr(args, "checkpoint_dir", None):
+            # Refuse loudly up front: the distributed branch returns
+            # before the single-process checkpoint loop, so the flag
+            # would otherwise be dropped silently — and a preempted
+            # multi-process sweep would have nothing to resume.
+            raise SystemExit(
+                "--checkpoint-dir is single-process (drop --processes)"
+            )
         if _autotune_requested(args):
             # The weight loop and calibration run in THIS process; the
             # distributed launcher's workers sweep in their own. Closing
@@ -437,6 +943,7 @@ def cmd_sweep(args) -> int:
         return 0
 
     os.environ["DEMI_DEVICE_IMPL"] = getattr(args, "impl", "xla")
+    _strict_io_begin(args)
     if getattr(args, "prefix_fork", False):
         os.environ["DEMI_PREFIX_FORK"] = "1"
     from .device import DeviceConfig
@@ -452,6 +959,8 @@ def cmd_sweep(args) -> int:
         timer_weight=args.timer_weight,
     )
     fuzzer = build_fuzzer(app, args)
+    if getattr(args, "checkpoint_dir", None):
+        return _sweep_checkpoint_run(args, app, cfg, fuzzer)
     gen = lambda s: fuzzer.generate_fuzz_test(seed=args.seed + s)  # noqa: E731
     chunk = min(args.batch, getattr(args, "chunk", None) or args.batch)
     autotune_summary = None
@@ -538,6 +1047,7 @@ def cmd_dpor(args) -> int:
     """Systematic batched DPOR search (BASELINE config 2 shape)."""
     _obs_begin(args)
     os.environ["DEMI_DEVICE_IMPL"] = getattr(args, "impl", "xla")
+    _strict_io_begin(args)
     if getattr(args, "prefix_fork", False):
         os.environ["DEMI_PREFIX_FORK"] = "1"
     if getattr(args, "async_min", False):
@@ -560,6 +1070,8 @@ def cmd_dpor(args) -> int:
         record_trace=True,
         record_parents=True,
     )
+    if getattr(args, "checkpoint_dir", None):
+        return _dpor_checkpoint_run(args, app, cfg)
     autotune = _autotune_requested(args)
     program = dsl_start_events(app) + [WaitQuiescence()]
     inflight_decision = None
@@ -964,6 +1476,32 @@ def main(argv: Optional[list] = None) -> int:
                  "the same; off by default)",
         )
 
+    def checkpoint_flags(p, default_every: int, unit: str):
+        p.add_argument(
+            "--checkpoint-dir", default=None, dest="checkpoint_dir",
+            metavar="DIR",
+            help="durable exploration state: write atomic, versioned "
+                 "snapshots of the search state under DIR (SIGTERM/"
+                 "SIGINT checkpoint at the next round boundary and exit "
+                 "3; continue with `demi_tpu resume DIR`)",
+        )
+        p.add_argument(
+            "--checkpoint-every", type=int, default=default_every,
+            dest="checkpoint_every", metavar="N",
+            help=f"snapshot every N {unit} (default {default_every}; "
+                 "boundaries are generation-frozen, so a snapshot "
+                 "resumes bit-identically)",
+        )
+
+    def strict_io_flags(p):
+        p.add_argument(
+            "--strict-io", action="store_true", dest="strict_io",
+            help="launch supervisor strictness: exhausted kernel-launch "
+                 "retries and native-analyzer degradations (NumPy-twin "
+                 "fallbacks) raise instead of limping — the CI mode "
+                 "(DEMI_STRICT_IO=1 does the same; off by default)",
+        )
+
     def sanitize_flags(p, strict: bool = False):
         p.add_argument(
             "--sanitize", action="store_true",
@@ -993,6 +1531,8 @@ def main(argv: Optional[list] = None) -> int:
     obs_flags(p)
     tune_flags(p)
     sanitize_flags(p)
+    checkpoint_flags(p, 25, "executions")
+    strict_io_flags(p)
     p.add_argument("--max-executions", type=int, default=200, dest="max_executions")
     p.add_argument("-o", "--output", default=None)
     p.set_defaults(fn=cmd_fuzz)
@@ -1007,6 +1547,7 @@ def main(argv: Optional[list] = None) -> int:
     fork_flags(p)
     async_min_flags(p)
     sanitize_flags(p)
+    strict_io_flags(p)
     p.add_argument("-e", "--experiment", required=True)
     p.add_argument("--no-wildcards", action="store_true")
     p.add_argument(
@@ -1073,6 +1614,8 @@ def main(argv: Optional[list] = None) -> int:
              "partition per process, summaries aggregated over the "
              "distributed runtime)",
     )
+    checkpoint_flags(p, 5, "chunks")
+    strict_io_flags(p)
     p.set_defaults(fn=cmd_sweep)
 
     p = sub.add_parser("dpor", help="systematic batched DPOR search")
@@ -1103,7 +1646,18 @@ def main(argv: Optional[list] = None) -> int:
              "so already-reversed races are not re-explored; "
              "DEMI_SLEEP_SETS=1 does the same; off by default",
     )
+    checkpoint_flags(p, 5, "rounds")
+    strict_io_flags(p)
     p.set_defaults(fn=cmd_dpor)
+
+    p = sub.add_parser(
+        "resume",
+        help="resume a checkpointed dpor/sweep/fuzz run from its "
+             "--checkpoint-dir (newest valid snapshot generation; "
+             "corrupt ones fall back to the previous good one)",
+    )
+    p.add_argument("dir", help="the original run's --checkpoint-dir")
+    p.set_defaults(fn=cmd_resume)
 
     p = sub.add_parser(
         "tune",

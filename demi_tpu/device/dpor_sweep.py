@@ -1035,7 +1035,7 @@ def _dpor_search_state(dpor: "DeviceDPOR") -> tuple:
         dpor.host_seconds, dpor.device_seconds,
         dict(dpor._sleep_rows), set(dpor._suppressed),
         set(dpor._suppressed_digests), set(dpor.violation_codes),
-        sleep_state, dict(dpor._guides),
+        sleep_state, dict(dpor._guides), list(dpor._explored_log),
     )
 
 
@@ -1054,6 +1054,10 @@ def _dpor_restore_state(dpor: "DeviceDPOR", state: tuple) -> None:
     dpor._suppressed_digests = set(state[13])
     dpor.violation_codes = set(state[14])
     dpor._guides = dict(state[16])
+    # The explored log rolls back with the set; the durable-checkpoint
+    # pack cache re-validates itself against it (prefix + last-entry
+    # check) and rebuilds when the rollback invalidated it.
+    dpor._explored_log = list(state[17])
     if state[15] is not None and dpor.sleep is not None:
         dpor.sleep.classes = set(state[15][0])
         dpor.sleep._node_flips = {
@@ -1336,6 +1340,14 @@ class DeviceDPOR:
         self.explored: Set[Tuple] = set()
         self.frontier: List[Tuple] = [tuple()]
         self.explored.add(tuple())
+        # Admission-ordered log of the explored set (kept in lockstep
+        # with ``explored`` — __init__/seed/_admit are the only
+        # writers). The durable-checkpoint codec serializes the log as
+        # one packed int32 blob and the frontier as INDICES into it, and
+        # keeps an incremental pack cache so each snapshot packs only
+        # the entries admitted since the last one (demi_tpu/persist).
+        self._explored_log: List[Tuple] = [tuple()]
+        self._persist_pack_cache = None
         # Digest twin of the explored set (16-byte content keys over the
         # packed prescription rows): the vectorized path's membership
         # check, maintained in lockstep with ``explored`` so a redundant
@@ -1389,6 +1401,7 @@ class DeviceDPOR:
         self.original = prescription
         if prescription not in self.explored:
             self.explored.add(prescription)
+            self._explored_log.append(prescription)
             self._explored_digests.add(prescription_digest(prescription))
             self.frontier.insert(0, prescription)
             if self.sleep is not None and prescription:
@@ -1401,6 +1414,45 @@ class DeviceDPOR:
                         self.cfg.rec_width,
                     )
                 )
+
+    def checkpoint_state(self) -> dict:
+        """JSON-able snapshot of everything a round mutates (frontier,
+        explored tuple/digest sets, sleep rows + class ledger, guides,
+        violation codes, rng round counters) — the durable twin of the
+        in-memory ``_dpor_search_state``. Round-trips bit-identically:
+        a fresh DeviceDPOR built with the same constructor arguments and
+        ``restore_state(payload)`` continues exactly where this one
+        stood (tests/test_persist.py)."""
+        from ..persist.checkpoint import device_dpor_payload
+
+        return device_dpor_payload(self)
+
+    def restore_state(self, payload: dict) -> None:
+        """Inverse of ``checkpoint_state``; raises
+        ``persist.CheckpointMismatch`` when the payload was captured
+        under a different workload shape."""
+        from ..persist.checkpoint import restore_device_dpor
+
+        restore_device_dpor(self, payload)
+
+    def _supervised_harvest(
+        self, parts, batch: List[Tuple], prescs: np.ndarray, keys
+    ):
+        """Harvest one round under the launch supervisor: a failed or
+        poisoned launch re-executes the round from its (pure) inputs —
+        the round is a function of (prescs, keys, batch) alone, so a
+        retry is bit-identical and nothing in the search state needs
+        rewinding. Exhausted retries re-raise (strict-io makes that a
+        StrictIOError); there is no host twin for the DPOR kernel."""
+        from ..persist.supervisor import SUPERVISOR
+
+        def attempt(n: int):
+            p = parts if n == 0 else self._dispatch_round(
+                prescs, keys, batch
+            )
+            return self._harvest_round(p, len(batch))
+
+        return SUPERVISOR.run(attempt, label="dpor.launch")
 
     def _pack(self, prescriptions: List[Tuple]) -> np.ndarray:
         r, w = self.cfg.max_steps, self.cfg.rec_width
@@ -1682,13 +1734,6 @@ class DeviceDPOR:
                 )[: len(idx)]
         return res_type(**merged)
 
-    def _launch_round(self, prescs: np.ndarray, keys, batch: List[Tuple]):
-        """One frontier round's lane work, harvested to LaneResult arrays
-        (the synchronous dispatch+harvest pair)."""
-        return self._harvest_round(
-            self._dispatch_round(prescs, keys, batch), len(batch)
-        )
-
     def _process_round(
         self,
         res: LaneResult,
@@ -1805,6 +1850,7 @@ class DeviceDPOR:
         ):
             return False
         self.explored.add(presc)
+        self._explored_log.append(presc)
         if key is not None:
             self._explored_digests.add(key)
         frontier.append(presc)
@@ -2195,12 +2241,14 @@ class DeviceDPOR:
         which follows the exact same generation policy."""
         gen = self.frontier
         pending: List[Tuple] = []  # the NEXT generation, fed by harvests
-        inflight = None  # (batch, parts, n_real) for the next round
+        # (batch, parts, n_real, prescs, keys) for the next round — the
+        # pure round inputs ride along for poisoned-launch re-dispatch.
+        inflight = None
         found = None
         for _ in range(max_rounds):
             round_t0 = time.perf_counter()
             if inflight is not None:
-                batch, parts, _ = inflight
+                batch, parts, _, r_prescs, r_keys = inflight
                 inflight = None
                 # A hit is an in-flight launch actually harvested as the
                 # next round — adoption alone isn't enough (the budget
@@ -2215,35 +2263,36 @@ class DeviceDPOR:
                 if not gen:
                     break
                 batch, gen = self._select_batch(gen)
-                parts = self._dispatch_round(
-                    self._pack(batch),
-                    self._round_keys(
-                        len(batch), self.interleavings, batch=batch
-                    ),
-                    batch,
+                r_prescs = self._pack(batch)
+                r_keys = self._round_keys(
+                    len(batch), self.interleavings, batch=batch
                 )
+                parts = self._dispatch_round(r_prescs, r_keys, batch)
             spec = None
             if self._double_buffer and gen:
                 sbatch, srest = self._select_batch(gen)
-                sparts = self._dispatch_round(
-                    self._pack(sbatch),
-                    self._round_keys(
-                        len(sbatch), self.interleavings + len(batch),
-                        batch=sbatch,
-                    ),
-                    sbatch,
+                s_prescs = self._pack(sbatch)
+                s_keys = self._round_keys(
+                    len(sbatch), self.interleavings + len(batch),
+                    batch=sbatch,
                 )
+                sparts = self._dispatch_round(s_prescs, s_keys, sbatch)
                 # len(gen) - len(srest) real entries precede the padding
                 # in sbatch — the count the budget-expiry requeue needs
                 # (a genuine root ``tuple()`` entry is falsy, so
-                # truthiness can't separate it from padding).
-                spec = (sbatch, sparts, len(gen) - len(srest))
+                # truthiness can't separate it from padding). The pure
+                # (prescs, keys) inputs ride along so a poisoned launch
+                # can re-execute this round at harvest time.
+                spec = (sbatch, sparts, len(gen) - len(srest),
+                        s_prescs, s_keys)
                 self._note_inflight("rounds")
             with obs.span(
                 "dpor.round", batch=len(batch), frontier=len(gen)
             ):
                 t_harvest = time.perf_counter()
-                res = self._harvest_round(parts, len(batch))
+                res = self._supervised_harvest(
+                    parts, batch, r_prescs, r_keys
+                )
                 dev_secs = time.perf_counter() - t_harvest
             hit = self._process_round(
                 res, batch, target_code, pending, frontier_extra=len(gen)
@@ -2257,7 +2306,7 @@ class DeviceDPOR:
                 self._account_round(round_t0, dev_secs)
                 break
             if spec is not None:
-                sbatch, sparts, sreal = spec
+                sbatch, sparts, sreal, s_prescs, s_keys = spec
                 # The speculative batch was selected from the UNMERGED
                 # remainder; validate against the merged pool the
                 # synchronous loop would select from at its next round
@@ -2266,7 +2315,7 @@ class DeviceDPOR:
                 mgen, mpending = self._merge_generations(gen, pending)
                 abatch, arest = self._select_batch(mgen)
                 if abatch == sbatch:
-                    inflight = (sbatch, sparts, sreal)
+                    inflight = (sbatch, sparts, sreal, s_prescs, s_keys)
                     gen, pending = arest, mpending
                 else:
                     self._note_inflight("waste")
@@ -2276,7 +2325,7 @@ class DeviceDPOR:
             # device: it was never harvested, so its prescriptions go
             # back to the worklist head and the next explore() call
             # re-selects (and re-dispatches) them.
-            batch, _parts, n_real = inflight
+            batch, _parts, n_real, _prescs, _keys = inflight
             gen = list(batch[:n_real]) + gen
             self._note_inflight("waste")
         self.frontier = gen + pending
@@ -2341,17 +2390,26 @@ def explore_window(
             # One launch for the whole window: lanes are elementwise
             # under vmap, so concatenating the instances' (prog, presc,
             # key) rows yields exactly each instance's own round results.
+            from ..persist.supervisor import SUPERVISOR
+
             progs = [dpors[i]._progs(len(b)) for i, b, *_ in staged]
             t_harvest = time.perf_counter()
-            res = dpors[staged[0][0]].kernel(
-                ExtProgram(*(
-                    np.concatenate([np.asarray(getattr(p, f)) for p in progs])
-                    for f in ExtProgram._fields
-                )),
-                np.concatenate([prescs for _, _, prescs, _ in staged]),
-                np.concatenate([np.asarray(keys) for *_, keys in staged]),
-            )
-            jax.block_until_ready(res.violation)
+
+            def _combined_launch(_attempt: int):
+                r = dpors[staged[0][0]].kernel(
+                    ExtProgram(*(
+                        np.concatenate(
+                            [np.asarray(getattr(p, f)) for p in progs]
+                        )
+                        for f in ExtProgram._fields
+                    )),
+                    np.concatenate([prescs for _, _, prescs, _ in staged]),
+                    np.concatenate([np.asarray(keys) for *_, keys in staged]),
+                )
+                jax.block_until_ready(r.violation)
+                return r
+
+            res = SUPERVISOR.run(_combined_launch, label="dpor.launch")
             # Window launches serve several instances at once: split the
             # blocked span evenly for the per-instance host-share ledger
             # (through the accounting helper, so windowed oracle rounds
@@ -2368,13 +2426,16 @@ def explore_window(
                 off += len(batch)
         else:
             handles = [
-                (i, batch, dpors[i]._dispatch_round(prescs, keys, batch))
+                (i, batch, dpors[i]._dispatch_round(prescs, keys, batch),
+                 prescs, keys)
                 for i, batch, prescs, keys in staged
             ]
             results = []
-            for i, batch, parts in handles:
+            for i, batch, parts, prescs, keys in handles:
                 t_harvest = time.perf_counter()
-                harvested = dpors[i]._harvest_round(parts, len(batch))
+                harvested = dpors[i]._supervised_harvest(
+                    parts, batch, prescs, keys
+                )
                 dpors[i]._account_device(time.perf_counter() - t_harvest)
                 results.append((i, batch, harvested))
         for i, batch, res in results:

@@ -129,6 +129,15 @@ class Fuzzer:
                     getattr(weights, f.name), kind=f.name
                 )
 
+    def checkpoint_state(self) -> dict:
+        """JSON-able snapshot of the fuzzer's mutable state — just the
+        live weights: generation is a pure function of (weights, seed),
+        which is what makes a resumed corpus sweep bit-identical."""
+        return {"weights": self.weights.as_dict()}
+
+    def restore_state(self, state: dict) -> None:
+        self.set_weights(FuzzerWeights.from_dict(state["weights"]))
+
     def generate_fuzz_test(self, seed: int) -> List[ExternalEvent]:
         rng = _random.Random(seed)
         self.message_gen.reset()

@@ -224,20 +224,32 @@ def racing_pair_scan(recs: np.ndarray) -> np.ndarray:
     ([k, 2] int32). Native when available, Python otherwise."""
     recs = np.ascontiguousarray(recs, np.int32)
     n, w = recs.shape
-    lib = _load_native()
+    from ..persist.supervisor import SUPERVISOR
+
+    # Shares the batch entry's degradation label: one poisoned library
+    # makes every symbol suspect, so a degraded analyzer routes ALL
+    # native scans to their Python/NumPy twins.
+    lib = None if SUPERVISOR.degraded("native.analysis") else _load_native()
     if lib is None or n == 0:
-        if lib is None:
+        if lib is None and not SUPERVISOR.degraded("native.analysis"):
             note_fallback("no native library")
         return _py_racing_pairs(recs)
-    cap = max(64, n * 4)
-    while True:
-        out = np.empty((cap, 2), np.int32)
-        count = lib.demi_racing_pairs(
-            recs.ctypes.data, n, w, out.ctypes.data, cap
-        )
-        if count <= cap:
-            return out[:count].copy()
-        cap = int(count)
+
+    def native_pairs(_attempt: int):
+        cap = max(64, n * 4)
+        while True:
+            out = np.empty((cap, 2), np.int32)
+            count = lib.demi_racing_pairs(
+                recs.ctypes.data, n, w, out.ctypes.data, cap
+            )
+            if count <= cap:
+                return out[:count].copy()
+            cap = int(count)
+
+    return SUPERVISOR.run(
+        native_pairs, label="native.analysis",
+        fallback=lambda: _py_racing_pairs(recs),
+    )
 
 
 # ---------------------------------------------------------------------------
@@ -311,9 +323,11 @@ def racing_prescriptions_batch(
     sleep_on = (
         sleep is not None and sleep.prune and sleep_ctx is not None
     )
-    lib = _load_native()
-    if lib is None:
-        note_fallback("no native library")
+
+    def numpy_path():
+        """The semantics-identical host twin — also the launch
+        supervisor's degradation target when the native scan keeps
+        failing (persist/supervisor.py)."""
         rows, offsets, lanes = _np_racing_prescriptions(records, lens)
         out = (rows, offsets, lanes, prescription_digests(rows, offsets))
         if independence is not None:
@@ -322,6 +336,15 @@ def racing_prescriptions_batch(
         if sleep_on:
             out = _apply_sleep_filter(*out, sleep=sleep, sleep_ctx=sleep_ctx)
         return out
+
+    from ..persist.supervisor import SUPERVISOR
+
+    if SUPERVISOR.degraded("native.analysis"):
+        return numpy_path()
+    lib = _load_native()
+    if lib is None:
+        note_fallback("no native library")
+        return numpy_path()
     lens = np.ascontiguousarray(lens)
     # The native per-pair filter serves the hot path; audit runs (which
     # must materialize every pruned prescription) post-filter the
@@ -356,7 +379,18 @@ def racing_prescriptions_batch(
     else:
         cap_presc = max(64, 4 * int(lens.sum()))
         cap_rows = max(256, cap_presc * max(8, rmax // 4))
-    while True:
+    def native_scan(_attempt: int):
+        return _native_scan_loop()
+
+    def _native_scan_loop():
+        nonlocal cap_presc, cap_rows
+        while True:
+            out = _native_scan_once()
+            if out is not None:
+                return out
+
+    def _native_scan_once():
+        nonlocal cap_presc, cap_rows
         rows = np.empty((cap_rows, w), np.int32)
         offsets = np.zeros(cap_presc + 1, np.int64)
         lanes = np.empty(cap_presc, np.int32)
@@ -409,23 +443,39 @@ def racing_prescriptions_batch(
                 lanes[:n],
                 digests[:n],
             )
-            if native_filter:
-                if independence is not None:
-                    independence.note_pruned(
-                        int(pruned[0]), int(pruned[1]), tier="device"
-                    )
-            elif independence is not None:
-                out = _apply_static_filter(records, lens, *out,
-                                           independence=independence)
-            if native_sleep:
-                sleep.note_pruned(sleep=int(pruned[2]), tier="device")
-            elif sleep_on:
-                out = _apply_sleep_filter(
-                    *out, sleep=sleep, sleep_ctx=sleep_ctx
-                )
-            return out
+            return out, (pruned if (native_filter or native_sleep) else None)
         cap_presc = max(cap_presc, int(n))
         cap_rows = max(cap_rows, int(total_rows.value))
+        return None  # buffers grown; the loop retries with exact sizes
+
+    # Bounded retry + permanent degradation to the NumPy twin: a native
+    # analyzer that segfault-adjacently raises (bad library rebuild,
+    # corrupted .so) must not kill an hours-long soak — the twin is
+    # bit-identical, just slower. --strict-io turns this into an error.
+    # The supervised region is the PURE scan (local buffers only):
+    # pruning-ledger notes and the host post-filters run once, after,
+    # so a retried attempt can never double-count pruning stats.
+    result = SUPERVISOR.run(
+        lambda attempt: ("native", native_scan(attempt)),
+        label="native.analysis",
+        fallback=lambda: ("host", numpy_path()),
+    )
+    if result[0] == "host":
+        return result[1]
+    out, pruned = result[1]
+    if native_filter:
+        if independence is not None:
+            independence.note_pruned(
+                int(pruned[0]), int(pruned[1]), tier="device"
+            )
+    elif independence is not None:
+        out = _apply_static_filter(records, lens, *out,
+                                   independence=independence)
+    if native_sleep:
+        sleep.note_pruned(sleep=int(pruned[2]), tier="device")
+    elif sleep_on:
+        out = _apply_sleep_filter(*out, sleep=sleep, sleep_ctx=sleep_ctx)
+    return out
 
 
 def _apply_static_filter(

@@ -70,6 +70,15 @@ class Counter:
         key = _label_key(labels)
         self.series[key] = self.series.get(key, 0) + n
 
+    def force_inc(self, n: float = 1, **labels) -> None:
+        """Record regardless of the telemetry switch — the counter twin
+        of ``Gauge.force_set``, for rare load-bearing events that must
+        reach every snapshot (checkpoint corruption fallbacks, launch
+        degradations, cache corruption): a run that silently degraded
+        must say so. Never for hot paths."""
+        key = _label_key(labels)
+        self.series[key] = self.series.get(key, 0) + n
+
     def value(self, **labels) -> float:
         return self.series.get(_label_key(labels), 0)
 

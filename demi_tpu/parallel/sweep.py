@@ -596,9 +596,18 @@ class SweepDriver:
         batch is padded up to a multiple of the mesh axis by repeating
         seeds; padded lanes are excluded from every reported count."""
         seeds = list(seeds)
+        from ..persist.supervisor import SUPERVISOR
+
+        # Chunks are pure functions of (seeds, base_key): a failed or
+        # poisoned launch re-dispatches the chunk from the same inputs
+        # under the launch supervisor (bounded retry + backoff;
+        # --strict-io turns exhausted retries into errors).
         with obs.span("device.sweep.chunk", lanes=len(seeds)):
-            return self._harvest_chunk(
-                self._dispatch_chunk(seeds, base_key), slice_index
+            return SUPERVISOR.run(
+                lambda attempt: self._harvest_chunk(
+                    self._dispatch_chunk(seeds, base_key), slice_index
+                ),
+                label="sweep.launch",
             )
 
     def _harvest_chunk(self, handle, slice_index: int = 0) -> SweepChunkResult:

@@ -1,0 +1,67 @@
+"""demi_tpu.persist: durable exploration state.
+
+Everything the explorer learns — the DPOR frontier with its sleep rows
+and Mazurkiewicz class set, the explored tuple/digest sets, fuzz
+controller weights, obs counters — used to live only in process memory,
+so a preemption at hour three of a soak threw all of it away. This
+package makes that state durable and the run preemption-tolerant:
+
+  - ``checkpoint``: ``CheckpointStore`` — atomic (tmp + fsync + rename),
+    versioned snapshot generations with a manifest carrying per-section
+    content digests; a torn or corrupt snapshot degrades to the previous
+    good generation (warn + ``persist.corrupt_fallbacks``, never a
+    crash). Plus the payload codecs: device ``DeviceDPOR``, host
+    ``DPORScheduler``, ``ExplorationController``/fuzzer weights, and the
+    obs registry all round-trip bit-identically through structural JSON.
+  - ``supervisor``: ``LaunchSupervisor`` — bounded retry/backoff around
+    device kernel launches and native ctypes calls; repeated native
+    failures degrade permanently to the NumPy twins (one-time warning +
+    ``persist.degradations``), and ``--strict-io`` / ``DEMI_STRICT_IO=1``
+    turns degradations into errors for CI. ``PreemptionGuard`` turns
+    SIGTERM/SIGINT into a checkpoint request honored at the next round
+    boundary (rounds are generation-frozen and deterministic, so a
+    boundary snapshot resumes bit-identically).
+
+CLI wiring: ``demi_tpu dpor/sweep/fuzz --checkpoint-dir/--checkpoint-
+every`` and ``demi_tpu resume <dir>``; ``tools/soak.py --mode
+kill-resume`` SIGKILLs itself mid-soak and verifies the resumed run
+converges to the uninterrupted run's violation set.
+"""
+
+from .checkpoint import (  # noqa: F401
+    FORMAT_VERSION,
+    Checkpoint,
+    CheckpointMismatch,
+    CheckpointStore,
+    controller_payload,
+    device_dpor_payload,
+    host_dpor_payload,
+    restore_controller,
+    restore_device_dpor,
+    restore_host_dpor,
+)
+from .supervisor import (  # noqa: F401
+    SUPERVISOR,
+    LaunchSupervisor,
+    PreemptionGuard,
+    StrictIOError,
+    strict_io_enabled,
+)
+
+__all__ = [
+    "FORMAT_VERSION",
+    "Checkpoint",
+    "CheckpointMismatch",
+    "CheckpointStore",
+    "LaunchSupervisor",
+    "PreemptionGuard",
+    "SUPERVISOR",
+    "StrictIOError",
+    "controller_payload",
+    "device_dpor_payload",
+    "host_dpor_payload",
+    "restore_controller",
+    "restore_device_dpor",
+    "restore_host_dpor",
+    "strict_io_enabled",
+]

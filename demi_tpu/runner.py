@@ -109,6 +109,8 @@ def fuzz(
     timer_weight: float = 1.0,
     validate_replay: bool = False,
     controller=None,
+    start_execution: int = 0,
+    round_hook=None,
 ) -> Optional[FuzzResult]:
     """Generate fuzz tests and run them until a violation is found
     (reference: RunnerUtils.fuzz, RunnerUtils.scala:62-147). With
@@ -119,7 +121,15 @@ def fuzz(
     measurement loop on the host tier: each execution runs under proposed
     fuzzer weights and is scored by whether its delivered sequence was new
     (plus a violation bonus), so event kinds that keep finding fresh
-    schedules earn weight."""
+    schedules earn weight.
+
+    Durable-state hooks (``demi_tpu.persist``): each execution is a pure
+    function of (seed, i) plus the controller's restored state, so a
+    resumed run passes ``start_execution`` to skip the executions the
+    dead run already burned. ``round_hook(executions_done)`` is called
+    after every non-violating execution; returning True stops the loop
+    (the preemption guard's boundary — the caller distinguishes
+    "preempted" from "exhausted" via its own guard flag)."""
     sched = RandomScheduler(
         config,
         seed=seed,
@@ -127,7 +137,7 @@ def fuzz(
         invariant_check_interval=invariant_check_interval,
         timer_weight=timer_weight,
     )
-    for i in range(max_executions):
+    for i in range(start_execution, max_executions):
         if controller is not None:
             controller.begin_round()
         program = fuzzer.generate_fuzz_test(seed=seed + i)
@@ -142,28 +152,32 @@ def fuzz(
                 violations=int(result.violation is not None),
                 lanes=1,
             )
-        if result.violation is None:
-            continue
-        obs.counter("fuzz.violations").inc()
-        if validate_replay:
-            replayer = ReplayScheduler(config)
-            try:
-                with obs.span("fuzz.validate_replay"):
-                    replayed = replayer.replay(result.trace, program)
-            except ReplayException:
-                obs.counter("fuzz.nondeterministic_discarded").inc()
-                continue
-            if replayed.violation is None or not replayed.violation.matches(
-                result.violation
-            ):
-                obs.counter("fuzz.nondeterministic_discarded").inc()
-                continue
-        return FuzzResult(
-            program=program,
-            trace=result.trace,
-            violation=result.violation,
-            executions=i + 1,
-        )
+        reproduced = result.violation is not None
+        if reproduced:
+            obs.counter("fuzz.violations").inc()
+            if validate_replay:
+                replayer = ReplayScheduler(config)
+                try:
+                    with obs.span("fuzz.validate_replay"):
+                        replayed = replayer.replay(result.trace, program)
+                except ReplayException:
+                    obs.counter("fuzz.nondeterministic_discarded").inc()
+                    reproduced = False
+                else:
+                    if replayed.violation is None or not (
+                        replayed.violation.matches(result.violation)
+                    ):
+                        obs.counter("fuzz.nondeterministic_discarded").inc()
+                        reproduced = False
+        if reproduced:
+            return FuzzResult(
+                program=program,
+                trace=result.trace,
+                violation=result.violation,
+                executions=i + 1,
+            )
+        if round_hook is not None and round_hook(i + 1):
+            return None
     return None
 
 

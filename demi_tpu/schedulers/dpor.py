@@ -347,6 +347,23 @@ class DPORScheduler(TestOracle):
         self.initial_trace = trace
         self._steer_next = trace is not None
 
+    # -- durable state (demi_tpu.persist) ----------------------------------
+    def checkpoint_state(self) -> dict:
+        """JSON-able snapshot of the resumable search state (dep-graph
+        records, backtrack heap, explored set, sleep ledgers, counters)
+        — the host twin of DeviceDPOR.checkpoint_state. Restore into a
+        freshly constructed scheduler with the same config/ordering
+        arguments; ``explore`` then continues bit-identically
+        (tests/test_persist.py)."""
+        from ..persist.checkpoint import host_dpor_payload
+
+        return host_dpor_payload(self)
+
+    def restore_state(self, payload: dict) -> None:
+        from ..persist.checkpoint import restore_host_dpor
+
+        restore_host_dpor(self, payload)
+
     # -- exploration -------------------------------------------------------
     def explore(
         self,

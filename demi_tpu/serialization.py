@@ -341,18 +341,41 @@ def save_dep_graph(directory: str, tracker) -> str:
     return path
 
 
+def _warn_corrupt(path: str, exc: Exception) -> None:
+    """A truncated or unparsable checkpoint behaves like an ABSENT one
+    (the --resume run redoes that stage) instead of crashing — but never
+    silently: warn + ``persist.stage_corrupt`` (force-written so the
+    degradation reaches every snapshot regardless of DEMI_OBS)."""
+    import sys
+
+    from . import obs
+
+    obs.counter("persist.stage_corrupt").force_inc()
+    print(
+        f"demi_tpu: checkpoint {path!r} is corrupt or truncated "
+        f"({type(exc).__name__}: {exc}); treating it as absent",
+        file=sys.stderr,
+    )
+
+
 def load_dep_graph(directory: str, fingerprinter):
-    """Rebuild the DepTracker saved by save_dep_graph, or None if absent."""
+    """Rebuild the DepTracker saved by save_dep_graph; None if absent —
+    or corrupt/truncated (warn + counter, treat as absent: a damaged
+    artifact must degrade a --resume run, never crash it)."""
     from .schedulers.dep_tracker import DepTracker
 
     path = os.path.join(directory, "dep_graph.json")
     if not os.path.exists(path):
         return None
-    with open(path) as f:
-        records = json.load(f)
-    for rec in records:
-        rec["fp"] = _fp_from_json(rec["fp"])
-    return DepTracker.from_records(records, fingerprinter)
+    try:
+        with open(path) as f:
+            records = json.load(f)
+        for rec in records:
+            rec["fp"] = _fp_from_json(rec["fp"])
+        return DepTracker.from_records(records, fingerprinter)
+    except Exception as exc:
+        _warn_corrupt(path, exc)
+        return None
 
 
 def save_stage(
@@ -375,15 +398,21 @@ def save_stage(
 
 
 def load_stage(directory: str, stage: str, app: Optional[DSLApp] = None):
-    """(externals, trace) for a checkpointed stage, or None if absent."""
+    """(externals, trace) for a checkpointed stage, or None if absent —
+    or truncated/unparsable (warn + counter, treat as absent so a
+    --resume run redoes the stage instead of crashing)."""
     path = os.path.join(directory, f"stage_{stage}.json")
     if not os.path.exists(path):
         return None
-    with open(path) as f:
-        obj = json.load(f)
-    externals = [_external_from_json(r, app) for r in obj["externals"]]
-    events = [_event_from_json(r, app) for r in obj["trace"]]
-    return externals, EventTrace(events, externals)
+    try:
+        with open(path) as f:
+            obj = json.load(f)
+        externals = [_external_from_json(r, app) for r in obj["externals"]]
+        events = [_event_from_json(r, app) for r in obj["trace"]]
+        return externals, EventTrace(events, externals)
+    except Exception as exc:
+        _warn_corrupt(path, exc)
+        return None
 
 
 class ExperimentDeserializer:

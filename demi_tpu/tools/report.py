@@ -311,6 +311,75 @@ def render_report(directory: str, app=None) -> str:
                         f"replay"
                     )
             lines.append("")
+        # Durability (persist.* counters, force-written so they reach
+        # every snapshot): checkpoints written/restored, corruption
+        # fallbacks, and what the launch supervisor absorbed — a run
+        # that survived a preemption or degraded a surface must say so.
+        persist = {
+            name: series
+            for name, series in counters.items()
+            if name.startswith("persist.")
+            or name in ("tune.cache_corrupt",)
+        }
+        if persist:
+            lines += ["### Durability", ""]
+
+            def _total(name):
+                return sum(persist.get(name, {}).values())
+
+            if "persist.snapshots_written" in persist:
+                lines.append(
+                    f"- checkpoints written: "
+                    f"{_total('persist.snapshots_written'):g} "
+                    f"({_total('persist.snapshot_bytes'):g} bytes)"
+                )
+            if "persist.restore_hits" in persist:
+                lines.append(
+                    f"- restores served: {_total('persist.restore_hits'):g}"
+                )
+            if "persist.corrupt_fallbacks" in persist:
+                lines.append(
+                    f"- corrupt snapshots degraded to a previous "
+                    f"generation: {_total('persist.corrupt_fallbacks'):g}"
+                )
+            if "persist.preemptions_requested" in persist:
+                lines.append(
+                    f"- preemptions honored at a round boundary: "
+                    f"{_total('persist.preemptions_requested'):g}"
+                )
+            if (
+                "persist.launch_failures" in persist
+                or "persist.launch_retries" in persist
+            ):
+                lines.append(
+                    f"- launch failures: "
+                    f"{_total('persist.launch_failures'):g} "
+                    f"({_total('persist.launch_retries'):g} retried)"
+                )
+                for key, v in sorted(
+                    persist.get("persist.launch_failures", {}).items()
+                ):
+                    lines.append(f"  - {key or '—'}: {v:g}")
+            if "persist.degradations" in persist:
+                lines.append(
+                    f"- surfaces degraded to host twins: "
+                    f"{_total('persist.degradations'):g}"
+                )
+                for key, v in sorted(
+                    persist["persist.degradations"].items()
+                ):
+                    lines.append(f"  - {key or '—'}: {v:g}")
+            if "persist.stage_corrupt" in persist:
+                lines.append(
+                    f"- corrupt stage checkpoints treated as absent: "
+                    f"{_total('persist.stage_corrupt'):g}"
+                )
+            if "tune.cache_corrupt" in persist:
+                lines.append(
+                    f"- corrupt tuning caches degraded to empty: "
+                    f"{_total('tune.cache_corrupt'):g}"
+                )
+            lines.append("")
         if counters:
             lines += ["| counter | series | value |", "|---|---|---|"]
             for name in sorted(counters):

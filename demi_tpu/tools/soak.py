@@ -82,17 +82,23 @@ def main(argv=None) -> int:
     p.add_argument("--lanes", type=int, default=24)
     p.add_argument("--seed", type=int, default=20260730)
     p.add_argument(
-        "--mode", default="continuous", choices=("continuous", "round-pin"),
+        "--mode", default="continuous",
+        choices=("continuous", "round-pin", "kill-resume"),
         help="continuous: per-seed verdict parity across continuous-driver "
              "variants; round-pin: fuzzed round-delivery lanes recorded and "
              "replayed through the sequential replay kernel "
              "(ignored_absent must be 0 — every round execution is a legal "
-             "sequential schedule)",
+             "sequential schedule); kill-resume: SIGKILL a checkpointed "
+             "DPOR soak mid-run and verify the resumed run converges to "
+             "the uninterrupted run's violation set (bit-parity on "
+             "explored/interleavings/first-found)",
     )
     args = p.parse_args(argv)
 
     if args.mode == "round-pin":
         return _round_pin_soak(args)
+    if args.mode == "kill-resume":
+        return _kill_resume_soak(args)
 
     import numpy as np
 
@@ -294,6 +300,117 @@ def _round_pin_soak(args) -> int:
         f"({skipped} overflow-skipped)",
         flush=True,
     )
+    return 0
+
+
+def _kill_resume_soak(args) -> int:
+    """Preemption-tolerance soak (demi_tpu.persist): per cycle, run one
+    checkpointed DPOR search to completion (the reference), then run the
+    SAME search again, SIGKILL it mid-soak — the harshest preemption:
+    no handler runs, a snapshot write may be torn mid-file — and
+    ``demi_tpu resume`` it to completion. The resumed run must converge
+    to the uninterrupted run's results EXACTLY: same violation-code set,
+    same first-found record digest, same explored count and
+    interleavings (checkpoints are atomic + generation-versioned, and
+    rounds are deterministic in the restored state, so kill-and-resume
+    is bit-parity, not just eventual agreement). The kill delay grows
+    with the cycle index so the SIGKILL lands at different phases —
+    including inside checkpoint writes."""
+    import json
+    import os
+    import shutil
+    import signal
+    import subprocess
+    import tempfile
+
+    cycles = args.rounds if args.rounds is not None else 3
+    rounds = int(os.environ.get("DEMI_SOAK_KR_ROUNDS", "8"))
+    base_cmd = [
+        sys.executable, "-m", "demi_tpu", "dpor",
+        "--app", "raft", "--bug", "multivote", "--nodes", "3",
+        "--batch", "8", "--rounds", str(rounds), "--max-messages", "60",
+        "--checkpoint-every", "1",
+    ]
+    env = dict(os.environ, JAX_PLATFORMS=os.environ.get(
+        "JAX_PLATFORMS", "cpu"
+    ))
+
+    def summary_of(out: str):
+        for line in reversed(out.strip().splitlines()):
+            line = line.strip()
+            if line.startswith("{"):
+                return json.loads(line)
+        return None
+
+    t0 = time.time()
+    for cycle in range(cycles):
+        if args.rounds is None and time.time() - t0 >= args.seconds:
+            break
+        workdir = tempfile.mkdtemp(prefix="demi_kr_")
+        try:
+            dir_u = os.path.join(workdir, "uninterrupted")
+            dir_k = os.path.join(workdir, "killed")
+            ref = subprocess.run(
+                base_cmd + ["--checkpoint-dir", dir_u],
+                capture_output=True, text=True, env=env, timeout=600,
+            )
+            want = summary_of(ref.stdout)
+            if want is None:
+                print(f"KILL-RESUME: no summary from reference run\n"
+                      f"{ref.stdout}\n{ref.stderr}", flush=True)
+                return 2
+            proc = subprocess.Popen(
+                base_cmd + ["--checkpoint-dir", dir_k],
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                text=True, env=env,
+            )
+            # Kill once at least one complete generation exists, after a
+            # cycle-dependent extra delay (land in different phases).
+            deadline = time.time() + 300
+            while time.time() < deadline:
+                gens = [
+                    e for e in (
+                        os.listdir(dir_k) if os.path.isdir(dir_k) else []
+                    )
+                    if e.startswith("ckpt-") and not e.endswith(".tmp")
+                ]
+                if gens or proc.poll() is not None:
+                    break
+                time.sleep(0.05)
+            time.sleep(0.1 * cycle)
+            if proc.poll() is None:
+                proc.send_signal(signal.SIGKILL)
+            proc.communicate(timeout=60)
+            res = subprocess.run(
+                [sys.executable, "-m", "demi_tpu", "resume", dir_k],
+                capture_output=True, text=True, env=env, timeout=600,
+            )
+            got = summary_of(res.stdout)
+            if got is None:
+                print(f"KILL-RESUME: no summary from resumed run\n"
+                      f"{res.stdout}\n{res.stderr}", flush=True)
+                return 2
+            for key in ("violation_codes", "first_found", "explored",
+                        "interleavings", "rounds_done",
+                        "violation_found"):
+                if want.get(key) != got.get(key):
+                    print(
+                        f"KILL-RESUME DIVERGENCE cycle={cycle} "
+                        f"key={key}: uninterrupted={want.get(key)!r} "
+                        f"resumed={got.get(key)!r}",
+                        flush=True,
+                    )
+                    return 2
+            print(
+                f"kill-resume cycle {cycle} ok "
+                f"(explored={got.get('explored')}, "
+                f"codes={got.get('violation_codes')}, "
+                f"{time.time() - t0:.0f}s)",
+                flush=True,
+            )
+        finally:
+            shutil.rmtree(workdir, ignore_errors=True)
+    print("KILL-RESUME SOAK OK", flush=True)
     return 0
 
 

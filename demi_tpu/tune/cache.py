@@ -71,10 +71,36 @@ class TuningCache:
             try:
                 with open(self.path) as f:
                     data = json.load(f)
-                self._data = data if isinstance(data, dict) else {}
-            except (OSError, ValueError):
+                if isinstance(data, dict):
+                    self._data = data
+                else:
+                    self._note_corrupt(
+                        f"top-level {type(data).__name__}, expected object"
+                    )
+                    self._data = {}
+            except FileNotFoundError:
+                # A first run simply has no cache yet — not corruption.
+                self._data = {}
+            except (OSError, ValueError) as e:
+                self._note_corrupt(str(e))
                 self._data = {}
         return self._data
+
+    def _note_corrupt(self, reason: str) -> None:
+        """A torn or corrupt cache degrades to empty (we just
+        re-calibrate), but silently would hide real data loss: warn once
+        and count ``tune.cache_corrupt`` (force-written — the snapshot
+        must say so even with telemetry off)."""
+        import sys
+
+        from .. import obs
+
+        obs.counter("tune.cache_corrupt").force_inc()
+        print(
+            f"demi_tpu.tune: cache at {self.path!r} is corrupt ({reason}); "
+            "starting from an empty cache — decisions will re-calibrate",
+            file=sys.stderr,
+        )
 
     def get(self, key: str) -> Optional[Dict[str, Any]]:
         entry = self._load().get(key)

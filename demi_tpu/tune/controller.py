@@ -174,6 +174,41 @@ class WeightTuner:
             )
             self._cursor += 1
 
+    # -- durable state (demi_tpu.persist) ----------------------------------
+    def checkpoint_state(self) -> dict:
+        """JSON-able snapshot of every coordinate-descent variable, so a
+        resumed soak keeps tuning from where the dead run stood instead
+        of re-learning its weights from the defaults."""
+        return {
+            "base": dict(self.base),
+            "current": dict(self.current),
+            "directions": dict(self._directions),
+            "baseline": self.baseline,
+            "cursor": self._cursor,
+            "rounds": self.rounds,
+            "accepted": self.accepted,
+            "pending": (
+                None
+                if self._pending is None
+                else [self._pending.kind, self._pending.direction]
+            ),
+        }
+
+    def restore_state(self, state: dict) -> None:
+        self.base = dict(state["base"])
+        self.current = dict(state["current"])
+        self.kinds = sorted(self.current)
+        self._directions = dict(state["directions"])
+        self.baseline = state["baseline"]
+        self._cursor = state["cursor"]
+        self.rounds = state["rounds"]
+        self.accepted = state["accepted"]
+        self._pending = (
+            None
+            if state["pending"] is None
+            else _Coordinate(state["pending"][0], state["pending"][1])
+        )
+
 
 # ---------------------------------------------------------------------------
 # DPOR budgets
@@ -327,3 +362,37 @@ class ExplorationController:
         if self.weight_tuner is None:
             return None
         return self.weight_tuner.weights()
+
+    # -- durable state (demi_tpu.persist) ----------------------------------
+    def checkpoint_state(self) -> dict:
+        """JSON-able snapshot: the cross-round corpus fingerprint set
+        (reward attribution stays exact across a restart — re-finding a
+        pre-kill schedule earns nothing), the weight-tuner coordinates,
+        and the fuzzer's LIVE weights (which may be a mid-flight trial
+        proposal, not the incumbent)."""
+        return {
+            "seen_hashes": sorted(self.seen_hashes),
+            "rounds": self.rounds,
+            "last_reward": self.last_reward,
+            "violation_bonus": self.violation_bonus,
+            "weight_tuner": (
+                None
+                if self.weight_tuner is None
+                else self.weight_tuner.checkpoint_state()
+            ),
+            "fuzzer_weights": (
+                None if self.fuzzer is None else self.fuzzer.weights.as_dict()
+            ),
+        }
+
+    def restore_state(self, state: dict) -> None:
+        self.seen_hashes = set(state["seen_hashes"])
+        self.rounds = state["rounds"]
+        self.last_reward = state["last_reward"]
+        self.violation_bonus = float(state["violation_bonus"])
+        if state["weight_tuner"] is not None and self.weight_tuner is not None:
+            self.weight_tuner.restore_state(state["weight_tuner"])
+        if state["fuzzer_weights"] is not None and self.fuzzer is not None:
+            self.fuzzer.set_weights(
+                type(self.fuzzer.weights).from_dict(state["fuzzer_weights"])
+            )

@@ -182,6 +182,39 @@ def test_bench_config9_smoke():
     assert record["value"] == section["redundancy_ratio_pruned"]
 
 
+def test_bench_config10_smoke():
+    record = _run_bench(
+        "10",
+        {
+            # Tiny durability A/B: shallow seed scan, few rounds, one
+            # checkpoint generation.
+            "DEMI_BENCH_CONFIG10_BUDGET": "120",
+            "DEMI_BENCH_CONFIG10_SEEDS": "10",
+            "DEMI_BENCH_CONFIG10_BATCH": "8",
+            "DEMI_BENCH_CONFIG10_ROUNDS": "4",
+            "DEMI_BENCH_CONFIG10_EVERY": "2",
+        },
+    )
+    assert record["metric"].startswith("checkpoint overhead %")
+    section = record["config10"]
+    assert "error" not in section, section
+    for key in ("app", "seed_deliveries", "batch", "rounds",
+                "checkpoint_every", "explored", "violation_codes",
+                "snapshots_written", "snapshot_bytes",
+                "rounds_per_sec_plain", "rounds_per_sec_checkpointed",
+                "checkpoint_overhead_pct", "time_to_resume_s",
+                "restore_match"):
+        assert key in section, key
+    # The identity contracts the bench asserts internally, echoed into
+    # the JSON: snapshotting changes nothing, and the cold restore is
+    # bit-identical to the writer's final state.
+    assert section["restore_match"] is True
+    assert section["snapshots_written"] >= 1
+    assert section["snapshot_bytes"] > 0
+    assert section["time_to_resume_s"] >= 0
+    assert record["value"] == section["checkpoint_overhead_pct"]
+
+
 def test_cli_lint_zoo_clean_subprocess():
     """Tier-1 CI contract at the real entry point: `demi_tpu lint` over
     the bundled zoo exits 0 with zero findings — run as a subprocess so
