@@ -96,18 +96,66 @@ def _autotune_requested(args) -> bool:
     return bool(getattr(args, "autotune", False)) or autotune_enabled()
 
 
+#: Live --metrics-port server for the current main() call (module
+#: state so _obs_end can shut it down — a leaked bound port would fail
+#: the next in-process invocation with EADDRINUSE).
+_METRICS_SERVER = None
+
+
 def _obs_begin(args) -> bool:
     """Turn telemetry on when the run asked for an observability artifact
-    (--trace-out / --stats-out; DEMI_OBS=1 enables it regardless)."""
+    (--trace-out / --stats-out; DEMI_OBS=1 enables it regardless).
+    ``--metrics-port`` additionally serves the live registry over HTTP
+    (Prometheus text at /metrics), and ``--journal DIR`` attaches the
+    continuous round journal for runs without a checkpoint dir (a
+    ``--checkpoint-dir`` run journals into that dir automatically)."""
     if getattr(args, "trace_out", None) or getattr(args, "stats_out", None):
         obs.enable()
+    if getattr(args, "metrics_port", None) is not None:
+        from .obs import timeseries
+
+        obs.enable()
+        global _METRICS_SERVER
+        _METRICS_SERVER = timeseries.serve(args.metrics_port)
+        print(
+            "metrics: serving http://127.0.0.1:"
+            f"{_METRICS_SERVER.server_address[1]}/metrics",
+            flush=True,
+        )
+    if getattr(args, "journal", None) and not getattr(
+        args, "checkpoint_dir", None
+    ):
+        obs.journal.attach(args.journal)
     return obs.enabled()
+
+
+def _cleanup_continuous() -> None:
+    """Idempotent teardown of the continuous-obs resources one
+    ``main()`` call must not leak into the next: shut down the
+    ``--metrics-port`` server (a leaked bound port fails the next
+    invocation with EADDRINUSE), flush the time-series delta next to
+    the journal, detach the journal. Shared by ``_obs_end`` (normal
+    exit) and ``main``'s finally (exception exit) so the two paths can
+    never drift."""
+    global _METRICS_SERVER
+    if _METRICS_SERVER is not None:
+        _METRICS_SERVER.shutdown()
+        _METRICS_SERVER.server_close()
+        _METRICS_SERVER = None
+    if obs.journal.attached():
+        from .obs import timeseries
+
+        timeseries.SERIES.flush_jsonl(obs.journal.JOURNAL.root)
+        obs.journal.detach()
 
 
 def _obs_end(args, experiment_dir: Optional[str] = None) -> None:
     """Export the run's observability artifacts: Perfetto trace and/or
     registry snapshot, plus obs_snapshot.json into the experiment dir so
-    `demi_tpu report` / `demi_tpu stats` can pick it up later."""
+    `demi_tpu report` / `demi_tpu stats` can pick it up later; the
+    continuous-obs resources (journal, time series, metrics server) are
+    torn down."""
+    _cleanup_continuous()
     if not obs.enabled():
         return
     if getattr(args, "trace_out", None):
@@ -192,6 +240,50 @@ def _sanitize_end(token) -> None:
     print(f"sanitizer: {json.dumps(sanitize.stats())}")
 
 
+def _profile_begin(args) -> bool:
+    """``--profile-rounds N``: arm the launch profiler (per-launch wall
+    attribution keyed by launch shape — obs/profiler.py) and open a
+    jax.profiler trace window over the first N round boundaries, written
+    to ``--profile-trace`` (default ./demi_profile)."""
+    rounds = getattr(args, "profile_rounds", 0) or 0
+    if not rounds:
+        return False
+    from .obs.profiler import PROFILER
+
+    PROFILER.enable()
+    logdir = getattr(args, "profile_trace", None) or "demi_profile"
+    PROFILER.start_trace_window(logdir, rounds)
+    return True
+
+
+def _profile_end(args, summary: dict, app, cfg) -> None:
+    """Close the trace window, fold the launch ledger into the summary,
+    and persist it in TuningCache-compatible form under the workload key
+    (extra discriminator ``profile=launch``) so the launch-economy cost
+    model consumes measured evidence instead of re-profiling."""
+    if not (getattr(args, "profile_rounds", 0) or 0):
+        return
+    import jax
+
+    from .obs.profiler import PROFILER, profile_enabled
+    from .tune import TuningCache, workload_key
+
+    PROFILER.stop_trace_window()
+    evidence = PROFILER.evidence()
+    summary["launch_profile"] = evidence
+    cache = TuningCache()
+    key = workload_key(
+        app.name, app.num_actors, cfg, jax.devices()[0].platform,
+        profile="launch", **_workload_discriminator(args),
+    )
+    PROFILER.persist_evidence(cache, key)
+    summary["launch_profile_cache"] = {"key": key, "path": cache.path}
+    # One main() call must not leak profiling into the next (tests run
+    # the CLI in-process); the env switch re-arms it when set.
+    PROFILER.reset()
+    PROFILER.enabled = profile_enabled()
+
+
 def _strict_io_begin(args) -> None:
     """``--strict-io``: degradations (native analyzer → NumPy twin,
     exhausted launch retries) become hard errors. Same env-switch
@@ -227,6 +319,43 @@ def _resume_args(args, command: str) -> dict:
     return {
         f: getattr(args, f, None) for f in _RESUME_FIELDS[command]
     }
+
+
+def _attach_checkpoint_journal(args, ckpt, kind: str, cursor: int) -> int:
+    """The ONE resume-continuity contract for every checkpointed
+    command: attach the round journal to the checkpoint dir with the
+    next incarnation, and on a resume drop what the dead run wrote past
+    the restored generation — ``kind`` records beyond ``cursor`` (those
+    rounds/chunks/executions re-execute and re-journal) plus flushed
+    time-series samples newer than the generation (by its MANIFEST
+    mtime). Returns the incarnation for the checkpoint meta."""
+    incarnation = (
+        int(ckpt.meta.get("incarnation", 0)) + 1 if ckpt is not None else 0
+    )
+    journal = obs.journal.attach(
+        args.checkpoint_dir, incarnation=incarnation
+    )
+    if ckpt is not None:
+        journal.truncate_from(kind, cursor)
+        from .obs import timeseries
+
+        try:
+            cutoff = os.path.getmtime(
+                os.path.join(ckpt.path, "MANIFEST.json")
+            )
+        except OSError:
+            return incarnation
+        timeseries.truncate_after(args.checkpoint_dir, cutoff)
+    return incarnation
+
+
+def _flush_samples(root: str) -> None:
+    """Flush the time-series delta next to the journal (called at the
+    same cadence as checkpoint saves, so the export's loss window is
+    bounded by the snapshot cadence)."""
+    from .obs import timeseries
+
+    timeseries.SERIES.flush_jsonl(root)
 
 
 def _restore_obs(ckpt) -> None:
@@ -343,6 +472,17 @@ def _dpor_checkpoint_run(args, app, cfg) -> int:
         _restore_obs(ckpt)
         resumed = True
     every = max(1, getattr(args, "checkpoint_every", None) or 5)
+    # Continuous observability: the round journal lives IN the
+    # checkpoint dir (one artifact to point `demi_tpu top` at), and a
+    # resume continues it round-contiguously — pinned by
+    # tests/test_persist.py and the kill-resume soak. Older checkpoints
+    # carry no round_index; pin it to the restored round count either
+    # way.
+    incarnation = _attach_checkpoint_journal(
+        args, ckpt, "dpor.round", rounds_done
+    )
+    dpor.round_index = rounds_done
+    _profile_begin(args)
 
     def save_ckpt(extra_meta=None) -> None:
         store.save(
@@ -362,9 +502,11 @@ def _dpor_checkpoint_run(args, app, cfg) -> int:
                 },
                 "rounds_done": rounds_done,
                 "checkpoint_every": every,
+                "incarnation": incarnation,
                 **(extra_meta or {}),
             },
         )
+        _flush_samples(args.checkpoint_dir)
 
     found = None
     print(
@@ -417,6 +559,7 @@ def _dpor_checkpoint_run(args, app, cfg) -> int:
         summary["host_share"] = round(dpor.host_share, 3)
     if dpor.sleep_stats is not None:
         summary["sleep_sets"] = dpor.sleep_stats
+    _profile_end(args, summary, app, cfg)
     # Terminal generation: final state + summary + completed marker, so
     # a resume of a finished run reports instead of re-exploring.
     save_ckpt({"completed": True, "summary": summary})
@@ -466,6 +609,13 @@ def _sweep_checkpoint_run(args, app, cfg, fuzzer) -> int:
         resumed = True
     hashes = set(int(h) for h in state["unique_hashes"])
     every = max(1, getattr(args, "checkpoint_every", None) or 5)
+    # Round journal in the checkpoint dir, chunk-contiguous across
+    # resumes (same contract as the DPOR loop; the driver continues the
+    # restored chunk numbering).
+    incarnation = _attach_checkpoint_journal(
+        args, ckpt, "sweep.chunk", int(state["chunks"])
+    )
+    driver.chunk_index = int(state["chunks"])
 
     def save_ckpt() -> None:
         state["unique_hashes"] = sorted(hashes)
@@ -477,8 +627,10 @@ def _sweep_checkpoint_run(args, app, cfg, fuzzer) -> int:
                 "cli_args": _resume_args(args, "sweep"),
                 "seeds_done": state["seeds_done"],
                 "checkpoint_every": every,
+                "incarnation": incarnation,
             },
         )
+        _flush_samples(args.checkpoint_dir)
 
     print(
         f"sweep: checkpointing to {args.checkpoint_dir} every {every} "
@@ -560,6 +712,11 @@ def _fuzz_checkpoint_run(args, app, config, fuzzer, controller) -> int:
         _restore_obs(ckpt)
         resumed = True
     every = max(1, getattr(args, "checkpoint_every", None) or 25)
+    # Round journal in the checkpoint dir, execution-contiguous across
+    # resumes (runner.fuzz numbers records from start_execution).
+    incarnation = _attach_checkpoint_journal(
+        args, ckpt, "fuzz.execution", start
+    )
 
     def save_ckpt(done: int, extra_meta=None) -> None:
         store.save(
@@ -580,9 +737,11 @@ def _fuzz_checkpoint_run(args, app, config, fuzzer, controller) -> int:
                 "cli_args": _resume_args(args, "fuzz"),
                 "executions_done": done,
                 "checkpoint_every": every,
+                "incarnation": incarnation,
                 **(extra_meta or {}),
             },
         )
+        _flush_samples(args.checkpoint_dir)
 
     print(
         f"fuzz: checkpointing to {args.checkpoint_dir} every {every} "
@@ -1107,6 +1266,7 @@ def cmd_dpor(args) -> int:
             True if getattr(args, "sleep_sets", False) else None
         ),
     )
+    _profile_begin(args)
     with obs.span("cli.dpor", app=args.app):
         trace = oracle.test(program, None)
     summary = {
@@ -1114,6 +1274,7 @@ def cmd_dpor(args) -> int:
         "violation_found": trace is not None,
         "deliveries": len(trace.deliveries()) if trace is not None else None,
     }
+    _profile_end(args, summary, app, cfg)
     if oracle.host_share() is not None:
         # Host-vs-device wall split across the frontier rounds (also the
         # dpor.host_share gauge under DEMI_OBS).
@@ -1370,7 +1531,13 @@ def cmd_stats(args) -> int:
         for path in inputs:
             with open(path) as f:
                 snaps.append(json.load(f))
-        print(json.dumps(obs.merge_snapshots(*snaps), indent=2, sort_keys=True))
+        merged = obs.merge_snapshots(*snaps)
+        if getattr(args, "prom", False):
+            from .obs.timeseries import prom_text
+
+            print(prom_text(merged), end="")
+        else:
+            print(json.dumps(merged, indent=2, sort_keys=True))
         return 0
 
     obs.enable()
@@ -1404,8 +1571,24 @@ def cmd_stats(args) -> int:
             app, cfg, lambda s: fuzzer.generate_fuzz_test(seed=args.seed + s)
         )
         driver.sweep(args.batch, args.batch, mode="chunked")
-    print(obs.REGISTRY.to_json())
+    if getattr(args, "prom", False):
+        from .obs.timeseries import prom_text
+
+        print(prom_text(obs.REGISTRY.snapshot()), end="")
+    else:
+        print(obs.REGISTRY.to_json())
     return 0
+
+
+def cmd_top(args) -> int:
+    """Live terminal dashboard over a run's round journal (demi_tpu.obs
+    journal wire format; `--once` renders a single frame for CI/pipes)."""
+    from .tools.top import run_top
+
+    return run_top(
+        args.dir, once=args.once, interval=args.interval,
+        window=args.window,
+    )
 
 
 def cmd_interactive(args) -> int:
@@ -1447,6 +1630,20 @@ def main(argv: Optional[list] = None) -> int:
             "--stats-out", default=None, dest="stats_out", metavar="PATH",
             help="enable telemetry and write the metrics-registry "
                  "snapshot JSON (readable via `demi_tpu stats -i`)",
+        )
+        p.add_argument(
+            "--journal", default=None, metavar="DIR",
+            help="continuous observability: append one JSONL record per "
+                 "round/chunk/level to DIR/journal.jsonl (crash-safe, "
+                 "rotation-bounded; tail it with `demi_tpu top DIR`). "
+                 "Runs with --checkpoint-dir journal there automatically",
+        )
+        p.add_argument(
+            "--metrics-port", type=int, default=None, dest="metrics_port",
+            metavar="PORT",
+            help="enable telemetry and serve the live registry over "
+                 "HTTP: Prometheus text at /metrics, snapshot JSON at "
+                 "/metrics.json (0 binds an ephemeral port)",
         )
 
     def tune_flags(p):
@@ -1648,6 +1845,21 @@ def main(argv: Optional[list] = None) -> int:
     )
     checkpoint_flags(p, 5, "rounds")
     strict_io_flags(p)
+    p.add_argument(
+        "--profile-rounds", type=int, default=0, dest="profile_rounds",
+        metavar="N",
+        help="launch profiler: attribute wall time per kernel launch "
+             "(trunk vs lane vs harvest, dispatch vs block, keyed by "
+             "launch shape), open a jax.profiler trace window over the "
+             "first N rounds, and persist the evidence to the tuning "
+             "cache (profile=launch) for the launch-economy cost model",
+    )
+    p.add_argument(
+        "--profile-trace", default=None, dest="profile_trace",
+        metavar="DIR",
+        help="jax.profiler trace output dir for --profile-rounds "
+             "(default ./demi_profile; load in TensorBoard/xprof)",
+    )
     p.set_defaults(fn=cmd_dpor)
 
     p = sub.add_parser(
@@ -1710,7 +1922,29 @@ def main(argv: Optional[list] = None) -> int:
         "--max-executions", type=int, default=8, dest="max_executions",
         help="host fuzz executions in the smoke workload",
     )
+    p.add_argument(
+        "--prom", action="store_true",
+        help="print the Prometheus text exposition instead of JSON "
+             "(the format --metrics-port serves at /metrics)",
+    )
     p.set_defaults(fn=cmd_stats)
+
+    p = sub.add_parser(
+        "top",
+        help="live dashboard tailing a run's round journal "
+             "(checkpoint dir or --journal dir); --once for one frame",
+    )
+    p.add_argument("dir", help="directory being journaled")
+    p.add_argument(
+        "--once", action="store_true",
+        help="render a single frame and exit (no TTY needed)",
+    )
+    p.add_argument("--interval", type=float, default=1.0, metavar="SECONDS")
+    p.add_argument(
+        "--window", type=int, default=30, metavar="N",
+        help="sliding window (records) for the rate numbers",
+    )
+    p.set_defaults(fn=cmd_top)
 
     p = sub.add_parser("report", help="markdown report of a saved experiment")
     p.add_argument("-e", "--experiment", required=True)
@@ -1768,7 +2002,12 @@ def main(argv: Optional[list] = None) -> int:
     p.set_defaults(fn=cmd_interactive)
 
     args = parser.parse_args(argv)
-    return args.fn(args)
+    try:
+        return args.fn(args)
+    finally:
+        # Commands that finish normally already ran _obs_end; this
+        # catches the exception exits (idempotent).
+        _cleanup_continuous()
 
 
 if __name__ == "__main__":

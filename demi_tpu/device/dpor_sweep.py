@@ -1382,6 +1382,14 @@ class DeviceDPOR:
         # rounds (always tracked — one np.unique per round): the
         # violation-set preservation surface the sleep-set A/B asserts.
         self.violation_codes: Set[int] = set()
+        # Continuous observability (obs/journal.py): rounds executed so
+        # far (1-based after the first round; checkpointed + restored so
+        # a resumed journal stays generation-contiguous) and the last
+        # round's local stats, stashed by _process_round for the journal
+        # record — a tiny always-on dict, measured inside bench config
+        # 11's <1% budget.
+        self.round_index = 0
+        self._last_round: Dict[str, object] = {}
         # Measurement-guided budget control (demi_tpu/tune): when set, the
         # tuner sees each round's fresh/redundant/pruned prescription
         # counts and adjusts max_distance and round_batch online. The
@@ -1593,19 +1601,28 @@ class DeviceDPOR:
         (prescription-free pads included) runs the scratch kernel.
         Per-lane keys follow batch position on both paths, so per-lane
         results are bit-identical."""
+        from ..obs.profiler import PROFILER
+
         sleeps = self._pack_sleep(batch) if self.sleep is not None else None
         sfrom = self._sleep_from(batch) if sleeps is not None else None
         if self._forker is None or len(batch) < 2:
+            t0 = time.perf_counter() if PROFILER.enabled else 0.0
             if sleeps is None:
-                return [
+                out = [
                     (None, self.kernel(self._progs(len(batch)), prescs, keys))
                 ]
-            return [(
-                None,
-                self.kernel(
-                    self._progs(len(batch)), prescs, keys, sleeps, sfrom
-                ),
-            )]
+            else:
+                out = [(
+                    None,
+                    self.kernel(
+                        self._progs(len(batch)), prescs, keys, sleeps, sfrom
+                    ),
+                )]
+            if PROFILER.enabled:
+                PROFILER.dispatch(
+                    "dpor", len(batch), time.perf_counter() - t0
+                )
+            return out
         from .fork import padded_size, prefix_digest
 
         keys = np.asarray(keys)
@@ -1660,6 +1677,7 @@ class DeviceDPOR:
             trunk_presc[: g.prefix_len] = plan_rows[
                 g.indices[0], : g.prefix_len
             ]
+            t0 = time.perf_counter() if PROFILER.enabled else 0.0
             snap, trunk_steps, hit = self._forker.trunk_hier_prescribed(
                 g.key,
                 ExtProgram(*(np.asarray(x) for x in self.prog)),
@@ -1667,9 +1685,15 @@ class DeviceDPOR:
                 jax.random.PRNGKey(0),
                 g.prefix_len,
             )
+            if PROFILER.enabled:
+                PROFILER.trunk(
+                    "dpor-trunk", 1, time.perf_counter() - t0,
+                    shape=f"p={g.prefix_len}",
+                )
             full = g.indices + [g.indices[0]] * (
                 padded_size(len(g.indices), self._mesh) - len(g.indices)
             )
+            t0 = time.perf_counter() if PROFILER.enabled else 0.0
             if sleeps is None:
                 res_g = self._fork_kernel(
                     self._progs(len(full)), prescs[full], keys[full], snap
@@ -1679,6 +1703,10 @@ class DeviceDPOR:
                     self._progs(len(full)), prescs[full], keys[full],
                     sleeps[full], sfrom[full], snap,
                 )
+            if PROFILER.enabled:
+                PROFILER.dispatch(
+                    "dpor-fork", len(full), time.perf_counter() - t0
+                )
             parts.append((g.indices, res_g))
             self._forker.note_group(len(g.indices), trunk_steps, hit)
             obs.histogram("dpor.prefix_group_size").observe(len(g.indices))
@@ -1686,6 +1714,7 @@ class DeviceDPOR:
             full = scratch + [scratch[0]] * (
                 padded_size(len(scratch), self._mesh) - len(scratch)
             )
+            t0 = time.perf_counter() if PROFILER.enabled else 0.0
             if sleeps is None:
                 res_s = self.kernel(
                     self._progs(len(full)), prescs[full], keys[full]
@@ -1694,6 +1723,10 @@ class DeviceDPOR:
                 res_s = self.kernel(
                     self._progs(len(full)), prescs[full], keys[full],
                     sleeps[full], sfrom[full],
+                )
+            if PROFILER.enabled:
+                PROFILER.dispatch(
+                    "dpor", len(full), time.perf_counter() - t0
                 )
             parts.append((scratch, res_s))
             self._forker.note_scratch(len(scratch))
@@ -1717,9 +1750,16 @@ class DeviceDPOR:
         """Block on a dispatched round's parts and merge them back into
         batch order (np arrays quack like the LaneResult — or
         DporSleepResult — the harvesting loops read)."""
+        from ..obs.profiler import PROFILER
+
+        t0 = time.perf_counter() if PROFILER.enabled else 0.0
         if len(parts) == 1 and parts[0][0] is None:
             res = parts[0][1]
             jax.block_until_ready(res.violation)
+            if PROFILER.enabled:
+                PROFILER.block(
+                    "dpor", batch_len, time.perf_counter() - t0
+                )
             return res
         res_type = type(parts[0][1])
         merged = {}
@@ -1732,6 +1772,8 @@ class DeviceDPOR:
                 merged[field][np.asarray(idx)] = np.asarray(
                     getattr(res, field)
                 )[: len(idx)]
+        if PROFILER.enabled:
+            PROFILER.block("dpor", batch_len, time.perf_counter() - t0)
         return res_type(**merged)
 
     def _process_round(
@@ -1781,9 +1823,8 @@ class DeviceDPOR:
         # Violation-set ledger (always on — one np.unique per round):
         # every distinct nonzero code any lane of any round produced,
         # the preservation surface the sleep-set A/B asserts against.
-        for code in np.unique(violations):
-            if code != 0:
-                self.violation_codes.add(int(code))
+        round_codes = [int(c) for c in np.unique(violations) if c != 0]
+        self.violation_codes.update(round_codes)
         hit_mask = (
             violations != 0
             if target_code is None
@@ -1806,6 +1847,16 @@ class DeviceDPOR:
             fresh_n, redundant_n, pruned_n = self._derive_legacy(
                 traces, lens, len(batch), frontier, batch=batch, res=res
             )
+        # Round-local stats for the journal record (obs/journal.py):
+        # stashed always — a handful of ints next to a kernel launch.
+        self._last_round = {
+            "batch": len(batch),
+            "depth": max((len(p) for p in batch), default=0),
+            "fresh": int(fresh_n),
+            "redundant": int(redundant_n),
+            "distance_pruned": int(pruned_n),
+            "violations": round_codes,
+        }
         if redundant_n:
             obs.counter("dpor.prescriptions_redundant").inc(redundant_n)
         if pruned_n:
@@ -2198,15 +2249,71 @@ class DeviceDPOR:
             if share is not None:
                 obs.gauge("dpor.host_share").set(share)
 
-    def _account_round(self, round_t0: float, device_secs: float) -> None:
+    def _account_round(
+        self, round_t0: float, device_secs: float
+    ) -> Tuple[float, float]:
         """Fold one frontier round's wall time into the host/device
         split: ``device_secs`` is the harvest-blocked span, the rest of
         the iteration is host work (selection, packing, dispatch prep,
         racing analysis, dedup). Always tracked (two clock reads); the
-        ``dpor.host_*`` obs series mirror it when telemetry is on."""
+        ``dpor.host_*`` obs series mirror it when telemetry is on.
+        Returns the (host, device) seconds so the journal record can
+        carry the per-round split."""
         host_secs = max(0.0, time.perf_counter() - round_t0 - device_secs)
         self._account_device(device_secs)
         self._account_host(host_secs)
+        return host_secs, device_secs
+
+    def _journal_round(
+        self, host_secs: float, device_secs: float, frontier: int
+    ) -> None:
+        """One generation-stamped journal record per frontier round —
+        the continuous-observability wire format (obs/journal.py):
+        per-round wall/host/device seconds, frontier size and depth,
+        fresh/redundant/pruned admission counts, in-flight economy, fork
+        economy, and the round's violation codes. Called after every
+        ``_account_round``; a detached journal costs one branch."""
+        self.round_index += 1
+        obs.profiler.PROFILER.tick_round()
+        if obs.journal.JOURNAL is None:
+            return
+        lr = self._last_round
+        rec: Dict[str, object] = {
+            "round": self.round_index,
+            "wall_s": round(host_secs + device_secs, 6),
+            "host_s": round(host_secs, 6),
+            "device_s": round(device_secs, 6),
+            "batch": lr.get("batch", 0),
+            "depth": lr.get("depth", 0),
+            "fresh": lr.get("fresh", 0),
+            "redundant": lr.get("redundant", 0),
+            "distance_pruned": lr.get("distance_pruned", 0),
+            "violations": lr.get("violations", []),
+            "frontier": frontier,
+            "interleavings": self.interleavings,
+            "explored": len(self.explored),
+            "inflight_hits": self.async_stats["inflight_hits"],
+            "inflight_waste": self.async_stats["inflight_waste"],
+        }
+        if self.static_independence is not None:
+            rec["static_pruned"] = int(
+                sum(self.static_independence.pruned_total.values())
+            )
+        if self.sleep is not None:
+            rec["sleep_pruned"] = int(
+                sum(self.sleep.pruned_total.values())
+            )
+            ratio = self.sleep.redundancy_ratio(len(self.explored))
+            if ratio is not None:
+                rec["redundancy_ratio"] = round(ratio, 4)
+        if self._forker is not None:
+            st = self._forker.stats_view()
+            rec["fork"] = {
+                "prefix_hits": st.get("prefix_hits", 0),
+                "steps_saved": st.get("steps_saved", 0),
+                "forked_lanes": st.get("forked_lanes", 0),
+            }
+        obs.journal.emit("dpor.round", **rec)
 
     def explore(
         self, target_code: Optional[int] = None, max_rounds: int = 20
@@ -2303,7 +2410,8 @@ class DeviceDPOR:
                     self._note_inflight("waste")
                 obs.counter("dpor.violations_found").inc()
                 found = hit
-                self._account_round(round_t0, dev_secs)
+                h, d = self._account_round(round_t0, dev_secs)
+                self._journal_round(h, d, len(gen) + len(pending))
                 break
             if spec is not None:
                 sbatch, sparts, sreal, s_prescs, s_keys = spec
@@ -2319,7 +2427,8 @@ class DeviceDPOR:
                     gen, pending = arest, mpending
                 else:
                     self._note_inflight("waste")
-            self._account_round(round_t0, dev_secs)
+            h, d = self._account_round(round_t0, dev_secs)
+            self._journal_round(h, d, len(gen) + len(pending))
         if inflight is not None:
             # The round budget expired with a speculative round still on
             # device: it was never harvested, so its prescriptions go

@@ -13,6 +13,7 @@ across thousands of lanes at once.
 
 from __future__ import annotations
 
+import time
 from typing import NamedTuple, Optional, Tuple
 
 import jax
@@ -535,7 +536,10 @@ def _counted_kernel(kernel, name: str):
     records launches/lanes only — no block_until_ready, so async dispatch
     (the double-buffered sweep path) keeps overlapping. Telemetry off =
     one branch per LAUNCH (not per lane/step), so the bench headline is
-    untouched."""
+    untouched. Under the launch profiler (DEMI_PROFILE=1 /
+    --profile-rounds) the async-visible DISPATCH cost — tracing plus
+    enqueue, never the device wait — is attributed per launch shape."""
+    from ..obs.profiler import PROFILER
 
     def call(progs, keys, *rest):
         if obs.enabled():
@@ -543,6 +547,13 @@ def _counted_kernel(kernel, name: str):
             obs.counter("device.kernel.lanes").inc(
                 int(keys.shape[0]), kernel=name
             )
+        if PROFILER.enabled:
+            t0 = time.perf_counter()
+            out = kernel(progs, keys, *rest)
+            PROFILER.dispatch(
+                name, int(keys.shape[0]), time.perf_counter() - t0
+            )
+            return out
         return kernel(progs, keys, *rest)
 
     return call

@@ -11,6 +11,7 @@ replaying a whole ddmin level's candidate subsequences as one vmapped batch.
 
 from __future__ import annotations
 
+import time
 from typing import Any, List, Optional, Sequence
 
 from .. import obs
@@ -250,6 +251,7 @@ class BatchedDDMin(Minimizer):
             for cand in candidates:
                 self.stats.record_replay()
                 self.stats.record_iteration_size(len(cand.get_all_events()))
+            t_level = time.perf_counter()
             with obs.span(
                 "ddmin.level", granularity=n, candidates=len(candidates)
             ):
@@ -296,6 +298,18 @@ class BatchedDDMin(Minimizer):
                 (i for i, ok in enumerate(verdicts) if ok), None
             )
             self._pred_adopt = adopted_idx
+            # One journal record per ddmin level (obs/journal.py): the
+            # minimizer's round-boundary in the continuous wire format.
+            obs.journal.emit(
+                "minimize.level",
+                stage="ddmin",
+                round=self.levels,
+                wall_s=round(time.perf_counter() - t_level, 6),
+                candidates=len(candidates),
+                granularity=n,
+                externals=len(atoms),
+                adopted=adopted_idx is not None,
+            )
             if adopted_idx is not None:
                 current = candidates[adopted_idx]
                 # Subset adopted -> restart at coarse granularity;

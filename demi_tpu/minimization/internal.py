@@ -14,6 +14,7 @@ batch instead of one-at-a-time.
 
 from __future__ import annotations
 
+import time
 from typing import Any, Callable, List, Optional, Sequence, Tuple
 
 from .. import obs
@@ -247,6 +248,7 @@ class BatchedInternalMinimizer:
         self.stats.update_strategy("BatchedOneAtATime", "DeviceReplay")
         self.stats.record_prune_start()
         last_failing = initial_failing
+        rounds_run = 0
         for _ in range(self.max_rounds):
             if self.budget.exhausted():
                 self.stats.record_budget_exhausted()
@@ -255,6 +257,7 @@ class BatchedInternalMinimizer:
             if not indices:
                 break
             candidates = [remove_delivery(last_failing, i) for i in indices]
+            t_round = time.perf_counter()
             with obs.span("intmin.round", candidates=len(candidates)):
                 if use_async:
                     adopted = self._async_round(
@@ -265,6 +268,18 @@ class BatchedInternalMinimizer:
                     adopted = next(
                         (r for r in results if r is not None), None
                     )
+            rounds_run += 1
+            # One journal record per internal-minimization level
+            # (obs/journal.py, continuous wire format).
+            obs.journal.emit(
+                "minimize.level",
+                stage="intmin",
+                round=rounds_run,
+                wall_s=round(time.perf_counter() - t_round, 6),
+                candidates=len(candidates),
+                deliveries=len(last_failing.deliveries()),
+                adopted=adopted is not None,
+            )
             obs.counter("minimize.internal.batched_trials").inc(
                 len(candidates)
             )

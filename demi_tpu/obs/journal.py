@@ -1,0 +1,306 @@
+"""Round journal: a crash-safe, rotation-bounded JSONL stream of
+exploration progress — one record per DPOR frontier round, sweep chunk,
+or minimizer level.
+
+Where the metrics registry answers "what happened over the whole run"
+(one merged snapshot at exit), the journal answers "what is happening
+NOW": every round boundary appends one self-contained JSON line that a
+tail -f, `demi_tpu top`, or a fleet coordinator can consume while the
+run is still exploring. The JSONL line format is deliberately the wire
+format the fleet story needs — a worker's journal IS its progress feed.
+
+Guarantees:
+
+  - **Crash-safe**: records are appended line-at-a-time and flushed; a
+    SIGKILL mid-write leaves at most one torn final line, which the
+    reader skips (and counts). No fsync on the hot path — the journal is
+    telemetry, not the checkpoint; the durable truth lives in persist/.
+  - **Rotation-bounded**: past ``max_bytes`` the live segment rotates to
+    ``<name>.1`` (one previous segment kept), so an always-on soak keeps
+    a bounded window of recent rounds instead of an unbounded log.
+  - **Resume-contiguous**: records carry a per-emitter ``round`` index
+    and an ``inc`` incarnation (bumped per resume). ``truncate_from``
+    drops the records a killed run wrote AFTER the checkpoint being
+    resumed, so a ``demi_tpu resume`` continues the same journal with no
+    duplicated and no missing rounds (tests/test_persist.py pins it).
+
+The journal is intentionally independent of the ``DEMI_OBS`` switch:
+its payloads come from the drivers' always-on local stats (host/device
+seconds, fresh/redundant counts, violation codes), so attaching a
+journal observes a run without changing what the run records elsewhere.
+Cost is one small dict + one json line per ROUND (not per lane or step)
+— measured < 1% of round wall on the deep raft frontier by
+``bench --config 11``, which is what lets it default on wherever a
+checkpoint directory already exists.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+#: Live journal segment name inside a run / checkpoint directory.
+JOURNAL_NAME = "journal.jsonl"
+
+#: Default rotation bound per segment (one rotated segment is kept, so
+#: the on-disk window is at most ~2x this).
+DEFAULT_MAX_BYTES = 8 * 1024 * 1024
+
+
+def _max_bytes() -> int:
+    try:
+        return int(
+            os.environ.get("DEMI_JOURNAL_MAX_MB", "")
+        ) * 1024 * 1024
+    except ValueError:
+        return DEFAULT_MAX_BYTES
+
+
+class RoundJournal:
+    """Append-only JSONL writer over ``<root>/journal.jsonl`` (see
+    module doc for the guarantees)."""
+
+    def __init__(
+        self,
+        root: str,
+        max_bytes: Optional[int] = None,
+        incarnation: int = 0,
+    ):
+        os.makedirs(root, exist_ok=True)
+        self.root = root
+        self.path = os.path.join(root, JOURNAL_NAME)
+        self.max_bytes = max_bytes if max_bytes is not None else _max_bytes()
+        self.incarnation = incarnation
+        self.seq = self._next_seq()
+        self.written = 0
+        self._f = None
+
+    # -- write --------------------------------------------------------------
+    def _next_seq(self) -> int:
+        last = -1
+        for rec in read_records(self.root):
+            last = max(last, rec.get("seq", -1))
+        return last + 1
+
+    def _file(self):
+        if self._f is None or self._f.closed:
+            self._f = open(self.path, "a")
+        return self._f
+
+    def emit(self, kind: str, **fields: Any) -> Dict[str, Any]:
+        """Append one record. ``kind`` names the boundary ("dpor.round",
+        "sweep.chunk", "minimize.level", ...); ``fields`` should include
+        the emitter's own 1-based ``round`` index for resume-contiguity
+        checks. Returns the record as written."""
+        rec = {
+            "seq": self.seq,
+            "t": round(time.time(), 6),
+            "inc": self.incarnation,
+            "kind": kind,
+        }
+        rec.update(fields)
+        self.seq += 1
+        line = json.dumps(rec, separators=(",", ":"), sort_keys=True)
+        f = self._file()
+        f.write(line + "\n")
+        f.flush()
+        self.written += 1
+        if f.tell() >= self.max_bytes:
+            self._rotate()
+        return rec
+
+    def _rotate(self) -> None:
+        self.close()
+        try:
+            os.replace(self.path, self.path + ".1")
+        except OSError:
+            pass
+
+    def close(self) -> None:
+        if self._f is not None and not self._f.closed:
+            self._f.close()
+        self._f = None
+
+    # -- resume -------------------------------------------------------------
+    def truncate_from(self, kind: str, round_index: int) -> int:
+        """Drop every ``kind`` record with ``round > round_index`` — the
+        rounds a killed run journaled AFTER the checkpoint generation now
+        being resumed (they will be re-executed and re-journaled). Both
+        segments are rewritten in place; returns the number of records
+        dropped. Re-derives ``seq`` so numbering stays monotonic."""
+        self.close()
+        dropped = rewrite_segments(
+            self.path,
+            lambda rec: not (
+                rec.get("kind") == kind
+                and rec.get("round", -1) > round_index
+            ),
+        )
+        self.seq = self._next_seq()
+        return dropped
+
+
+def rewrite_segments(base: str, keep) -> int:
+    """Rewrite both JSONL segments of ``base`` in place, keeping the
+    records ``keep(rec)`` accepts — the one filter-and-replace machinery
+    behind the journal's AND the time-series export's resume
+    truncation. Returns records dropped."""
+    dropped = 0
+    for path in (base + ".1", base):
+        if not os.path.exists(path):
+            continue
+        kept: List[str] = []
+        for line, rec in _read_lines(path):
+            if not keep(rec):
+                dropped += 1
+                continue
+            kept.append(line)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            for line in kept:
+                f.write(line + "\n")
+        os.replace(tmp, path)
+    return dropped
+
+
+def _read_lines(path: str) -> List[Tuple[str, Dict[str, Any]]]:
+    out: List[Tuple[str, Dict[str, Any]]] = []
+    try:
+        with open(path) as f:
+            lines = f.read().splitlines()
+    except OSError:
+        return out
+    for line in lines:
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            # Torn tail from a SIGKILL mid-write (or a corrupt line):
+            # skip — the journal is telemetry, every record is
+            # self-contained, and persist/ holds the durable truth.
+            continue
+        if isinstance(rec, dict):
+            out.append((line, rec))
+    return out
+
+
+def read_records(
+    root: str, kind: Optional[str] = None
+) -> List[Dict[str, Any]]:
+    """All parseable records under ``root`` (rotated segment first, so
+    the list is in write order), optionally filtered by kind. Torn or
+    corrupt lines are skipped. ``root`` may also be the journal file
+    itself."""
+    if os.path.isdir(root):
+        base = os.path.join(root, JOURNAL_NAME)
+    else:
+        base = root
+    recs: List[Dict[str, Any]] = []
+    for path in (base + ".1", base):
+        recs.extend(rec for _, rec in _read_lines(path))
+    if kind is not None:
+        recs = [r for r in recs if r.get("kind") == kind]
+    return recs
+
+
+def contiguous_rounds(
+    records: List[Dict[str, Any]], kind: str
+) -> Tuple[bool, List[int]]:
+    """Continuity check used by the kill-resume soak and tests: the
+    ``kind`` records' round indices must be exactly 1..N with no
+    duplicates and no gaps. Returns (ok, rounds-in-order)."""
+    rounds = [r.get("round") for r in records if r.get("kind") == kind]
+    ok = rounds == list(range(1, len(rounds) + 1))
+    return ok, rounds
+
+
+# ---------------------------------------------------------------------------
+# Process-wide attachment: drivers call ``emit`` unconditionally; it is
+# one branch when no journal is attached (the same contract as the
+# metrics registry's enabled-switch).
+# ---------------------------------------------------------------------------
+
+JOURNAL: Optional[RoundJournal] = None
+
+#: Kinds that also take a time-series registry sample at emit: the
+#: round-grained boundaries (one kernel launch or minimizer level per
+#: record). Fine-grained kinds — per-~ms host fuzz executions — journal
+#: only; sampling them would pay a full registry scan per execution and
+#: grow the (unrotated within one flush window) time-series export per
+#: execution instead of per round.
+_SAMPLED_KINDS = frozenset(
+    ("dpor.round", "sweep.chunk", "minimize.level", "minimize.stage")
+)
+
+
+def attach(
+    root: str,
+    incarnation: int = 0,
+    max_bytes: Optional[int] = None,
+) -> RoundJournal:
+    """Open (or continue) the journal under ``root`` and make it the
+    process-wide sink. ``incarnation`` should count resumes so records
+    from different process lifetimes are distinguishable."""
+    global JOURNAL
+    detach()
+    JOURNAL = RoundJournal(root, max_bytes=max_bytes, incarnation=incarnation)
+    from . import timeseries
+
+    # Samples share the journal's incarnation so (inc, seq) is unique
+    # across resumes (sample seq is per-process).
+    timeseries.SERIES.incarnation = incarnation
+    return JOURNAL
+
+
+def detach() -> None:
+    global JOURNAL
+    if JOURNAL is not None:
+        JOURNAL.close()
+    JOURNAL = None
+
+
+def attached() -> bool:
+    return JOURNAL is not None
+
+
+def emit(kind: str, **fields: Any) -> None:
+    """Record one round boundary into the attached journal, and sample
+    the time-series ring at the same boundary (see obs/timeseries.py).
+    With no journal attached this is one branch — the drivers call it
+    unconditionally per round, and nothing consumes ring samples that
+    were never going to be flushed, so an un-journaled DEMI_OBS=1 run
+    pays no per-round registry scan."""
+    global JOURNAL
+    if JOURNAL is None:
+        return
+    try:
+        JOURNAL.emit(kind, **fields)
+    except OSError as exc:
+        # The journal is telemetry, not the checkpoint: a full disk or
+        # yanked volume must never abort a healthy search. Warn, count
+        # (force-written — the snapshot must say the stream went dark),
+        # and detach so the run continues un-journaled.
+        import sys
+
+        from . import metrics as _m
+
+        _m.counter("obs.journal_write_errors").force_inc()
+        print(
+            f"demi_tpu.obs: journal write failed ({exc}); detaching — "
+            "the run continues without continuous telemetry",
+            file=sys.stderr,
+        )
+        try:
+            JOURNAL.close()
+        except OSError:
+            pass
+        JOURNAL = None
+        return
+    if kind in _SAMPLED_KINDS:
+        from . import timeseries
+
+        timeseries.SERIES.sample(kind=kind)

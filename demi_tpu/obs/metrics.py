@@ -87,23 +87,35 @@ class Counter:
 
 
 class Gauge:
-    """Last-write-wins labeled gauge (occupancy, frontier size, ...)."""
+    """Last-write-wins labeled gauge (occupancy, frontier size, ...).
+
+    Every write is stamped with wall time so CROSS-PROCESS merges are
+    order-independent: ``load`` keeps the series with the larger
+    ``(stamp, value)`` — the max of a total order, which makes merging
+    commutative and associative (the fleet prerequisite the merge-audit
+    property test pins). Snapshots without stamps (older writers) fall
+    back to plain last-write-wins."""
 
     def __init__(self, name: str):
         self.name = name
         self.series: Dict[str, float] = {}
+        self.stamps: Dict[str, float] = {}
 
     def set(self, v: float, **labels) -> None:
         if not _enabled:
             return
-        self.series[_label_key(labels)] = float(v)
+        key = _label_key(labels)
+        self.series[key] = float(v)
+        self.stamps[key] = time.time()
 
     def force_set(self, v: float, **labels) -> None:
         """Record regardless of the telemetry switch — the same direct
         series write ``load``/merge uses. For rare, load-bearing facts
         that must reach every snapshot (e.g. autotune decisions: a run
         that changed its own knobs must say so), never for hot paths."""
-        self.series[_label_key(labels)] = float(v)
+        key = _label_key(labels)
+        self.series[key] = float(v)
+        self.stamps[key] = time.time()
 
     def value(self, **labels) -> Optional[float]:
         return self.series.get(_label_key(labels))
@@ -217,9 +229,20 @@ class MetricsRegistry:
                 out["counters"][name] = dict(m.series)
             elif isinstance(m, Gauge):
                 out["gauges"][name] = dict(m.series)
+                if m.stamps:
+                    # Write stamps ride a parallel map so every existing
+                    # consumer of ["gauges"] keeps reading plain floats.
+                    out.setdefault("gauge_stamps", {})[name] = dict(
+                        m.stamps
+                    )
             else:
                 out["histograms"][name] = {
                     key: {
+                        # Bucket upper bounds ride along so a merge
+                        # across builds with different boundaries
+                        # re-bins by VALUE instead of by index
+                        # (bucket-alignment drift; see ``load``).
+                        "le": list(_BUCKETS),
                         "buckets": list(s[0]),
                         "count": s[1],
                         "sum": s[2],
@@ -232,26 +255,81 @@ class MetricsRegistry:
 
     def load(self, snap: Dict[str, Any]) -> None:
         """Merge a snapshot into this registry: counters and histogram
-        buckets add, gauges last-write-win. Merging is how multi-process
-        sweeps (parallel/distributed.py shape) aggregate telemetry."""
+        buckets add, gauges keep the larger ``(stamp, value)`` (falling
+        back to last-write-wins for stamp-less legacy snapshots).
+        Counter adds, stamped-gauge max, and bucket-wise histogram adds
+        are each commutative and associative, so merging any number of
+        per-process snapshots in any order or grouping yields one answer
+        — the fleet-aggregation contract the merge-audit property test
+        pins. Merging is how multi-process sweeps
+        (parallel/distributed.py shape) aggregate telemetry."""
         for name, series in snap.get("counters", {}).items():
             c = self.counter(name)
             for key, v in series.items():
                 c.series[key] = c.series.get(key, 0) + v
         for name, series in snap.get("gauges", {}).items():
-            self.gauge(name).series.update(series)
+            g = self.gauge(name)
+            stamps = snap.get("gauge_stamps", {}).get(name, {})
+            for key, v in series.items():
+                ts = stamps.get(key)
+                cur_ts = g.stamps.get(key)
+                if key in g.series:
+                    if ts is None and cur_ts is not None:
+                        # A missing stamp ranks as -inf: a stamped value
+                        # always beats a legacy stamp-less one, in BOTH
+                        # merge orders — mixing build eras stays
+                        # commutative. (Stamp-less vs stamp-less is the
+                        # documented last-write-wins fallback.)
+                        continue
+                    if ts is not None and cur_ts is not None and (
+                        (ts, v) < (cur_ts, g.series[key])
+                    ):
+                        # Max under the (stamp, value) total order —
+                        # deterministic whichever side loads first.
+                        continue
+                g.series[key] = v
+                if ts is not None:
+                    g.stamps[key] = ts
+                else:
+                    g.stamps.pop(key, None)
         for name, series in snap.get("histograms", {}).items():
             h = self.histogram(name)
             for key, rec in series.items():
                 s = h._series(key)
-                for i, n in enumerate(rec["buckets"]):
-                    s[0][i] += n
+                self._merge_buckets(s[0], rec)
                 s[1] += rec["count"]
                 s[2] += rec["sum"]
                 if rec["min"] is not None:
                     s[3] = min(s[3], rec["min"])
                 if rec["max"] is not None:
                     s[4] = max(s[4], rec["max"])
+
+    @staticmethod
+    def _merge_buckets(local: List[float], rec: Dict[str, Any]) -> None:
+        """Bucket-wise add, aligned by VALUE. A snapshot from a build
+        with different log2 boundaries (or a truncated/extended bucket
+        list) used to add index-wise — silently shifting every count one
+        bucket over, or raising — so counts are re-binned through the
+        recorded ``le`` bounds: each foreign bucket lands in the first
+        local bucket whose bound covers it, drift past the local range
+        lands in overflow. Identical bounds take the fast exact path."""
+        bounds = rec.get("le")
+        counts = rec["buckets"]
+        if bounds is None or tuple(bounds) == _BUCKETS:
+            n_local = len(local)
+            for i, n in enumerate(counts):
+                local[min(i, n_local - 1)] += n
+            return
+        import bisect
+
+        for i, n in enumerate(counts):
+            if not n:
+                continue
+            if i < len(bounds):
+                b = bisect.bisect_left(_BUCKETS, bounds[i])
+            else:
+                b = len(_BUCKETS)  # the foreign overflow bucket
+            local[min(b, len(local) - 1)] += n
 
     def to_json(self) -> str:
         return json.dumps(self.snapshot(), indent=2, sort_keys=True)

@@ -154,15 +154,38 @@ class span:
         if not self._live:
             return
         self._live = False
-        stack = _local.stack
-        assert stack and stack[-1] is self, "span stack discipline violated"
-        stack.pop()
         if exc_type is not None:
             self.args["error"] = exc_type.__name__
-        TRACER.record(
-            self.name, self._ts, max(0, _now_us() - self._ts),
-            threading.get_ident() & 0xFFFF, self._op, next(_ops), self.args,
-        )
+        end = _now_us()
+        tid = threading.get_ident() & 0xFFFF
+        try:
+            # Stack repair instead of an assert: a stage that raised
+            # past a manually-entered inner span (or any misnested
+            # usage) must not trade the real exception for an
+            # AssertionError — and must not leave the inner span's B
+            # event orphaned in the export. Pop down to self, closing
+            # every abandoned inner span with an end event at 'now'.
+            stack = getattr(_local, "stack", None)
+            if stack and self in stack:
+                while stack:
+                    top = stack.pop()
+                    if top is self:
+                        break
+                    top._live = False
+                    top.args.setdefault("error", "orphaned")
+                    TRACER.record(
+                        top.name, top._ts, max(0, end - top._ts), tid,
+                        top._op, next(_ops), top.args,
+                    )
+        finally:
+            # The end event is emitted from a finally so a raising
+            # handler/stage can never orphan this span's B/E pair —
+            # Perfetto trace validity under exceptions is pinned by
+            # tests/test_obs.py.
+            TRACER.record(
+                self.name, self._ts, max(0, end - self._ts), tid,
+                self._op, next(_ops), self.args,
+            )
 
     def set(self, **args) -> None:
         """Attach result attributes discovered mid-span."""

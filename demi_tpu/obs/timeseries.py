@@ -1,0 +1,305 @@
+"""Per-round time series over the metrics registry, with delta export
+and Prometheus text exposition.
+
+The registry (obs/metrics.py) is cumulative — one number per series for
+the whole run. This module adds the TIME axis: at every round boundary
+(``obs.journal.emit`` calls ``SERIES.sample``) the registry's scalar
+view is appended to a bounded ring buffer, so "rounds/sec over the last
+minute" and "is the frontier still growing" are answerable while the
+run is live, and ``demi_tpu top`` / ``tools/stats_graph.py`` can render
+trends instead of totals.
+
+Three consumers, one buffer:
+
+  - **Delta export** (``export_delta`` / ``flush_jsonl``): samples since
+    the last export, appended as JSONL next to the round journal —
+    the file ``tools/stats_graph.py`` graphs.
+  - **Prometheus exposition** (``prom_text``): the standard text format
+    over a registry snapshot — ``demi_tpu stats --prom`` prints it, and
+    ``--metrics-port`` serves it at ``/metrics`` for a scraper.
+  - **In-process ring** (``SERIES.rows()``): the live dashboard's data.
+
+The ring is bounded (default 4096 samples — hours of rounds) and
+sampling is one pass over the registry's families per ROUND, so the
+always-on cost rides inside bench config 11's < 1% budget.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+from . import metrics as _metrics
+from .journal import _max_bytes
+
+
+def registry_scalars(
+    registry: Optional[_metrics.MetricsRegistry] = None,
+) -> Dict[str, float]:
+    """Flat scalar view of a registry: one entry per labeled series —
+    ``name`` for unlabeled, ``name{k=v,...}`` for labeled; histograms
+    contribute ``name.count`` and ``name.sum``. This is the sample row
+    format (and the series naming the dashboard shows)."""
+    registry = registry or _metrics.REGISTRY
+    out: Dict[str, float] = {}
+    for name, m in sorted(registry._metrics.items()):
+        if isinstance(m, (_metrics.Counter, _metrics.Gauge)):
+            for key, v in m.series.items():
+                out[f"{name}{{{key}}}" if key else name] = float(v)
+        elif isinstance(m, _metrics.Histogram):
+            for key, s in m.series.items():
+                base = f"{name}{{{key}}}" if key else name
+                out[base + ".count"] = float(s[1])
+                out[base + ".sum"] = float(s[2])
+    return out
+
+
+class TimeSeries:
+    """Bounded ring of (seq, t, kind, scalars) samples."""
+
+    def __init__(self, capacity: int = 4096):
+        self._lock = threading.Lock()
+        self.capacity = capacity
+        self._ring: deque = deque(maxlen=capacity)
+        self.seq = 0
+        self._exported_seq = -1
+        # Incarnation stamp (set by obs.journal.attach): sample seq is
+        # per-process, so (inc, seq) is the cross-resume unique key.
+        self.incarnation = 0
+
+    def sample(
+        self,
+        kind: str = "",
+        registry: Optional[_metrics.MetricsRegistry] = None,
+    ) -> Dict[str, Any]:
+        row = {
+            "seq": self.seq,
+            "inc": self.incarnation,
+            "t": round(time.time(), 6),
+            "kind": kind,
+            "v": registry_scalars(registry),
+        }
+        with self._lock:
+            self._ring.append(row)
+            self.seq += 1
+        return row
+
+    def rows(self, last: Optional[int] = None) -> List[Dict[str, Any]]:
+        with self._lock:
+            rows = list(self._ring)
+        return rows if last is None else rows[-last:]
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()
+            self.seq = 0
+            self._exported_seq = -1
+
+    # -- delta export -------------------------------------------------------
+    def export_delta(self) -> List[Dict[str, Any]]:
+        """Samples appended since the previous export (ring-evicted
+        samples are simply gone — the ring bounds memory, the export
+        cadence bounds loss)."""
+        with self._lock:
+            rows = [r for r in self._ring if r["seq"] > self._exported_seq]
+            if rows:
+                self._exported_seq = rows[-1]["seq"]
+        return rows
+
+    def flush_jsonl(self, root: str, name: str = "timeseries.jsonl") -> int:
+        """Append the delta to ``<root>/timeseries.jsonl`` (the round
+        journal's sibling artifact); returns rows written. Rotation-
+        bounded like the journal (one ``.1`` segment kept), so an
+        always-on soak's export window stays bounded on disk."""
+        rows = self.export_delta()
+        if not rows:
+            return 0
+        os.makedirs(root, exist_ok=True)
+        path = os.path.join(root, name)
+        with open(path, "a") as f:
+            for row in rows:
+                f.write(json.dumps(row, separators=(",", ":")) + "\n")
+            end = f.tell()
+        if end >= _max_bytes():
+            try:
+                os.replace(path, path + ".1")
+            except OSError:
+                pass
+        return len(rows)
+
+
+#: Process-wide ring ``obs.journal.emit`` samples at round boundaries.
+SERIES = TimeSeries()
+
+
+def truncate_after(
+    root: str, t_cutoff: float, name: str = "timeseries.jsonl"
+) -> int:
+    """Drop flushed samples newer than ``t_cutoff`` — the time-series
+    twin of the journal's resume truncation: a killed run's samples past
+    the checkpoint generation being restored describe rounds that will
+    re-execute and re-sample. Both segments rewritten; returns rows
+    dropped."""
+    from .journal import rewrite_segments
+
+    return rewrite_segments(
+        os.path.join(root, name),
+        lambda rec: rec.get("t", 0.0) <= t_cutoff,
+    )
+
+
+def read_jsonl(root: str, name: str = "timeseries.jsonl") -> List[Dict]:
+    """Parse a flushed time-series export, rotated segment first (torn
+    lines skipped — the reader is the round journal's, so the two
+    tolerances can never drift apart)."""
+    from .journal import _read_lines
+
+    base = os.path.join(root, name) if os.path.isdir(root) else root
+    return [
+        rec
+        for path in (base + ".1", base)
+        for _, rec in _read_lines(path)
+    ]
+
+
+# ---------------------------------------------------------------------------
+# Prometheus text exposition
+# ---------------------------------------------------------------------------
+
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def _prom_name(name: str) -> str:
+    return "demi_" + _NAME_RE.sub("_", name)
+
+
+def _esc(v: str) -> str:
+    """Prometheus label-value escaping (backslash first, then quote)."""
+    return str(v).replace("\\", "\\\\").replace('"', '\\"')
+
+
+def _num(v: float) -> str:
+    """Exact sample-value rendering: repr's shortest round-trip form
+    (%g would quantize counters above ~1e6 to 6 significant digits —
+    a 1M-lane sweep's counter would scrape wrong, and small increments
+    to large counters would vanish between scrapes)."""
+    s = repr(float(v))
+    return s[:-2] if s.endswith(".0") else s
+
+
+def _prom_labels(key: str, extra=()) -> str:
+    """Registry label key ('k=v,k2=v2') -> Prometheus label block, with
+    optional extra (name, value) pairs appended — the one
+    parse-sanitize-escape path for counters, gauges, AND histogram
+    bucket labels."""
+    parts = []
+    if key:
+        for pair in key.split(","):
+            k, _, v = pair.partition("=")
+            parts.append((k, v))
+    parts.extend(extra)
+    if not parts:
+        return ""
+    return "{" + ",".join(
+        f'{_NAME_RE.sub("_", k)}="{_esc(v)}"' for k, v in parts
+    ) + "}"
+
+
+def prom_text(snapshot: Dict[str, Any]) -> str:
+    """Render a registry snapshot (``MetricsRegistry.snapshot()`` shape)
+    in the Prometheus text exposition format: counters as ``_total``,
+    gauges as-is, histograms as cumulative ``_bucket{le=...}`` series
+    plus ``_sum``/``_count`` — the format `demi_tpu stats --prom` prints
+    and ``--metrics-port`` serves (pinned by tests/test_obs.py)."""
+    lines: List[str] = []
+    for name, series in sorted(snapshot.get("counters", {}).items()):
+        pname = _prom_name(name) + "_total"
+        lines.append(f"# TYPE {pname} counter")
+        for key, v in sorted(series.items()):
+            lines.append(f"{pname}{_prom_labels(key)} {_num(v)}")
+    for name, series in sorted(snapshot.get("gauges", {}).items()):
+        pname = _prom_name(name)
+        lines.append(f"# TYPE {pname} gauge")
+        for key, v in sorted(series.items()):
+            lines.append(f"{pname}{_prom_labels(key)} {_num(v)}")
+    for name, series in sorted(snapshot.get("histograms", {}).items()):
+        pname = _prom_name(name)
+        lines.append(f"# TYPE {pname} histogram")
+        for key, rec in sorted(series.items()):
+            bounds = rec.get("le") or list(_metrics._BUCKETS)
+            cum = 0
+            for le, n in zip(bounds, rec["buckets"]):
+                cum += n
+                lbl = _prom_labels(key, [("le", f"{le:g}")])
+                lines.append(f"{pname}_bucket{lbl} {cum}")
+            # The trailing overflow bucket (and any drift past the local
+            # bounds) lands in +Inf, whose cumulative count is exact by
+            # definition.
+            lbl = _prom_labels(key, [("le", "+Inf")])
+            lines.append(f"{pname}_bucket{lbl} {rec['count']}")
+            lines.append(
+                f"{pname}_sum{_prom_labels(key)} {_num(rec['sum'])}"
+            )
+            lines.append(
+                f"{pname}_count{_prom_labels(key)} {rec['count']}"
+            )
+    return "\n".join(lines) + "\n"
+
+
+# ---------------------------------------------------------------------------
+# Optional HTTP endpoint (--metrics-port)
+# ---------------------------------------------------------------------------
+
+def serve(port: int, registry: Optional[_metrics.MetricsRegistry] = None):
+    """Serve the live registry at ``/metrics`` (Prometheus text) and
+    ``/metrics.json`` (snapshot JSON) on a daemon thread. ``port=0``
+    binds an ephemeral port; the bound server is returned (its
+    ``server_address[1]`` is the real port). Never blocks the run."""
+    import http.server
+
+    reg = registry or _metrics.REGISTRY
+
+    def safe_snapshot():
+        # The handler thread reads while driver threads mutate series
+        # dicts (inc/set take no lock); a first-seen label mid-copy can
+        # raise "dictionary changed size during iteration" — retry, and
+        # degrade to an empty snapshot rather than failing the scrape.
+        for _ in range(5):
+            try:
+                return reg.snapshot()
+            except RuntimeError:
+                time.sleep(0.005)
+        return {"counters": {}, "gauges": {}, "histograms": {}}
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):  # noqa: N802 - http.server API
+            if self.path.startswith("/metrics.json"):
+                body = json.dumps(safe_snapshot(), sort_keys=True).encode()
+                ctype = "application/json"
+            elif self.path.startswith("/metrics") or self.path == "/":
+                body = prom_text(safe_snapshot()).encode()
+                ctype = "text/plain; version=0.0.4"
+            else:
+                self.send_response(404)
+                self.end_headers()
+                return
+            self.send_response(200)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *args):  # quiet — telemetry must not spam
+            pass
+
+    server = http.server.ThreadingHTTPServer(("127.0.0.1", port), Handler)
+    thread = threading.Thread(
+        target=server.serve_forever, name="demi-metrics", daemon=True
+    )
+    thread.start()
+    return server

@@ -350,6 +350,10 @@ class SweepDriver:
                 self.kernel = make_explore_kernel(app, cfg)
             self._align = 1
         self._cont_cache = None
+        # Continuous observability (obs/journal.py): 1-based chunk
+        # counter for the round journal; a checkpointed resume seeds it
+        # at the restored chunk count so the journal stays contiguous.
+        self.chunk_index = 0
         # Host-share ledger (always on — a few clock reads per chunk):
         # wall time on host planning/lowering/harvest accumulation vs
         # device segments / blocked kernel waits. Continuous sweeps split
@@ -611,12 +615,16 @@ class SweepDriver:
             )
 
     def _harvest_chunk(self, handle, slice_index: int = 0) -> SweepChunkResult:
+        from ..obs.profiler import PROFILER
+
         real, res, t0 = handle
         n_real = len(real)
         t_block = time.perf_counter()
         jax.block_until_ready(res)
         t_done = time.perf_counter()
         seconds = t_done - t0
+        if PROFILER.enabled:
+            PROFILER.block("sweep", n_real, t_done - t_block)
         # Chunked-path host share: the blocked wait is device time, the
         # rest of the dispatch->harvest span (lowering, fork planning,
         # accumulation below is counted by the NEXT chunk's span) is host.
@@ -651,6 +659,22 @@ class SweepDriver:
                 unique_schedules=int(chunk_uniq.size),
             )
             obs.histogram("device.sweep.chunk_seconds").observe(seconds)
+        # One journal record per harvested chunk (obs/journal.py — one
+        # branch when detached): the sweep's continuous wire format.
+        self.chunk_index += 1
+        if obs.journal.JOURNAL is not None:
+            obs.journal.emit(
+                "sweep.chunk",
+                round=self.chunk_index,
+                lanes=n_real,
+                wall_s=round(seconds, 6),
+                host_s=round(max(0.0, t_block - t0), 6),
+                device_s=round(t_done - t_block, 6),
+                violations=int((violations != 0).sum()),
+                codes=codes,
+                unique=int(chunk_uniq.size),
+                overflow=int((statuses == ST_OVERFLOW).sum()),
+            )
         return SweepChunkResult(
             slice_index=slice_index,
             lanes=n_real,

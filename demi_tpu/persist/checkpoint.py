@@ -576,6 +576,7 @@ def device_dpor_payload(dpor) -> Dict[str, Any]:
         ),
         "max_distance": dpor.max_distance,
         "interleavings": dpor.interleavings,
+        "round_index": dpor.round_index,
         "round_batch": dpor.round_batch,
         "async_stats": dict(dpor.async_stats),
         "tuner": tuner,
@@ -632,6 +633,10 @@ def restore_device_dpor(dpor, payload: Dict[str, Any]) -> None:
     )
     dpor.max_distance = payload["max_distance"]
     dpor.interleavings = payload["interleavings"]
+    # Journal continuity (obs/journal.py): the resumed explorer's next
+    # round continues the dead run's numbering, so the round journal
+    # stays generation-contiguous (older payloads default to 0).
+    dpor.round_index = int(payload.get("round_index", 0))
     dpor.round_batch = payload["round_batch"]
     dpor.async_stats = dict(payload["async_stats"])
     dpor.host_seconds = payload["host_seconds"]

@@ -146,6 +146,19 @@ def fuzz(
             sp.set(deliveries=result.deliveries,
                    violation=result.violation is not None)
         obs.counter("fuzz.executions").inc()
+        # Continuous wire format (obs/journal.py): one record per host
+        # fuzz execution — `i + 1` continues a resumed run's numbering
+        # (start_execution), so the journal stays contiguous. Gated on
+        # an ATTACHED journal, not the obs switch: executions are ~ms
+        # (not kernel rounds), so a DEMI_OBS=1 run without a journal
+        # must not pay a registry scan per execution.
+        if obs.journal.attached():
+            obs.journal.emit(
+                "fuzz.execution",
+                round=i + 1,
+                deliveries=result.deliveries,
+                violation=result.violation is not None,
+            )
         if controller is not None:
             controller.end_round(
                 hashes=[_trace_fingerprint(result.trace)],
@@ -473,6 +486,16 @@ def run_the_gamut(
 
     def record(stage: str, ext: Sequence[ExternalEvent], tr: EventTrace):
         result.stages.append((stage, len(ext), len(tr.deliveries())))
+        # Stage boundary in the continuous wire format (obs/journal.py):
+        # the pipeline's coarse progress marks between the per-level
+        # records the batched minimizers emit.
+        obs.journal.emit(
+            "minimize.stage",
+            round=len(result.stages),
+            stage=stage,
+            externals=len(ext),
+            deliveries=len(tr.deliveries()),
+        )
 
     def checkpoint(stage: str, ext: Sequence[ExternalEvent], tr: EventTrace):
         if checkpoint_dir is not None:

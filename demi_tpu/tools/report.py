@@ -408,6 +408,42 @@ def render_report(directory: str, app=None) -> str:
             "(merge/print: `python -m demi_tpu stats -e <dir>`)."
         )
 
+    # Continuous observability (obs/journal.py): when the experiment dir
+    # was journaled (--journal / --checkpoint-dir), summarize the round
+    # stream — the over-time view the exit snapshot above cannot give.
+    try:
+        from ..obs import journal as _journal
+
+        jrecs = _journal.read_records(directory)
+    except Exception:
+        jrecs = []
+    if jrecs:
+        lines += ["", "## Continuous observability", ""]
+        kinds: dict = {}
+        for r in jrecs:
+            kinds[r.get("kind", "?")] = kinds.get(r.get("kind", "?"), 0) + 1
+        incs = {r.get("inc", 0) for r in jrecs}
+        lines.append(
+            f"- journal: {len(jrecs)} records "
+            f"({', '.join(f'{k}: {n}' for k, n in sorted(kinds.items()))}) "
+            f"across {len(incs)} incarnation(s)"
+        )
+        dpor_recs = [r for r in jrecs if r.get("kind") == "dpor.round"]
+        if dpor_recs:
+            wall = sum(r.get("wall_s") or 0.0 for r in dpor_recs)
+            host = sum(r.get("host_s") or 0.0 for r in dpor_recs)
+            last = dpor_recs[-1]
+            lines.append(
+                f"- DPOR: {len(dpor_recs)} rounds"
+                + (f", {len(dpor_recs) / wall:.2f} rounds/sec" if wall else "")
+                + (f", host share {host / wall:.1%}" if wall else "")
+                + f"; last frontier {last.get('frontier')}, "
+                f"explored {last.get('explored')}"
+            )
+        lines.append(
+            f"- tail live: `python -m demi_tpu top {directory}`"
+        )
+
     inventory = sorted(
         f for f in os.listdir(directory) if os.path.isfile(
             os.path.join(directory, f)

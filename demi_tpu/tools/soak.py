@@ -401,6 +401,31 @@ def _kill_resume_soak(args) -> int:
                         flush=True,
                     )
                     return 2
+            # Journal continuity (obs/journal.py): the resumed run must
+            # have continued the SAME round journal with no duplicated
+            # and no missing rounds — even when the SIGKILL landed
+            # between a checkpoint and later journaled rounds (resume
+            # truncates those, then re-journals them).
+            from ..obs import journal as _journal
+
+            rounds = [
+                r.get("round")
+                for r in _journal.read_records(dir_k, "dpor.round")
+            ]
+            # Rotation-tolerant continuity: a long soak's journal may
+            # have rotated away its oldest rounds, so require a
+            # gap-free, duplicate-free run ENDING at rounds_done (a
+            # fresh-start prefix of 1..N satisfies this too).
+            ok = bool(rounds) and rounds == list(
+                range(rounds[0], rounds[0] + len(rounds))
+            )
+            if not ok or rounds[-1] != got.get("rounds_done"):
+                print(
+                    f"KILL-RESUME JOURNAL GAP cycle={cycle}: rounds="
+                    f"{rounds} rounds_done={got.get('rounds_done')}",
+                    flush=True,
+                )
+                return 2
             print(
                 f"kill-resume cycle {cycle} ok "
                 f"(explored={got.get('explored')}, "

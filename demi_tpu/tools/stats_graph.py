@@ -1,4 +1,4 @@
-"""Minimization-progress graphs from minimization_stats.json.
+"""Progress graphs: minimization stats AND the continuous time series.
 
 Reference: src/main/python/minimization_stats/{generate_graph.py,
 combine_graphs.py} — gnuplot charts of iteration → #events. Here: CSV
@@ -6,7 +6,17 @@ for any plotting tool, an inline ASCII chart, and a rendered PNG/SVG
 (``--render``; matplotlib, headless Agg backend — skipped gracefully if
 matplotlib is absent).
 
+Two input shapes, auto-detected per directory:
+
+  - the continuous-observability exports (``journal.jsonl`` /
+    ``timeseries.jsonl`` from obs/journal.py + obs/timeseries.py —
+    any ``--checkpoint-dir`` or ``--journal`` run): per-round frontier /
+    explored / rounds-per-sec trends;
+  - ``minimization_stats.json`` (the per-experiment minimizer stats):
+    iteration → externals-remaining, the original mode.
+
     python -m demi_tpu.tools.stats_graph experiment_dir/ [--render [out.png]]
+    python -m demi_tpu.tools.stats_graph checkpoint_dir/
 """
 
 from __future__ import annotations
@@ -95,6 +105,106 @@ def render(stats: MinimizationStats, out_path: str) -> str:
     return out_path
 
 
+def timeseries_rows(root: str) -> List[Tuple[int, float, int, int, float]]:
+    """(round, t, frontier, explored, wall_s) per journaled DPOR round —
+    the continuous export's graphable core. Falls back to the flushed
+    time-series rows' registry scalars when no round journal exists."""
+    from ..obs import journal as _journal
+
+    rows = [
+        (
+            int(r.get("round", 0)),
+            float(r.get("t", 0.0)),
+            int(r.get("frontier", 0)),
+            int(r.get("explored", 0)),
+            float(r.get("wall_s", 0.0)),
+        )
+        for r in _journal.read_records(root, kind="dpor.round")
+    ]
+    if rows:
+        return rows
+    from ..obs import timeseries as _ts
+
+    out = []
+    for i, row in enumerate(_ts.read_jsonl(root)):
+        v = row.get("v", {})
+        out.append(
+            (
+                i + 1,
+                float(row.get("t", 0.0)),
+                int(v.get("dpor.frontier_size", 0)),
+                int(v.get("dpor.explored_set_size", 0)),
+                0.0,
+            )
+        )
+    return out
+
+
+def timeseries_csv(rows) -> str:
+    lines = ["round,t,frontier,explored,wall_s"]
+    for rnd, t, frontier, explored, wall in rows:
+        lines.append(f"{rnd},{t},{frontier},{explored},{wall}")
+    return "\n".join(lines) + "\n"
+
+
+def timeseries_ascii(rows, width: int = 60) -> str:
+    if not rows:
+        return "(no time-series data)\n"
+    peak = max(frontier for _, _, frontier, _, _ in rows) or 1
+    out = []
+    for rnd, _, frontier, explored, wall in rows:
+        bar = "#" * max(1, int(width * frontier / peak))
+        rate = f"{1.0 / wall:6.2f}/s" if wall > 0 else "      —"
+        out.append(
+            f"{rnd:>5} frontier {frontier:>6} explored {explored:>6} "
+            f"{rate} {bar}"
+        )
+    return "\n".join(out) + "\n"
+
+
+def render_timeseries(rows, out_path: str) -> str:
+    """Rendered round-stream plot: frontier and explored vs round (the
+    same matplotlib/Agg contract as ``render``)."""
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    fig, ax = plt.subplots(figsize=(8, 4.5))
+    if rows:
+        xs = [r for r, _, _, _, _ in rows]
+        ax.step(xs, [f for _, _, f, _, _ in rows], where="post",
+                label="frontier", linewidth=2)
+        ax.step(xs, [e for _, _, _, e, _ in rows], where="post",
+                label="explored", linewidth=2)
+        ax.legend(fontsize=8)
+    ax.set_xlabel("round")
+    ax.set_ylabel("prescriptions")
+    ax.set_title("exploration progress (round journal)")
+    ax.grid(True, alpha=0.3)
+    fig.tight_layout()
+    fig.savefig(out_path, dpi=120)
+    plt.close(fig)
+    return out_path
+
+
+def _timeseries_main(root: str, do_render: bool = False,
+                     render_path=None) -> int:
+    rows = timeseries_rows(root)
+    csv_path = os.path.join(root, "timeseries.csv")
+    with open(csv_path, "w") as f:
+        f.write(timeseries_csv(rows))
+    print(timeseries_ascii(rows), end="")
+    print(f"csv written to {csv_path}")
+    if do_render:
+        out = render_path or os.path.join(root, "timeseries.png")
+        try:
+            print(f"plot written to {render_timeseries(rows, out)}")
+        except ImportError:
+            print("matplotlib unavailable; skipped --render")
+    return 0
+
+
 def main(argv=None) -> int:
     args = list(argv if argv is not None else sys.argv[1:])
     do_render = False
@@ -115,6 +225,12 @@ def main(argv=None) -> int:
         return 2
     path = args[0]
     if os.path.isdir(path):
+        # Continuous-observability exports take precedence: any journaled
+        # run (checkpoint dir or --journal dir) graphs its round stream.
+        if os.path.exists(os.path.join(path, "journal.jsonl")) or (
+            os.path.exists(os.path.join(path, "timeseries.jsonl"))
+        ):
+            return _timeseries_main(path, do_render, render_path)
         path = os.path.join(path, "minimization_stats.json")
     with open(path) as f:
         stats = MinimizationStats.from_json(f.read())

@@ -1,0 +1,299 @@
+"""`demi_tpu top`: a live terminal dashboard over a run's round journal.
+
+Point it at any directory a journal is being written into — a
+``--checkpoint-dir``, or wherever ``--journal`` pointed — and it tails
+the JSONL round journal (obs/journal.py) plus the time-series export,
+rendering the numbers an operator actually watches during a soak:
+
+  - rounds/sec over a sliding window (and per-round wall breakdown:
+    host vs device share);
+  - frontier size / explored total / interleavings, and their trend;
+  - redundancy ratio and prune economy (fresh vs redundant vs pruned);
+  - violations: distinct codes seen and time-to-first-violation;
+  - sweep chunk and minimizer level progress when those tiers are live.
+
+``--once`` renders a single frame and exits (no TTY, no clearing) — the
+mode CI smokes; the default loops with ANSI clear-screen until ^C. The
+reader side is crash-tolerant by construction: records are
+self-contained JSON lines, torn tails are skipped, and a resumed run's
+records continue the same round numbering (inc marks the incarnation).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+from typing import Any, Dict, List, Optional
+
+from ..obs import journal as _journal
+
+
+def _fmt(v: Optional[float], spec: str = ".2f", unit: str = "") -> str:
+    if v is None:
+        return "—"
+    return f"{v:{spec}}{unit}"
+
+
+def _rate(records: List[Dict[str, Any]], window: int) -> Optional[float]:
+    """Rounds/sec over the last ``window`` records, by journaled
+    per-round wall seconds (robust to gaps from kills/resumes, unlike
+    wall-clock deltas across records)."""
+    recent = records[-window:]
+    secs = sum(r.get("wall_s") or 0.0 for r in recent)
+    return len(recent) / secs if secs > 0 else None
+
+
+def _bar(frac: Optional[float], width: int = 20) -> str:
+    if frac is None:
+        return " " * width
+    n = max(0, min(width, int(round(frac * width))))
+    return "#" * n + "-" * (width - n)
+
+
+class _JournalTail:
+    """Incremental journal reader for the live loop: records are
+    append-only and self-contained, so each refresh reads only the bytes
+    appended since the last one (a dashboard polling a rotation-bound
+    journal every second must not re-parse megabytes per tick). A
+    rotation or a resume-truncation (live file shrank, or the rotated
+    segment changed) falls back to one full re-read."""
+
+    def __init__(self, root: str):
+        base = root if not os.path.isdir(root) else os.path.join(
+            root, _journal.JOURNAL_NAME
+        )
+        self.base = base
+        self.records: List[Dict[str, Any]] = []
+        self._offset = 0
+        self._rot_sig: Any = None
+        self._live_ino: Any = None
+
+    @staticmethod
+    def _parse(chunk: str) -> List[Dict[str, Any]]:
+        import json
+
+        out = []
+        for line in chunk.splitlines():
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(rec, dict):
+                out.append(rec)
+        return out
+
+    def poll(self) -> List[Dict[str, Any]]:
+        rot = self.base + ".1"
+        try:
+            rot_sig = (os.path.getsize(rot), os.path.getmtime(rot))
+        except OSError:
+            rot_sig = None
+        try:
+            st = os.stat(self.base)
+            live_size, live_ino = st.st_size, st.st_ino
+        except OSError:
+            live_size, live_ino = 0, None
+        # The inode is part of the signature: a resume truncation
+        # rewrites the live file via os.replace, and fast re-appends can
+        # bring the NEW file back to >= the old offset within one poll —
+        # size alone would keep tailing stale bytes' worth of state.
+        if (
+            rot_sig != self._rot_sig
+            or live_ino != self._live_ino
+            or live_size < self._offset
+        ):
+            self._live_ino = live_ino
+            # Full re-read (rotation or truncation). The offset derives
+            # from the bytes WE consumed — never from a pre-read stat —
+            # so a record appended mid-re-read is neither duplicated by
+            # the next incremental poll nor split mid-line.
+            self._rot_sig = rot_sig
+            rot_recs = [
+                rec for _, rec in _journal._read_lines(rot)
+            ]
+            try:
+                with open(self.base) as f:
+                    chunk = f.read()
+            except OSError:
+                chunk = ""
+            complete = chunk.rfind("\n") + 1
+            self._offset = complete
+            self.records = rot_recs + self._parse(chunk[:complete])
+            return self.records
+        if live_size > self._offset:
+            with open(self.base) as f:
+                f.seek(self._offset)
+                chunk = f.read(live_size - self._offset)
+            # Hold back a torn trailing line (a writer mid-append): the
+            # offset only advances past complete lines.
+            complete = chunk.rfind("\n") + 1
+            self._offset += complete
+            self.records.extend(self._parse(chunk[:complete]))
+        return self.records
+
+
+def render_frame(
+    root: str, window: int = 30, width: int = 72, records=None
+) -> str:
+    """One dashboard frame (pure text; the CLI adds clearing/looping).
+    ``records`` lets the live loop hand in the incrementally-tailed
+    list; a one-shot call reads the journal fully."""
+    if records is None:
+        records = _journal.read_records(root)
+    lines: List[str] = []
+    title = f"demi_tpu top — {root}"
+    lines.append(title)
+    lines.append("=" * min(width, max(len(title), 24)))
+    if not records:
+        lines.append("(no journal records yet — is the run writing to "
+                      f"{os.path.join(root, _journal.JOURNAL_NAME)}?)")
+        return "\n".join(lines) + "\n"
+
+    t0 = records[0].get("t")
+    t_last = records[-1].get("t")
+    incs = {r.get("inc", 0) for r in records}
+    lines.append(
+        f"records: {len(records)}  incarnations: {len(incs)}  "
+        f"span: {_fmt((t_last - t0) if t0 and t_last else None, '.1f', 's')}"
+    )
+
+    dpor = [r for r in records if r.get("kind") == "dpor.round"]
+    if dpor:
+        last = dpor[-1]
+        rps = _rate(dpor, window)
+        host = sum(r.get("host_s") or 0.0 for r in dpor[-window:])
+        dev = sum(r.get("device_s") or 0.0 for r in dpor[-window:])
+        share = host / (host + dev) if (host + dev) > 0 else None
+        fresh = sum(r.get("fresh") or 0 for r in dpor[-window:])
+        redundant = sum(r.get("redundant") or 0 for r in dpor[-window:])
+        pruned = sum(r.get("distance_pruned") or 0 for r in dpor[-window:])
+        lines.append("")
+        lines.append(f"DPOR  round {last.get('round')}  "
+                     f"rounds/sec {_fmt(rps)}  "
+                     f"batch {last.get('batch')}  depth {last.get('depth')}")
+        lines.append(f"  host share   [{_bar(share)}] {_fmt(share, '.1%')}"
+                     f"  ({host:.2f}s host / {dev:.2f}s device)")
+        lines.append(f"  frontier {last.get('frontier')}  "
+                     f"explored {last.get('explored')}  "
+                     f"interleavings {last.get('interleavings')}")
+        denom = max(1, fresh + redundant + pruned)
+        lines.append(f"  admissions (last {min(window, len(dpor))} rounds): "
+                     f"{fresh} fresh / {redundant} redundant / "
+                     f"{pruned} pruned "
+                     f"[{_bar(fresh / denom)}]")
+        extras = []
+        if last.get("redundancy_ratio") is not None:
+            extras.append(f"redundancy ratio {last['redundancy_ratio']}")
+        if last.get("sleep_pruned") is not None:
+            extras.append(f"sleep-pruned {last['sleep_pruned']}")
+        if last.get("static_pruned") is not None:
+            extras.append(f"static-pruned {last['static_pruned']}")
+        if last.get("inflight_hits") or last.get("inflight_waste"):
+            extras.append(
+                f"inflight {last.get('inflight_hits', 0)} hit / "
+                f"{last.get('inflight_waste', 0)} waste"
+            )
+        if extras:
+            lines.append("  " + "  ".join(extras))
+        # Violations: distinct codes + time-to-first.
+        codes: set = set()
+        first_t = None
+        for r in dpor:
+            if r.get("violations"):
+                codes.update(r["violations"])
+                if first_t is None:
+                    first_t = r.get("t")
+        if codes:
+            ttfv = (first_t - t0) if (first_t and t0) else None
+            lines.append(f"  violations: codes {sorted(codes)}  "
+                         f"time-to-first {_fmt(ttfv, '.2f', 's')}")
+        else:
+            lines.append("  violations: none yet")
+
+    sweep = [r for r in records if r.get("kind") == "sweep.chunk"]
+    if sweep:
+        last = sweep[-1]
+        lanes = sum(r.get("lanes") or 0 for r in sweep)
+        viol = sum(r.get("violations") or 0 for r in sweep)
+        secs = sum(r.get("wall_s") or 0.0 for r in sweep[-window:])
+        recent_lanes = sum(r.get("lanes") or 0 for r in sweep[-window:])
+        lines.append("")
+        lines.append(f"SWEEP  chunk {last.get('round')}  "
+                     f"lanes {lanes}  violations {viol}  "
+                     f"schedules/sec "
+                     f"{_fmt(recent_lanes / secs if secs > 0 else None, '.1f')}")
+
+    levels = [r for r in records if r.get("kind") == "minimize.level"]
+    stages = [r for r in records if r.get("kind") == "minimize.stage"]
+    if levels or stages:
+        lines.append("")
+        if stages:
+            last = stages[-1]
+            lines.append(f"MINIMIZE  stage {last.get('stage')}  "
+                         f"externals {last.get('externals')}  "
+                         f"deliveries {last.get('deliveries')}")
+        if levels:
+            last = levels[-1]
+            lines.append(f"  level {last.get('round')} ({last.get('stage')})"
+                         f"  candidates {last.get('candidates')}  "
+                         f"adopted {last.get('adopted')}")
+
+    fuzz = [r for r in records if r.get("kind") == "fuzz.execution"]
+    if fuzz:
+        lines.append("")
+        viol = sum(1 for r in fuzz if r.get("violation"))
+        lines.append(f"FUZZ  execution {fuzz[-1].get('round')}  "
+                     f"violations {viol}")
+
+    lines.append("")
+    lines.append(f"last record: {time.strftime('%H:%M:%S', time.localtime(t_last))}"
+                 if t_last else "")
+    return "\n".join(lines) + "\n"
+
+
+def run_top(
+    root: str,
+    once: bool = False,
+    interval: float = 1.0,
+    window: int = 30,
+    out=None,
+) -> int:
+    out = out or sys.stdout
+    if once:
+        out.write(render_frame(root, window=window))
+        return 0
+    tail = _JournalTail(root)
+    try:
+        while True:
+            # ANSI home+clear keeps the frame stable without curses (and
+            # degrades to scrolling output on dumb terminals).
+            out.write("\x1b[H\x1b[2J")
+            out.write(
+                render_frame(root, window=window, records=tail.poll())
+            )
+            out.flush()
+            time.sleep(interval)
+    except KeyboardInterrupt:
+        return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="live dashboard over a run's round journal"
+    )
+    p.add_argument("dir", help="run or checkpoint directory being journaled")
+    p.add_argument("--once", action="store_true",
+                   help="render a single frame and exit (no TTY needed)")
+    p.add_argument("--interval", type=float, default=1.0)
+    p.add_argument("--window", type=int, default=30,
+                   help="sliding window (records) for the rate numbers")
+    args = p.parse_args(argv)
+    return run_top(
+        args.dir, once=args.once, interval=args.interval, window=args.window
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -279,3 +279,38 @@ def test_bench_config8_smoke():
     assert static["noop_only"] is True
     assert static["interleavings_match"] is True
     assert sum(static["static_pruned"].values()) > 0
+
+
+def test_bench_config11_smoke():
+    record = _run_bench(
+        "11",
+        {
+            # Tiny continuous-obs A/B: shallow seed scan, few rounds.
+            "DEMI_BENCH_CONFIG11_BUDGET": "120",
+            "DEMI_BENCH_CONFIG11_SEEDS": "10",
+            "DEMI_BENCH_CONFIG11_BATCH": "8",
+            "DEMI_BENCH_CONFIG11_ROUNDS": "4",
+        },
+    )
+    assert record["metric"].startswith("continuous-obs overhead %")
+    section = record["config11"]
+    assert "error" not in section, section
+    for key in ("app", "seed_deliveries", "batch", "rounds",
+                "journal_records", "journal_contiguous",
+                "journal_schema_ok", "timeseries_samples",
+                "prom_renders", "explored", "explored_match",
+                "violations_match", "rounds_per_sec_plain",
+                "rounds_per_sec_journaled", "journal_overhead_pct"):
+        assert key in section, key
+    # The identity contracts the bench asserts internally, echoed into
+    # the JSON: observing the run changes nothing, the journal is
+    # round-contiguous with the full per-round schema, and the time
+    # series sampled every round.
+    assert section["explored_match"] is True
+    assert section["violations_match"] is True
+    assert section["journal_contiguous"] is True
+    assert section["journal_schema_ok"] is True
+    assert section["journal_records"] >= 1
+    assert section["timeseries_samples"] == section["journal_records"]
+    assert section["prom_renders"] is True
+    assert record["value"] == section["journal_overhead_pct"]

@@ -184,3 +184,91 @@ def test_cli_dpor_sleep_sets(capsys):
         assert key in sleep, key
     for kind in ("sleep", "class"):
         assert kind in sleep["pruned"], kind
+
+
+def test_cli_stats_prom_smoke(tmp_path, capsys):
+    """`demi_tpu stats --prom` renders a saved snapshot in the
+    Prometheus text exposition (tier-1, no TTY, no live run)."""
+    snap = {
+        "counters": {"dpor.interleavings": {"": 42}},
+        "gauges": {"dpor.host_share": {"": 0.5}},
+        "histograms": {},
+    }
+    p = tmp_path / "snap.json"
+    p.write_text(json.dumps(snap))
+    rc = main(["stats", "-i", str(p), "--prom"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "# TYPE demi_dpor_interleavings_total counter" in out
+    assert "demi_dpor_interleavings_total 42" in out
+    assert "demi_dpor_host_share 0.5" in out
+
+
+def test_cli_top_once_smoke(tmp_path, capsys):
+    """`demi_tpu top DIR --once` renders one dashboard frame from a
+    journaled directory and exits 0 (tier-1, no TTY needed)."""
+    from demi_tpu.obs import journal
+
+    d = str(tmp_path)
+    j = journal.RoundJournal(d)
+    for i in range(3):
+        j.emit(
+            "dpor.round", round=i + 1, wall_s=0.5, host_s=0.4,
+            device_s=0.1, batch=8, depth=40, fresh=10, redundant=2,
+            distance_pruned=0, violations=[7] if i == 2 else [],
+            frontier=100 + i, explored=50 + i, interleavings=8 * (i + 1),
+            inflight_hits=0, inflight_waste=0,
+        )
+    j.emit("sweep.chunk", round=1, lanes=32, wall_s=0.2, violations=3,
+           codes={"7": 3}, unique=30, overflow=0)
+    j.emit("minimize.level", round=1, stage="ddmin", wall_s=0.1,
+           candidates=4, granularity=2, externals=10, adopted=True)
+    j.close()
+    rc = main(["top", d, "--once"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "demi_tpu top" in out
+    assert "DPOR  round 3" in out
+    assert "rounds/sec" in out
+    assert "frontier 102" in out
+    assert "violations: codes [7]" in out
+    assert "SWEEP  chunk 1" in out
+    assert "MINIMIZE" not in out or "level 1" in out
+    # An empty dir renders a helpful frame instead of crashing.
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    rc = main(["top", str(empty), "--once"])
+    assert rc == 0
+    assert "no journal records yet" in capsys.readouterr().out
+
+
+def test_cli_dpor_profile_rounds(tmp_path, capsys, monkeypatch):
+    """`dpor --profile-rounds N`: the summary carries the launch-shape
+    ledger and the evidence lands in the tuning cache under the
+    profile=launch workload key (the cost model's input)."""
+    cache_path = tmp_path / "tune.json"
+    monkeypatch.setenv("DEMI_TUNE_CACHE", str(cache_path))
+    rc = main([
+        "dpor", "--app", "broadcast", "--nodes", "3", "--bug",
+        "unreliable", "--batch", "8", "--rounds", "2",
+        "--max-messages", "60", "--profile-rounds", "1",
+        "--profile-trace", str(tmp_path / "trace"),
+    ])
+    assert rc in (0, 1)
+    out = capsys.readouterr().out
+    summary = json.loads(
+        [line for line in out.splitlines() if line.startswith("{")][-1]
+    )
+    prof = summary["launch_profile"]
+    assert prof["profile"] == "launch" and prof["source"] == "measured"
+    kinds = {(r["kernel"], r["kind"]) for r in prof["launches"]}
+    assert ("dpor", "dispatch") in kinds
+    assert ("dpor", "block") in kinds
+    for row in prof["launches"]:
+        assert row["launches"] >= 1 and row["seconds"] >= 0
+    # Persisted evidence is a TuningCache consumer away.
+    from demi_tpu.tune import TuningCache
+
+    key = summary["launch_profile_cache"]["key"]
+    assert "profile=launch" in key
+    assert TuningCache(str(cache_path)).get(key)["launches"]

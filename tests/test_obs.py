@@ -423,3 +423,328 @@ def test_sleep_counters_and_report_section(telemetry, tmp_path):
     text2 = render_report(str(exp2))
     assert "### Static analysis" in text2
     assert "redundancy ratio" in text2
+
+
+# ---------------------------------------------------------------------------
+# Span end events under exceptions (finally discipline)
+# ---------------------------------------------------------------------------
+
+def test_span_end_events_survive_abandoned_inner_span(telemetry, tmp_path):
+    """A stage that raises past a manually-entered inner span must not
+    trade the real exception for an AssertionError, and the exported
+    Perfetto trace must still be valid bracketing — the outer span's end
+    event is emitted from a finally, and the abandoned inner span is
+    closed as 'orphaned'."""
+    with pytest.raises(ValueError, match="stage blew up"):
+        with obs.span("outer.stage"):
+            inner = obs.span("inner.handler")
+            inner.__enter__()  # a handler that never reaches its exit
+            raise ValueError("stage blew up")
+    names = {s["name"] for s in obs.TRACER.spans}
+    assert names == {"outer.stage", "inner.handler"}
+    by_name = {s["name"]: s for s in obs.TRACER.spans}
+    assert by_name["inner.handler"]["args"]["error"] == "orphaned"
+    assert by_name["outer.stage"]["args"]["error"] == "ValueError"
+    # Stack fully repaired: nothing leaks into the next span.
+    assert obs_spans.current_depth() == 0
+    out = tmp_path / "t.json"
+    obs.TRACER.export_perfetto(str(out))
+    _check_trace_events(json.loads(out.read_text())["traceEvents"])
+
+
+# ---------------------------------------------------------------------------
+# Cross-process merge audit: associative + commutative (fleet prereq)
+# ---------------------------------------------------------------------------
+
+def _random_snapshot(seed: int):
+    """One simulated per-process registry snapshot with counters,
+    stamped gauges, and histograms."""
+    rng = np.random.RandomState(seed)
+    reg = obs.MetricsRegistry()
+    c = reg.counter("p.count")
+    g = reg.gauge("p.gauge")
+    h = reg.histogram("p.hist")
+    for _ in range(rng.randint(1, 6)):
+        c.series["k=a"] = c.series.get("k=a", 0) + int(rng.randint(1, 9))
+        c.series[""] = c.series.get("", 0) + 1
+    g.force_set(float(rng.rand()), node=int(rng.randint(2)))
+    g.force_set(float(rng.rand()))
+    for _ in range(rng.randint(1, 8)):
+        v = float(2.0 ** rng.uniform(-19, 6))
+        key = ""
+        s = h._series(key)
+        b = 0
+        from demi_tpu.obs.metrics import _BUCKETS
+        while b < len(_BUCKETS) and v > _BUCKETS[b]:
+            b += 1
+        s[0][b] += 1
+        s[1] += 1
+        s[2] += v
+        s[3] = min(s[3], v)
+        s[4] = max(s[4], v)
+    return json.loads(json.dumps(reg.snapshot()))
+
+
+def _snap_eq(a, b):
+    """Snapshot equality with float tolerance on the SUM accumulators
+    (float addition is not bit-associative; counts, buckets, gauges,
+    stamps, and min/max must match exactly)."""
+    import copy
+
+    a, b = copy.deepcopy(a), copy.deepcopy(b)
+    for snap in (a, b):
+        for series in snap.get("histograms", {}).values():
+            for rec in series.values():
+                rec["sum"] = round(rec["sum"], 6)
+    return a == b
+
+
+def test_merge_is_associative_and_commutative(telemetry):
+    """Property test over counters, gauges, and log2 histogram buckets:
+    merging per-process snapshots must give ONE answer for any merge
+    order or grouping — the prerequisite for fleet aggregation, where
+    workers' snapshots arrive in nondeterministic order. (Histogram
+    SUM accumulators compare with float tolerance; every discrete
+    series — counts, buckets, gauges + stamps, min/max — exactly.)"""
+    for seed in range(10):
+        a = _random_snapshot(3 * seed)
+        b = _random_snapshot(3 * seed + 1)
+        c = _random_snapshot(3 * seed + 2)
+        # Commutative.
+        assert _snap_eq(
+            obs.merge_snapshots(a, b), obs.merge_snapshots(b, a)
+        )
+        # Associative (grouping-independent).
+        ab_c = obs.merge_snapshots(obs.merge_snapshots(a, b), c)
+        a_bc = obs.merge_snapshots(a, obs.merge_snapshots(b, c))
+        abc = obs.merge_snapshots(a, b, c)
+        assert _snap_eq(ab_c, a_bc) and _snap_eq(a_bc, abc)
+        # And every permutation lands on the same result.
+        assert _snap_eq(obs.merge_snapshots(c, a, b), abc)
+        assert _snap_eq(obs.merge_snapshots(b, c, a), abc)
+
+
+def test_histogram_bucket_alignment_drift_rebins_by_value(telemetry):
+    """A snapshot written with DIFFERENT bucket boundaries (an older or
+    newer build) must merge by VALUE, not by index: every count lands in
+    the local bucket covering its recorded bound, drift past the local
+    range lands in overflow, and the total count is exact."""
+    from demi_tpu.obs.metrics import _BUCKETS
+
+    reg = obs.MetricsRegistry()
+    # Foreign build: half the buckets, shifted boundaries, plus values
+    # beyond the local range.
+    foreign_bounds = [0.001, 0.1, 10.0, 1000.0]
+    rec = {
+        "le": foreign_bounds,
+        "buckets": [2, 3, 4, 5, 6],  # last = foreign overflow
+        "count": 20,
+        "sum": 12.5,
+        "min": 0.0005,
+        "max": 2000.0,
+    }
+    reg.load({"histograms": {"d.h": {"": rec}}})
+    snap = reg.snapshot()["histograms"]["d.h"][""]
+    assert sum(snap["buckets"]) == 20  # nothing lost, nothing doubled
+    assert snap["count"] == 20
+    # The 1000.0-bound counts and the foreign overflow exceed the local
+    # top bound (128s) and both land in overflow.
+    assert snap["buckets"][-1] == 11
+    # Each kept bound landed at a local bucket covering it.
+    import bisect
+    for bound, n in zip(foreign_bounds[:-1], rec["buckets"]):
+        b = bisect.bisect_left(_BUCKETS, bound)
+        assert snap["buckets"][b] >= n
+    # Same-bounds fast path stays exact (index-wise).
+    reg2 = obs.MetricsRegistry()
+    reg2.load(reg.snapshot())
+    assert reg2.snapshot()["histograms"]["d.h"][""]["buckets"] == (
+        snap["buckets"]
+    )
+
+
+# ---------------------------------------------------------------------------
+# Round journal (obs/journal.py)
+# ---------------------------------------------------------------------------
+
+def test_journal_write_read_and_torn_tail(tmp_path):
+    from demi_tpu.obs import journal
+
+    j = journal.RoundJournal(str(tmp_path))
+    j.emit("dpor.round", round=1, wall_s=0.5)
+    j.emit("dpor.round", round=2, wall_s=0.4)
+    j.emit("sweep.chunk", round=1, lanes=8)
+    j.close()
+    # SIGKILL mid-write: a torn trailing line is skipped, not fatal.
+    with open(j.path, "a") as f:
+        f.write('{"seq": 99, "kind": "dpor.rou')
+    recs = journal.read_records(str(tmp_path))
+    assert [r["kind"] for r in recs] == [
+        "dpor.round", "dpor.round", "sweep.chunk"
+    ]
+    ok, rounds = journal.contiguous_rounds(recs, "dpor.round")
+    assert ok and rounds == [1, 2]
+
+
+def test_journal_rotation_bounds_disk(tmp_path):
+    from demi_tpu.obs import journal
+
+    j = journal.RoundJournal(str(tmp_path), max_bytes=300)
+    for i in range(50):
+        j.emit("dpor.round", round=i + 1, pad="x" * 40)
+    j.close()
+    import os as _os
+    live = _os.path.getsize(j.path) if _os.path.exists(j.path) else 0
+    rotated = (
+        _os.path.getsize(j.path + ".1")
+        if _os.path.exists(j.path + ".1") else 0
+    )
+    # Bounded window: at most ~2x the rotation bound stays on disk.
+    assert live + rotated < 4 * 300
+    # The kept window is the most recent suffix, in order.
+    recs = journal.read_records(str(tmp_path), kind="dpor.round")
+    rounds = [r["round"] for r in recs]
+    assert rounds == sorted(rounds)
+    assert rounds[-1] == 50
+
+
+def test_journal_truncate_from_resumes_contiguously(tmp_path):
+    from demi_tpu.obs import journal
+
+    j = journal.attach(str(tmp_path))
+    for i in range(5):
+        journal.emit("dpor.round", round=i + 1)
+    journal.detach()
+    # Resume from the round-3 checkpoint: rounds 4..5 were journaled by
+    # the dead run but will re-execute — drop them.
+    j = journal.attach(str(tmp_path), incarnation=1)
+    dropped = j.truncate_from("dpor.round", 3)
+    assert dropped == 2
+    journal.emit("dpor.round", round=4)
+    journal.emit("dpor.round", round=5)
+    journal.emit("dpor.round", round=6)
+    recs = journal.read_records(str(tmp_path))
+    journal.detach()
+    ok, rounds = journal.contiguous_rounds(recs, "dpor.round")
+    assert ok and rounds == [1, 2, 3, 4, 5, 6]
+    assert [r["inc"] for r in recs] == [0, 0, 0, 1, 1, 1]
+    # seq stays strictly monotonic across the truncation.
+    seqs = [r["seq"] for r in recs]
+    assert seqs == sorted(seqs) and len(set(seqs)) == len(seqs)
+
+
+# ---------------------------------------------------------------------------
+# Time series + Prometheus exposition (obs/timeseries.py)
+# ---------------------------------------------------------------------------
+
+def test_timeseries_ring_delta_export_and_flush(telemetry, tmp_path):
+    from demi_tpu.obs import timeseries
+
+    ts = timeseries.TimeSeries(capacity=4)
+    obs.counter("r.c").inc(3)
+    ts.sample(kind="dpor.round")
+    obs.counter("r.c").inc(2)
+    ts.sample(kind="dpor.round")
+    delta = ts.export_delta()
+    assert [row["v"]["r.c"] for row in delta] == [3.0, 5.0]
+    assert ts.export_delta() == []  # nothing new since the export
+    ts.sample(kind="dpor.round")
+    n = ts.flush_jsonl(str(tmp_path))
+    assert n == 1
+    rows = timeseries.read_jsonl(str(tmp_path))
+    assert len(rows) == 1 and rows[0]["v"]["r.c"] == 5.0
+    # The ring is bounded: old samples evict, seq keeps counting.
+    for _ in range(10):
+        ts.sample()
+    assert len(ts.rows()) == 4
+    assert ts.seq == 13
+
+
+def test_prom_text_format_pinned(telemetry):
+    """The Prometheus exposition format `stats --prom` prints and
+    --metrics-port serves: TYPE lines, _total counters, label blocks,
+    cumulative le buckets with +Inf, _sum/_count."""
+    from demi_tpu.obs.timeseries import prom_text
+
+    obs.counter("dpor.rounds").inc(7, app="raft")
+    obs.gauge("dpor.host_share").set(0.25)
+    obs.histogram("dpor.round_seconds").observe(0.002)
+    obs.histogram("dpor.round_seconds").observe(3.0)
+    text = prom_text(obs.REGISTRY.snapshot())
+    lines = text.splitlines()
+    assert "# TYPE demi_dpor_rounds_total counter" in lines
+    assert 'demi_dpor_rounds_total{app="raft"} 7' in lines
+    assert "# TYPE demi_dpor_host_share gauge" in lines
+    assert "demi_dpor_host_share 0.25" in lines
+    assert "# TYPE demi_dpor_round_seconds histogram" in lines
+    assert 'demi_dpor_round_seconds_bucket{le="+Inf"} 2' in lines
+    assert "demi_dpor_round_seconds_count 2" in lines
+    assert any(
+        line.startswith("demi_dpor_round_seconds_sum ") for line in lines
+    )
+    # Cumulative: bucket counts never decrease along the le axis.
+    cums = [
+        int(line.rsplit(" ", 1)[1])
+        for line in lines
+        if line.startswith('demi_dpor_round_seconds_bucket{le="')
+    ]
+    assert cums == sorted(cums) and cums[-1] == 2
+
+
+def test_metrics_http_endpoint(telemetry):
+    import urllib.request
+
+    from demi_tpu.obs import timeseries
+
+    obs.counter("http.c").inc(4)
+    server = timeseries.serve(0)
+    try:
+        port = server.server_address[1]
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=10
+        ).read().decode()
+        assert "demi_http_c_total 4" in body
+        snap = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics.json", timeout=10
+        ).read().decode())
+        assert snap["counters"]["http.c"][""] == 4
+    finally:
+        server.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Launch profiler (obs/profiler.py)
+# ---------------------------------------------------------------------------
+
+def test_launch_profiler_ledger_and_tuningcache_evidence(tmp_path):
+    from demi_tpu.obs.profiler import LaunchProfiler
+    from demi_tpu.tune import TuningCache
+
+    p = LaunchProfiler()
+    p.enable()
+    p.dispatch("dpor", 16, 0.02)
+    p.dispatch("dpor", 16, 0.04)
+    p.block("dpor", 16, 0.5)
+    p.trunk("dpor-trunk", 1, 0.1, shape="p=24")
+    ev = p.evidence()
+    assert ev["profile"] == "launch" and ev["source"] == "measured"
+    rows = {(r["kernel"], r["kind"], r["shape"]): r for r in ev["launches"]}
+    disp = rows[("dpor", "dispatch", "b=16")]
+    assert disp["launches"] == 2 and disp["lanes"] == 32
+    assert disp["seconds"] == pytest.approx(0.06)
+    assert rows[("dpor", "block", "b=16")]["seconds"] == pytest.approx(0.5)
+    assert ("dpor-trunk", "trunk", "p=24") in rows
+    # Heaviest-first ordering (the cost model reads the top shapes).
+    secs = [r["seconds"] for r in ev["launches"]]
+    assert secs == sorted(secs, reverse=True)
+    # TuningCache-compatible persistence: get() returns the evidence.
+    cache = TuningCache(str(tmp_path / "tune.json"))
+    p.persist_evidence(cache, "wk,profile=launch")
+    assert TuningCache(str(tmp_path / "tune.json")).get(
+        "wk,profile=launch"
+    )["profile"] == "launch"
+    # Disabled profiler records nothing (one-branch contract).
+    p2 = LaunchProfiler()
+    p2.enabled = False
+    p2.dispatch("x", 8, 1.0)
+    assert p2.evidence()["launches"] == []

@@ -571,6 +571,16 @@ def test_cli_sigterm_writes_loadable_checkpoint(tmp_path):
     d = DeviceDPOR(app, cfg, program, batch_size=saved["batch"])
     d.restore_state(ckpt.sections["dpor"])
     assert len(d.explored) >= 1
+    # The round journal was written alongside the checkpoints and is a
+    # contiguous 1..rounds_done prefix (SIGTERM lands at a round
+    # boundary, so journal and checkpoint agree on the round count).
+    from demi_tpu.obs import journal
+
+    ok, rounds = journal.contiguous_rounds(
+        journal.read_records(ckdir), "dpor.round"
+    )
+    assert ok and rounds, rounds
+    assert rounds[-1] == ckpt.meta["rounds_done"]
 
 
 # ---------------------------------------------------------------------------
@@ -664,3 +674,62 @@ def test_report_durability_block(tmp_path):
     assert "launch failures: 2 (2 retried)" in text
     assert "surfaces degraded to host twins: 1" in text
     assert "corrupt tuning caches degraded to empty: 1" in text
+
+
+# ---------------------------------------------------------------------------
+# Journal continuity across kill-resume (obs/journal.py satellite)
+# ---------------------------------------------------------------------------
+
+def test_journal_contiguous_across_simulated_kill_resume(tmp_path, capsys):
+    """A `dpor --checkpoint-dir` run journals one record per round; a
+    resume from an OLDER generation (the SIGKILL shape: the dead run
+    journaled rounds past the snapshot being restored) must continue the
+    SAME journal with no duplicated and no missing rounds — the records
+    past the restore point are truncated and re-journaled by the resumed
+    incarnation."""
+    from demi_tpu.cli import main
+    from demi_tpu.obs import journal
+
+    d = str(tmp_path / "ck")
+    rc = main([
+        "dpor", "--app", "raft", "--bug", "multivote", "--nodes", "3",
+        "--batch", "8", "--rounds", "4", "--max-messages", "60",
+        "--checkpoint-dir", d, "--checkpoint-every", "2",
+    ])
+    assert rc in (0, 1)
+    want = json.loads(
+        [line for line in capsys.readouterr().out.splitlines()
+         if line.startswith("{")][-1]
+    )
+    ok, rounds = journal.contiguous_rounds(
+        journal.read_records(d), "dpor.round"
+    )
+    assert ok and rounds == [1, 2, 3, 4]
+    # Simulate the kill landing after the round-2 checkpoint: every
+    # later generation is gone, but the journal still carries rounds
+    # 3..4 from the dead run.
+    gens = sorted(g for g in os.listdir(d) if g.startswith("ckpt-"))
+    for g in gens[1:]:
+        shutil.rmtree(os.path.join(d, g))
+    rc = main(["resume", d])
+    assert rc in (0, 1)
+    got = json.loads(
+        [line for line in capsys.readouterr().out.splitlines()
+         if line.startswith("{")][-1]
+    )
+    recs = journal.read_records(d, "dpor.round")
+    ok, rounds = journal.contiguous_rounds(
+        journal.read_records(d), "dpor.round"
+    )
+    assert ok and rounds == [1, 2, 3, 4], rounds
+    # Rounds 3..4 were re-journaled by the resumed incarnation.
+    assert [r["inc"] for r in recs] == [0, 0, 1, 1]
+    # And the resumed search itself converged identically (the PR 10
+    # parity surface, re-checked here so journal truncation can never
+    # mask a state divergence).
+    for key in ("explored", "interleavings", "violation_codes",
+                "rounds_done"):
+        assert want[key] == got[key], key
+    # The per-round records agree with the final summary.
+    assert recs[-1]["explored"] == got["explored"]
+    assert recs[-1]["interleavings"] == got["interleavings"]

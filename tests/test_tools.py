@@ -130,3 +130,62 @@ def test_dot_export():
     assert f"e{e1.id} -> root;" in out
     assert f"e{e2.id} -> e{e1.id};" in out
     assert "fillcolor" in out
+
+
+def test_stats_graph_timeseries_mode(tmp_path, capsys):
+    """A journaled directory (checkpoint dir / --journal dir) is
+    auto-detected and graphed from the continuous exports instead of
+    minimization_stats.json: per-round frontier/explored/rate CSV plus
+    an ASCII trend."""
+    from demi_tpu.obs import journal
+    from demi_tpu.tools.stats_graph import (
+        timeseries_ascii,
+        timeseries_csv,
+        timeseries_rows,
+    )
+
+    d = str(tmp_path)
+    j = journal.RoundJournal(d)
+    for i in range(4):
+        j.emit(
+            "dpor.round", round=i + 1, wall_s=0.5, frontier=100 + 10 * i,
+            explored=50 + 20 * i, interleavings=8 * (i + 1),
+        )
+    j.close()
+    rows = timeseries_rows(d)
+    assert [r[0] for r in rows] == [1, 2, 3, 4]
+    assert rows[-1][2] == 130 and rows[-1][3] == 110
+    csv = timeseries_csv(rows)
+    assert csv.splitlines()[0] == "round,t,frontier,explored,wall_s"
+    assert "4," in csv.splitlines()[4]
+    chart = timeseries_ascii(rows)
+    assert "frontier" in chart and "#" in chart
+
+    assert stats_main([d]) == 0
+    out = capsys.readouterr().out
+    assert "csv written" in out
+    assert os.path.exists(os.path.join(d, "timeseries.csv"))
+
+
+def test_stats_graph_timeseries_fallback_to_flushed_samples(tmp_path,
+                                                            capsys):
+    """With no round journal but a flushed time-series export (the
+    registry-sample JSONL), the rows derive from the sampled scalars."""
+    import json as _json
+
+    d = str(tmp_path)
+    rows = [
+        {"seq": i, "t": 1.0 + i, "kind": "dpor.round",
+         "v": {"dpor.frontier_size": 10 * (i + 1),
+               "dpor.explored_set_size": 5 * (i + 1)}}
+        for i in range(3)
+    ]
+    with open(os.path.join(d, "timeseries.jsonl"), "w") as f:
+        for row in rows:
+            f.write(_json.dumps(row) + "\n")
+    from demi_tpu.tools.stats_graph import timeseries_rows
+
+    got = timeseries_rows(d)
+    assert [r[2] for r in got] == [10, 20, 30]
+    assert stats_main([d]) == 0
+    assert "csv written" in capsys.readouterr().out
