@@ -311,7 +311,8 @@ _RESUME_FIELDS = {
         "prefix_fork", "autotune",
     ),
     "fuzz": _RESUME_COMMON + ("max_executions", "output", "autotune",
-                              "sanitize"),
+                              "sanitize", "streaming", "split", "chunk",
+                              "pool", "prefix_fork", "async_min"),
 }
 
 
@@ -813,6 +814,181 @@ def _fuzz_checkpoint_run(args, app, config, fuzzer, controller) -> int:
     return 0
 
 
+def _streaming_device_cfg(args, app):
+    """Device sweep shapes for the streaming fuzz pipeline — the same
+    sizing rule as the telemetry confirm sweep (the lanes re-execute the
+    fuzzer's own programs)."""
+    from .device import DeviceConfig
+
+    return DeviceConfig.for_app(
+        app,
+        pool_capacity=getattr(args, "pool", None) or 256,
+        max_steps=args.max_messages,
+        max_external_ops=max(16, args.num_events + app.num_actors + 2),
+        invariant_interval=1,
+        timer_weight=args.timer_weight,
+    )
+
+
+def _resolve_split(args, app, cfg) -> float:
+    """--split wins; under --autotune the TuningCache axis decides
+    (cache hit or recorded default — calibrate_pipeline_split); plain
+    runs take the lane-for-lane default without touching the cache."""
+    from .pipeline.budget import DEFAULT_SPLIT
+
+    if getattr(args, "split", None):
+        return args.split
+    if _autotune_requested(args):
+        import jax
+
+        from .tune import TuningCache, calibrate_pipeline_split
+
+        decision = calibrate_pipeline_split(
+            app, cfg, platform=jax.devices()[0].platform,
+            cache=TuningCache(), extra_key=_workload_discriminator(args),
+        )
+        return decision.split
+    return DEFAULT_SPLIT
+
+
+def _fuzz_streaming_run(args, app, config, fuzzer) -> int:
+    """The streaming fuzz→minimize→replay pipeline (demi_tpu/pipeline/):
+    a device fuzz sweep whose violating lanes hand off to the gamut
+    minimizer while the sweep keeps running. With --checkpoint-dir the
+    queue frames + sweep cursor snapshot at chunk/frame boundaries
+    (SIGTERM exits 3; `demi_tpu resume` continues mid-queue, no
+    violation lost or minimized twice)."""
+    from .pipeline import StreamingPipeline
+    from .serialization import ExperimentSerializer
+
+    cfg = _streaming_device_cfg(args, app)
+    gen = lambda s: fuzzer.generate_fuzz_test(seed=args.seed + s)  # noqa: E731
+    total = args.max_executions
+    chunk = min(total, getattr(args, "chunk", None) or max(8, min(64, total // 4)))
+    split = _resolve_split(args, app, cfg)
+    ckpt = getattr(args, "_resume_checkpoint", None)
+    checkpointed = bool(getattr(args, "checkpoint_dir", None))
+    pipe = StreamingPipeline(
+        app, cfg, config, gen,
+        base_key=0, chunk=chunk, split=split,
+        checkpoint_dir=getattr(args, "checkpoint_dir", None),
+    )
+    store = None
+    incarnation = 0
+    if checkpointed:
+        from .persist import CheckpointStore
+
+        store = CheckpointStore(args.checkpoint_dir)
+        if ckpt is not None:
+            if ckpt.meta.get("completed"):
+                return _report_completed(ckpt, args)
+
+            def _apply(c):
+                pipe.restore_state(c.sections["pipeline"])
+                fuzzer.restore_state(c.sections["fuzzer"])
+
+            _restore_or_exit(_apply, ckpt)
+            _restore_obs(ckpt)
+        incarnation = _attach_checkpoint_journal(
+            args, ckpt, "sweep.chunk", int(pipe.state["chunks"])
+        )
+        if ckpt is not None:
+            # The dead incarnation's post-checkpoint pipeline records
+            # re-execute and re-journal (frames re-minimize from their
+            # stage files, lanes re-enqueue) — drop them like the
+            # sweep.chunk rounds so frame/enqueue numbering stays
+            # contiguous across the resume.
+            obs.journal.JOURNAL.truncate_from(
+                "pipeline.frame", int(pipe.state["frames_done"])
+            )
+            obs.journal.JOURNAL.truncate_from(
+                "pipeline.enqueue", int(pipe.state["enqueued"])
+            )
+
+    def save_ckpt(extra_meta=None) -> None:
+        store.save(
+            {
+                "pipeline": pipe.checkpoint_state(),
+                "fuzzer": fuzzer.checkpoint_state(),
+                "obs": obs.REGISTRY.snapshot(),
+            },
+            meta={
+                "command": "fuzz",
+                "cli_args": _resume_args(args, "fuzz"),
+                "chunks_done": int(pipe.state["chunks"]),
+                "incarnation": incarnation,
+                **(extra_meta or {}),
+            },
+        )
+        _flush_samples(args.checkpoint_dir)
+
+    result = None
+    if checkpointed:
+        from .persist import PreemptionGuard
+
+        every = max(1, getattr(args, "checkpoint_every", None) or 5)
+        boundaries = [0]
+        print(
+            f"fuzz --streaming: checkpointing to {args.checkpoint_dir} "
+            f"every {every} chunk/frame boundary(ies)"
+            + (
+                f"; resumed at chunk {pipe.state['chunks']}"
+                if ckpt is not None else ""
+            ),
+            flush=True,
+        )
+        with PreemptionGuard() as guard:
+
+            def hook(kind: str) -> bool:
+                boundaries[0] += 1
+                if guard.requested or boundaries[0] % every == 0:
+                    # The in-flight elapsed time is folded in at save so
+                    # a resumed run's ttf/mcs-rate clocks stay honest.
+                    save_ckpt()
+                return guard.requested
+
+            result = pipe.run(total, boundary_hook=hook)
+        if result.preempted:
+            save_ckpt()
+            return _preempted_exit(
+                args, store,
+                {"chunks_done": int(pipe.state["chunks"]),
+                 "queue": result.queue},
+            )
+    else:
+        result = pipe.run(total)
+    summary = pipe.summary(result)
+    summary["resumed"] = ckpt is not None
+    if args.output:
+        for frame in pipe.queue.done_frames():
+            gr = pipe.results.get(frame.seed)
+            if gr is None:
+                continue  # minimized by a previous incarnation
+            out_dir = os.path.join(args.output, f"seed-{frame.seed}")
+            ExperimentSerializer.save(
+                out_dir,
+                gr.final_trace.original_externals or gr.mcs_externals,
+                gr.final_trace,
+                None,
+                app_name=args.app,
+                mcs=gr.mcs_externals,
+                minimized_trace=gr.final_trace,
+            )
+        summary["output"] = args.output
+    if checkpointed:
+        save_ckpt({"completed": True, "summary": {
+            # violation_found keys _report_completed's exit code — a
+            # resume of this finished run must report success iff MCSes
+            # were produced, like the other checkpointed commands.
+            "violation_found": bool(summary["mcs_count"]),
+            **{k: v for k, v in summary.items() if k != "mcs"},
+        }})
+        summary["checkpoints"] = dict(store.stats)
+    print(json.dumps(summary))
+    _obs_end(args, args.output)
+    return 0 if summary["mcs_count"] else 1
+
+
 def cmd_resume(args) -> int:
     """Resume a checkpointed dpor/sweep/fuzz run: load the newest valid
     snapshot generation (corrupt ones degrade to the previous good one),
@@ -866,6 +1042,26 @@ def cmd_fuzz(args) -> int:
 
     _obs_begin(args)
     _strict_io_begin(args)
+    if getattr(args, "streaming", False):
+        # Streaming pipeline: device fuzz sweep → violation queue →
+        # gamut minimizer, interleaved in flight (demi_tpu/pipeline/).
+        # Same env-switch contract as minimize for the oracle flags.
+        if getattr(args, "sanitize", False):
+            # Refuse loudly rather than silently not sanitizing: the
+            # streaming tiers run device lanes + guided lifts, not the
+            # host RandomScheduler executions the sanitizer instruments.
+            raise SystemExit(
+                "--sanitize does not compose with --streaming yet "
+                "(strict-sanitize the saved experiments via "
+                "`demi_tpu replay --sanitize` instead)"
+            )
+        if getattr(args, "prefix_fork", False):
+            os.environ["DEMI_PREFIX_FORK"] = "1"
+        if getattr(args, "async_min", False):
+            os.environ["DEMI_ASYNC_MIN"] = "1"
+        app = build_app(args)
+        config = SchedulerConfig(invariant_check=make_host_invariant(app))
+        return _fuzz_streaming_run(args, app, config, build_fuzzer(app, args))
     sanitizing = _sanitize_begin(args)
     # The device sweep is extra WORK, not just bookkeeping: run it only
     # when this invocation explicitly asked for observability artifacts
@@ -967,6 +1163,11 @@ def cmd_minimize(args) -> int:
     from .serialization import ExperimentDeserializer, ExperimentSerializer
 
     _obs_begin(args)
+    # Launch profiler on the minimizer tier: BatchedDDMin levels /
+    # internal rounds are this command's "rounds" — dispatches and
+    # harvest blocks land in the per-shape ledger exactly like dpor
+    # rounds, persisted under the same profile=launch TuningCache key.
+    profiling = _profile_begin(args)
     sanitizing = _sanitize_begin(args)
     app = build_app(args)
     config = SchedulerConfig(invariant_check=make_host_invariant(app))
@@ -975,6 +1176,20 @@ def cmd_minimize(args) -> int:
     trace = de.get_trace(externals)
     violation = de.get_violation()
     fr = FuzzResult(program=externals, trace=trace, violation=violation, executions=0)
+
+    def profile_end() -> None:
+        if not profiling:
+            return
+        from .device.batch_oracle import default_device_config
+
+        prof = {}
+        _profile_end(
+            args, prof, app, default_device_config(app, trace, externals)
+        )
+        print("profile: " + json.dumps(
+            {k: prof[k] for k in ("launch_profile_cache",) if k in prof}
+        ))
+
     if args.strategy == "incddmin":
         from .runner import edit_distance_dpor_ddmin
 
@@ -999,6 +1214,7 @@ def cmd_minimize(args) -> int:
         )
         kept = mcs.get_all_events()
         print(f"IncDDMin MCS: {len(externals)} -> {len(kept)} externals")
+        profile_end()
         _sanitize_end(sanitizing)
         ExperimentSerializer.save(
             args.experiment, externals, trace, violation, app_name=args.app,
@@ -1016,14 +1232,48 @@ def cmd_minimize(args) -> int:
             app, trace, externals, replay_peek=args.peek
         )
     with obs.span("cli.minimize", app=args.app):
-        result = run_the_gamut(
-            config, fr, wildcards=not args.no_wildcards,
-            app=None if args.host else app,
-            device_cfg=device_cfg,
-            checkpoint_dir=args.experiment, resume=args.resume,
-            stage_budget_seconds=args.stage_budget,
-        )
+        if getattr(args, "streaming", False):
+            # Single-frame streaming drive: the SAME generator the
+            # orchestrator steps (run_the_gamut drains it), exercised
+            # level-by-level here so the run journals/spans like one
+            # pipeline frame — useful for watching a lone minimization
+            # in `demi_tpu top` and for A/B-ing the generator path.
+            import time as _time
+
+            from .runner import run_the_gamut_streaming
+
+            from .minimization.pipeline import drain_stream
+
+            t_frame = _time.perf_counter()
+            result = drain_stream(run_the_gamut_streaming(
+                config, fr, wildcards=not args.no_wildcards,
+                app=None if args.host else app,
+                device_cfg=device_cfg,
+                checkpoint_dir=args.experiment, resume=args.resume,
+                stage_budget_seconds=args.stage_budget,
+            ))
+            obs.journal.emit(
+                "pipeline.frame",
+                round=1,
+                seed=args.seed,
+                code=getattr(violation, "code", None),
+                wall_s=round(_time.perf_counter() - t_frame, 6),
+                mcs_externals=len(result.mcs_externals),
+                deliveries=len(result.final_trace.deliveries()),
+                stages=len(result.stages),
+                queue_depth=0,
+                ttf_mcs_s=round(_time.perf_counter() - t_frame, 6),
+            )
+        else:
+            result = run_the_gamut(
+                config, fr, wildcards=not args.no_wildcards,
+                app=None if args.host else app,
+                device_cfg=device_cfg,
+                checkpoint_dir=args.experiment, resume=args.resume,
+                stage_budget_seconds=args.stage_budget,
+            )
     print_minimization_stats(result)
+    profile_end()
     _sanitize_end(sanitizing)
     ExperimentSerializer.save(
         args.experiment, externals, trace, violation, app_name=args.app,
@@ -1730,8 +1980,36 @@ def main(argv: Optional[list] = None) -> int:
     sanitize_flags(p)
     checkpoint_flags(p, 25, "executions")
     strict_io_flags(p)
+    fork_flags(p)
+    async_min_flags(p)
     p.add_argument("--max-executions", type=int, default=200, dest="max_executions")
     p.add_argument("-o", "--output", default=None)
+    p.add_argument(
+        "--streaming", action="store_true",
+        help="streaming fuzz→minimize→replay pipeline: a device fuzz "
+             "sweep over --max-executions lanes whose violating lanes "
+             "hand off to the gamut minimizer WHILE the sweep keeps "
+             "running (one shared in-flight launch budget; "
+             "time-to-first-MCS / MCSes-per-hour in the summary). Off "
+             "by default; the staged fuzz-then-minimize path is the "
+             "pinned bit-identical baseline (bench --config 12)",
+    )
+    p.add_argument(
+        "--split", type=float, default=None,
+        help="streaming budget split: the minimizer's share of each "
+             "in-flight turn (0<split<1; default 0.5 = lane-for-lane; "
+             "under --autotune the TuningCache pipeline_split axis "
+             "decides)",
+    )
+    p.add_argument(
+        "--chunk", type=int, default=None,
+        help="streaming sweep chunk lanes per launch (default: "
+             "max_executions/4 clamped to [8, 64])",
+    )
+    p.add_argument(
+        "--pool", type=int, default=256,
+        help="streaming device pool capacity (pending-event slots)",
+    )
     p.set_defaults(fn=cmd_fuzz)
 
     p = sub.add_parser("minimize", help="run the minimization gamut on an experiment")
@@ -1776,6 +2054,29 @@ def main(argv: Optional[list] = None) -> int:
         help="replay peek budget: absent expected deliveries may be "
              "enabled by delivering up to K pending entries "
              "(device kernel + host bookkeeping replay both peek)",
+    )
+    p.add_argument(
+        "--streaming", action="store_true",
+        help="drive the gamut through its streaming generator (one "
+             "pipeline frame: level-stepped, journaled as pipeline.* "
+             "records for `demi_tpu top`); results bit-identical to the "
+             "staged drive — same code path",
+    )
+    p.add_argument(
+        "--profile-rounds", type=int, default=0, dest="profile_rounds",
+        metavar="N",
+        help="launch profiler on the minimizer tier: attribute wall "
+             "time per replay launch (dispatch vs harvest block, keyed "
+             "by launch shape), open a jax.profiler trace window over "
+             "the first N BatchedDDMin/internal levels, and persist the "
+             "evidence to the tuning cache under the same "
+             "profile=launch key the dpor profiler uses",
+    )
+    p.add_argument(
+        "--profile-trace", default=None, dest="profile_trace",
+        metavar="DIR",
+        help="jax.profiler trace output dir for --profile-rounds "
+             "(default ./demi_profile)",
     )
     p.set_defaults(fn=cmd_minimize)
 

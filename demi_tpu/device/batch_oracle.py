@@ -121,6 +121,12 @@ class DeviceReplayChecker:
         # batch over its lane axis instead (one DDMin level spread across
         # chips, SURVEY.md §2.8).
         impl = impl or os.environ.get("DEMI_DEVICE_IMPL", "xla")
+        # Launch-telemetry + profiler parity with the explore kernels:
+        # every replay launch passes through _counted_kernel, so the
+        # launch profiler (--profile-rounds on minimize) attributes
+        # minimizer dispatches per shape exactly like dpor rounds.
+        from .explore import _counted_kernel
+
         if mesh is not None:
             from ..parallel.mesh import shard_replay_kernel
 
@@ -132,13 +138,19 @@ class DeviceReplayChecker:
                     "replay kernel; ignoring impl=pallas",
                     file=sys.stderr,
                 )
-            self.kernel = shard_replay_kernel(app, cfg, mesh)
+            self.kernel = _counted_kernel(
+                shard_replay_kernel(app, cfg, mesh), "replay-mesh"
+            )
         elif impl == "pallas":
             from .pallas_explore import make_replay_kernel_pallas
 
-            self.kernel = make_replay_kernel_pallas(app, cfg)
+            self.kernel = _counted_kernel(
+                make_replay_kernel_pallas(app, cfg), "replay-pallas"
+            )
         else:
-            self.kernel = make_replay_kernel(app, cfg)
+            self.kernel = _counted_kernel(
+                make_replay_kernel(app, cfg), "replay"
+            )
         self.max_records = cfg.max_steps + cfg.max_external_ops
         # Prefix-fork (device/fork.py, DEMI_PREFIX_FORK=1 / --prefix-fork):
         # a level's candidates are identical up to the first removed index,
@@ -162,12 +174,14 @@ class DeviceReplayChecker:
             if mesh is not None:
                 from ..parallel.mesh import shard_replay_kernel
 
-                self._fork_kernel = shard_replay_kernel(
-                    app, cfg, mesh, start_state=True
+                self._fork_kernel = _counted_kernel(
+                    shard_replay_kernel(app, cfg, mesh, start_state=True),
+                    "replay-fork-mesh",
                 )
             else:
-                self._fork_kernel = make_replay_kernel(
-                    app, cfg, start_state=True
+                self._fork_kernel = _counted_kernel(
+                    make_replay_kernel(app, cfg, start_state=True),
+                    "replay-fork",
                 )
             from .fork import make_replay_prefix_resume_runner
 
@@ -188,6 +202,11 @@ class DeviceReplayChecker:
         # consumes rng), so every async answer is bit-identical to the
         # synchronous path's.
         self._async = async_min_enabled(async_min)
+        # Streaming orchestration (demi_tpu/pipeline/budget.py): when a
+        # LaunchBudget is attached, every replay launch reports its lane
+        # count under the "minimize" tier — the shared in-flight ledger
+        # the fuzz sweep reports into as "fuzz".
+        self.launch_budget = None
         self._lowerer = (
             CandidateLowerer(app, cfg, self.max_records) if self._async else None
         )
@@ -410,6 +429,9 @@ class DeviceReplayChecker:
         res = self.kernel(batch, replay_keys(bucket))
         self.pipeline_stats["launches"] += 1
         self.pipeline_stats["lanes_launched"] += bucket
+        pending.lanes_launched += bucket
+        if self.launch_budget is not None:
+            self.launch_budget.note_dispatch("minimize", bucket)
         if obs.enabled():
             obs.counter("device.replay.pad_lanes").inc(pad)
         pending.add_part(
@@ -475,6 +497,9 @@ class DeviceReplayChecker:
             res = self._fork_kernel(batch, replay_keys(bucket), snap)
             self.pipeline_stats["launches"] += 1
             self.pipeline_stats["lanes_launched"] += bucket
+            pending.lanes_launched += bucket
+            if self.launch_budget is not None:
+                self.launch_budget.note_dispatch("minimize", bucket)
             pending.add_part(
                 res.violation,
                 np.asarray([idxs[i] for i in g.indices], np.intp),
@@ -490,6 +515,23 @@ class DeviceReplayChecker:
         # Leftover speculation (no scratch launch, no prefix-compatible
         # group padding) is simply dropped: speculation only ever rides
         # lanes that already exist — it never pays for its own launch.
+
+    def _pull_codes(self, violation_dev, bucket: int) -> np.ndarray:
+        """The ONE blocking verdict pull of the synchronous paths:
+        budget-ledgered (dispatch+harvest bracket the inline block) and
+        profiler-attributed as a harvest block, so minimizer launches
+        show up in the launch ledger the way dpor rounds do."""
+        from ..obs.profiler import PROFILER
+
+        if self.launch_budget is not None:
+            self.launch_budget.note_dispatch("minimize", bucket)
+        t0 = time.perf_counter() if PROFILER.enabled else 0.0
+        arr = np.asarray(violation_dev)
+        if PROFILER.enabled:
+            PROFILER.block("replay", bucket, time.perf_counter() - t0)
+        if self.launch_budget is not None:
+            self.launch_budget.note_harvest("minimize", bucket)
+        return arr
 
     def _scratch_codes(self, records: np.ndarray, n: int) -> np.ndarray:
         """Replay ``records`` from step 0 and return per-lane violation
@@ -511,7 +553,7 @@ class DeviceReplayChecker:
         res = self.kernel(records, replay_keys(bucket))
         if obs.enabled():
             obs.counter("device.replay.pad_lanes").inc(bucket - n)
-        return np.asarray(res.violation)[:n]
+        return self._pull_codes(res.violation, bucket)[:n]
 
     def _forked_codes(self, records: np.ndarray, n: int) -> np.ndarray:
         """Prefix-fork verdicts: group candidates by bucketed shared
@@ -545,9 +587,9 @@ class DeviceReplayChecker:
                     [suffixes, np.repeat(suffixes[:1], bucket - len(g.indices), axis=0)]
                 )
             res = self._fork_kernel(suffixes, replay_keys(bucket), snap)
-            codes[np.asarray(g.indices)] = np.asarray(res.violation)[
-                : len(g.indices)
-            ]
+            codes[np.asarray(g.indices)] = self._pull_codes(
+                res.violation, bucket
+            )[: len(g.indices)]
             self._forker.note_group(len(g.indices), trunk_steps, hit)
         if scratch:
             codes[np.asarray(scratch)] = self._scratch_codes(
@@ -593,6 +635,9 @@ class PendingVerdicts:
         self._parts: List[tuple] = []
         self._dispatched_at: Optional[float] = None
         self._verdicts: Optional[List[bool]] = None
+        # Lanes launched for this handle (budget ledger: dispatched at
+        # launch, harvested when the codes are pulled below).
+        self.lanes_launched = 0
 
     def add_part(self, violation_dev, cand_idx, lane_idx, spec_lanes) -> None:
         self._parts.append((violation_dev, cand_idx, lane_idx, spec_lanes))
@@ -625,6 +670,16 @@ class PendingVerdicts:
         stats["harvest_wait_seconds"] += wait
         stats["spec_dispatched"] += spec_count
         obs.counter("pipe.harvest_wait_seconds").inc(wait)
+        if self.lanes_launched:
+            from ..obs.profiler import PROFILER
+
+            if PROFILER.enabled:
+                PROFILER.block("replay", self.lanes_launched, wait)
+            if self.checker.launch_budget is not None:
+                self.checker.launch_budget.note_harvest(
+                    "minimize", self.lanes_launched
+                )
+            self.lanes_launched = 0
         if obs.enabled():
             # Host-vs-device split of the pipeline's round-trip time:
             # overlap_seconds is host planning done UNDER device

@@ -15,6 +15,7 @@ import time
 from typing import Any, List, Optional, Sequence
 
 from .. import obs
+from ..obs.profiler import PROFILER
 from ..trace import EventTrace
 from .event_dag import AtomicEvent, EventDag, UnmodifiedEventDag
 from .pipeline import async_min_enabled, speculation_room
@@ -227,6 +228,20 @@ class BatchedDDMin(Minimizer):
         return candidates, n_subsets, n
 
     def minimize(self, dag: EventDag, violation_fingerprint: Any, init=None) -> EventDag:
+        from .pipeline import drain_stream
+
+        return drain_stream(
+            self.minimize_stream(dag, violation_fingerprint, init=init)
+        )
+
+    def minimize_stream(self, dag: EventDag, violation_fingerprint: Any, init=None):
+        """Generator form of ``minimize``: yields ``("ddmin", level)``
+        after every batched level so a streaming caller (the
+        fuzz→minimize→replay orchestrator, demi_tpu/pipeline/) can
+        interleave other tiers' launches between levels. ``minimize``
+        drains this generator to completion, so the two forms are one
+        code path — level order, verdicts, and the MCS are identical by
+        construction."""
         if init is not None:
             raise NotImplementedError(
                 "BatchedDDMin does not thread init through test_batch"
@@ -310,6 +325,11 @@ class BatchedDDMin(Minimizer):
                 externals=len(atoms),
                 adopted=adopted_idx is not None,
             )
+            # Level boundary: close a --profile-rounds trace window after
+            # its budgeted levels (minimizer levels are this tier's
+            # "rounds"), and hand control back to a streaming driver.
+            PROFILER.tick_round()
+            yield ("ddmin", self.levels)
             if adopted_idx is not None:
                 current = candidates[adopted_idx]
                 # Subset adopted -> restart at coarse granularity;

@@ -18,6 +18,7 @@ import time
 from typing import Any, Callable, List, Optional, Sequence, Tuple
 
 from .. import obs
+from ..obs.profiler import PROFILER
 from ..events import (
     BeginUnignorableEvents,
     EndUnignorableEvents,
@@ -242,6 +243,16 @@ class BatchedInternalMinimizer:
         self.spec_exec_waste = 0
 
     def minimize(self, initial_failing: EventTrace) -> EventTrace:
+        from .pipeline import drain_stream
+
+        return drain_stream(self.minimize_stream(initial_failing))
+
+    def minimize_stream(self, initial_failing: EventTrace):
+        """Generator form of ``minimize``: yields ``("intmin", round)``
+        after every batched removal round so a streaming caller
+        (demi_tpu/pipeline/) can interleave other tiers' launches
+        between rounds. ``minimize`` drains it, so round order and the
+        minimized trace are identical by construction."""
         use_async = self.speculative and getattr(
             self.batch_check, "supports_async", False
         )
@@ -280,6 +291,10 @@ class BatchedInternalMinimizer:
                 deliveries=len(last_failing.deliveries()),
                 adopted=adopted is not None,
             )
+            # Round boundary: --profile-rounds window accounting + the
+            # streaming caller's interleave point.
+            PROFILER.tick_round()
+            yield ("intmin", rounds_run)
             obs.counter("minimize.internal.batched_trials").inc(
                 len(candidates)
             )

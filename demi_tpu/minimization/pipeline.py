@@ -35,6 +35,19 @@ import os
 from typing import Optional
 
 
+def drain_stream(gen):
+    """Drive a streaming-minimizer generator to completion and return
+    its ``StopIteration`` value — the ONE drain idiom behind
+    ``run_the_gamut``, ``BatchedDDMin.minimize``,
+    ``BatchedInternalMinimizer.minimize``, and the CLI's single-frame
+    streaming drive."""
+    while True:
+        try:
+            next(gen)
+        except StopIteration as stop:
+            return stop.value
+
+
 def async_min_enabled(explicit: Optional[bool] = None) -> bool:
     """Resolve the async-minimization switch: an explicit constructor arg
     wins, otherwise ``DEMI_ASYNC_MIN`` (off by default) — the same

@@ -233,7 +233,8 @@ JOURNAL: Optional[RoundJournal] = None
 #: grow the (unrotated within one flush window) time-series export per
 #: execution instead of per round.
 _SAMPLED_KINDS = frozenset(
-    ("dpor.round", "sweep.chunk", "minimize.level", "minimize.stage")
+    ("dpor.round", "sweep.chunk", "minimize.level", "minimize.stage",
+     "pipeline.frame")
 )
 
 

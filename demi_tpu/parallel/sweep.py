@@ -354,6 +354,15 @@ class SweepDriver:
         # counter for the round journal; a checkpointed resume seeds it
         # at the restored chunk count so the journal stays contiguous.
         self.chunk_index = 0
+        # Streaming handoff (demi_tpu/pipeline/): called with the
+        # violating retirements' (seeds, codes) arrays at every chunk
+        # harvest / continuous retirement batch — the sweep keeps
+        # running; the hook's owner queues the lanes for minimization.
+        self.violation_hook = None
+        # Shared fuzz/minimize in-flight ledger (pipeline/budget.py):
+        # when attached, every chunk dispatch/harvest reports its lane
+        # count under the "fuzz" tier.
+        self.launch_budget = None
         # Host-share ledger (always on — a few clock reads per chunk):
         # wall time on host planning/lowering/harvest accumulation vs
         # device segments / blocked kernel waits. Continuous sweeps split
@@ -448,6 +457,8 @@ class SweepDriver:
             res = self._dispatch_forked(progs, keys)
         else:
             res = self.kernel(progs, keys)
+        if self.launch_budget is not None:
+            self.launch_budget.note_dispatch("fuzz", len(real))
         return real, res, t0
 
     def _dispatch_forked(self, progs, keys):
@@ -640,9 +651,17 @@ class SweepDriver:
                 res.status, res.violation, res.deliveries, n_real,
                 invariant_interval=self.cfg.invariant_interval,
             )
+        if self.launch_budget is not None:
+            self.launch_budget.note_harvest("fuzz", n_real)
         violations = np.asarray(res.violation)[:n_real]
         statuses = np.asarray(res.status)[:n_real]
         lanes = np.nonzero(statuses == ST_VIOLATION)[0]
+        if self.violation_hook is not None and len(lanes):
+            # Streaming handoff: every violating lane of this chunk, in
+            # lane (= seed) order, the moment the chunk harvests.
+            self.violation_hook(
+                np.asarray(real)[lanes], violations[lanes]
+            )
         uniq, cnt = np.unique(violations, return_counts=True)
         codes = {
             int(c): int(k) for c, k in zip(uniq.tolist(), cnt.tolist())
@@ -806,24 +825,24 @@ class SweepDriver:
         acc = _HarvestAccumulator()
         t0 = time.perf_counter()
         for seeds, statuses, codes, hashes in drv._run_batches(total_lanes):
-            if stop_on_violation:
-                vio = np.flatnonzero(codes != 0)
-                if len(vio):
-                    # Stop AT the first violating retirement: lanes after
-                    # it in the same harvest round are uncounted, exactly
-                    # like the per-item loop's mid-round break.
-                    end = int(vio[0]) + 1
-                    seeds, statuses, codes, hashes = (
-                        seeds[:end], statuses[:end], codes[:end],
-                        hashes[:end],
-                    )
-                    acc.add(seeds, statuses, codes, hashes)
-                    if retire_hook is not None:
-                        retire_hook(seeds, statuses, codes, hashes)
-                    break
+            # Every retirement in this harvest round is PAID-FOR device
+            # work — count them all before deciding to stop. (The old
+            # array path truncated at the first violating retirement,
+            # mimicking the per-item loop's mid-round break; that threw
+            # away already-retired non-violating verdicts in the same
+            # round, undercounting lanes/codes the device had computed.
+            # tests/test_streaming.py pins the retained-lane counts.)
             acc.add(seeds, statuses, codes, hashes)
             if retire_hook is not None:
                 retire_hook(seeds, statuses, codes, hashes)
+            vio = np.flatnonzero(codes != 0)
+            if self.violation_hook is not None and len(vio):
+                # Streaming handoff from the continuous driver: the
+                # violating retirements, in retirement order, without
+                # stopping the sweep.
+                self.violation_hook(seeds[vio], codes[vio])
+            if stop_on_violation and len(vio):
+                break
         chunk = acc.chunk(slice_index=0, seconds=time.perf_counter() - t0)
         result = SweepResult(chunks=[chunk])
         result.occupancy = drv.last_occupancy

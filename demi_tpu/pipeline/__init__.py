@@ -1,0 +1,47 @@
+"""demi_tpu.pipeline: streaming fuzz→minimize→replay orchestration.
+
+Violation lanes hand off to the minimizer while the sweep keeps running:
+a ``ViolationQueue`` of persist/-serializable frames fed by the sweep
+drivers' violation hooks, drained by a consumer that steps the gamut's
+batched minimizers level-by-level between sweep chunk dispatch and
+harvest, under one ``LaunchBudget`` split between the tiers.
+
+Off by default — ``--streaming`` on the fuzz/minimize CLI, with the
+staged ``run_the_gamut`` path as the pinned bit-identical A/B baseline
+(bench ``--config 12``: time-to-first-MCS and MCSes/hour).
+
+``queue``/``budget`` import light (no jax); the orchestrator (which
+pulls in the device stack) loads lazily on first attribute access.
+"""
+
+from .budget import (  # noqa: F401
+    DEFAULT_SPLIT,
+    PIPELINE_SPLIT_AXIS,
+    LaunchBudget,
+)
+from .queue import ViolationFrame, ViolationQueue  # noqa: F401
+
+__all__ = [
+    "DEFAULT_SPLIT",
+    "PIPELINE_SPLIT_AXIS",
+    "LaunchBudget",
+    "PipelineRunResult",
+    "StreamingPipeline",
+    "ViolationFrame",
+    "ViolationQueue",
+    "lift_violating_seed",
+    "run_staged",
+]
+
+_LAZY = {
+    "StreamingPipeline", "PipelineRunResult", "run_staged",
+    "lift_violating_seed", "frame_signature",
+}
+
+
+def __getattr__(name: str):
+    if name in _LAZY:
+        from . import orchestrator
+
+        return getattr(orchestrator, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,85 @@
+"""Shared in-flight launch budget: one ledger both tiers report into.
+
+The streaming orchestrator runs the fuzz sweep and the minimizer through
+the SAME device; what keeps either tier from starving the other is a
+single launch-lane budget split between them. The ``split`` knob is the
+minimizer's share of each in-flight turn: while one sweep chunk of
+``C`` lanes is in flight, the orchestrator lets the minimizer dispatch
+up to ``C * split / (1 - split)`` lanes before harvesting the chunk —
+``split=0.5`` interleaves the tiers lane-for-lane, ``0.75`` gives the
+minimizer three lanes per sweep lane (drain-biased), ``0.25`` one per
+three (sweep-biased). The knob is a measured calibration axis
+(``demi_tpu.tune.calibrate_pipeline_split``) persisted to the
+TuningCache like every other knob here.
+
+The ledger itself is tier-labeled dispatch/harvest lane counts; the
+drivers (``SweepDriver``, ``DeviceReplayChecker``) report
+unconditionally through one attribute-is-None branch, mirroring the
+journal's attachment contract. Gauges: ``pipe.inflight_lanes`` per tier
+(in-flight lanes right now) under DEMI_OBS.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+from .. import obs
+
+#: Default minimizer share of an in-flight turn (equal lane split).
+DEFAULT_SPLIT = 0.5
+
+#: The calibration axis ``calibrate_pipeline_split`` walks.
+PIPELINE_SPLIT_AXIS = (0.25, 0.5, 0.75)
+
+
+class LaunchBudget:
+    """Tier-labeled in-flight launch-lane ledger + the split policy."""
+
+    def __init__(self, split: float = DEFAULT_SPLIT):
+        if not (0.0 < split < 1.0):
+            raise ValueError(f"split must be in (0, 1); got {split!r}")
+        self.split = split
+        self.inflight: Dict[str, int] = {}
+        self.dispatched: Dict[str, int] = {}
+        self.harvested: Dict[str, int] = {}
+        self.launches: Dict[str, int] = {}
+
+    # -- ledger --------------------------------------------------------------
+    def note_dispatch(self, tier: str, lanes: int) -> None:
+        self.inflight[tier] = self.inflight.get(tier, 0) + int(lanes)
+        self.dispatched[tier] = self.dispatched.get(tier, 0) + int(lanes)
+        self.launches[tier] = self.launches.get(tier, 0) + 1
+        if obs.enabled():
+            obs.gauge("pipe.inflight_lanes").set(
+                self.inflight[tier], tier=tier
+            )
+
+    def note_harvest(self, tier: str, lanes: int) -> None:
+        self.inflight[tier] = max(0, self.inflight.get(tier, 0) - int(lanes))
+        self.harvested[tier] = self.harvested.get(tier, 0) + int(lanes)
+        if obs.enabled():
+            obs.gauge("pipe.inflight_lanes").set(
+                self.inflight[tier], tier=tier
+            )
+
+    def lanes_dispatched(self, tier: str) -> int:
+        return self.dispatched.get(tier, 0)
+
+    # -- split policy --------------------------------------------------------
+    def turn_allowance(self, chunk_lanes: int) -> int:
+        """Minimizer lanes allowed while a ``chunk_lanes``-lane sweep
+        chunk is in flight: the split knob applied to the turn's total
+        in-flight lane budget ``chunk_lanes / (1 - split)``. Always at
+        least one minimizer LEVEL advances per turn (a tiny chunk must
+        not wedge the queue), which the orchestrator enforces by
+        checking the allowance only between levels."""
+        return max(1, round(chunk_lanes * self.split / (1.0 - self.split)))
+
+    def snapshot(self) -> Dict[str, Dict[str, int]]:
+        return {
+            "split": self.split,
+            "inflight": dict(self.inflight),
+            "dispatched": dict(self.dispatched),
+            "harvested": dict(self.harvested),
+            "launches": dict(self.launches),
+        }

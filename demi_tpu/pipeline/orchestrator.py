@@ -1,0 +1,659 @@
+"""Streaming fuzz→minimize→replay orchestrator.
+
+``run_the_gamut`` was fuzz-to-completion, then minimize, then replay —
+the device idled between tiers and time-to-first-MCS was the SUM of the
+stages. Here the tiers share one in-flight launch budget:
+
+  - the fuzz sweep dispatches each chunk WITHOUT blocking (the
+    ``sweep_async`` dispatch/harvest split);
+  - every violating lane is enqueued as a ``ViolationFrame`` the moment
+    its chunk harvests, while the sweep keeps fuzzing the remaining
+    lanes;
+  - between a chunk's dispatch and its harvest, the consumer advances
+    the queued frames' gamut generators
+    (``runner.run_the_gamut_streaming``) level by level through the
+    async double-buffered replay oracles — minimization levels and fuzz
+    chunks overlap in flight, split by ``LaunchBudget.turn_allowance``.
+
+Wall-clock math on one device: device work still serializes, but each
+tier's HOST half (chunk lowering/harvest vs candidate planning, lifts,
+host bookkeeping STS executions — the dominant minimization cost on
+CPU, BENCH_r05) now runs under the OTHER tier's kernels. Headline
+metrics move from time-to-first-violation to time-to-first-MCS and
+MCSes/hour (bench ``--config 12``).
+
+Parity: the staged baseline (``run_staged``) and the streaming path
+execute the SAME per-frame generator — ``run_the_gamut`` drains the
+generator the orchestrator steps — and frames are independent (each
+gets its own checker; verdicts are pure functions of record bytes), so
+MCS externals, final traces, and violation-code sets are bit-identical
+by construction (tests/test_streaming.py pins it).
+
+Fleet seam (ROADMAP item 1): frames serialize via persist/'s structural
+JSON — (seed, code) in, minimization artifacts out — so a "stage" can
+live on another host; the coordinator's service loop is this queue with
+the lift/minimize consumer on a different worker.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from .. import obs
+from ..config import SchedulerConfig
+from .budget import DEFAULT_SPLIT, LaunchBudget
+from .queue import ViolationFrame, ViolationQueue
+
+
+@dataclass
+class PipelineRunResult:
+    """Shared result shape of the staged baseline and the streaming
+    orchestrator, so the A/B compares field-for-field."""
+
+    results: Dict[int, Any] = field(default_factory=dict)  # seed -> GamutResult
+    codes: Dict[int, int] = field(default_factory=dict)    # seed -> code
+    lanes: int = 0
+    violations: int = 0
+    ttf_mcs_s: Optional[float] = None
+    wall_s: float = 0.0
+    # Durable done-frame count: spans incarnations on a resumed run,
+    # where ``results`` holds only THIS process's live GamutResults.
+    frames_done: int = 0
+    queue: Dict[str, int] = field(default_factory=dict)
+    budget: Dict[str, Any] = field(default_factory=dict)
+    preempted: bool = False
+
+    @property
+    def mcs_count(self) -> int:
+        return max(self.frames_done, len(self.results))
+
+    @property
+    def mcs_per_hour(self) -> Optional[float]:
+        if self.wall_s <= 0 or not self.mcs_count:
+            return None
+        return self.mcs_count * 3600.0 / self.wall_s
+
+
+def _frame_result_payload(gamut_result, code: int, wall_s: float) -> dict:
+    """Structural-JSON minimization artifacts for a done frame — the
+    codec serialization.py already defines, so the frame round-trips
+    through persist/ (and, in the fleet story, over the wire)."""
+    from ..serialization import _event_to_json, _external_to_json
+
+    def ext(e):
+        try:
+            return _external_to_json(e)
+        except TypeError:
+            return {"type": "repr", "v": repr(e)}
+
+    return {
+        "code": int(code),
+        "wall_s": round(wall_s, 6),
+        "stages": [[s, e, d] for s, e, d in gamut_result.stages],
+        "mcs": [ext(e) for e in gamut_result.mcs_externals],
+        "final_trace": [
+            _event_to_json(u) for u in gamut_result.final_trace.events
+        ],
+    }
+
+
+def _handle_ready(handle) -> bool:
+    """True when a dispatched sweep chunk's device work has completed
+    (its result buffers are ready) — the work-conserving signal that
+    stops the minimizer turn. Falls back to True (harvest now) when the
+    backend's arrays don't expose readiness."""
+    _real, res, _t0 = handle
+    leaf = res[0] if isinstance(res, tuple) else res
+    probe = getattr(leaf, "is_ready", None)
+    if probe is None:
+        return True
+    try:
+        return bool(probe())
+    except Exception:
+        return True
+
+
+def frame_signature(gamut_result) -> tuple:
+    """Eid-insensitive canonical signature of a frame's minimization
+    artifacts: MCS external records + final-trace event records with
+    the per-process identity counters (eid / Unique id) stripped. Every
+    lift mints fresh eids from the global counter, so two runs of the
+    SAME pipeline produce identical content under different ids —
+    bit-identity for the streaming-vs-staged A/B is over this signature
+    (bench --config 12, tests/test_streaming.py)."""
+    import json as _json
+
+    from ..serialization import _event_to_json, _external_to_json
+
+    exts = []
+    for e in gamut_result.mcs_externals:
+        try:
+            rec = _external_to_json(e)
+            rec.pop("eid", None)
+            rec.pop("block", None)
+        except TypeError:
+            rec = {"repr": repr(e)}
+        exts.append(_json.dumps(rec, sort_keys=True))
+    events = []
+    for u in gamut_result.final_trace.events:
+        rec = _event_to_json(u)
+        rec.pop("id", None)
+        events.append(_json.dumps(rec, sort_keys=True))
+    return (tuple(exts), tuple(events))
+
+
+def make_lift_kernel(app, cfg):
+    """One traced single-lane kernel shared across a run's lifts (the
+    per-call build in ``lift_lane_to_host`` would recompile per
+    violation)."""
+    from ..device.explore import make_single_lane_trace_kernel
+
+    return make_single_lane_trace_kernel(app, cfg)
+
+
+def lift_violating_seed(
+    app, cfg, config, program_gen, seed, base_key=0, trace_kernel=None
+):
+    """Re-derive a violating sweep lane's host experiment: the standard
+    device→host lift ritual (``runner.lift_lane_to_host``) on a
+    batch-of-one rebuilt from the seed — a frame's trace/externals are a
+    pure function of (seed, base_key), which is what lets the queue ship
+    frames as a few ints. Returns the GuidedScheduler host result."""
+    import jax
+
+    from ..device.encoding import (
+        device_trace_to_guide,
+        lower_program,
+        stack_programs,
+    )
+    from ..schedulers.guided import GuidedScheduler
+
+    if trace_kernel is None:
+        trace_kernel = make_lift_kernel(app, cfg)
+    progs = stack_programs([lower_program(app, cfg, program_gen(seed))])
+    keys = jax.vmap(
+        lambda s: jax.random.fold_in(jax.random.PRNGKey(base_key), s)
+    )(np.asarray([seed], np.uint32))
+    single = trace_kernel(
+        jax.tree_util.tree_map(lambda x: x[0], progs), keys[0]
+    )
+    guide = device_trace_to_guide(
+        app, np.asarray(single.trace), int(single.trace_len)
+    )
+    return GuidedScheduler(config, app).execute_guide(guide)
+
+
+class StreamingPipeline:
+    """The streaming orchestrator (see module doc).
+
+    ``max_frames`` caps how many violations are MINIMIZED (in enqueue
+    order — chunked sweeps retire in seed order, so the cap selects the
+    same frame set as the staged baseline's); later violations are still
+    counted and journaled, just marked skipped. ``checkpoint_dir``
+    enables durable frames: each frame's gamut stages checkpoint under
+    ``<dir>/frames/seed-N/`` via the existing stage machinery, and
+    ``checkpoint_state``/``restore_state`` snapshot the queue + sweep
+    cursor so a SIGKILLed run resumes mid-queue with no violation lost
+    or minimized twice (seed-keyed dedup)."""
+
+    def __init__(
+        self,
+        app,
+        cfg,
+        config: SchedulerConfig,
+        program_gen: Callable[[int], list],
+        *,
+        base_key: int = 0,
+        chunk: int = 64,
+        split: float = DEFAULT_SPLIT,
+        depth: int = 4,
+        wildcards: bool = True,
+        stage_budget_seconds: Optional[float] = None,
+        max_frames: Optional[int] = None,
+        checkpoint_dir: Optional[str] = None,
+    ):
+        from ..parallel.sweep import SweepDriver
+
+        self.app = app
+        self.cfg = cfg
+        self.config = config
+        self.program_gen = program_gen
+        self.base_key = base_key
+        self.chunk = chunk
+        # Fuzz-tier pipeline depth: chunks kept in flight at once. One
+        # chunk is ~subsecond of device work; a minimizer host phase (a
+        # fresh frame's kernel compiles, candidate planning) can span
+        # several seconds — depth > 1 keeps the device fed with sweep
+        # work through those phases instead of idling after the lone
+        # chunk retires.
+        self.depth = max(1, depth)
+        self.wildcards = wildcards
+        self.stage_budget_seconds = stage_budget_seconds
+        self.max_frames = max_frames
+        self.checkpoint_dir = checkpoint_dir
+        self.budget = LaunchBudget(split)
+        self.queue = ViolationQueue()
+        self.driver = SweepDriver(app, cfg, program_gen)
+        self.driver.launch_budget = self.budget
+        self._fresh: List[tuple] = []  # (seed, code) from the last harvest
+        self.driver.violation_hook = (
+            lambda seeds, codes: self._fresh.extend(
+                zip(seeds.tolist(), codes.tolist())
+            )
+        )
+        self.results: Dict[int, Any] = {}
+        # One compiled replay oracle per bucketed frame shape, shared
+        # across queue frames: the staged path compiles a fresh checker
+        # per violation; the orchestrator amortizes those compiles over
+        # the queue (and previews the fleet's multi-tenant minimization
+        # batching, where many tenants' frames share one oracle).
+        self._checkers: Dict[tuple, Any] = {}
+        self._lift_kernel = None
+        self.state: Dict[str, Any] = {
+            "seeds_done": 0,
+            "chunks": 0,
+            "violations": 0,
+            "codes": {},
+            "overflow_lanes": 0,
+            "enqueued": 0,
+            "frames_done": 0,
+            "ttf_mcs_s": None,
+            "elapsed_s": 0.0,
+            "max_depth": 0,
+        }
+        self._resumed = False
+
+    # -- persist -------------------------------------------------------------
+    def checkpoint_state(self) -> Dict[str, Any]:
+        return {
+            "state": dict(self.state),
+            "queue": self.queue.checkpoint_state(),
+        }
+
+    def restore_state(self, payload: Dict[str, Any]) -> None:
+        self.state.update(payload["state"])
+        self.queue.restore_state(payload["queue"])
+        self.driver.chunk_index = int(self.state["chunks"])
+        self._resumed = True
+
+    # -- internals -----------------------------------------------------------
+    def _frame_dir(self, seed: int) -> Optional[str]:
+        if self.checkpoint_dir is None:
+            return None
+        return os.path.join(self.checkpoint_dir, "frames", f"seed-{seed}")
+
+    def _frame_checker(self, trace, externals):
+        """Shared replay oracle for a frame, keyed by its BUCKETED
+        device shape: ``default_device_config`` sizes from the trace in
+        multiples of 8; bucketing rounds pool/steps up to 128 (externals
+        to 16) so frames of similar depth land on ONE compiled kernel.
+        Capacities only ever round UP — padding is semantics-free
+        (early_exit keeps replay wall tracking the live candidate), so
+        verdicts and the MCS are identical to per-frame sizing."""
+        import dataclasses as _dc
+
+        from ..device.batch_oracle import (
+            DeviceReplayChecker,
+            default_device_config,
+        )
+
+        cfg = default_device_config(self.app, trace, externals)
+
+        def up(n: int, m: int) -> int:
+            return (n + m - 1) // m * m
+
+        cfg = _dc.replace(
+            cfg,
+            pool_capacity=up(cfg.pool_capacity, 128),
+            max_steps=up(cfg.max_steps, 128),
+            max_external_ops=up(cfg.max_external_ops, 16),
+        )
+        key = (cfg.pool_capacity, cfg.max_steps, cfg.max_external_ops)
+        checker = self._checkers.get(key)
+        if checker is None:
+            checker = DeviceReplayChecker(self.app, cfg, self.config)
+            checker.launch_budget = self.budget
+            self._checkers[key] = checker
+            if obs.enabled():
+                obs.gauge("pipe.checker_shapes").set(len(self._checkers))
+        return checker
+
+    def _start_frame(self, frame: ViolationFrame):
+        """Lift the frame's lane to a host experiment and open its gamut
+        generator. The lift is a single-lane launch on the minimize side
+        of the seam; it rides the same budget ledger."""
+        from ..runner import FuzzResult, run_the_gamut_streaming
+
+        if self._lift_kernel is None:
+            self._lift_kernel = make_lift_kernel(self.app, self.cfg)
+        self.budget.note_dispatch("minimize", 1)
+        try:
+            host = lift_violating_seed(
+                self.app, self.cfg, self.config, self.program_gen,
+                frame.seed, self.base_key, trace_kernel=self._lift_kernel,
+            )
+        finally:
+            self.budget.note_harvest("minimize", 1)
+        if host.violation is None:
+            # The guide executed clean on the host — possible only for
+            # invariant-window edge cases; surface it, don't crash the
+            # pipeline.
+            obs.counter("pipe.lift_no_violation").force_inc()
+            return None, None
+        externals = list(host.trace.original_externals)
+        fr = FuzzResult(
+            program=externals,
+            trace=host.trace,
+            violation=host.violation,
+            executions=0,
+        )
+        gen = run_the_gamut_streaming(
+            self.config, fr,
+            wildcards=self.wildcards,
+            app=self.app,
+            checkpoint_dir=self._frame_dir(frame.seed),
+            resume=self._resumed,
+            stage_budget_seconds=self.stage_budget_seconds,
+            launch_budget=self.budget,
+            checker=self._frame_checker(host.trace, externals),
+        )
+        return fr, gen
+
+    def _finish_frame(self, frame, fr, gamut_result, wall_s, clock) -> None:
+        self.results[frame.seed] = gamut_result
+        payload = _frame_result_payload(gamut_result, frame.code, wall_s)
+        self.queue.mark_done(frame.seed, payload)
+        self.state["frames_done"] += 1
+        elapsed = clock()
+        if self.state["ttf_mcs_s"] is None:
+            self.state["ttf_mcs_s"] = round(elapsed, 6)
+            obs.REGISTRY.gauge("pipe.ttf_mcs").force_set(
+                self.state["ttf_mcs_s"]
+            )
+        if elapsed > 0:
+            obs.REGISTRY.gauge("pipe.mcs_per_hour").force_set(
+                round(self.state["frames_done"] * 3600.0 / elapsed, 3)
+            )
+        obs.journal.emit(
+            "pipeline.frame",
+            round=self.state["frames_done"],
+            seed=frame.seed,
+            code=frame.code,
+            wall_s=round(wall_s, 6),
+            mcs_externals=len(gamut_result.mcs_externals),
+            deliveries=len(gamut_result.final_trace.deliveries()),
+            stages=len(gamut_result.stages),
+            queue_depth=self.queue.depth,
+            ttf_mcs_s=self.state["ttf_mcs_s"],
+        )
+
+    def _absorb_harvest(self, chunk_result) -> None:
+        self.state["seeds_done"] += chunk_result.lanes
+        self.state["chunks"] += 1
+        self.state["violations"] += chunk_result.violations
+        self.state["overflow_lanes"] += chunk_result.overflow_lanes
+        for code, n in chunk_result.codes.items():
+            key = str(code)
+            self.state["codes"][key] = self.state["codes"].get(key, 0) + n
+        for seed, code in self._fresh:
+            frame = self.queue.offer(seed, code)
+            if frame is None:
+                continue  # resume re-retirement: already queued/minimized
+            self.state["enqueued"] += 1
+            if (
+                self.max_frames is not None
+                and self.queue.enqueued > self.max_frames
+            ):
+                # Beyond the minimization cap: counted and journaled as
+                # a violation, never minimized — the staged baseline
+                # applies the same first-K (enqueue-order) rule.
+                self.queue.mark_skipped(seed)
+            depth = self.queue.depth
+            self.state["max_depth"] = max(self.state["max_depth"], depth)
+            if obs.enabled():
+                obs.gauge("pipe.queue_depth").set(depth)
+            obs.journal.emit(
+                "pipeline.enqueue",
+                round=self.state["enqueued"],
+                seed=int(seed),
+                code=int(code),
+                queue_depth=depth,
+                minimize=frame.status == "queued",
+            )
+        self._fresh = []
+
+    # -- the service loop ----------------------------------------------------
+    def run(
+        self,
+        total_lanes: int,
+        boundary_hook: Optional[Callable[[str], bool]] = None,
+    ) -> PipelineRunResult:
+        """Drive the sweep and the minimizer queue to completion.
+        ``boundary_hook(kind)`` fires at every chunk harvest ("chunk")
+        and frame completion ("frame") — the durable runs' checkpoint /
+        preemption boundary; returning True stops the loop gracefully
+        (queued frames stay queued in the checkpointed state)."""
+        t0 = time.perf_counter()
+        base_elapsed = float(self.state["elapsed_s"])
+        # Run-spanning clock: prior incarnations' elapsed plus this
+        # run's — what ttf_mcs / MCSes-per-hour are measured against,
+        # synced into the checkpointable state at every boundary.
+        clock = lambda: base_elapsed + (time.perf_counter() - t0)  # noqa: E731
+
+        def sync_clock() -> None:
+            self.state["elapsed_s"] = round(clock(), 6)
+
+        cur = int(self.state["seeds_done"])
+        pending: List[tuple] = []  # in-flight (handle, lanes), oldest first
+        active = None   # (frame, FuzzResult, generator, started_at)
+        preempted = False
+        with obs.span("pipeline.streaming", lanes=total_lanes):
+            while not preempted:
+                # Keep the fuzz tier's pipeline full: up to ``depth``
+                # chunks in flight (dispatch is ~ms; device work queues).
+                while len(pending) < self.depth and cur < total_lanes:
+                    n = min(self.chunk, total_lanes - cur)
+                    handle = self.driver._dispatch_chunk(
+                        range(cur, cur + n), self.base_key
+                    )
+                    pending.append((handle, n))
+                    cur += n
+                # Minimizer turn: advance frames while chunks are in
+                # flight. Work-conserving: as long as the OLDEST chunk's
+                # device work is unfinished, harvesting would only
+                # block, so keep stepping the minimizer (its launches
+                # queue behind the chunks — the device never idles).
+                # Once it IS ready, the split's lane allowance bounds
+                # how much longer its harvest waits — the fuzz tier's
+                # guaranteed share of the turn. Unbounded once the
+                # sweep is exhausted.
+                allowance = (
+                    self.budget.turn_allowance(pending[0][1])
+                    if pending
+                    else None
+                )
+                mark = self.budget.lanes_dispatched("minimize")
+                while active is not None or self.queue.depth:
+                    if (
+                        allowance is not None
+                        and _handle_ready(pending[0][0])
+                        and self.budget.lanes_dispatched("minimize") - mark
+                        >= allowance
+                    ):
+                        break
+                    if active is None:
+                        frame = self.queue.next_queued()
+                        if frame is None:
+                            break
+                        fr, gen = self._start_frame(frame)
+                        if gen is None:
+                            self.queue.mark_skipped(frame.seed)
+                            continue
+                        active = (frame, fr, gen, time.perf_counter())
+                    frame, fr, gen, started = active
+                    try:
+                        next(gen)
+                    except StopIteration as stop:
+                        self._finish_frame(
+                            frame, fr, stop.value,
+                            time.perf_counter() - started, clock,
+                        )
+                        active = None
+                        sync_clock()
+                        if boundary_hook is not None and boundary_hook(
+                            "frame"
+                        ):
+                            preempted = True
+                            break
+                if preempted:
+                    break
+                if pending:
+                    # Harvest the oldest chunk (plus any others already
+                    # retired — their data is ready, the pull is cheap)
+                    # and refill the pipeline on the next loop pass.
+                    handle, _n = pending.pop(0)
+                    self._absorb_harvest(self.driver._harvest_chunk(handle))
+                    while pending and _handle_ready(pending[0][0]):
+                        handle, _n = pending.pop(0)
+                        self._absorb_harvest(
+                            self.driver._harvest_chunk(handle)
+                        )
+                    sync_clock()
+                    if boundary_hook is not None and boundary_hook("chunk"):
+                        preempted = True
+                        break
+                elif active is None and not self.queue.depth:
+                    break
+        sync_clock()
+        return self._result(preempted)
+
+    def _result(self, preempted: bool) -> PipelineRunResult:
+        return PipelineRunResult(
+            results=dict(self.results),
+            codes={
+                f.seed: f.code for f in self.queue.frames.values()
+            },
+            lanes=int(self.state["seeds_done"]),
+            violations=int(self.state["violations"]),
+            ttf_mcs_s=self.state["ttf_mcs_s"],
+            wall_s=float(self.state["elapsed_s"]),
+            frames_done=int(self.state["frames_done"]),
+            queue={
+                "enqueued": self.queue.enqueued,
+                "done": self.queue.done,
+                "skipped": sum(
+                    1 for f in self.queue.frames.values()
+                    if f.status == "skipped"
+                ),
+                "depth": self.queue.depth,
+                "max_depth": int(self.state["max_depth"]),
+            },
+            budget=self.budget.snapshot(),
+            preempted=preempted,
+        )
+
+    def summary(self, result: Optional[PipelineRunResult] = None) -> dict:
+        """JSON summary in the CLI's house style."""
+        r = result or self._result(False)
+        out = {
+            "lanes": r.lanes,
+            "violations": r.violations,
+            "codes": dict(self.state["codes"]),
+            "mcs_count": r.mcs_count,
+            "ttf_mcs_s": r.ttf_mcs_s,
+            "wall_s": round(r.wall_s, 3),
+            "mcs_per_hour": (
+                round(r.mcs_per_hour, 2) if r.mcs_per_hour else None
+            ),
+            "queue": r.queue,
+            "split": self.budget.split,
+            "launches": dict(self.budget.launches),
+            "preempted": r.preempted,
+        }
+        mcs = {}
+        for f in self.queue.done_frames():
+            res = f.result or {}
+            mcs[str(f.seed)] = {
+                "code": f.code,
+                "mcs_externals": len(res.get("mcs", [])),
+                "stages": len(res.get("stages", [])),
+            }
+        out["mcs"] = mcs
+        return out
+
+
+def run_staged(
+    app,
+    cfg,
+    config: SchedulerConfig,
+    program_gen,
+    total_lanes: int,
+    *,
+    base_key: int = 0,
+    chunk: int = 64,
+    wildcards: bool = True,
+    stage_budget_seconds: Optional[float] = None,
+    max_frames: Optional[int] = None,
+) -> PipelineRunResult:
+    """The pinned A/B baseline: fuzz-to-completion (blocking chunked
+    sweep), THEN lift+minimize each violating seed sequentially —
+    exactly the tiers ``run_the_gamut`` runs today, over the same frame
+    set the streaming path minimizes. Identical per-frame code path
+    (``run_the_gamut`` drains the same generator), so the MCS artifacts
+    must match bit-for-bit."""
+    from ..parallel.sweep import SweepDriver
+    from ..runner import FuzzResult, run_the_gamut
+
+    out = PipelineRunResult()
+    driver = SweepDriver(app, cfg, program_gen)
+    found: List[tuple] = []
+    driver.violation_hook = (
+        lambda seeds, codes: found.extend(
+            zip(seeds.tolist(), codes.tolist())
+        )
+    )
+    t0 = time.perf_counter()
+    sweep = driver.sweep(total_lanes, chunk, mode="chunked")
+    out.lanes = sweep.lanes
+    out.violations = sweep.violations
+    out.codes = {int(s): int(c) for s, c in found}
+    minimize = found if max_frames is None else found[:max_frames]
+    # The lift kernel is shared across the staged loop's lifts too —
+    # kernel reuse is not an orchestration advantage, so both sides of
+    # the A/B get it; per-frame checker compiles stay per-frame here
+    # (the existing run_the_gamut contract the baseline pins).
+    lift_kernel = make_lift_kernel(app, cfg) if minimize else None
+    for seed, code in minimize:
+        host = lift_violating_seed(
+            app, cfg, config, program_gen, seed, base_key,
+            trace_kernel=lift_kernel,
+        )
+        if host.violation is None:
+            continue
+        fr = FuzzResult(
+            program=list(host.trace.original_externals),
+            trace=host.trace,
+            violation=host.violation,
+            executions=0,
+        )
+        out.results[seed] = run_the_gamut(
+            config, fr, wildcards=wildcards, app=app,
+            stage_budget_seconds=stage_budget_seconds,
+        )
+        if out.ttf_mcs_s is None:
+            out.ttf_mcs_s = round(time.perf_counter() - t0, 6)
+    out.wall_s = round(time.perf_counter() - t0, 6)
+    out.frames_done = len(out.results)
+    out.queue = {
+        "enqueued": len(found),
+        "done": len(out.results),
+        "skipped": len(found) - len(minimize),
+        "depth": 0,
+        "max_depth": len(found),
+    }
+    return out

@@ -1,0 +1,115 @@
+"""Violation queue: the fuzz→minimize handoff as persist/-serializable
+frames.
+
+Each violating sweep lane becomes one ``ViolationFrame`` the moment it
+retires: (seed, violation code) — the lane's trace and externals are a
+PURE function of those (the deterministic lift ritual,
+``runner.lift_lane_to_host``), so the frame on the wire is a few ints,
+not a serialized trace, and re-deriving after a resume is bit-identical
+to the original lift. A frame finishes with its minimization artifacts
+attached in the structural-JSON codec ``demi_tpu.serialization``
+already defines (externals/event records), so a done frame round-trips
+through a checkpoint — or, in the fleet story, over DCN to a
+coordinator — without the producing process.
+
+The queue itself is an insertion-ordered, seed-keyed map: offering the
+same seed twice is a no-op (a resumed sweep re-retires the lanes the
+dead run found after its last checkpoint; dedup here is what makes "no
+violation minimized twice" hold across kills). ``checkpoint_state`` /
+``restore_state`` ride the same structural-JSON contract as every other
+persist/ payload.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+
+@dataclass
+class ViolationFrame:
+    """One violating lane's journey through the pipeline."""
+
+    seed: int
+    code: int
+    status: str = "queued"  # queued | done | skipped
+    # Structural-JSON minimization artifacts once done (serialization.py
+    # codecs): {"mcs": [...], "final_trace": [...], "stages": [...],
+    # "wall_s": float, "code": int}.
+    result: Optional[Dict[str, Any]] = None
+
+    def to_json(self) -> Dict[str, Any]:
+        return {
+            "seed": int(self.seed),
+            "code": int(self.code),
+            "status": self.status,
+            "result": self.result,
+        }
+
+    @classmethod
+    def from_json(cls, obj: Dict[str, Any]) -> "ViolationFrame":
+        return cls(
+            seed=int(obj["seed"]),
+            code=int(obj["code"]),
+            status=obj.get("status", "queued"),
+            result=obj.get("result"),
+        )
+
+
+@dataclass
+class ViolationQueue:
+    """Insertion-ordered seed-keyed frame map (see module doc)."""
+
+    frames: Dict[int, ViolationFrame] = field(default_factory=dict)
+
+    def offer(self, seed: int, code: int) -> Optional[ViolationFrame]:
+        """Enqueue a violating lane; None if the seed is already known
+        (resume re-retirement, or a duplicate retirement path)."""
+        seed = int(seed)
+        if seed in self.frames:
+            return None
+        frame = ViolationFrame(seed=seed, code=int(code))
+        self.frames[seed] = frame
+        return frame
+
+    def next_queued(self) -> Optional[ViolationFrame]:
+        for frame in self.frames.values():
+            if frame.status == "queued":
+                return frame
+        return None
+
+    def mark_done(
+        self, seed: int, result: Optional[Dict[str, Any]]
+    ) -> None:
+        self.frames[int(seed)].status = "done"
+        self.frames[int(seed)].result = result
+
+    def mark_skipped(self, seed: int) -> None:
+        self.frames[int(seed)].status = "skipped"
+
+    # -- accounting ----------------------------------------------------------
+    @property
+    def depth(self) -> int:
+        """Frames enqueued but not yet minimized (the live queue depth)."""
+        return sum(1 for f in self.frames.values() if f.status == "queued")
+
+    @property
+    def done(self) -> int:
+        return sum(1 for f in self.frames.values() if f.status == "done")
+
+    @property
+    def enqueued(self) -> int:
+        return len(self.frames)
+
+    def done_frames(self) -> List[ViolationFrame]:
+        return [f for f in self.frames.values() if f.status == "done"]
+
+    # -- persist -------------------------------------------------------------
+    def checkpoint_state(self) -> Dict[str, Any]:
+        return {"frames": [f.to_json() for f in self.frames.values()]}
+
+    def restore_state(self, state: Dict[str, Any]) -> None:
+        self.frames = {}
+        for obj in state.get("frames", []):
+            frame = ViolationFrame.from_json(obj)
+            self.frames[frame.seed] = frame

@@ -111,6 +111,7 @@ def fuzz(
     controller=None,
     start_execution: int = 0,
     round_hook=None,
+    on_violation=None,
 ) -> Optional[FuzzResult]:
     """Generate fuzz tests and run them until a violation is found
     (reference: RunnerUtils.fuzz, RunnerUtils.scala:62-147). With
@@ -129,7 +130,15 @@ def fuzz(
     dead run already burned. ``round_hook(executions_done)`` is called
     after every non-violating execution; returning True stops the loop
     (the preemption guard's boundary — the caller distinguishes
-    "preempted" from "exhausted" via its own guard flag)."""
+    "preempted" from "exhausted" via its own guard flag).
+
+    ``on_violation(FuzzResult)`` is the streaming-tier hook
+    (demi_tpu/pipeline/): instead of RETURNING the first reproduced
+    violation, the loop hands it to the hook and keeps fuzzing the
+    remaining executions — the host analog of the sweep drivers'
+    violation handoff. Returning True from the hook stops the loop;
+    with the hook set, ``fuzz`` always returns None (every violation
+    flowed through the hook)."""
     sched = RandomScheduler(
         config,
         seed=seed,
@@ -183,12 +192,16 @@ def fuzz(
                         obs.counter("fuzz.nondeterministic_discarded").inc()
                         reproduced = False
         if reproduced:
-            return FuzzResult(
+            found = FuzzResult(
                 program=program,
                 trace=result.trace,
                 violation=result.violation,
                 executions=i + 1,
             )
+            if on_violation is None:
+                return found
+            if on_violation(found):
+                return None
         if round_hook is not None and round_hook(i + 1):
             return None
     return None
@@ -447,10 +460,47 @@ def run_the_gamut(
     resume: bool = False,
     stage_budget_seconds: Optional[float] = None,
 ) -> GamutResult:
-    """The full minimization pipeline (reference: RunnerUtils.runTheGamut,
-    RunnerUtils.scala:171-500): provenance pruning → external DDMin →
-    internal minimization → wildcard (clock-cluster) minimization → final
-    internal minimization.
+    """Drain ``run_the_gamut_streaming`` to completion — the staged
+    entry point. The generator IS the pipeline body, so the staged and
+    streaming paths cannot drift: same stages, same oracles, same
+    per-level decisions, bit-identical MCS."""
+    from .minimization.pipeline import drain_stream
+
+    return drain_stream(run_the_gamut_streaming(
+        config, fuzz_result, wildcards=wildcards, provenance=provenance,
+        internal_strategy=internal_strategy, app=app, device_cfg=device_cfg,
+        checkpoint_dir=checkpoint_dir, resume=resume,
+        stage_budget_seconds=stage_budget_seconds,
+    ))
+
+
+def run_the_gamut_streaming(
+    config: SchedulerConfig,
+    fuzz_result: FuzzResult,
+    wildcards: bool = True,
+    provenance: bool = True,
+    internal_strategy: Optional[RemovalStrategy] = None,
+    app=None,
+    device_cfg=None,
+    checkpoint_dir: Optional[str] = None,
+    resume: bool = False,
+    stage_budget_seconds: Optional[float] = None,
+    launch_budget=None,
+    checker=None,
+):
+    """Generator form of the full minimization pipeline (reference:
+    RunnerUtils.runTheGamut, RunnerUtils.scala:171-500): provenance
+    pruning → external DDMin → internal minimization → wildcard
+    (clock-cluster) minimization → final internal minimization.
+
+    Yields ``(kind, tag)`` markers at every resumable boundary — one per
+    batched minimizer level/round plus one per completed stage — and
+    returns the ``GamutResult`` via ``StopIteration.value``. The
+    streaming orchestrator (demi_tpu/pipeline/) advances this generator
+    between the fuzz sweep's chunk dispatch and harvest, so minimization
+    levels overlap sweep kernels in flight under one launch budget;
+    ``run_the_gamut`` drains it synchronously — the pinned A/B baseline
+    is the same code path by construction.
 
     ``stage_budget_seconds`` caps each minimizer stage's wall clock
     (reference: RunnerUtils.scala:180 caps every gamut minimizer): on
@@ -532,15 +582,18 @@ def run_the_gamut(
         return r_ext, r_trace
 
     record("original", externals, trace)
+    yield ("stage", "original")
 
     if provenance:
         affected = getattr(violation, "affected_nodes", lambda: ())()
         if affected:
             trace = prune_concurrent_events(trace, affected)
             record("provenance", externals, trace)
+            yield ("stage", "provenance")
 
-    checker = None
-    if app is not None:
+    if app is None:
+        checker = None
+    else:
         from .device.batch_oracle import (
             DeviceReplayChecker,
             DeviceSTSOracle,
@@ -551,8 +604,23 @@ def run_the_gamut(
         from .minimization.internal import BatchedInternalMinimizer
         from .minimization.wildcards import BatchedWildcardMinimizer
 
-        device_cfg = device_cfg or default_device_config(app, trace, externals)
-        checker = DeviceReplayChecker(app, device_cfg, config)
+        if checker is not None:
+            # A caller-owned checker (the streaming orchestrator shares
+            # one compiled replay oracle across queue frames at a
+            # bucketed shape — the multi-tenant minimization seam).
+            # Verdicts are pure functions of record bytes, so sharing
+            # never changes results; the cfg must be the checker's own.
+            device_cfg = checker.cfg
+        else:
+            device_cfg = device_cfg or default_device_config(
+                app, trace, externals
+            )
+            checker = DeviceReplayChecker(app, device_cfg, config)
+            # Streaming orchestration: the checker reports every replay
+            # launch into the shared fuzz/minimize in-flight ledger
+            # (demi_tpu/pipeline/budget.py) so the split policy sees
+            # real lane counts. None (the staged path) costs one branch.
+            checker.launch_budget = launch_budget
 
     # External-event DDMin.
     restored = restore("ddmin")
@@ -563,7 +631,9 @@ def run_the_gamut(
             if checker is not None:
                 oracle = DeviceSTSOracle(app, device_cfg, config, trace, checker=checker)
                 ddmin = BatchedDDMin(oracle, stats=stats, budget=stage_budget())
-                mcs_dag = ddmin.minimize(make_dag(list(externals)), violation)
+                mcs_dag = yield from ddmin.minimize_stream(
+                    make_dag(list(externals)), violation
+                )
                 verified = ddmin.verified_trace
             else:
                 mcs_dag, verified = sts_sched_ddmin(
@@ -576,14 +646,15 @@ def run_the_gamut(
                 trace = verified
         checkpoint("ddmin", externals, trace)
     record("ddmin", externals, trace)
+    yield ("stage", "ddmin")
 
-    def _device_int_min(tr: EventTrace) -> EventTrace:
+    def _device_int_min(tr: EventTrace):
         minimizer = BatchedInternalMinimizer(
             make_batched_internal_check(checker, list(externals), violation),
             stats=stats,
             budget=stage_budget(),
         )
-        return minimizer.minimize(tr)
+        return minimizer.minimize_stream(tr)
 
     # Internal minimization.
     restored = restore("int_min")
@@ -592,7 +663,7 @@ def run_the_gamut(
     else:
         with obs.span("gamut.int_min", deliveries=len(trace.deliveries())):
             if checker is not None:
-                trace = _device_int_min(trace)
+                trace = yield from _device_int_min(trace)
             else:
                 trace = minimize_internals(
                     config, trace, externals, violation,
@@ -601,6 +672,7 @@ def run_the_gamut(
                 )
         checkpoint("int_min", externals, trace)
     record("int_min", externals, trace)
+    yield ("stage", "int_min")
 
     if wildcards:
         def check(candidate: EventTrace) -> Optional[EventTrace]:
@@ -631,6 +703,7 @@ def run_the_gamut(
                 trace = wc.minimize(trace, config.fingerprinter)
             checkpoint("wildcard", externals, trace)
         record("wildcard", externals, trace)
+        yield ("stage", "wildcard")
 
         restored = restore("int_min2")
         if restored is not None:
@@ -638,7 +711,7 @@ def run_the_gamut(
         else:
             with obs.span("gamut.int_min2"):
                 if checker is not None:
-                    trace = _device_int_min(trace)
+                    trace = yield from _device_int_min(trace)
                 else:
                     trace = minimize_internals(
                         config, trace, externals, violation,
@@ -647,6 +720,7 @@ def run_the_gamut(
                     )
             checkpoint("int_min2", externals, trace)
         record("int_min2", externals, trace)
+        yield ("stage", "int_min2")
 
     result.mcs_externals = list(externals)
     result.final_trace = trace

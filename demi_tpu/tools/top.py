@@ -159,6 +159,34 @@ def render_frame(
         f"span: {_fmt((t_last - t0) if t0 and t_last else None, '.1f', 's')}"
     )
 
+    # Per-tier activity: once fuzz/sweep records arrive concurrently
+    # with minimize/pipeline ones (the streaming pipeline), the tiers
+    # are INTERLEAVED on one timeline — show who was active in the
+    # recent window instead of assuming a sequential staged run.
+    tier_of = {
+        "fuzz.execution": "fuzz", "sweep.chunk": "sweep",
+        "dpor.round": "dpor", "minimize.level": "minimize",
+        "minimize.stage": "minimize", "pipeline.enqueue": "pipeline",
+        "pipeline.frame": "pipeline",
+    }
+    recent = records[-window:]
+    counts: Dict[str, int] = {}
+    for r in recent:
+        tier = tier_of.get(r.get("kind"))
+        if tier:
+            counts[tier] = counts.get(tier, 0) + 1
+    active_tiers = [t for t in ("fuzz", "sweep", "dpor", "minimize",
+                                "pipeline") if counts.get(t)]
+    if len(active_tiers) > 1:
+        total = sum(counts[t] for t in active_tiers)
+        lines.append(
+            "tiers (last %d records, interleaved): " % len(recent)
+            + "  ".join(
+                f"{t} [{_bar(counts[t] / total, 10)}] {counts[t]}"
+                for t in active_tiers
+            )
+        )
+
     dpor = [r for r in records if r.get("kind") == "dpor.round"]
     if dpor:
         last = dpor[-1]
@@ -246,6 +274,38 @@ def render_frame(
         viol = sum(1 for r in fuzz if r.get("violation"))
         lines.append(f"FUZZ  execution {fuzz[-1].get('round')}  "
                      f"violations {viol}")
+
+    enq = [r for r in records if r.get("kind") == "pipeline.enqueue"]
+    frames = [r for r in records if r.get("kind") == "pipeline.frame"]
+    if enq or frames:
+        lines.append("")
+        latest = max(enq + frames, key=lambda r: r.get("seq", 0))
+        depth = latest.get("queue_depth")
+        ttf = next(
+            (r.get("ttf_mcs_s") for r in frames
+             if r.get("ttf_mcs_s") is not None),
+            None,
+        )
+        span_s = (t_last - t0) if (t0 and t_last) else None
+        mph = (
+            len(frames) * 3600.0 / span_s if span_s and frames else None
+        )
+        lines.append(
+            f"PIPELINE  enqueued {len(enq)}  minimized {len(frames)}  "
+            f"queue depth {depth if depth is not None else '—'}"
+        )
+        lines.append(
+            f"  time-to-first-MCS {_fmt(ttf, '.2f', 's')}  "
+            f"MCSes/hour {_fmt(mph, '.1f')}"
+        )
+        if frames:
+            last = frames[-1]
+            lines.append(
+                f"  last MCS: seed {last.get('seed')}  "
+                f"{last.get('mcs_externals')} externals  "
+                f"{last.get('deliveries')} deliveries  "
+                f"{_fmt(last.get('wall_s'), '.2f', 's')}"
+            )
 
     lines.append("")
     lines.append(f"last record: {time.strftime('%H:%M:%S', time.localtime(t_last))}"

@@ -28,9 +28,11 @@ from .calibrate import (  # noqa: F401
     BonusDecision,
     ForkDecision,
     InflightDecision,
+    SplitDecision,
     SweepDecision,
     calibrate_dpor_inflight,
     calibrate_fork,
+    calibrate_pipeline_split,
     calibrate_sweep,
     calibrate_weight_bonus,
     coordinate_descent,
@@ -40,6 +42,7 @@ from .calibrate import (  # noqa: F401
     make_bonus_measure,
     make_dpor_inflight_measure,
     make_fork_measure,
+    make_pipeline_split_measure,
     median_rate,
     sweep_axes,
 )
@@ -59,6 +62,7 @@ __all__ = [
     "FORK_BUCKET_AXIS",
     "ForkDecision",
     "InflightDecision",
+    "SplitDecision",
     "SweepDecision",
     "TuningCache",
     "VIOLATION_BONUS_AXIS",
@@ -67,6 +71,7 @@ __all__ = [
     "autotune_enabled",
     "calibrate_dpor_inflight",
     "calibrate_fork",
+    "calibrate_pipeline_split",
     "calibrate_sweep",
     "calibrate_weight_bonus",
     "coordinate_descent",
@@ -77,6 +82,7 @@ __all__ = [
     "make_bonus_measure",
     "make_dpor_inflight_measure",
     "make_fork_measure",
+    "make_pipeline_split_measure",
     "median_rate",
     "record_decision",
     "sweep_axes",

@@ -629,6 +629,144 @@ def calibrate_dpor_inflight(
     return decision
 
 
+@dataclass
+class SplitDecision:
+    """One streaming budget-split calibration outcome: the minimizer's
+    share of each in-flight turn (demi_tpu/pipeline/budget.py) plus the
+    measured MCSes/hour per candidate."""
+
+    split: float
+    rate: float  # MCSes/hour of the chosen point (0.0 when defaulted)
+    source: str  # "calibrated" | "cached" | "default"
+    rates: Dict[str, float] = field(default_factory=dict)
+    signals: Dict[str, Any] = field(default_factory=dict)
+    key: Optional[str] = None
+    calibration_seconds: float = 0.0
+
+    def to_json(self) -> Dict[str, Any]:
+        return {
+            "split": float(self.split),
+            "rate": round(self.rate, 3),
+            "source": self.source,
+            "rates": {k: round(v, 3) for k, v in self.rates.items()},
+            "signals": dict(self.signals),
+            "key": self.key,
+            "calibration_seconds": round(self.calibration_seconds, 2),
+        }
+
+    @classmethod
+    def from_json(cls, obj: Dict[str, Any], source: str) -> "SplitDecision":
+        return cls(
+            split=float(obj.get("split", 0.5)),
+            rate=float(obj.get("rate", 0.0)),
+            source=source,
+            rates=dict(obj.get("rates", {})),
+            signals=dict(obj.get("signals", {})),
+            key=obj.get("key"),
+        )
+
+
+def make_pipeline_split_measure(
+    app, cfg, config, program_gen, *, total_lanes: int, chunk: int,
+    max_frames: Optional[int] = None, wildcards: bool = False,
+    reps: int = 1,
+):
+    """Real measurement for one split candidate: a fresh
+    ``StreamingPipeline`` per rep over the same (seed-deterministic)
+    lane range, scored by MCSes/hour. Expensive relative to the other
+    axes — each point runs a whole small streaming pipeline — so the
+    production path prefers the cache and the bench measures at its own
+    shapes; reps default to 1 with no warm-up drop (kernel compiles are
+    shared across points after the first)."""
+    from ..pipeline import StreamingPipeline
+
+    def measure(params: Dict[str, Any]) -> float:
+        split = float(params["pipeline_split"])
+        rates = []
+        for _ in range(reps):
+            pipe = StreamingPipeline(
+                app, cfg, config, program_gen, chunk=chunk, split=split,
+                wildcards=wildcards, max_frames=max_frames,
+            )
+            result = pipe.run(total_lanes)
+            rates.append(result.mcs_per_hour or 0.0)
+        return median_rate(rates, drop_first=False)
+
+    return measure
+
+
+def calibrate_pipeline_split(
+    app,
+    cfg,
+    *,
+    platform: Optional[str] = None,
+    cache: Optional[TuningCache] = None,
+    measure: Optional[Callable[[Dict[str, Any]], float]] = None,
+    axis: Optional[Sequence[float]] = None,
+    extra_key: Optional[Dict[str, Any]] = None,
+) -> SplitDecision:
+    """Calibrate the streaming pipeline's fuzz/minimize budget split for
+    one workload shape + platform — the knob ``LaunchBudget`` applies
+    per in-flight turn. Caching contract as the other axes: a cache hit
+    costs nothing; a miss with no ``measure`` records the default
+    (0.5 — lane-for-lane interleave) as a decided value rather than
+    guessing a measurement; a miss with a measure walks the axis by
+    MCSes/hour (``make_pipeline_split_measure``). Persisted to the
+    TuningCache, recorded as ``tune.pipeline.split`` decisions."""
+    from ..pipeline.budget import DEFAULT_SPLIT, PIPELINE_SPLIT_AXIS
+
+    if platform is None:
+        import jax
+
+        platform = jax.devices()[0].platform
+    cache = cache or TuningCache()
+    key = workload_key(
+        app.name, app.num_actors, cfg, platform,
+        axis="pipeline_split", **(extra_key or {}),
+    )
+    cached = cache.get(key)
+    if cached is not None:
+        decision = SplitDecision.from_json(cached, source="cached")
+        decision.key = key
+        _record_split_decision(decision)
+        return decision
+    if measure is None:
+        decision = SplitDecision(
+            split=DEFAULT_SPLIT, rate=0.0, source="default", key=key,
+            signals={
+                "reason": "no measurement available; lane-for-lane "
+                          "interleave until the workload is measured"
+            },
+        )
+        _record_split_decision(decision)
+        cache.put(key, decision.to_json())
+        return decision
+    candidates = list(axis) if axis is not None else list(PIPELINE_SPLIT_AXIS)
+    t0 = time.perf_counter()
+    params, rate, rates = coordinate_descent(
+        {"pipeline_split": candidates}, measure,
+        {"pipeline_split": candidates[0]},
+        order=("pipeline_split",),
+    )
+    decision = SplitDecision(
+        split=float(params["pipeline_split"]),
+        rate=rate,
+        source="calibrated",
+        rates=rates,
+        key=key,
+        calibration_seconds=time.perf_counter() - t0,
+    )
+    _record_split_decision(decision)
+    cache.put(key, decision.to_json())
+    return decision
+
+
+def _record_split_decision(decision: SplitDecision) -> None:
+    record_decision("pipeline.split", decision.split)
+    record_decision("pipeline.split_rate", decision.rate)
+    record_decision("pipeline.split_source", decision.source)
+
+
 #: Candidate violation-bonus weights (the ExplorationController reward's
 #: "one violating lane is worth this many fresh schedules" knob — 10.0
 #: was hand-set in PR 2; the ROADMAP debt is measuring it).

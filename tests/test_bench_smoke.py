@@ -215,6 +215,42 @@ def test_bench_config10_smoke():
     assert record["value"] == section["checkpoint_overhead_pct"]
 
 
+def test_bench_config12_smoke():
+    record = _run_bench(
+        "12",
+        {
+            # Tiny streaming-vs-staged A/B: short shallow sweep, one
+            # frame (shallow traces keep the replay shapes — and their
+            # compiles — small).
+            "DEMI_BENCH_CONFIG12_LANES": "32",
+            "DEMI_BENCH_CONFIG12_CHUNK": "8",
+            "DEMI_BENCH_CONFIG12_MAX_MCS": "1",
+            "DEMI_BENCH_CONFIG12_STEPS": "96",
+        },
+    )
+    assert record["metric"].startswith("MCSes/hour speedup")
+    section = record["config12"]
+    assert "error" not in section, section
+    for key in ("app", "lanes", "chunk", "max_mcs", "split", "violations",
+                "mcs_count", "ttf_mcs_staged_s", "ttf_mcs_streaming_s",
+                "wall_staged_s", "wall_streaming_s", "mcs_per_hour_staged",
+                "mcs_per_hour_streaming", "speedup", "mcs_match",
+                "codes_match", "tiers_interleaved", "queue",
+                "journal_enqueues", "journal_frames", "budget"):
+        assert key in section, key
+    for key in ("enqueued", "done", "skipped", "depth", "max_depth"):
+        assert key in section["queue"], key
+    # The acceptance-grade >=1.3x MCSes/hour needs the DEEP fixture
+    # (bench default lanes); at smoke shapes only the identity
+    # contracts — bit-identical MCS artifacts and violation codes — are
+    # asserted (the bench asserts them internally too).
+    assert section["mcs_match"] is True
+    assert section["codes_match"] is True
+    assert section["mcs_count"] >= 1
+    assert section["journal_frames"] == section["queue"]["done"]
+    assert record["value"] == section["speedup"]
+
+
 def test_cli_lint_zoo_clean_subprocess():
     """Tier-1 CI contract at the real entry point: `demi_tpu lint` over
     the bundled zoo exits 0 with zero findings — run as a subprocess so

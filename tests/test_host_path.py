@@ -367,10 +367,11 @@ def test_continuous_autotuned_attribution_parity():
     assert result.lanes == lanes_total
 
 
-def test_continuous_stop_on_violation_truncates_mid_round():
-    """stop_on_violation counts lanes up to and including the first
-    violating retirement — the array path must truncate mid-round
-    exactly like the per-item break did."""
+def test_continuous_stop_on_violation_keeps_retired_round():
+    """stop_on_violation stops at the first violating HARVEST ROUND but
+    keeps every already-retired lane result in that round (they are
+    paid-for device work — the old array path truncated them away); the
+    first violating seed is still the first in retirement order."""
     from demi_tpu.apps.broadcast import (
         broadcast_send_generator,
         make_broadcast_app,

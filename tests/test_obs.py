@@ -586,6 +586,63 @@ def test_journal_write_read_and_torn_tail(tmp_path):
     assert ok and rounds == [1, 2]
 
 
+def test_journal_pipeline_record_schema(tmp_path):
+    """The streaming pipeline's journal wire format is pinned: one
+    pipeline.enqueue record per violating lane handed off, one
+    pipeline.frame per minimized violation, with the schema keys `top`
+    and the fleet coordinator consume; pipeline.frame is a SAMPLED kind
+    (round-grained time-series boundary), pipeline.enqueue is not (it
+    can arrive many-per-chunk)."""
+    from demi_tpu.apps.broadcast import (
+        broadcast_send_generator,
+        make_broadcast_app,
+    )
+    from demi_tpu.apps.common import dsl_start_events, make_host_invariant
+    from demi_tpu.config import SchedulerConfig
+    from demi_tpu.device import DeviceConfig
+    from demi_tpu.fuzzing import Fuzzer, FuzzerWeights
+    from demi_tpu.obs import journal
+    from demi_tpu.pipeline import StreamingPipeline
+
+    assert "pipeline.frame" in journal._SAMPLED_KINDS
+    assert "pipeline.enqueue" not in journal._SAMPLED_KINDS
+
+    app = make_broadcast_app(4, reliable=False)
+    fz = Fuzzer(
+        num_events=8,
+        weights=FuzzerWeights(send=0.6, wait_quiescence=0.25, kill=0.15),
+        message_gen=broadcast_send_generator(app),
+        prefix=dsl_start_events(app), max_kills=1,
+    )
+    cfg = DeviceConfig.for_app(
+        app, pool_capacity=64, max_steps=96, max_external_ops=24
+    )
+    config = SchedulerConfig(invariant_check=make_host_invariant(app))
+    journal.attach(str(tmp_path))
+    pipe = StreamingPipeline(
+        app, cfg, config, lambda s: fz.generate_fuzz_test(seed=s),
+        chunk=8, wildcards=False, max_frames=1,
+    )
+    result = pipe.run(8)
+    journal.detach()
+    assert result.frames_done >= 1, "fixture found no violation"
+
+    enq = journal.read_records(str(tmp_path), kind="pipeline.enqueue")
+    frames = journal.read_records(str(tmp_path), kind="pipeline.frame")
+    assert enq and frames
+    for key in ("round", "seed", "code", "queue_depth", "minimize"):
+        assert key in enq[0], key
+    for key in ("round", "seed", "code", "wall_s", "mcs_externals",
+                "deliveries", "stages", "queue_depth", "ttf_mcs_s"):
+        assert key in frames[0], key
+    assert frames[0]["round"] == 1
+    assert frames[0]["ttf_mcs_s"] is not None
+    # sweep.chunk and minimize.level records share the same journal —
+    # the interleaved-tiers wire `demi_tpu top` renders.
+    assert journal.read_records(str(tmp_path), kind="sweep.chunk")
+    assert journal.read_records(str(tmp_path), kind="minimize.level")
+
+
 def test_journal_rotation_bounds_disk(tmp_path):
     from demi_tpu.obs import journal
 
