@@ -217,6 +217,12 @@ class SleepSets:
         # the optimal-DPOR lower bound `bench --config 9` reports
         # explored counts against.
         self.classes: Set[tuple] = set()
+        # Classes covered by a PRIOR run or another fleet host
+        # (``seed_covered``): suppressed like any seen class, with the
+        # skips counted separately in ``warm_hits`` — the warm-start
+        # evidence `bench --config 13` asserts on.
+        self.warm: Set[tuple] = set()
+        self.warm_hits = 0
         self.pruned_total: Dict[str, int] = {"sleep": 0, "class": 0}
         self.pruned_prescriptions: List[Tuple[Tuple[int, ...], ...]] = []
         # Wakeup ledger: per branch node (exact prefix bytes), the flip
@@ -242,6 +248,61 @@ class SleepSets:
 
     def note_class(self, key: tuple) -> None:
         self.classes.add(key)
+
+    def note_warm(self, key: tuple) -> None:
+        """Count a class-dedup hit that was satisfied by warm-start
+        coverage (a prior run / another host), not by this exploration's
+        own admissions — the `fleet.warm_skips` evidence."""
+        if key in self.warm:
+            self.warm_hits += 1
+            from .. import obs
+
+            obs.counter("fleet.warm_skips").inc()
+
+    # -- fleet export / merge ---------------------------------------------
+    def export_classes(self) -> Dict[str, Any]:
+        """Packed wire payload of the class ledger — sorted, delta-
+        encoded zlib frames (the persist/ codec), the unit a fleet
+        worker ships to the coordinator and the coordinator publishes
+        to the content-addressed class store. Sorted order makes the
+        bytes deterministic for a given class set, so equal ledgers
+        produce equal content addresses."""
+        from ..persist.checkpoint import pack_prescriptions
+
+        return pack_prescriptions(sorted(self.classes))
+
+    def merge_classes(self, payload) -> int:
+        """Union class keys into this ledger (a packed payload from
+        ``export_classes`` or an iterable of key tuples); returns how
+        many were new. Set union is associative and commutative, so
+        per-worker ledgers merge in any order or grouping to one answer
+        — the fleet-aggregation contract tests/test_fleet.py pins."""
+        if isinstance(payload, dict):
+            from ..persist.checkpoint import unpack_prescriptions
+
+            keys = unpack_prescriptions(payload)
+        else:
+            keys = list(payload)
+        new = 0
+        for k in keys:
+            k = tuple(k)
+            if k not in self.classes:
+                self.classes.add(k)
+                new += 1
+        return new
+
+    def seed_covered(self, payload) -> int:
+        """Warm start: merge ``payload`` AND mark those classes as
+        covered by prior work — candidates in them are suppressed like
+        any seen class, and each skip counts in ``warm_hits``."""
+        if isinstance(payload, dict):
+            from ..persist.checkpoint import unpack_prescriptions
+
+            keys = [tuple(k) for k in unpack_prescriptions(payload)]
+        else:
+            keys = [tuple(k) for k in payload]
+        self.warm.update(keys)
+        return self.merge_classes(keys)
 
     # -- wakeup ledger / sleep assignment ---------------------------------
     def node_flips(self, node_key: bytes) -> List[Tuple[int, ...]]:

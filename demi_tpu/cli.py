@@ -510,13 +510,17 @@ def _dpor_checkpoint_run(args, app, cfg) -> int:
         _flush_samples(args.checkpoint_dir)
 
     found = None
-    print(
-        f"dpor: checkpointing to {args.checkpoint_dir} every {every} "
-        "round(s)"
-        + (f"; resumed at round {rounds_done}" if resumed else ""),
-        flush=True,
-    )
     with PreemptionGuard() as guard:
+        # Announce readiness only with the guard INSTALLED: the line is
+        # the "SIGTERM now checkpoints" contract (tests and operators
+        # signal the moment they see it), and printing first loses that
+        # race on a busy one-core host.
+        print(
+            f"dpor: checkpointing to {args.checkpoint_dir} every {every} "
+            "round(s)"
+            + (f"; resumed at round {rounds_done}" if resumed else ""),
+            flush=True,
+        )
         while rounds_done < args.rounds and dpor.frontier and found is None:
             found = dpor.explore(max_rounds=1)
             rounds_done += 1
@@ -633,13 +637,14 @@ def _sweep_checkpoint_run(args, app, cfg, fuzzer) -> int:
         )
         _flush_samples(args.checkpoint_dir)
 
-    print(
-        f"sweep: checkpointing to {args.checkpoint_dir} every {every} "
-        "chunk(s) (chunked rounds)"
-        + (f"; resumed at seed {state['seeds_done']}" if resumed else ""),
-        flush=True,
-    )
     with PreemptionGuard() as guard:
+        # Readiness line with the guard installed (see the dpor loop).
+        print(
+            f"sweep: checkpointing to {args.checkpoint_dir} every {every} "
+            "chunk(s) (chunked rounds)"
+            + (f"; resumed at seed {state['seeds_done']}" if resumed else ""),
+            flush=True,
+        )
         while state["seeds_done"] < args.batch:
             n = min(chunk, args.batch - state["seeds_done"])
             c = driver.run_chunk(
@@ -744,14 +749,15 @@ def _fuzz_checkpoint_run(args, app, config, fuzzer, controller) -> int:
         )
         _flush_samples(args.checkpoint_dir)
 
-    print(
-        f"fuzz: checkpointing to {args.checkpoint_dir} every {every} "
-        "execution(s)"
-        + (f"; resumed at execution {start}" if resumed else ""),
-        flush=True,
-    )
     executions_done = start
     with PreemptionGuard() as guard:
+        # Readiness line with the guard installed (see the dpor loop).
+        print(
+            f"fuzz: checkpointing to {args.checkpoint_dir} every {every} "
+            "execution(s)"
+            + (f"; resumed at execution {start}" if resumed else ""),
+            flush=True,
+        )
 
         def hook(done: int) -> bool:
             nonlocal executions_done
@@ -928,16 +934,19 @@ def _fuzz_streaming_run(args, app, config, fuzzer) -> int:
 
         every = max(1, getattr(args, "checkpoint_every", None) or 5)
         boundaries = [0]
-        print(
-            f"fuzz --streaming: checkpointing to {args.checkpoint_dir} "
-            f"every {every} chunk/frame boundary(ies)"
-            + (
-                f"; resumed at chunk {pipe.state['chunks']}"
-                if ckpt is not None else ""
-            ),
-            flush=True,
-        )
         with PreemptionGuard() as guard:
+            # Readiness line with the guard installed (see the dpor
+            # loop).
+            print(
+                f"fuzz --streaming: checkpointing to "
+                f"{args.checkpoint_dir} every {every} chunk/frame "
+                "boundary(ies)"
+                + (
+                    f"; resumed at chunk {pipe.state['chunks']}"
+                    if ckpt is not None else ""
+                ),
+                flush=True,
+            )
 
             def hook(kind: str) -> bool:
                 boundaries[0] += 1
@@ -1555,6 +1564,53 @@ def cmd_dpor(args) -> int:
     return 0 if trace is not None else 1
 
 
+def cmd_fleet(args) -> int:
+    """Sharded exploration fleet (demi_tpu/fleet): coordinator +
+    worker processes over generation-frozen round leases, global
+    class-key dedup, optional cross-run warm start via the
+    content-addressed class store. Coverage is bit-identical to the
+    single-process `demi_tpu dpor` loop at any worker count."""
+    _obs_begin(args)
+    _strict_io_begin(args)
+    from .fleet import run_fleet
+
+    workload = {
+        "app": args.app,
+        "nodes": args.nodes,
+        "bug": args.bug,
+        "seed": args.seed,
+        "num_events": args.num_events,
+        "max_messages": args.max_messages,
+        "timer_weight": args.timer_weight,
+        "kill_weight": args.kill_weight,
+        "partition_weight": args.partition_weight,
+        "pool": args.pool,
+    }
+    with obs.span("cli.fleet", app=args.app, workers=args.workers):
+        summary = run_fleet(
+            workload,
+            workers=args.workers,
+            batch=args.batch,
+            rounds=args.rounds,
+            # --class-store implies the global class dedup (a covered
+            # class must suppress, or the warm start cannot skip it);
+            # --sleep-sets turns the same pruning on without a store.
+            prune=bool(args.sleep_sets) or args.class_store is not None,
+            class_store_dir=args.class_store,
+            warm_start=args.class_store is not None,
+            stop_on_violation=args.stop_on_violation,
+            journal_dir=getattr(args, "journal", None),
+            max_outstanding=1 if args.serialize_leases else None,
+            devices_per_worker=args.devices_per_worker,
+            lease_timeout=args.lease_timeout,
+        )
+    print(json.dumps(summary))
+    _obs_end(args)
+    if args.stop_on_violation:
+        return 0 if summary.get("violation_found") else 1
+    return 0
+
+
 def cmd_shiviz(args) -> int:
     """Export a saved experiment's trace for the ShiViz visualizer
     (reference: RunnerUtils.visualizeDeliveries, RunnerUtils.scala:1341)."""
@@ -2162,6 +2218,61 @@ def main(argv: Optional[list] = None) -> int:
              "(default ./demi_profile; load in TensorBoard/xprof)",
     )
     p.set_defaults(fn=cmd_dpor)
+
+    p = sub.add_parser(
+        "fleet",
+        help="sharded exploration fleet: a coordinator assigns "
+             "generation-frozen DPOR round leases to worker processes; "
+             "admissions dedup globally on content digests and "
+             "Mazurkiewicz class keys (coverage bit-identical to a "
+             "single-process dpor run at any worker count)",
+    )
+    common(p)
+    obs_flags(p)
+    p.add_argument("--workers", type=int, default=2,
+                   help="worker processes to spawn (default 2)")
+    p.add_argument("--batch", type=int, default=16)
+    p.add_argument("--pool", type=int, default=256)
+    p.add_argument("--rounds", type=int, default=10,
+                   help="frontier-round budget across the whole fleet")
+    p.add_argument(
+        "--class-store", default=None, dest="class_store", metavar="DIR",
+        help="content-addressed class store: load prior runs' covered "
+             "Mazurkiewicz classes (warm start — covered classes are "
+             "never re-explored) and publish this run's ledger at exit",
+    )
+    p.add_argument(
+        "--sleep-sets", action="store_true", dest="sleep_sets",
+        help="class-dedup pruning without a store (implied by "
+             "--class-store); off = observe mode, classes tracked only",
+    )
+    p.add_argument(
+        "--stop-on-violation", action="store_true",
+        dest="stop_on_violation",
+        help="stop the fleet at the first violating round (default: "
+             "coverage mode — drain the round budget)",
+    )
+    p.add_argument(
+        "--devices-per-worker", type=int, default=1,
+        dest="devices_per_worker", metavar="N",
+        help="virtual (CPU) or local (TPU) devices per worker; >1 "
+             "shards each leased round over the worker's mesh (the "
+             "intra-slice ring; batch must divide by N)",
+    )
+    p.add_argument(
+        "--serialize-leases", action="store_true", dest="serialize_leases",
+        help="at most one lease in flight (uncontended per-worker "
+             "timing on a shared-core host — what bench config 13 "
+             "measures); default overlaps leases across workers",
+    )
+    p.add_argument(
+        "--lease-timeout", type=float, default=120.0, dest="lease_timeout",
+        metavar="S",
+        help="revoke and re-lease a round not returned within S seconds "
+             "(re-execution is bit-identical — round inputs are pure)",
+    )
+    strict_io_flags(p)
+    p.set_defaults(fn=cmd_fleet)
 
     p = sub.add_parser(
         "resume",

@@ -1172,12 +1172,10 @@ class DeviceDPOR:
             )
         self.key_mode = key_mode
         impl = impl or os.environ.get("DEMI_DEVICE_IMPL", "xla")
-        if self.sleep is not None and (
-            mesh is not None or impl == "pallas"
-        ):
+        if self.sleep is not None and impl == "pallas" and mesh is None:
             raise ValueError(
-                "sleep sets run on the XLA DPOR kernel (mesh sharding and "
-                "the pallas twin do not carry the sleep inputs yet)"
+                "sleep sets run on the XLA DPOR kernels (the pallas twin "
+                "does not carry the sleep inputs yet)"
             )
         if mesh is not None:
             # Frontier rounds sharded over the device mesh (SURVEY.md
@@ -1200,7 +1198,18 @@ class DeviceDPOR:
                     f"batch_size {batch_size} must be a multiple of the "
                     f"mesh axis {mesh.shape[LANES]}"
                 )
-            self.kernel = shard_dpor_kernel(app, cfg, mesh)
+            if self.sleep is not None:
+                # Intra-slice fleet ring: the sleep-set twin shards its
+                # extra per-lane inputs (sleep rows, node ordinals) with
+                # the batch (parallel/mesh.py).
+                from ..parallel.mesh import shard_dpor_sleep_kernel
+
+                self.kernel = shard_dpor_sleep_kernel(
+                    app, cfg, mesh, self.sleep.cap,
+                    commute_matrix=self.sleep.matrix,
+                )
+            else:
+                self.kernel = shard_dpor_kernel(app, cfg, mesh)
         elif impl == "pallas":
             from .pallas_explore import make_dpor_kernel_pallas
 
@@ -1252,6 +1261,13 @@ class DeviceDPOR:
                     app, cfg, start_state=True,
                     sleep_cap=self.sleep.cap if self.sleep else 0,
                     commute_matrix=self.sleep.matrix if self.sleep else None,
+                )
+            elif self.sleep is not None:
+                from ..parallel.mesh import shard_dpor_sleep_kernel
+
+                self._fork_kernel = shard_dpor_sleep_kernel(
+                    app, cfg, mesh, self.sleep.cap,
+                    commute_matrix=self.sleep.matrix, start_state=True,
                 )
             else:
                 from ..parallel.mesh import shard_dpor_kernel
@@ -1485,12 +1501,9 @@ class DeviceDPOR:
         return np.asarray([len(p) for p in batch], np.int32)
 
     def _progs(self, b: int) -> ExtProgram:
-        return ExtProgram(
-            op=np.broadcast_to(self.prog.op, (b,) + np.asarray(self.prog.op).shape),
-            a=np.broadcast_to(self.prog.a, (b,) + np.asarray(self.prog.a).shape),
-            b=np.broadcast_to(self.prog.b, (b,) + np.asarray(self.prog.b).shape),
-            msg=np.broadcast_to(self.prog.msg, (b,) + np.asarray(self.prog.msg).shape),
-        )
+        from .explore import broadcast_program
+
+        return broadcast_program(self.prog, b)
 
     def _select_batch(
         self, frontier: List[Tuple]
@@ -1925,6 +1938,9 @@ class DeviceDPOR:
         ckey = sleep.class_key(rows, own_pos, recw)
         if sleep.prune and sleep.class_seen(ckey):
             sleep.note_pruned(klass=1, tier="device")
+            # Warm-start accounting: a hit satisfied by PRIOR-run /
+            # other-host coverage (fleet class store) counts separately.
+            sleep.note_warm(ckey)
             if sleep.audit:
                 sleep.note_pruned_prescription(presc)
             return "class", None
@@ -2316,10 +2332,17 @@ class DeviceDPOR:
         obs.journal.emit("dpor.round", **rec)
 
     def explore(
-        self, target_code: Optional[int] = None, max_rounds: int = 20
+        self, target_code: Optional[int] = None, max_rounds: int = 20,
+        stop_on_violation: bool = True,
     ) -> Optional[Tuple[np.ndarray, int]]:
         """Returns (records, trace_len) of a violating lane, or None.
         Continues from the persisted frontier; call again for more rounds.
+
+        ``stop_on_violation=False`` is COVERAGE mode (the fleet parity
+        baseline): a hit is recorded (the FIRST one is returned) but the
+        loop keeps draining rounds until the frontier empties or the
+        round budget expires, so the explored/class/violation-code sets
+        measure the schedule space, not the race to the first bug.
 
         Rounds are GENERATION-FROZEN: each round's batch is selected from
         the generation frozen at the previous generation boundary, and
@@ -2406,13 +2429,15 @@ class DeviceDPOR:
             )
             obs.gauge("dpor.frontier_size").set(len(gen) + len(pending))
             if hit is not None:
-                if spec is not None:
-                    self._note_inflight("waste")
                 obs.counter("dpor.violations_found").inc()
-                found = hit
-                h, d = self._account_round(round_t0, dev_secs)
-                self._journal_round(h, d, len(gen) + len(pending))
-                break
+                if found is None:
+                    found = hit
+                if stop_on_violation:
+                    if spec is not None:
+                        self._note_inflight("waste")
+                    h, d = self._account_round(round_t0, dev_secs)
+                    self._journal_round(h, d, len(gen) + len(pending))
+                    break
             if spec is not None:
                 sbatch, sparts, sreal, s_prescs, s_keys = spec
                 # The speculative batch was selected from the UNMERGED

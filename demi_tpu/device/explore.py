@@ -67,6 +67,20 @@ class LaneResult(NamedTuple):
     sched_hash: jnp.ndarray  # uint32
 
 
+def broadcast_program(prog: ExtProgram, b: int) -> ExtProgram:
+    """One lowered external program broadcast across a lane batch
+    (NumPy views, no copies) — the ONE batch-layout rule shared by the
+    DPOR frontier driver and the fleet worker's remote round execution
+    (demi_tpu/fleet), so a leased round's program rows mean exactly
+    what the coordinator's would."""
+    return ExtProgram(
+        *(
+            np.broadcast_to(np.asarray(x), (b,) + np.asarray(x).shape)
+            for x in prog
+        )
+    )
+
+
 def _precomputed(app: DSLApp, cfg: DeviceConfig):
     n = cfg.num_actors
     init_states = np.stack(

@@ -1,0 +1,47 @@
+"""demi_tpu.fleet: the sharded exploration fleet (ROADMAP item 1).
+
+One explorer process caps aggregate interleavings/sec at one host no
+matter how many chips or hosts exist. This package scales the DPOR
+search past one process in the three rings the roadmap names:
+
+  - **intra-slice (ICI)**: each worker's leased round shards its lane
+    batch over the worker's local device mesh via the existing kernel
+    twins (``parallel/mesh.py``; the sleep-set twin gained a sharded
+    build for this) — chips inside a slice split a round.
+  - **cross-host (DCN)**: a coordinator (``coordinator.py``) owns the
+    host half of ONE DeviceDPOR search and assigns generation-frozen
+    round leases to workers (``worker.py``); frontier prescriptions and
+    lane results cross the wire as the delta-encoded zlib payloads
+    ``persist/`` already defines. Admissions are deduped globally on
+    content digests AND Mazurkiewicz class keys, so no host re-explores
+    a prescription — or a class — any host covered. Leases are
+    revocable and workers preemptible because round inputs are pure:
+    a re-leased round re-executes bit-identically.
+  - **across runs**: the class ledger (``ledger.py``) persists as a
+    content-addressed segment store; a second run of the same workload
+    warm-starts at the prior class frontier and re-explores none of it
+    (the TuningCache warm-start story applied to the search itself).
+
+The whole construction is bit-identical to the single-process loop —
+same explored set, class set, violation codes, first find — at any
+worker count, preemption included (tests/test_fleet.py; scaling curve
+in ``bench --config 13``; ``demi_tpu fleet`` is the CLI verb and
+``demi_tpu top`` grows a FLEET panel over the coordinator journal).
+"""
+
+from .coordinator import (  # noqa: F401
+    FleetCoordinator,
+    build_fleet_workload,
+    run_fleet,
+    set_digest,
+)
+from .ledger import ClassLedger, ClassStore  # noqa: F401
+
+__all__ = [
+    "ClassLedger",
+    "ClassStore",
+    "FleetCoordinator",
+    "build_fleet_workload",
+    "run_fleet",
+    "set_digest",
+]

@@ -1,0 +1,193 @@
+"""Global class ledger + content-addressed class store.
+
+The fleet's dedup currency is the Mazurkiewicz class key (PR 9's
+``analysis.canonical_class_key``): equivalent reversal orders of
+independent races canonicalize to the same key on every host, so "no
+host re-explores a class any host covered" is one set-membership check
+at admission. A ``ClassLedger`` is that set plus the violation codes
+observed while covering it; merging per-worker ledgers is set union —
+associative and commutative, so any merge order or grouping yields one
+answer (the property test in tests/test_fleet.py pins it, mirroring the
+PR 11 obs merge audit).
+
+``ClassStore`` persists ledgers ACROSS runs as a content-addressed
+segment directory:
+
+    <root>/<workload fingerprint>/<sha256-of-bytes>.seg
+
+Each segment is the zlib-compressed JSON of a ledger payload (class
+keys ride the same delta-encoded frames the persist/ explored-log
+sections use), and its filename is the sha256 of its bytes — the
+address IS the integrity check. Loading re-hashes every segment: a
+torn, truncated, or bit-rotted segment fails its own address and is
+skipped (warn + ``persist.corrupt_fallbacks``), degrading to the
+remaining good segments exactly the way checkpoint generations degrade.
+Publishing an identical ledger twice is a no-op by construction (same
+bytes, same address), so concurrent runs of the same workload converge
+instead of duplicating.
+
+A second run of the same workload loads the store and seeds its
+explorer's class set as *covered* (``SleepSets.seed_covered``): every
+candidate whose class a prior run admitted is suppressed at admission
+(counted in ``fleet.warm_skips``), so the search starts at the prior
+class frontier — the TuningCache warm-start story applied to the
+search itself.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import sys
+import zlib
+from typing import Any, Dict, Iterable, List, Optional, Set
+
+from .. import obs
+
+
+def _warn(msg: str) -> None:
+    print(f"demi_tpu.fleet: {msg}", file=sys.stderr)
+
+
+class ClassLedger:
+    """A mergeable set of Mazurkiewicz class keys + observed violation
+    codes (see module doc). Keys are the canonical tuples
+    ``analysis.canonical_class_key`` produces."""
+
+    def __init__(
+        self,
+        classes: Iterable[tuple] = (),
+        violation_codes: Iterable[int] = (),
+    ):
+        self.classes: Set[tuple] = {
+            tuple(tuple(r) for r in k) for k in classes
+        }
+        self.violation_codes: Set[int] = {int(c) for c in violation_codes}
+
+    def __len__(self) -> int:
+        return len(self.classes)
+
+    def __eq__(self, other) -> bool:
+        return (
+            isinstance(other, ClassLedger)
+            and self.classes == other.classes
+            and self.violation_codes == other.violation_codes
+        )
+
+    def covered(self, key: tuple) -> bool:
+        return key in self.classes
+
+    def merge(self, other: "ClassLedger") -> "ClassLedger":
+        """In-place set union (associative + commutative); returns self."""
+        self.classes |= other.classes
+        self.violation_codes |= other.violation_codes
+        return self
+
+    @classmethod
+    def merged(cls, ledgers: Iterable["ClassLedger"]) -> "ClassLedger":
+        out = cls()
+        for led in ledgers:
+            out.merge(led)
+        return out
+
+    # -- wire / disk form --------------------------------------------------
+    def to_payload(self) -> Dict[str, Any]:
+        """Deterministic JSON-able payload: sorted class keys as one
+        delta-encoded zlib frame (the persist/ codec) + sorted codes.
+        Equal ledgers produce equal payload bytes — the property the
+        content-addressed store's dedup rests on."""
+        from ..persist.checkpoint import pack_prescriptions
+
+        return {
+            "classes": pack_prescriptions(sorted(self.classes)),
+            "violation_codes": sorted(self.violation_codes),
+        }
+
+    @classmethod
+    def from_payload(cls, payload: Dict[str, Any]) -> "ClassLedger":
+        from ..persist.checkpoint import unpack_prescriptions
+
+        return cls(
+            classes=unpack_prescriptions(payload["classes"]),
+            violation_codes=payload.get("violation_codes", ()),
+        )
+
+
+class ClassStore:
+    """Content-addressed, cross-run persistent ledger store (see module
+    doc). One directory per workload fingerprint, so raft-with-bug-A can
+    never warm-start raft-with-bug-B (the persist/ handler-fingerprint
+    discriminator reused)."""
+
+    def __init__(self, root: str, workload_fp: str):
+        self.root = root
+        self.workload_fp = workload_fp
+        self.dir = os.path.join(root, workload_fp)
+        self.stats: Dict[str, int] = {
+            "segments_loaded": 0, "segments_corrupt": 0,
+            "segments_published": 0,
+        }
+
+    def segments(self) -> List[str]:
+        try:
+            return sorted(
+                e for e in os.listdir(self.dir) if e.endswith(".seg")
+            )
+        except OSError:
+            return []
+
+    def load(self) -> ClassLedger:
+        """Merge every valid segment (any order — union is order-free).
+        A segment whose bytes no longer hash to its own filename, or
+        that fails to decompress/parse, is skipped and counted — the
+        store degrades to the good segments, never crashes."""
+        merged = ClassLedger()
+        for name in self.segments():
+            path = os.path.join(self.dir, name)
+            try:
+                with open(path, "rb") as f:
+                    data = f.read()
+                if hashlib.sha256(data).hexdigest() != name[:-len(".seg")]:
+                    raise ValueError("content digest != segment address")
+                payload = json.loads(zlib.decompress(data))
+                merged.merge(ClassLedger.from_payload(payload))
+            except Exception as exc:
+                self.stats["segments_corrupt"] += 1
+                obs.counter("persist.corrupt_fallbacks").force_inc()
+                _warn(
+                    f"class-store segment {path!r} unusable ({exc}); "
+                    "skipping — coverage degrades to the remaining "
+                    "segments"
+                )
+                continue
+            self.stats["segments_loaded"] += 1
+        return merged
+
+    def publish(self, ledger: ClassLedger) -> Optional[str]:
+        """Write one segment holding ``ledger`` (atomic: tmp + fsync +
+        rename). Content-addressed: an identical ledger maps to an
+        existing address and publishing is a no-op. Empty ledgers are
+        not published. Returns the segment path (or None)."""
+        if not ledger.classes:
+            return None
+        data = zlib.compress(
+            json.dumps(
+                ledger.to_payload(), sort_keys=True, separators=(",", ":")
+            ).encode(),
+            6,
+        )
+        name = hashlib.sha256(data).hexdigest() + ".seg"
+        path = os.path.join(self.dir, name)
+        if os.path.exists(path):
+            return path
+        os.makedirs(self.dir, exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        self.stats["segments_published"] += 1
+        obs.counter("fleet.store_segments_published").force_inc()
+        return path

@@ -44,6 +44,7 @@ from .metrics import (  # noqa: F401
     gauge,
     histogram,
     merge_snapshots,
+    relabel_snapshot,
     timed,
 )
 from .spans import TRACER, Tracer, span  # noqa: F401
@@ -62,6 +63,7 @@ __all__ = [
     "journal",
     "merge_snapshots",
     "profiler",
+    "relabel_snapshot",
     "span",
     "timed",
     "timeseries",

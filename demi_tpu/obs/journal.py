@@ -234,7 +234,7 @@ JOURNAL: Optional[RoundJournal] = None
 #: execution instead of per round.
 _SAMPLED_KINDS = frozenset(
     ("dpor.round", "sweep.chunk", "minimize.level", "minimize.stage",
-     "pipeline.frame")
+     "pipeline.frame", "fleet.round")
 )
 
 

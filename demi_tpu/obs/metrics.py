@@ -335,6 +335,39 @@ class MetricsRegistry:
         return json.dumps(self.snapshot(), indent=2, sort_keys=True)
 
 
+def relabel_snapshot(snap: Dict[str, Any], **labels) -> Dict[str, Any]:
+    """A copy of a registry snapshot with ``labels`` folded into every
+    series key — the fleet-merge primitive: a worker's snapshot is
+    relabeled with its worker id before the coordinator merges, so
+    per-worker series (host share, rounds/sec) survive aggregation as
+    distinct labeled series instead of summing into one anonymous
+    total. ``demi_tpu stats`` and ``stats --prom`` then render the
+    ``worker`` label like any other."""
+    def rekey(key: str) -> str:
+        parts: Dict[str, Any] = {}
+        if key:
+            for pair in key.split(","):
+                k, _, v = pair.partition("=")
+                parts[k] = v
+        parts.update({k: str(v) for k, v in labels.items()})
+        return _label_key(parts)
+
+    out: Dict[str, Any] = {}
+    for fam, series_map in snap.items():
+        if not isinstance(series_map, dict):
+            out[fam] = series_map
+            continue
+        out[fam] = {
+            name: (
+                {rekey(k): v for k, v in series.items()}
+                if isinstance(series, dict)
+                else series
+            )
+            for name, series in series_map.items()
+        }
+    return out
+
+
 def merge_snapshots(*snaps: Dict[str, Any]) -> Dict[str, Any]:
     """Combine snapshots (cross-process aggregation helper). ``load``
     mutates series storage directly, so merging works with telemetry off."""

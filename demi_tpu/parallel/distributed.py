@@ -41,17 +41,24 @@ DEFAULT_WORKLOAD = {
 }
 
 
-def _build_workload(workload: dict):
-    """Build the app/config/fuzzer from a CLI-args-shaped dict, reusing the
-    CLI's own builders so every flag means the same thing with or without
-    --processes."""
+def workload_args(workload: Optional[dict]):
+    """CLI-args-shaped namespace over DEFAULT_WORKLOAD + overrides — the
+    shared front half of every multi-process workload builder (this
+    module's sweep slices AND the fleet's coordinator/worker pair), so
+    a flag means the same thing in every process."""
     import argparse
 
+    return argparse.Namespace(**{**DEFAULT_WORKLOAD, **(workload or {})})
+
+
+def build_workload(workload: Optional[dict], record: bool = False):
+    """Build (app, DeviceConfig, fuzzer) from a CLI-args-shaped dict,
+    reusing the CLI's own builders. ``record=True`` turns on trace +
+    parent recording (the DPOR/fleet shape; sweeps keep it off)."""
     from ..cli import build_app, build_fuzzer
     from ..device.core import DeviceConfig
 
-    merged = {**DEFAULT_WORKLOAD, **workload}
-    args = argparse.Namespace(**merged)
+    args = workload_args(workload)
     app = build_app(args)
     cfg = DeviceConfig.for_app(
         app,
@@ -60,9 +67,14 @@ def _build_workload(workload: dict):
         max_external_ops=max(16, args.num_events + app.num_actors + 2),
         invariant_interval=1,
         timer_weight=args.timer_weight,
+        record_trace=record,
+        record_parents=record,
     )
     fuzzer = build_fuzzer(app, args)
     return app, cfg, fuzzer
+
+
+_build_workload = build_workload  # back-compat alias
 
 
 def run_slice(
@@ -78,9 +90,25 @@ def run_slice(
     CLI-args-shaped dict (see DEFAULT_WORKLOAD)."""
     import jax
 
-    jax.distributed.initialize(
-        coordinator, num_processes=num_processes, process_id=process_id
-    )
+    from ..persist.supervisor import SUPERVISOR
+
+    def _connect(attempt: int):
+        # A worker that races the coordination-service startup (rank 0
+        # not listening yet, a slow DNS, a recycled port) used to fail
+        # the whole launch on its first refused connection; bounded
+        # retry/backoff rides the same LaunchSupervisor as every other
+        # I/O surface (DEMI_LAUNCH_RETRIES; --strict-io raises
+        # StrictIOError on exhaustion instead of limping).
+        if attempt:
+            try:
+                jax.distributed.shutdown()
+            except Exception:
+                pass  # a half-initialized runtime blocks re-initialize
+        jax.distributed.initialize(
+            coordinator, num_processes=num_processes, process_id=process_id
+        )
+
+    SUPERVISOR.run(_connect, label="distributed.connect")
     import jax.numpy as jnp
     from jax.experimental import multihost_utils
 
@@ -124,14 +152,27 @@ def run_slice(
         seconds = sum(c.seconds for c in chunks)
     # Only summaries cross the wire (O(counters) per slice).
     local = jnp.asarray([lanes, violations, overflow], jnp.int32)
-    gathered = multihost_utils.process_allgather(local)
-    per_slice = [[int(x) for x in row] for row in gathered]
-    totals = [int(x) for x in gathered.sum(axis=0)]
+    allgather_ok = True
+    try:
+        gathered = multihost_utils.process_allgather(local)
+        per_slice = [[int(x) for x in row] for row in gathered]
+        totals = [int(x) for x in gathered.sum(axis=0)]
+    except Exception:
+        # Some backends (current CPU runtimes among them) form the
+        # distributed coordination service but implement no multiprocess
+        # collectives. Degrade instead of failing the launch: every rank
+        # reports its LOCAL row, and the launcher aggregates the printed
+        # summaries — same totals, O(counters) over stdout instead of
+        # over the collective.
+        allgather_ok = False
+        per_slice = [[lanes, violations, overflow]]
+        totals = [lanes, violations, overflow]
     return {
         "process_id": process_id,
         "num_processes": num_processes,
         "global_devices": jax.device_count(),
         "local_devices": jax.local_device_count(),
+        "allgather_ok": allgather_ok,
         "per_slice": per_slice,
         "total_lanes": totals[0],
         "total_violations": totals[1],
@@ -172,16 +213,24 @@ def launch_distributed_sweep(
     env.pop("JAX_NUM_PROCESSES", None)
     repo = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
     env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    from ..persist.supervisor import SUPERVISOR
+
     procs = [
-        subprocess.Popen(
-            [
-                sys.executable, "-m", "demi_tpu.parallel.distributed",
-                coordinator, str(num_processes), str(rank),
-                str(total_lanes), str(chunk_size),
-                json.dumps(workload or {}),
-            ],
-            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
-            env=env,
+        # Spawn under the launch supervisor: a transient fork/exec
+        # failure (EAGAIN under memory pressure, a racing fd limit)
+        # retries with backoff instead of failing the whole launch.
+        SUPERVISOR.run(
+            lambda _attempt, rank=rank: subprocess.Popen(
+                [
+                    sys.executable, "-m", "demi_tpu.parallel.distributed",
+                    coordinator, str(num_processes), str(rank),
+                    str(total_lanes), str(chunk_size),
+                    json.dumps(workload or {}),
+                ],
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+                env=env,
+            ),
+            label="distributed.spawn",
         )
         for rank in range(num_processes)
     ]
@@ -220,21 +269,54 @@ def launch_distributed_sweep(
     ]
     for rc, out, err in outs:
         if rc != 0:
-            raise RuntimeError(
+            from ..persist.supervisor import StrictIOError, strict_io_enabled
+
+            msg = (
                 f"worker failed rc={rc}: stdout={out[-300:]!r} "
                 f"stderr={err[-800:]!r}"
             )
+            # --strict-io (env DEMI_STRICT_IO, inherited by the workers)
+            # makes a dead slice the loud CI failure class it is.
+            if strict_io_enabled(None):
+                raise StrictIOError(msg)
+            raise RuntimeError(msg)
     # Every rank prints its summary; rank 0's carries the aggregate. The
     # sentinel + raw_decode survives collective backends (Gloo) writing
     # status text onto the same stdout, even mid-line.
-    out0 = outs[0][1]
-    pos = out0.rfind(_SUMMARY_MARK)
-    if pos < 0:
-        raise RuntimeError(
-            f"no summary in rank-0 stdout: {out0[-500:]!r}"
+    def rank_summary(out: str) -> dict:
+        pos = out.rfind(_SUMMARY_MARK)
+        if pos < 0:
+            raise RuntimeError(f"no summary in worker stdout: {out[-500:]!r}")
+        summary, _ = json.JSONDecoder().raw_decode(
+            out[pos + len(_SUMMARY_MARK):]
         )
-    summary, _ = json.JSONDecoder().raw_decode(
-        out0[pos + len(_SUMMARY_MARK):]
+        return summary
+
+    summary = rank_summary(outs[0][1])
+    if summary.get("allgather_ok", True):
+        return summary
+    # Collective-less backend: aggregate the ranks' LOCAL rows here —
+    # same totals the allgather would have produced, degraded to stdout
+    # transport (counted; the deployment shape still formed).
+    from .. import obs
+
+    obs.counter("distributed.allgather_fallbacks").force_inc()
+    print(
+        "demi_tpu.distributed: backend lacks multiprocess collectives; "
+        "aggregating per-rank summaries in the launcher",
+        file=sys.stderr,
+    )
+    ranks = sorted(
+        (rank_summary(out) for _rc, out, _err in outs),
+        key=lambda s: s["process_id"],
+    )
+    per_slice = [list(s["per_slice"][0]) for s in ranks]
+    totals = [sum(row[i] for row in per_slice) for i in range(3)]
+    summary.update(
+        per_slice=per_slice,
+        total_lanes=totals[0],
+        total_violations=totals[1],
+        total_overflow=totals[2],
     )
     return summary
 

@@ -60,12 +60,11 @@ def _shard_lane_kernel(
     batch_sharding = NamedSharding(mesh, P(axis))
     if start_state:
         replicated = NamedSharding(mesh, P())
-        fn = {
-            2: lambda a, b, snap: run_lane(a, b, snap),
-            3: lambda a, b, c, snap: run_lane(a, b, c, snap),
-        }[n_in]
         return jax.jit(
-            jax.vmap(fn, in_axes=(0,) * n_in + (None,)),
+            jax.vmap(
+                lambda *args: run_lane(*args),
+                in_axes=(0,) * n_in + (None,),
+            ),
             in_shardings=(batch_sharding,) * n_in + (replicated,),
             out_shardings=batch_sharding,
         )
@@ -124,6 +123,31 @@ def shard_dpor_kernel(
     )
 
 
+def shard_dpor_sleep_kernel(
+    app: DSLApp,
+    cfg: DeviceConfig,
+    mesh: Mesh,
+    sleep_cap: int,
+    commute_matrix=None,
+    axis: str = LANES,
+    start_state: bool = False,
+):
+    """The sleep-set DPOR twin sharded over the mesh — the fleet's
+    intra-slice ring with optimal-DPOR tracking on: per-lane sleep rows
+    ([B, sleep_cap, recw]) and node ordinals shard with the lane batch
+    (``n_in=5``), the optional trunk snapshot stays replicated, and the
+    per-lane wake observations come back sharded like every other
+    result field. Lane semantics are bit-identical to the unsharded
+    sleep kernel (lanes have no cross-lane ops; sharding is placement
+    only)."""
+    from ..device.dpor_sweep import make_dpor_sleep_run_lane
+
+    return _shard_lane_kernel(
+        make_dpor_sleep_run_lane(app, cfg, sleep_cap, commute_matrix),
+        mesh, axis, n_in=5, start_state=start_state,
+    )
+
+
 def shard_explore_kernel_pallas(
     app: DSLApp,
     cfg: DeviceConfig,
@@ -139,6 +163,12 @@ def shard_explore_kernel_pallas(
     from ..device.explore import ExtProgram, LaneResult
     from ..device.pallas_explore import make_explore_kernel_pallas
 
+    # shard_map's import home moved across jax releases; prefer the
+    # stable top-level name, fall back to the experimental module.
+    shard_map = getattr(jax, "shard_map", None)
+    if shard_map is None:
+        from jax.experimental.shard_map import shard_map
+
     kernel = make_explore_kernel_pallas(app, cfg, block_lanes=block_lanes)
     lane = P(axis)
     in_specs = (ExtProgram(op=lane, a=lane, b=lane, msg=lane), lane)
@@ -146,14 +176,23 @@ def shard_explore_kernel_pallas(
         status=lane, violation=lane, deliveries=lane, trace=lane,
         trace_len=lane, sched_hash=lane,
     )
+    # pallas_call's out_shape ShapeDtypeStructs carry no varying-mesh-
+    # axes annotation; skip the replication/vma check (lanes are fully
+    # independent, nothing is replicated). The kwarg name changed
+    # across jax releases (check_rep -> check_vma).
+    import inspect
+
+    params = inspect.signature(shard_map).parameters
+    check_kw = (
+        {"check_vma": False}
+        if "check_vma" in params
+        else {"check_rep": False} if "check_rep" in params else {}
+    )
     return jax.jit(
-        jax.shard_map(
+        shard_map(
             lambda progs, keys: kernel(progs, keys),
             mesh=mesh, in_specs=in_specs, out_specs=out_specs,
-            # pallas_call's out_shape ShapeDtypeStructs carry no varying-
-            # mesh-axes annotation; skip the vma check (lanes are fully
-            # independent, nothing is replicated).
-            check_vma=False,
+            **check_kw,
         )
     )
 

@@ -36,9 +36,13 @@ from .checkpoint import (  # noqa: F401
     controller_payload,
     device_dpor_payload,
     host_dpor_payload,
+    pack_array,
+    pack_prescriptions,
     restore_controller,
     restore_device_dpor,
     restore_host_dpor,
+    unpack_array,
+    unpack_prescriptions,
 )
 from .supervisor import (  # noqa: F401
     SUPERVISOR,
@@ -60,8 +64,12 @@ __all__ = [
     "controller_payload",
     "device_dpor_payload",
     "host_dpor_payload",
+    "pack_array",
+    "pack_prescriptions",
     "restore_controller",
     "restore_device_dpor",
     "restore_host_dpor",
     "strict_io_enabled",
+    "unpack_array",
+    "unpack_prescriptions",
 ]

@@ -326,6 +326,57 @@ def _unpack_digests(s: str, size: int = 16) -> set:
 
 
 # ---------------------------------------------------------------------------
+# Fleet wire codecs: the delta-encoded zlib frames the explored-log
+# sections already ride, exposed as standalone payloads so frontier
+# deltas, round leases, and class-ledger segments cross the DCN in the
+# exact on-disk format (demi_tpu/fleet).
+# ---------------------------------------------------------------------------
+
+def pack_prescriptions(items) -> Dict[str, Any]:
+    """One delta-encoded zlib frame over an ordered list of row-tuple
+    sequences (prescriptions OR Mazurkiewicz class keys — any nested
+    int-tuple rows of one fixed width). Deterministic bytes for a given
+    input order, which is what makes the fleet's content-addressed
+    class-store segments self-verifying."""
+    items = list(items)
+    frame, w, _last = _encode_explored_frame(items, (), 0)
+    return {"n": len(items), "w": w, "frames": [_b64(frame)]}
+
+
+def unpack_prescriptions(obj: Dict[str, Any]) -> List[tuple]:
+    """Inverse of ``pack_prescriptions``."""
+    return _decode_explored_frames(obj["frames"])
+
+
+def pack_array(a) -> Dict[str, Any]:
+    """zlib-compressed ndarray payload (shape + dtype + bytes) — the
+    lease/result codec for kernel inputs and harvested lane records
+    (trace blocks are highly regular; level-1 zlib shrinks them ~10x)."""
+    import zlib
+
+    import numpy as np
+
+    a = np.ascontiguousarray(np.asarray(a))
+    return {
+        "shape": list(a.shape),
+        "dtype": str(a.dtype),
+        "z": _b64(zlib.compress(a.tobytes(), 1)),
+    }
+
+
+def unpack_array(obj: Dict[str, Any]):
+    """Inverse of ``pack_array``."""
+    import zlib
+
+    import numpy as np
+
+    buf = zlib.decompress(_unb64(obj["z"]))
+    return (
+        np.frombuffer(buf, dtype=obj["dtype"]).reshape(obj["shape"]).copy()
+    )
+
+
+# ---------------------------------------------------------------------------
 # DeviceDPOR payload
 # ---------------------------------------------------------------------------
 

@@ -167,7 +167,8 @@ def render_frame(
         "fuzz.execution": "fuzz", "sweep.chunk": "sweep",
         "dpor.round": "dpor", "minimize.level": "minimize",
         "minimize.stage": "minimize", "pipeline.enqueue": "pipeline",
-        "pipeline.frame": "pipeline",
+        "pipeline.frame": "pipeline", "fleet.round": "fleet",
+        "fleet.worker": "fleet",
     }
     recent = records[-window:]
     counts: Dict[str, int] = {}
@@ -176,7 +177,7 @@ def render_frame(
         if tier:
             counts[tier] = counts.get(tier, 0) + 1
     active_tiers = [t for t in ("fuzz", "sweep", "dpor", "minimize",
-                                "pipeline") if counts.get(t)]
+                                "pipeline", "fleet") if counts.get(t)]
     if len(active_tiers) > 1:
         total = sum(counts[t] for t in active_tiers)
         lines.append(
@@ -239,6 +240,57 @@ def render_frame(
                          f"time-to-first {_fmt(ttfv, '.2f', 's')}")
         else:
             lines.append("  violations: none yet")
+
+    fleet = [r for r in records if r.get("kind") == "fleet.round"]
+    fleet_w = [r for r in records if r.get("kind") == "fleet.worker"]
+    if fleet or fleet_w:
+        lines.append("")
+        last = fleet[-1] if fleet else fleet_w[-1]
+        alive = last.get("workers_alive")
+        outstanding = (
+            fleet[-1].get("leases_outstanding") if fleet else None
+        )
+        lines.append(
+            f"FLEET  round {fleet[-1].get('round') if fleet else '—'}  "
+            f"workers alive {alive if alive is not None else '—'}  "
+            f"leases outstanding "
+            f"{outstanding if outstanding is not None else '—'}"
+        )
+        if fleet:
+            recent_f = fleet[-window:]
+            # Aggregate interleavings/sec over the recent window: total
+            # leased lanes over the wall span those rounds landed in
+            # (concurrent workers overlap, so per-round busy seconds
+            # would double-count the wall).
+            lanes = sum(r.get("batch") or 0 for r in recent_f)
+            span = (
+                (recent_f[-1].get("t") or 0) - (recent_f[0].get("t") or 0)
+                if len(recent_f) > 1
+                else None
+            )
+            agg = lanes / span if span and span > 0 else None
+            lines.append(
+                f"  global class frontier {fleet[-1].get('classes')}"
+                f"  explored {fleet[-1].get('explored')}"
+                f"  frontier {fleet[-1].get('frontier')}"
+                f"  aggregate interleavings/sec {_fmt(agg, '.1f')}"
+            )
+            # Per-worker round share over the window.
+            per: Dict[str, int] = {}
+            for r in recent_f:
+                w = str(r.get("worker"))
+                per[w] = per.get(w, 0) + 1
+            total_r = sum(per.values())
+            if per:
+                lines.append(
+                    "  rounds by worker: " + "  ".join(
+                        f"{w} [{_bar(n / total_r, 10)}] {n}"
+                        for w, n in sorted(per.items())
+                    )
+                )
+            warm = fleet[-1].get("warm_skips")
+            if warm:
+                lines.append(f"  warm-start skips {warm}")
 
     sweep = [r for r in records if r.get("kind") == "sweep.chunk"]
     if sweep:

@@ -64,6 +64,10 @@ _HEAVY_TEST_MODULES = {
     "test_device": 6,
     "test_pallas": 6,
     "test_continuous": 6,
+    # Subprocess-heavy (each fleet run spawns worker processes that
+    # import jax + compile): last, so a tier-1 time-cap truncation cuts
+    # these new tests before any of the breadth suite.
+    "test_fleet": 7,
 }
 
 

@@ -251,6 +251,56 @@ def test_bench_config12_smoke():
     assert record["value"] == section["speedup"]
 
 
+def test_bench_config13_smoke():
+    record = _run_bench(
+        "13",
+        {
+            # Tiny fleet curve: shallow seed scan, two rounds, ONE
+            # worker count (each fleet run pays a worker-process jax
+            # startup + compile, the dominant smoke cost; multi-worker
+            # parity is tests/test_fleet.py's job). The scaling
+            # thresholds need the default shapes, so strict is off and
+            # only the identity contracts — coverage/violation parity,
+            # zero warm re-exploration — are asserted; the bench
+            # asserts them internally too.
+            "DEMI_BENCH_CONFIG13_ROUNDS": "2",
+            "DEMI_BENCH_CONFIG13_WORKERS": "1",
+            "DEMI_BENCH_CONFIG13_BUDGET": "120",
+            "DEMI_BENCH_CONFIG13_SEEDS": "4",
+            "DEMI_BENCH_CONFIG13_BATCH": "8",
+            "DEMI_BENCH_CONFIG13_STRICT": "0",
+        },
+    )
+    assert record["metric"].startswith("aggregate interleavings/sec")
+    section = record["config13"]
+    assert "error" not in section, section
+    for key in ("app", "batch", "rounds", "seed_deliveries", "baseline",
+                "curve", "scaling", "coverage_match", "violations_match",
+                "warm_start"):
+        assert key in section, key
+    for key in ("interleavings", "explored", "classes", "violation_codes",
+                "rounds", "wall_seconds"):
+        assert key in section["baseline"], key
+    assert len(section["curve"]) == 1
+    for pt in section["curve"]:
+        for key in ("workers", "rounds", "interleavings",
+                    "aggregate_interleavings_per_sec", "scaling_x",
+                    "busy_seconds", "wall_seconds", "per_worker",
+                    "violating_rounds", "violations_per_hour",
+                    "coverage_match", "violations_match",
+                    "leases_reissued"):
+            assert key in pt, key
+        assert pt["coverage_match"] is True
+        assert pt["violations_match"] is True
+        assert pt["rounds"] == section["baseline"]["rounds"]
+    for key in ("covered_loaded", "warm_skips", "reexplored_classes",
+                "explored", "rounds", "store_segments"):
+        assert key in section["warm_start"], key
+    assert section["warm_start"]["reexplored_classes"] == 0
+    assert section["warm_start"]["covered_loaded"] > 0
+    assert record["value"] == section["curve"][-1]["scaling_x"]
+
+
 def test_cli_lint_zoo_clean_subprocess():
     """Tier-1 CI contract at the real entry point: `demi_tpu lint` over
     the bundled zoo exits 0 with zero findings — run as a subprocess so

@@ -242,6 +242,37 @@ def test_cli_top_once_smoke(tmp_path, capsys):
     assert "no journal records yet" in capsys.readouterr().out
 
 
+def test_cli_top_once_fleet_panel(tmp_path, capsys):
+    """`demi_tpu top DIR --once` over a coordinator journal renders the
+    FLEET panel (workers alive, leases outstanding, global class
+    frontier, aggregate interleavings/sec, per-worker round share)."""
+    from demi_tpu.obs import journal
+
+    d = str(tmp_path)
+    j = journal.RoundJournal(d)
+    j.emit("fleet.worker", worker="w0", event="hello", workers_alive=1)
+    j.emit("fleet.worker", worker="w1", event="hello", workers_alive=2)
+    for i in range(4):
+        j.emit(
+            "fleet.round", round=i + 1, worker=f"w{i % 2}", lease=i,
+            wall_s=0.05, busy_s=0.04, host_s=0.01, batch=16, fresh=6,
+            redundant=1, violations=[], frontier=40 - i, explored=10 + i,
+            interleavings=16 * (i + 1), classes=9 + i, warm_skips=3,
+            workers_alive=2, leases_outstanding=2,
+        )
+    j.close()
+    rc = main(["top", d, "--once"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "FLEET  round 4" in out
+    assert "workers alive 2" in out
+    assert "leases outstanding 2" in out
+    assert "global class frontier 12" in out
+    assert "aggregate interleavings/sec" in out
+    assert "rounds by worker" in out and "w0" in out and "w1" in out
+    assert "warm-start skips 3" in out
+
+
 def test_cli_dpor_profile_rounds(tmp_path, capsys, monkeypatch):
     """`dpor --profile-rounds N`: the summary carries the launch-shape
     ledger and the evidence lands in the tuning cache under the

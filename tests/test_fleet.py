@@ -1,0 +1,263 @@
+"""Sharded exploration fleet (demi_tpu/fleet): ledger merge algebra,
+content-addressed store degradation, coordinator/worker coverage parity
+vs the single-process loop (preemption included), and the cross-run
+warm start."""
+
+import hashlib
+import os
+
+import numpy as np
+import pytest
+
+from demi_tpu import obs
+from demi_tpu.analysis import SleepSets, StaticIndependence, sleep_cap
+from demi_tpu.fleet import (
+    ClassLedger,
+    ClassStore,
+    build_fleet_workload,
+    run_fleet,
+    set_digest,
+)
+
+#: Small-but-racy fixture: raft elections derive hundreds of racing
+#: prescriptions within a few rounds at this budget.
+WORKLOAD = {
+    "app": "raft", "nodes": 3, "bug": "multivote",
+    "max_messages": 48, "pool": 64, "num_events": 8,
+}
+
+
+def _rand_ledger(rng: np.random.RandomState) -> ClassLedger:
+    n = rng.randint(0, 6)
+    classes = []
+    for _ in range(n):
+        m = rng.randint(1, 4)
+        classes.append(
+            tuple(
+                tuple(int(x) for x in rng.randint(0, 9, size=5))
+                for _ in range(m)
+            )
+        )
+    codes = [int(c) for c in rng.randint(1, 5, size=rng.randint(0, 3))]
+    return ClassLedger(classes=classes, violation_codes=codes)
+
+
+def test_class_ledger_merge_associative_commutative():
+    """Fleet aggregation contract (mirror of the PR 11 obs merge
+    audit): per-worker ledgers merge to ONE answer under any order or
+    grouping."""
+    import itertools
+
+    for seed in range(10):
+        rng = np.random.RandomState(seed)
+        ledgers = [_rand_ledger(rng) for _ in range(4)]
+        ref = ClassLedger.merged(ledgers)
+        for perm in itertools.permutations(range(4)):
+            assert ClassLedger.merged([ledgers[i] for i in perm]) == ref
+        # Arbitrary grouping: ((a+b) + (c+d)) and (a + (b + (c + d))).
+        left = ClassLedger.merged(ledgers[:2]).merge(
+            ClassLedger.merged(ledgers[2:])
+        )
+        right = ledgers[0:1][0]
+        right = ClassLedger.merged(
+            [ledgers[0], ClassLedger.merged(ledgers[1:])]
+        )
+        assert left == ref and right == ref
+        # Round-trip through the wire payload preserves identity.
+        assert ClassLedger.from_payload(ref.to_payload()) == ref
+
+
+def test_class_store_corrupt_segment_degrades(tmp_path):
+    """A torn or bit-rotted segment fails its own content address and
+    is skipped (counted in persist.corrupt_fallbacks), degrading to the
+    remaining good segments — never a crash."""
+    store = ClassStore(str(tmp_path), "fp-test")
+    l1 = ClassLedger(classes=[((1, 2, 3),)], violation_codes=[7])
+    l2 = ClassLedger(classes=[((4, 5, 6), (7, 8, 9))])
+    p1 = store.publish(l1)
+    p2 = store.publish(l2)
+    assert p1 != p2
+    # Identical ledger re-publish is a content-addressed no-op.
+    assert store.publish(l1) == p1
+    assert ClassStore(str(tmp_path), "fp-test").load() == ClassLedger.merged(
+        [l1, l2]
+    )
+    # Corrupt one segment in place; also drop a torn partial write.
+    with open(p2, "r+b") as f:
+        f.write(b"\x00\x01")
+    with open(os.path.join(store.dir, "nothex.seg"), "wb") as f:
+        f.write(b"torn")
+    before = obs.counter("persist.corrupt_fallbacks").total()
+    st = ClassStore(str(tmp_path), "fp-test")
+    loaded = st.load()
+    assert loaded == l1  # degraded to the good segment
+    assert st.stats["segments_corrupt"] == 2
+    assert obs.counter("persist.corrupt_fallbacks").total() == before + 2
+    # A different workload fingerprint sees an empty store.
+    assert len(ClassStore(str(tmp_path), "other-fp").load()) == 0
+
+
+def test_relabel_snapshot_worker_label_prom():
+    """Merged fleet snapshots carry a worker label on every series, and
+    the Prometheus exposition (`stats --prom`) renders it."""
+    from demi_tpu.obs import merge_snapshots, relabel_snapshot
+    from demi_tpu.obs.timeseries import prom_text
+
+    w0 = {"counters": {"dpor.host_seconds": {"": 1.5}},
+          "gauges": {"dpor.host_share": {"": 0.25}},
+          "gauge_stamps": {"dpor.host_share": {"": 10.0}}}
+    w1 = {"counters": {"dpor.host_seconds": {"": 2.5}},
+          "gauges": {"dpor.host_share": {"": 0.5}},
+          "gauge_stamps": {"dpor.host_share": {"": 11.0}}}
+    merged = merge_snapshots(
+        relabel_snapshot(w0, worker="w0"), relabel_snapshot(w1, worker="w1")
+    )
+    assert merged["counters"]["dpor.host_seconds"] == {
+        "worker=w0": 1.5, "worker=w1": 2.5
+    }
+    assert merged["gauges"]["dpor.host_share"]["worker=w0"] == 0.25
+    text = prom_text(merged)
+    assert 'demi_dpor_host_share{worker="w0"} 0.25' in text
+    assert 'demi_dpor_host_seconds_total{worker="w1"} 2.5' in text
+
+
+def _baseline(batch=8, rounds=4):
+    from demi_tpu.device.dpor_sweep import DeviceDPOR
+
+    app, cfg, program = build_fleet_workload(WORKLOAD)
+    rel = StaticIndependence.for_app(app)
+    base = DeviceDPOR(
+        app, cfg, program, batch_size=batch, prefix_fork=False,
+        double_buffer=False,
+        sleep_sets=SleepSets(independence=rel, prune=False, cap=sleep_cap()),
+    )
+    found = base.explore(max_rounds=rounds, stop_on_violation=False)
+    return base, found
+
+
+def test_fleet_parity_with_preempted_worker():
+    """2-worker fleet vs the single-process loop: the explored
+    prescription set, Mazurkiewicz class set, violation codes, and
+    frontier size are bit-identical — with worker w0 dying abruptly
+    while HOLDING a lease (the coordinator revokes and re-leases it,
+    re-execution is bit-identical) and each worker's rounds sharded
+    over a 2-device local mesh (the intra-slice sleep-kernel twin)."""
+    base, found = _baseline()
+    s = run_fleet(
+        WORKLOAD, workers=2, batch=8, rounds=4,
+        devices_per_worker=2,
+        worker_env={"w0": {"DEMI_FLEET_DIE_AFTER": "1"}},
+        timeout=420.0,
+    )
+    assert s["explored_sha"] == set_digest(base.explored)
+    assert s["classes_sha"] == set_digest(base.sleep.classes)
+    assert s["violation_codes"] == sorted(base.violation_codes)
+    assert s["explored"] == len(base.explored)
+    assert s["frontier"] == len(base.frontier)
+    assert s["rounds"] == base.round_index
+    bfound = (
+        hashlib.sha256(found[0][: found[1]].tobytes()).hexdigest()[:16]
+        if found is not None
+        else None
+    )
+    assert s["first_found_sha"] == bfound
+    # The preemption really happened and was really healed: w0 died
+    # holding its first lease, and the surviving worker re-executed it.
+    assert 17 in s["worker_returncodes"]
+    assert s["leases_reissued"] >= 1
+    assert sum(pw["rounds"] for pw in s["per_worker"].values()) >= s["rounds"]
+
+
+def test_fleet_warm_start_across_runs(tmp_path):
+    """Run 1 publishes its class ledger to the content-addressed store;
+    run 2 of the same workload loads it and re-explores ZERO covered
+    classes — only the root round executes and the frontier drains."""
+    store = str(tmp_path / "classes")
+    s1 = run_fleet(
+        WORKLOAD, workers=1, batch=8, rounds=3,
+        class_store_dir=store, timeout=420.0,
+    )
+    assert s1["classes"] > 1
+    assert s1["store"]["segments"] == 1
+    s2 = run_fleet(
+        WORKLOAD, workers=1, batch=8, rounds=3,
+        class_store_dir=store, warm_start=True, prune=True, timeout=420.0,
+    )
+    assert s2["warm_covered"] == s1["classes"]
+    assert s2["warm_skips"] > 0
+    assert s2["explored"] == 1  # the root re-executes; nothing else
+    assert s2["rounds"] == 1
+    assert s2["frontier"] == 0
+
+
+def test_explore_stop_on_violation_flag():
+    """Coverage mode (`stop_on_violation=False`) keeps draining rounds
+    past a hit and still returns the FIRST violating lane's records —
+    the fleet-parity baseline contract."""
+    from demi_tpu.apps.common import make_host_invariant
+    from demi_tpu.config import SchedulerConfig
+    from demi_tpu.device.dpor_sweep import DeviceDPOR, steering_prescription
+    from demi_tpu.schedulers import RandomScheduler
+
+    wl = dict(WORKLOAD, commands=3, max_messages=160, pool=256)
+    app, cfg, program = build_fleet_workload(wl)
+    config = SchedulerConfig(invariant_check=make_host_invariant(app))
+    fr = None
+    for seed in range(4):
+        r = RandomScheduler(
+            config, seed=seed, max_messages=120, invariant_check_interval=1
+        ).execute(program)
+        if r.violation is not None:
+            fr = r
+            break
+    assert fr is not None
+    fr.trace.set_original_externals(list(program))
+    presc = steering_prescription(app, cfg, fr.trace, program)
+
+    def run(stop):
+        d = DeviceDPOR(
+            app, cfg, program, batch_size=8, prefix_fork=False,
+            double_buffer=False,
+        )
+        d.seed(presc)
+        found = d.explore(max_rounds=3, stop_on_violation=stop)
+        return d, found
+
+    stopped, f1 = run(True)
+    drained, f2 = run(False)
+    # The seeded schedule violates in round 1 on both paths.
+    assert f1 is not None and f2 is not None
+    assert f1[0][: f1[1]].tobytes() == f2[0][: f2[1]].tobytes()
+    assert stopped.round_index == 1  # stopped at the hit
+    assert drained.round_index == 3  # kept draining the budget
+    assert len(drained.explored) >= len(stopped.explored)
+    assert drained.violation_codes >= stopped.violation_codes
+
+
+def test_fleet_journal_and_top_panel(tmp_path):
+    """The coordinator journal's fleet.* records drive the `demi_tpu
+    top` FLEET panel (synthetic records — the render contract, not the
+    fleet itself)."""
+    from demi_tpu.obs import journal
+    from demi_tpu.tools.top import render_frame
+
+    d = str(tmp_path / "run")
+    j = journal.RoundJournal(d)
+    j.emit("fleet.worker", worker="w0", event="hello", workers_alive=1)
+    for i in range(3):
+        j.emit(
+            "fleet.round", round=i + 1, worker=f"w{i % 2}", lease=i,
+            wall_s=0.05, busy_s=0.04, host_s=0.01, batch=8, fresh=4,
+            redundant=1, violations=[2] if i == 2 else [],
+            frontier=10 - i, explored=8 + i, interleavings=8 * (i + 1),
+            classes=8 + i, warm_skips=2, workers_alive=2,
+            leases_outstanding=1,
+        )
+    j.close()
+    frame = render_frame(d, window=10)
+    assert "FLEET" in frame
+    assert "workers alive 2" in frame
+    assert "global class frontier 10" in frame
+    assert "leases outstanding 1" in frame
+    assert "rounds by worker" in frame
+    assert "warm-start skips 2" in frame

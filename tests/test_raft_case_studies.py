@@ -99,16 +99,29 @@ def test_gap_append_device_sweep_and_host_lift():
     assert int(single.violation) == 2
     assert host.violation is not None and host.violation.code == 2
 
-    # Minimize the lifted lane. externals=None selects the lifted trace's
+    # Minimize a lifted lane. externals=None selects the lifted trace's
     # own externals — the program's objects never executed in this trace,
     # so they would project to "absent" under STS (the round-4 verify
     # slice caught exactly that footgun).
-    mcs, verified = sts_sched_ddmin(config, host.trace, None, host.violation)
-    kept = mcs.get_all_events()
-    assert verified is not None
-    # Real reduction required (gap_append needs at most 2 of the 3 client
-    # commands): <= would also pass for a no-op DDMin.
-    assert len(kept) < len(host.trace.original_externals)
+    #
+    # Real reduction required (gap_append needs at most 2 of the 3
+    # client commands): <= would also pass for a no-op DDMin. WHICH
+    # lanes reduce is schedule-dependent — a particular lane's MCS can
+    # genuinely be its full external set under ignore-absent STS — so
+    # the strict-reduction evidence may come from any of the first few
+    # violating lanes (each independently verified to reproduce).
+    reduced = False
+    for lane in lanes[:4]:
+        _single, h = lift_lane_to_host(
+            app, cfg, progs, keys, int(lane), config
+        )
+        assert h.violation is not None and h.violation.code == 2
+        mcs, verified = sts_sched_ddmin(config, h.trace, None, h.violation)
+        assert verified is not None
+        if len(mcs.get_all_events()) < len(h.trace.original_externals):
+            reduced = True
+            break
+    assert reduced, "no violating lane's MCS reduced below its externals"
 
 
 def test_correct_raft_clean_under_same_sweep():
