@@ -2119,6 +2119,220 @@ def bench_config13(jax):
     }
 
 
+def bench_config14(jax):
+    """Multi-tenant exploration service vs dedicated solo runs
+    (demi_tpu/service): N tenants submit the SAME multi-violation raft
+    workload (config-12 shape) with per-tenant rng base keys — distinct
+    violation sets — and the service batches their fuzz sweeps into
+    shared mixed chunks and their minimization frames through pooled
+    replay oracles. The baseline runs each tenant as a dedicated solo
+    ``StreamingPipeline``, SEQUENTIALLY (serialized uncontended busy
+    time — the one-core convention: no wall-clock parallelism claims,
+    just fewer compiles and launches for the same artifacts).
+
+    Hard identity contracts, asserted per tenant: MCS artifact
+    signatures (eid-insensitive, over the structural-JSON payloads both
+    sides persist) and violation-code sets bit-identical between the
+    shared-batch service and the solo run. Economy contracts: shared
+    compiled executables AND total kernel launches strictly fewer than
+    the solo sum (lanes deliberately not a chunk multiple, so solo tail
+    chunks pay launches the mixed fill merges away). Headline:
+    aggregate MCSes per serialized busy second, service vs
+    solo-sequential — the >=1.15x bar is mostly shared-compile economy
+    on CPU (each solo run compiles its own sweep kernel, lift kernel,
+    and per-shape checkers; the service compiles each once).
+
+    Knobs: DEMI_BENCH_CONFIG14_TENANTS / _LANES / _CHUNK / _MAX_MCS /
+    _STEPS / _SPLIT / _WILDCARDS / _STRICT."""
+    import tempfile
+
+    from demi_tpu.obs import journal as obs_journal
+    from demi_tpu.pipeline import StreamingPipeline
+    from demi_tpu.service import (
+        ExplorationService,
+        artifact_signature,
+        build_service_workload,
+    )
+
+    nodes, commands = 3, 2
+    n_tenants = int(os.environ.get("DEMI_BENCH_CONFIG14_TENANTS", 3))
+    lanes = int(os.environ.get("DEMI_BENCH_CONFIG14_LANES", 56))
+    chunk = int(os.environ.get("DEMI_BENCH_CONFIG14_CHUNK", 16))
+    max_mcs = int(os.environ.get("DEMI_BENCH_CONFIG14_MAX_MCS", 2))
+    steps = int(os.environ.get("DEMI_BENCH_CONFIG14_STEPS", 192))
+    split = float(os.environ.get("DEMI_BENCH_CONFIG14_SPLIT", 0.5))
+    wildcards = bool(
+        int(os.environ.get("DEMI_BENCH_CONFIG14_WILDCARDS", 0))
+    )
+    strict = os.environ.get("DEMI_BENCH_CONFIG14_STRICT", "1") != "0"
+    workload = {
+        "app": "raft", "nodes": nodes, "bug": "multivote",
+        "commands": commands, "max_messages": steps, "pool": 96,
+        # num_events keeps max_external_ops at the floor (16) so the
+        # solo and service kernels share the config-12 shapes.
+        "num_events": 8, "timer_weight": 0.2,
+    }
+    app, cfg, config, gen, fp = build_service_workload(workload)
+
+    # Process warm-up outside both measured windows (config-12 rule):
+    # jax runtime init + first-touch costs land on neither side. Every
+    # measured pipeline/service still compiles its own kernels — that
+    # asymmetry IS the thing being measured.
+    warm = StreamingPipeline(
+        app, cfg, config, gen, chunk=chunk, wildcards=wildcards,
+        max_frames=0,
+    )
+    warm.run(chunk)
+
+    # Solo-sequential baseline: one dedicated StreamingPipeline per
+    # tenant, run back to back in this process.
+    solo = []
+    solo_wall = 0.0
+    for i in range(n_tenants):
+        pipe = StreamingPipeline(
+            app, cfg, config, gen, base_key=i, chunk=chunk, split=split,
+            wildcards=wildcards, max_frames=max_mcs,
+        )
+        t0 = time.perf_counter()
+        result = pipe.run(lanes)
+        wall = time.perf_counter() - t0
+        solo_wall += wall
+        sigs = {
+            f.seed: artifact_signature(f.result)
+            for f in pipe.queue.done_frames()
+        }
+        compiles = (
+            1  # the sweep kernel
+            + (1 if pipe._lift_kernel is not None else 0)
+            + len(pipe._checkers)
+        )
+        solo.append({
+            "tenant": f"t{i}",
+            "wall_s": wall,
+            "sigs": sigs,
+            "codes": {int(s): int(c) for s, c in result.codes.items()},
+            "violations": result.violations,
+            "mcs": len(sigs),
+            "launches": sum(pipe.budget.launches.values()),
+            "fuzz_launches": pipe.budget.launches.get("fuzz", 0),
+            "compiles": compiles,
+        })
+    if not any(s["mcs"] for s in solo):  # pragma: no cover
+        return {"error": "no violation found to minimize"}
+
+    # Shared-batch service: the same tenants through one engine.
+    with tempfile.TemporaryDirectory() as tmp:
+        obs_journal.attach(tmp)
+        svc = ExplorationService(
+            None, split=split, depth=4, default_chunk=chunk,
+        )
+        job_ids = []
+        for i in range(n_tenants):
+            job = svc.submit(
+                f"t{i}", workload, lanes=lanes, chunk=chunk, base_key=i,
+                max_frames=max_mcs, wildcards=wildcards,
+            )
+            job_ids.append(job["job"])
+        t0 = time.perf_counter()
+        svc.run_until_idle()
+        svc_wall = time.perf_counter() - t0
+        recs = obs_journal.read_records(tmp)
+        obs_journal.detach()
+    savings = svc.savings()
+
+    per_tenant = []
+    all_sigs_match = True
+    all_codes_match = True
+    for i, job_id in enumerate(job_ids):
+        job = svc.jobs[job_id]
+        frames = svc.job_frames(job_id)
+        sigs = {
+            int(f["seed"]): artifact_signature(f["result"])
+            for f in frames
+            if f["status"] == "done"
+        }
+        sig_match = sigs == solo[i]["sigs"]
+        codes_match = job.codes == solo[i]["codes"]
+        all_sigs_match &= sig_match
+        all_codes_match &= codes_match
+        per_tenant.append({
+            "tenant": f"t{i}",
+            "job": job_id,
+            "mcs": len(sigs),
+            "violations": job.violations,
+            "ttf_mcs_s": job.ttf_mcs_s,
+            "artifacts_match": sig_match,
+            "codes_match": codes_match,
+        })
+    assert all_sigs_match, "service MCS artifacts diverged from solo runs"
+    assert all_codes_match, "service violation codes diverged from solo"
+
+    solo_launches = sum(s["launches"] for s in solo)
+    solo_compiles = sum(s["compiles"] for s in solo)
+    svc_launches = sum(savings["launches"].values())
+    svc_compiles = savings["compiled_executables"]
+    assert svc_compiles < solo_compiles, (
+        "service compiled executables not fewer than solo sum",
+        svc_compiles, solo_compiles,
+    )
+    assert svc_launches < solo_launches, (
+        "service kernel launches not fewer than solo sum",
+        svc_launches, solo_launches,
+    )
+
+    mcs_total = sum(s["mcs"] for s in solo)
+    rate_solo = mcs_total / solo_wall if solo_wall > 0 else None
+    rate_svc = mcs_total / svc_wall if svc_wall > 0 else None
+    speedup = (
+        round(rate_svc / rate_solo, 3) if rate_solo and rate_svc else None
+    )
+    if strict and speedup is not None:
+        assert speedup >= 1.15, (
+            "service MCSes per serialized busy second below the 1.15x "
+            "bar vs solo-sequential", speedup,
+        )
+    svc_frames_recs = [
+        r for r in recs if r.get("kind") == "service.frame"
+    ]
+    svc_chunk_recs = [
+        r for r in recs if r.get("kind") == "service.chunk"
+    ]
+    return {
+        "app": f"raft{nodes}",
+        "tenants": n_tenants,
+        "lanes": lanes,
+        "chunk": chunk,
+        "max_mcs": max_mcs,
+        "split": split,
+        "wildcards": wildcards,
+        "mcs_total": mcs_total,
+        "per_tenant": per_tenant,
+        "artifacts_match": all_sigs_match,
+        "codes_match": all_codes_match,
+        "wall_solo_sequential_s": round(solo_wall, 3),
+        "wall_service_s": round(svc_wall, 3),
+        "mcs_per_busy_hour_solo": (
+            round(rate_solo * 3600.0, 2) if rate_solo else None
+        ),
+        "mcs_per_busy_hour_service": (
+            round(rate_svc * 3600.0, 2) if rate_svc else None
+        ),
+        "speedup": speedup,
+        "solo_launches": solo_launches,
+        "service_launches": svc_launches,
+        "launches_saved": solo_launches - svc_launches,
+        "solo_compiles": solo_compiles,
+        "service_compiles": svc_compiles,
+        "compiles_saved": solo_compiles - svc_compiles,
+        "savings": savings,
+        "journal_frames": len(svc_frames_recs),
+        "journal_chunks": len(svc_chunk_recs),
+        "journal_mixed_chunks": sum(
+            1 for r in svc_chunk_recs if r.get("mixed")
+        ),
+    }
+
+
 def bench_config5_rehearsal(jax, total_lanes=None):
     """Config-5 machinery rehearsal at >=1e5 lanes (VERDICT r3 #6): the
     64-actor *reliable* flood runs ~1 lane/sec on CPU, so the full config
@@ -2297,7 +2511,7 @@ def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--config", default=None,
                         help="run only one section: 2, 3, 4, 5, 6, 7, 8, "
-                             "9, 10, 11, 12, 13, or 'rehearsal'")
+                             "9, 10, 11, 12, 13, 14, or 'rehearsal'")
     args = parser.parse_args()
     if args.config is not None and args.config != "rehearsal":
         args.config = int(args.config)
@@ -2490,6 +2704,21 @@ def main():
         )
         emit(out)
         return
+    if args.config == 14:
+        out["metric"] = (
+            "aggregate MCSes per serialized busy second, shared-batch "
+            "service vs solo-sequential (multi-tenant raft mix)"
+        )
+        out["unit"] = "x"
+        out["config14"] = bench_config14(jax)
+        out["value"] = out["config14"].get("speedup")
+        # Target: >= 1.15x MCSes per serialized uncontended busy second
+        # over running each tenant as a dedicated solo pipeline, with
+        # per-tenant artifacts bit-identical and strictly fewer
+        # compiled executables + kernel launches.
+        out["vs_baseline"] = round((out["value"] or 0) / 1.15, 3)
+        emit(out)
+        return
     if args.config == "rehearsal":
         out["metric"] = (
             "schedules/sec (config-5 machinery rehearsal, >=1e5 lanes)"
@@ -2520,6 +2749,7 @@ def main():
     config11 = bench_config11(jax)
     config12 = bench_config12(jax)
     config13 = bench_config13(jax)
+    config14 = bench_config14(jax)
     rehearsal = bench_config5_rehearsal(jax)
     out.update(
         {
@@ -2553,6 +2783,7 @@ def main():
             "config11": config11,
             "config12": config12,
             "config13": config13,
+            "config14": config14,
             "config5_rehearsal": rehearsal,
         }
     )

@@ -1897,6 +1897,109 @@ def cmd_top(args) -> int:
     )
 
 
+def _service_workload(args) -> dict:
+    """CLI-args-shaped workload dict for the service wire — the same
+    fields the fleet ships, so a submission means the same thing on any
+    daemon host."""
+    w = {
+        "app": args.app,
+        "nodes": args.nodes,
+        "bug": args.bug,
+        "seed": args.seed,
+        "num_events": args.num_events,
+        "max_messages": args.max_messages,
+        "timer_weight": args.timer_weight,
+        "kill_weight": args.kill_weight,
+        "partition_weight": args.partition_weight,
+        "pool": args.pool,
+    }
+    if getattr(args, "commands", 0):
+        w["commands"] = args.commands
+    return w
+
+
+def cmd_serve(args) -> int:
+    """Multi-tenant exploration service daemon (demi_tpu/service):
+    accepts tenant job submissions over the fleet's TCP JSON wire and
+    batches their fuzz→minimize work into shared device launches.
+    Announces `{"op": "listening", "addr": ...}` on stdout; SIGTERM
+    checkpoints mid-queue and exits 3 (`serve --resume` continues)."""
+    _obs_begin(args)
+    from .service import run_service
+
+    rc = run_service(
+        args.state_dir,
+        host=args.host,
+        port=args.port,
+        split=args.split,
+        depth=args.depth,
+        default_chunk=args.chunk,
+        stage_budget_seconds=args.stage_budget,
+        resume=args.resume,
+        drain_when_idle=args.drain,
+    )
+    _obs_end(args)
+    return rc
+
+
+def cmd_submit(args) -> int:
+    """Submit one tenant job (app spec + seed range) to a running
+    `demi_tpu serve` daemon; prints the admitted job summary JSON."""
+    from .service import ServiceClient, ServiceError
+
+    try:
+        with ServiceClient(args.addr) as client:
+            reply = client.submit(
+                args.tenant,
+                _service_workload(args),
+                lanes=args.lanes,
+                chunk=args.chunk,
+                base_key=args.base_key,
+                max_frames=args.max_frames,
+                weight=args.weight,
+                wildcards=not args.no_wildcards,
+            )
+    except ServiceError as exc:
+        print(json.dumps({"error": str(exc), "refused": exc.refused}))
+        return 2 if exc.refused else 1
+    print(json.dumps(reply))
+    return 0
+
+
+def cmd_jobs(args) -> int:
+    """List/poll a daemon's jobs, or fetch one job's minimization
+    artifacts (`--job ID --fetch [--out DIR]`)."""
+    from .service import ServiceClient, ServiceError
+
+    try:
+        with ServiceClient(args.addr) as client:
+            if args.job and args.fetch:
+                frames = client.fetch(args.job)
+                if args.out:
+                    os.makedirs(args.out, exist_ok=True)
+                    path = os.path.join(
+                        args.out, f"{args.job}-artifacts.json"
+                    )
+                    with open(path, "w") as f:
+                        json.dump(frames, f, indent=2, sort_keys=True)
+                    print(json.dumps({
+                        "job": args.job, "frames": len(frames),
+                        "out": path,
+                    }))
+                else:
+                    print(json.dumps(frames))
+            elif args.job:
+                print(json.dumps(client.poll(args.job)))
+            elif args.status:
+                print(json.dumps(client.status()))
+            else:
+                print(json.dumps(client.jobs(args.tenant)))
+    except ServiceError as exc:
+        print(json.dumps({"error": str(exc)}))
+        return 1
+    return 0
+
+
 def cmd_interactive(args) -> int:
     from .schedulers.interactive import InteractiveScheduler
 
@@ -2273,6 +2376,94 @@ def main(argv: Optional[list] = None) -> int:
     )
     strict_io_flags(p)
     p.set_defaults(fn=cmd_fleet)
+
+    p = sub.add_parser(
+        "serve",
+        help="multi-tenant exploration service daemon: tenants submit "
+             "fuzz→minimize jobs over the fleet's TCP JSON wire; the "
+             "service batches many tenants' lanes into shared device "
+             "launches (per-tenant results bit-identical to solo runs); "
+             "SIGTERM drains — checkpoint mid-queue, exit 3 — and "
+             "`serve --resume` continues with no job lost",
+    )
+    obs_flags(p)
+    p.add_argument("--state-dir", default=None, dest="state_dir",
+                   metavar="DIR",
+                   help="durable tenant/job/artifact state + journal "
+                        "(omit for an ephemeral in-memory service)")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=0,
+                   help="TCP port (0 = ephemeral; the bound address is "
+                        "announced as a JSON line on stdout)")
+    p.add_argument("--split", type=float, default=0.5,
+                   help="minimizer share of each in-flight turn "
+                        "(pipeline/budget.py split knob)")
+    p.add_argument("--depth", type=int, default=2,
+                   help="sweep chunks kept in flight per shared group")
+    p.add_argument("--chunk", type=int, default=64,
+                   help="default lanes per shared sweep chunk")
+    p.add_argument("--stage-budget", type=float, default=None,
+                   dest="stage_budget", metavar="S",
+                   help="per-minimizer-stage wall-clock cap, seconds")
+    p.add_argument("--resume", action="store_true",
+                   help="continue from --state-dir's newest checkpoint "
+                        "(after a SIGTERM drain or a SIGKILL)")
+    p.add_argument("--drain", action="store_true",
+                   help="exit 0 once every submitted job is done "
+                        "(default: keep serving until shutdown)")
+    p.set_defaults(fn=cmd_serve)
+
+    p = sub.add_parser(
+        "submit",
+        help="submit one tenant fuzz→minimize job (app spec + seed "
+             "range) to a running `demi_tpu serve` daemon",
+    )
+    common(p)
+    p.add_argument("--addr", required=True, metavar="HOST:PORT",
+                   help="the daemon's announced address")
+    p.add_argument("--tenant", required=True,
+                   help="tenant account name (handler fingerprint pinned "
+                        "on first submission)")
+    p.add_argument("--pool", type=int, default=64)
+    p.add_argument("--commands", type=int, default=0,
+                   help="raft only: fixed program with N client commands "
+                        "(the multi-violation bench shape) instead of "
+                        "per-seed fuzzer programs")
+    p.add_argument("--lanes", type=int, default=256,
+                   help="seed range to sweep: seeds 0..lanes")
+    p.add_argument("--chunk", type=int, default=None,
+                   help="lanes per sweep chunk (default: the daemon's)")
+    p.add_argument("--base-key", type=int, default=0, dest="base_key",
+                   help="rng base key (distinct per tenant by "
+                        "convention — same seeds, different schedules)")
+    p.add_argument("--max-frames", type=int, default=None,
+                   dest="max_frames",
+                   help="minimize at most K violations (enqueue order)")
+    p.add_argument("--weight", type=float, default=1.0,
+                   help="fair-share weight of this tenant's account")
+    p.add_argument("--no-wildcards", action="store_true",
+                   dest="no_wildcards",
+                   help="skip the wildcard minimization stage")
+    p.set_defaults(fn=cmd_submit)
+
+    p = sub.add_parser(
+        "jobs",
+        help="list/poll a serve daemon's jobs or fetch artifacts "
+             "(--job ID [--fetch [--out DIR]])",
+    )
+    p.add_argument("--addr", required=True, metavar="HOST:PORT")
+    p.add_argument("--tenant", default=None,
+                   help="restrict the listing to one tenant")
+    p.add_argument("--job", default=None, help="poll one job by id")
+    p.add_argument("--fetch", action="store_true",
+                   help="with --job: fetch the violation frames + "
+                        "minimization artifacts")
+    p.add_argument("--out", default=None, metavar="DIR",
+                   help="with --fetch: write artifacts JSON under DIR")
+    p.add_argument("--status", action="store_true",
+                   help="print the service summary (tenants, queue, "
+                        "shared-launch savings) instead of a job list")
+    p.set_defaults(fn=cmd_jobs)
 
     p = sub.add_parser(
         "resume",

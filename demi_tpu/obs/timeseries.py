@@ -179,8 +179,19 @@ def _prom_name(name: str) -> str:
 
 
 def _esc(v: str) -> str:
-    """Prometheus label-value escaping (backslash first, then quote)."""
-    return str(v).replace("\\", "\\\\").replace('"', '\\"')
+    """Prometheus label-value escaping per the text exposition format:
+    backslash first (so the later escapes don't double up), then
+    double-quote, then newline. The newline arm matters now that label
+    values include user-supplied strings (the service's tenant names) —
+    the worker ids that motivated the original renderer could never
+    carry one, but an unescaped newline in a label value tears the
+    exposition line and the whole scrape fails to parse."""
+    return (
+        str(v)
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
 
 
 def _num(v: float) -> str:

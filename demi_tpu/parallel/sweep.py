@@ -440,18 +440,44 @@ class SweepDriver:
             progs.append(prog)
         return stack_programs(progs)
 
-    def _dispatch_chunk(self, seeds: Sequence[int], base_key: int = 0):
+    def _dispatch_chunk(
+        self,
+        seeds: Sequence[int],
+        base_key: int = 0,
+        base_keys: Optional[Sequence[int]] = None,
+    ):
         """Launch one chunk's kernel WITHOUT blocking (jax async
-        dispatch); pair with ``_harvest_chunk``."""
+        dispatch); pair with ``_harvest_chunk``.
+
+        ``base_keys`` (parallel to ``seeds``) gives each lane its own
+        rng base — the multi-tenant mixed-chunk shape (demi_tpu/service):
+        tenants' lanes share one launch but each lane's key is still
+        ``fold_in(PRNGKey(base), seed)``, the exact value the lane gets
+        in a dedicated solo run, so mixing changes which launch a lane
+        rides, never what it computes."""
         real = list(seeds)
         assert real, "empty chunk"
         padded = list(real)
+        if base_keys is not None:
+            assert len(base_keys) == len(real), "base_keys/seeds mismatch"
+            bases = list(base_keys)
         while len(padded) % self._align:
-            padded.extend(real[: self._align - (len(padded) % self._align)])
+            take = self._align - (len(padded) % self._align)
+            padded.extend(real[:take])
+            if base_keys is not None:
+                bases.extend(bases[:take])
         progs = self._programs(padded)
-        keys = jax.vmap(
-            lambda s: jax.random.fold_in(jax.random.PRNGKey(base_key), s)
-        )(np.asarray(padded, np.uint32))
+        if base_keys is None:
+            keys = jax.vmap(
+                lambda s: jax.random.fold_in(jax.random.PRNGKey(base_key), s)
+            )(np.asarray(padded, np.uint32))
+        else:
+            keys = jax.vmap(
+                lambda s, b: jax.random.fold_in(jax.random.PRNGKey(b), s)
+            )(
+                np.asarray(padded, np.uint32),
+                np.asarray(bases, np.uint32),
+            )
         t0 = time.perf_counter()
         if self._forker is not None:
             res = self._dispatch_forked(progs, keys)

@@ -19,9 +19,14 @@ from .budget import (  # noqa: F401
     PIPELINE_SPLIT_AXIS,
     LaunchBudget,
 )
-from .queue import ViolationFrame, ViolationQueue  # noqa: F401
+from .queue import (  # noqa: F401
+    DEFAULT_NAMESPACE,
+    ViolationFrame,
+    ViolationQueue,
+)
 
 __all__ = [
+    "DEFAULT_NAMESPACE",
     "DEFAULT_SPLIT",
     "PIPELINE_SPLIT_AXIS",
     "LaunchBudget",
@@ -29,13 +34,15 @@ __all__ = [
     "StreamingPipeline",
     "ViolationFrame",
     "ViolationQueue",
+    "bucketed_replay_config",
     "lift_violating_seed",
     "run_staged",
 ]
 
 _LAZY = {
     "StreamingPipeline", "PipelineRunResult", "run_staged",
-    "lift_violating_seed", "frame_signature",
+    "lift_violating_seed", "frame_signature", "bucketed_replay_config",
+    "make_lift_kernel",
 }
 
 

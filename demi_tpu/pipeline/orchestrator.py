@@ -156,6 +156,34 @@ def make_lift_kernel(app, cfg):
     return make_single_lane_trace_kernel(app, cfg)
 
 
+def bucketed_replay_config(app, trace, externals):
+    """Device config for a frame's replay oracle, BUCKETED: size from
+    the trace (``default_device_config``), then round pool/steps up to
+    multiples of 128 (externals to 16) so frames of similar depth land
+    on ONE compiled kernel. Capacities only ever round UP — padding is
+    semantics-free (early_exit keeps replay wall tracking the live
+    candidate), so verdicts and the MCS are identical to per-frame
+    sizing. The ONE bucketing rule both the streaming orchestrator and
+    the multi-tenant service use — shared so the shapes (and therefore
+    the shared-compile economics and the parity A/B) cannot drift."""
+    import dataclasses as _dc
+
+    from ..device.batch_oracle import default_device_config
+
+    cfg = default_device_config(app, trace, externals)
+
+    def up(n: int, m: int) -> int:
+        return (n + m - 1) // m * m
+
+    cfg = _dc.replace(
+        cfg,
+        pool_capacity=up(cfg.pool_capacity, 128),
+        max_steps=up(cfg.max_steps, 128),
+        max_external_ops=up(cfg.max_external_ops, 16),
+    )
+    return cfg, (cfg.pool_capacity, cfg.max_steps, cfg.max_external_ops)
+
+
 def lift_violating_seed(
     app, cfg, config, program_gen, seed, base_key=0, trace_kernel=None
 ):
@@ -289,31 +317,12 @@ class StreamingPipeline:
 
     def _frame_checker(self, trace, externals):
         """Shared replay oracle for a frame, keyed by its BUCKETED
-        device shape: ``default_device_config`` sizes from the trace in
-        multiples of 8; bucketing rounds pool/steps up to 128 (externals
-        to 16) so frames of similar depth land on ONE compiled kernel.
-        Capacities only ever round UP — padding is semantics-free
-        (early_exit keeps replay wall tracking the live candidate), so
-        verdicts and the MCS are identical to per-frame sizing."""
-        import dataclasses as _dc
+        device shape (``bucketed_replay_config``): frames of similar
+        depth land on ONE compiled kernel, verdicts identical to
+        per-frame sizing."""
+        from ..device.batch_oracle import DeviceReplayChecker
 
-        from ..device.batch_oracle import (
-            DeviceReplayChecker,
-            default_device_config,
-        )
-
-        cfg = default_device_config(self.app, trace, externals)
-
-        def up(n: int, m: int) -> int:
-            return (n + m - 1) // m * m
-
-        cfg = _dc.replace(
-            cfg,
-            pool_capacity=up(cfg.pool_capacity, 128),
-            max_steps=up(cfg.max_steps, 128),
-            max_external_ops=up(cfg.max_external_ops, 16),
-        )
-        key = (cfg.pool_capacity, cfg.max_steps, cfg.max_external_ops)
+        cfg, key = bucketed_replay_config(self.app, trace, externals)
         checker = self._checkers.get(key)
         if checker is None:
             checker = DeviceReplayChecker(self.app, cfg, self.config)

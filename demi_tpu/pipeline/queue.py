@@ -12,10 +12,16 @@ already defines (externals/event records), so a done frame round-trips
 through a checkpoint — or, in the fleet story, over DCN to a
 coordinator — without the producing process.
 
-The queue itself is an insertion-ordered, seed-keyed map: offering the
-same seed twice is a no-op (a resumed sweep re-retires the lanes the
-dead run found after its last checkpoint; dedup here is what makes "no
-violation minimized twice" hold across kills). ``checkpoint_state`` /
+The queue itself is an insertion-ordered, (namespace, seed)-keyed map:
+offering the same key twice is a no-op (a resumed sweep re-retires the
+lanes the dead run found after its last checkpoint; dedup here is what
+makes "no violation minimized twice" hold across kills). Namespaces are
+the multi-tenant fix: a solo streaming run lives entirely in the
+default ``""`` namespace (keys stay plain seeds — the pre-service
+checkpoint shape), while the exploration service (demi_tpu/service/)
+multiplexes many tenants' jobs through ONE queue with
+``namespace="<tenant>/<job>"``, so two jobs submitting the same seed no
+longer dedup each other's violations. ``checkpoint_state`` /
 ``restore_state`` ride the same structural-JSON contract as every other
 persist/ payload.
 """
@@ -25,6 +31,11 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
 
+#: The solo-streaming namespace: frames keyed by their bare seed, which
+#: is both the pre-namespace behavior and the pre-namespace checkpoint
+#: format (a PR-12 checkpoint restores unchanged).
+DEFAULT_NAMESPACE = ""
+
 
 @dataclass
 class ViolationFrame:
@@ -33,18 +44,23 @@ class ViolationFrame:
     seed: int
     code: int
     status: str = "queued"  # queued | done | skipped
+    #: Owning tenant/job namespace ("" = solo streaming run).
+    namespace: str = DEFAULT_NAMESPACE
     # Structural-JSON minimization artifacts once done (serialization.py
     # codecs): {"mcs": [...], "final_trace": [...], "stages": [...],
     # "wall_s": float, "code": int}.
     result: Optional[Dict[str, Any]] = None
 
     def to_json(self) -> Dict[str, Any]:
-        return {
+        out = {
             "seed": int(self.seed),
             "code": int(self.code),
             "status": self.status,
             "result": self.result,
         }
+        if self.namespace != DEFAULT_NAMESPACE:
+            out["ns"] = self.namespace
+        return out
 
     @classmethod
     def from_json(cls, obj: Dict[str, Any]) -> "ViolationFrame":
@@ -52,46 +68,87 @@ class ViolationFrame:
             seed=int(obj["seed"]),
             code=int(obj["code"]),
             status=obj.get("status", "queued"),
+            namespace=obj.get("ns", DEFAULT_NAMESPACE),
             result=obj.get("result"),
         )
 
 
+def _key(namespace: str, seed: int):
+    """Frame map key: bare seed in the default namespace (solo runs and
+    their existing checkpoints), ``(namespace, seed)`` otherwise."""
+    return seed if namespace == DEFAULT_NAMESPACE else (namespace, seed)
+
+
 @dataclass
 class ViolationQueue:
-    """Insertion-ordered seed-keyed frame map (see module doc)."""
+    """Insertion-ordered (namespace, seed)-keyed frame map (see module
+    doc). Methods take an optional ``namespace=``; omitting it keeps
+    the solo single-namespace behavior bit-for-bit."""
 
-    frames: Dict[int, ViolationFrame] = field(default_factory=dict)
+    frames: Dict[Any, ViolationFrame] = field(default_factory=dict)
 
-    def offer(self, seed: int, code: int) -> Optional[ViolationFrame]:
-        """Enqueue a violating lane; None if the seed is already known
-        (resume re-retirement, or a duplicate retirement path)."""
+    def offer(
+        self, seed: int, code: int, namespace: str = DEFAULT_NAMESPACE
+    ) -> Optional[ViolationFrame]:
+        """Enqueue a violating lane; None if (namespace, seed) is
+        already known (resume re-retirement, or a duplicate retirement
+        path). Distinct namespaces never dedup each other."""
         seed = int(seed)
-        if seed in self.frames:
+        key = _key(namespace, seed)
+        if key in self.frames:
             return None
-        frame = ViolationFrame(seed=seed, code=int(code))
-        self.frames[seed] = frame
+        frame = ViolationFrame(
+            seed=seed, code=int(code), namespace=namespace
+        )
+        self.frames[key] = frame
         return frame
 
-    def next_queued(self) -> Optional[ViolationFrame]:
+    def next_queued(
+        self, namespace: Optional[str] = None
+    ) -> Optional[ViolationFrame]:
+        """Oldest queued frame, optionally restricted to one namespace
+        (the service's per-tenant drain order)."""
         for frame in self.frames.values():
-            if frame.status == "queued":
-                return frame
+            if frame.status != "queued":
+                continue
+            if namespace is not None and frame.namespace != namespace:
+                continue
+            return frame
         return None
 
     def mark_done(
-        self, seed: int, result: Optional[Dict[str, Any]]
+        self,
+        seed: int,
+        result: Optional[Dict[str, Any]],
+        namespace: str = DEFAULT_NAMESPACE,
     ) -> None:
-        self.frames[int(seed)].status = "done"
-        self.frames[int(seed)].result = result
+        frame = self.frames[_key(namespace, int(seed))]
+        frame.status = "done"
+        frame.result = result
 
-    def mark_skipped(self, seed: int) -> None:
-        self.frames[int(seed)].status = "skipped"
+    def mark_skipped(
+        self, seed: int, namespace: str = DEFAULT_NAMESPACE
+    ) -> None:
+        self.frames[_key(namespace, int(seed))].status = "skipped"
 
     # -- accounting ----------------------------------------------------------
+    def _in(self, namespace: Optional[str]):
+        return (
+            self.frames.values()
+            if namespace is None
+            else [
+                f for f in self.frames.values() if f.namespace == namespace
+            ]
+        )
+
+    def depth_of(self, namespace: Optional[str] = None) -> int:
+        """Frames enqueued but not yet minimized, per namespace (None =
+        whole queue — the live queue depth)."""
+        return sum(1 for f in self._in(namespace) if f.status == "queued")
+
     @property
     def depth(self) -> int:
-        """Frames enqueued but not yet minimized (the live queue depth)."""
-        return sum(1 for f in self.frames.values() if f.status == "queued")
+        return self.depth_of(None)
 
     @property
     def done(self) -> int:
@@ -101,8 +158,13 @@ class ViolationQueue:
     def enqueued(self) -> int:
         return len(self.frames)
 
-    def done_frames(self) -> List[ViolationFrame]:
-        return [f for f in self.frames.values() if f.status == "done"]
+    def enqueued_of(self, namespace: str) -> int:
+        return sum(1 for _ in self._in(namespace))
+
+    def done_frames(
+        self, namespace: Optional[str] = None
+    ) -> List[ViolationFrame]:
+        return [f for f in self._in(namespace) if f.status == "done"]
 
     # -- persist -------------------------------------------------------------
     def checkpoint_state(self) -> Dict[str, Any]:
@@ -112,4 +174,4 @@ class ViolationQueue:
         self.frames = {}
         for obj in state.get("frames", []):
             frame = ViolationFrame.from_json(obj)
-            self.frames[frame.seed] = frame
+            self.frames[_key(frame.namespace, frame.seed)] = frame

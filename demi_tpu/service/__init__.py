@@ -1,0 +1,62 @@
+"""demi_tpu.service: the multi-tenant exploration service.
+
+ROADMAP item 1's *service ring*: a long-running daemon (``demi_tpu
+serve``) that admits many tenants' fuzz→minimize jobs (``demi_tpu
+submit`` / ``demi_tpu jobs``) and batches their device work into SHARED
+launches — mixed sweep chunks interleave tenants' seed streams, and
+violation frames minimize through replay oracles pooled by (handler
+fingerprint, bucketed shape) — so N tenants cost far fewer compiled
+executables and kernel launches than N solo runs, while every tenant's
+MCS artifacts and violation codes stay bit-identical to a dedicated run
+(bench ``--config 14`` pins the A/B).
+
+``jobs``/``scheduler`` import light; the engine (which pulls in the
+device stack) loads lazily on first attribute access.
+"""
+
+from .jobs import (  # noqa: F401
+    JobSpec,
+    ServiceJob,
+    ServiceRefusal,
+    Tenant,
+    artifact_signature,
+)
+from .scheduler import fill_share, pick_tenant  # noqa: F401
+
+__all__ = [
+    "ExplorationService",
+    "JobSpec",
+    "ServiceClient",
+    "ServiceDaemon",
+    "ServiceError",
+    "ServiceGroup",
+    "ServiceJob",
+    "ServiceRefusal",
+    "Tenant",
+    "artifact_signature",
+    "build_service_workload",
+    "fill_share",
+    "pick_tenant",
+    "run_service",
+]
+
+_LAZY = {
+    "ExplorationService": "daemon",
+    "ServiceGroup": "batching",
+    "ServiceDaemon": "server",
+    "run_service": "server",
+    "ServiceClient": "client",
+    "ServiceError": "client",
+    "build_service_workload": "jobs",
+    "pack_payload": "server",
+    "unpack_payload": "server",
+}
+
+
+def __getattr__(name: str):
+    mod = _LAZY.get(name)
+    if mod is not None:
+        import importlib
+
+        return getattr(importlib.import_module(f".{mod}", __name__), name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

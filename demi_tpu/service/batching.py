@@ -1,0 +1,208 @@
+"""The batching plane: shared device launches across tenants.
+
+A ``ServiceGroup`` collects every job built from the SAME workload spec
+(canonical workload JSON + handler fingerprint — anything that changes
+what a prescription or a seed means forces a new group) and gives them
+ONE compiled sweep kernel, ONE lift kernel, and one in-flight chunk
+pipeline. Chunk filling interleaves the member jobs' seed streams in
+deficit-WRR order, so a launch that tenant A cannot fill carries tenant
+B's lanes in the would-be padding — N tenants' sweeps cost
+``ceil(sum(lanes)/chunk)`` launches instead of ``sum(ceil(lanes/chunk))``
+solo launches, and one compile instead of N.
+
+Parity is structural: a lane's result is a pure function of its
+``(program(seed), fold_in(PRNGKey(base_key), seed))`` pair, which the
+mixed dispatch preserves per lane (``SweepDriver._dispatch_chunk``'s
+``base_keys=``), and each job's lanes enter chunks in increasing seed
+order with harvests processed oldest-first — so every job observes the
+SAME per-seed verdict stream, in the SAME order, as its dedicated solo
+run. Sharing changes which launch a lane rides, never what it computes
+(the fleet's parity discipline, applied to the sweep tier).
+
+Replay oracles are pooled one level up (the service), keyed by
+(fingerprint, bucketed shape) so same-workload tenants share compiled
+checkers while different-fingerprint tenants can never touch each
+other's kernels.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..pipeline.budget import LaunchBudget
+from .jobs import ServiceJob, build_service_workload
+from .scheduler import fill_share, pick_tenant
+
+
+def workload_key(workload: Optional[dict], fp: str) -> str:
+    """Canonical group key: the full CLI-args-shaped workload (defaults
+    folded in) plus the handler fingerprint. Jobs in one group may
+    differ ONLY by tenant, seed range, rng base key, and minimization
+    cap — everything that reaches the compiled kernels or the program
+    generator is part of the key."""
+    import json
+
+    from ..parallel.distributed import DEFAULT_WORKLOAD
+
+    w = {**DEFAULT_WORKLOAD, **(workload or {})}
+    return json.dumps(w, sort_keys=True) + "|" + fp
+
+
+class ServiceGroup:
+    """One shared sweep plane (see module doc)."""
+
+    def __init__(
+        self,
+        key: str,
+        workload: Optional[dict],
+        *,
+        split: float,
+        chunk: int,
+    ):
+        from ..parallel.sweep import SweepDriver
+
+        self.key = key
+        (
+            self.app, self.cfg, self.config, self.gen, self.fp
+        ) = build_service_workload(workload)
+        self.chunk = int(chunk)
+        self.budget = LaunchBudget(split)
+        self.driver = SweepDriver(self.app, self.cfg, self.gen)
+        self.driver.launch_budget = self.budget
+        self.jobs: List[ServiceJob] = []
+        # In-flight mixed chunks, oldest first: (handle, entries) where
+        # entries is the per-lane [(job, seed)] map the router needs.
+        self.pending: List[Tuple[Any, List[Tuple[ServiceJob, int]]]] = []
+        self._lift_kernel = None
+        self.chunks = 0
+        self.mixed_chunks = 0
+        self.rides = 0  # lanes that rode a chunk led by another tenant
+
+    # -- shared kernels ------------------------------------------------------
+    def lift_kernel(self):
+        """The group's one compiled single-lane lift kernel (solo runs
+        compile one PER RUN — the first shared executable)."""
+        if self._lift_kernel is None:
+            from ..pipeline.orchestrator import make_lift_kernel
+
+            self._lift_kernel = make_lift_kernel(self.app, self.cfg)
+        return self._lift_kernel
+
+    @property
+    def lift_built(self) -> bool:
+        return self._lift_kernel is not None
+
+    # -- chunk plane ---------------------------------------------------------
+    def _fillable(self) -> List[ServiceJob]:
+        return [
+            j for j in self.jobs
+            if j.status == "running" and j.seeds_dispatched < j.spec.lanes
+        ]
+
+    def fillable(self) -> bool:
+        return bool(self._fillable())
+
+    def fill_entries(self) -> List[Tuple[ServiceJob, int]]:
+        """Assemble one mixed chunk: deficit-WRR turns over the
+        contending tenants, each claiming up to its proportional share
+        of the chunk from its oldest fillable job, until the chunk is
+        full or no job has lanes left. Per-job seed order is strictly
+        increasing — the solo-parity prerequisite."""
+        entries: List[Tuple[ServiceJob, int]] = []
+        while len(entries) < self.chunk:
+            cands = self._fillable()
+            if not cands:
+                break
+            tenants = {j.tenant.name: j.tenant for j in cands}.values()
+            tenant = pick_tenant(tenants)
+            job = next(j for j in cands if j.tenant is tenant)
+            share = fill_share(self.chunk, tenant, tenants)
+            n = min(
+                share,
+                self.chunk - len(entries),
+                job.spec.lanes - job.seeds_dispatched,
+            )
+            start = job.seeds_dispatched
+            entries.extend((job, s) for s in range(start, start + n))
+            job.seeds_dispatched += n
+            # Charge the account at fill time so the WRR order reacts
+            # within one chunk, not one chunk late.
+            tenant.budget.note_dispatch("fuzz", n)
+        return entries
+
+    def dispatch(self) -> bool:
+        """Dispatch one mixed chunk (non-blocking); False when no job
+        had lanes to sweep."""
+        entries = self.fill_entries()
+        if not entries:
+            return False
+        seeds = [s for _, s in entries]
+        bases = [j.spec.base_key for j, _ in entries]
+        handle = self.driver._dispatch_chunk(seeds, base_keys=bases)
+        self.pending.append((handle, entries))
+        return True
+
+    def harvest_oldest(self, service) -> None:
+        """Harvest the oldest in-flight chunk and route every lane's
+        verdict to its owning job: per-job sweep cursors advance, found
+        violations land in the shared queue under the job's namespace,
+        per-tenant accounts and registries absorb the lane counts."""
+        from ..device.core import ST_VIOLATION
+
+        handle, entries = self.pending.pop(0)
+        t0 = time.perf_counter()
+        self.driver._harvest_chunk(handle)
+        busy = time.perf_counter() - t0
+        _real, res, _d = handle
+        n = len(entries)
+        codes = np.asarray(res.violation)[:n]
+        statuses = np.asarray(res.status)[:n]
+        self.chunks += 1
+        per_tenant: Dict[str, int] = {}
+        lead = entries[0][0].tenant.name
+        for i, (job, seed) in enumerate(entries):
+            tname = job.tenant.name
+            per_tenant[tname] = per_tenant.get(tname, 0) + 1
+            if tname != lead:
+                self.rides += 1
+            job.seeds_done += 1
+            code = int(codes[i])
+            if code != 0:
+                job.violations += 1
+            if int(statuses[i]) == ST_VIOLATION:
+                job.codes[int(seed)] = code
+                service._offer_frame(job, int(seed), code)
+        if len(per_tenant) > 1:
+            self.mixed_chunks += 1
+        for tname, lanes in per_tenant.items():
+            tenant = next(
+                j.tenant for j, _ in entries if j.tenant.name == tname
+            )
+            tenant.budget.note_harvest("fuzz", lanes)
+            tenant.lanes_done += lanes
+            tenant.note("lanes", lanes)
+            tenant.note("busy_seconds", busy * lanes / n)
+        service._chunk_harvested(self, entries, per_tenant)
+
+    # -- accounting ----------------------------------------------------------
+    def solo_equiv_chunks(self) -> int:
+        """Chunk launches the member jobs would cost as dedicated solo
+        runs: per-job ceil(lanes/chunk)."""
+        return sum(
+            -(-j.spec.lanes // self.chunk) for j in self.jobs
+        )
+
+    def stats(self) -> Dict[str, Any]:
+        return {
+            "jobs": len(self.jobs),
+            "chunk": self.chunk,
+            "chunks": self.chunks,
+            "mixed_chunks": self.mixed_chunks,
+            "rides": self.rides,
+            "solo_equiv_chunks": self.solo_equiv_chunks(),
+            "launches": dict(self.budget.launches),
+            "inflight": len(self.pending),
+        }

@@ -1,0 +1,138 @@
+"""Client side of the exploration service wire: ``demi_tpu submit`` /
+``demi_tpu jobs`` and the programmatic ``ServiceClient``.
+
+One persistent line-JSON connection (the fleet worker's framing); every
+verb is one request/reply pair, so a client can be as dumb as
+``nc host port``. Artifact fetches arrive as the persist/ zlib+b64
+payload and are unpacked back to the structural-JSON frame list the
+service checkpoints — a fetched artifact is byte-identical to the
+checkpointed one.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+from typing import Any, Dict, List, Optional
+
+from ..persist.supervisor import SUPERVISOR
+from .server import unpack_payload
+
+
+class ServiceError(RuntimeError):
+    """An ``op: error`` reply (``refused`` marks admission refusals)."""
+
+    def __init__(self, message: str, refused: bool = False):
+        super().__init__(message)
+        self.refused = refused
+
+
+class ServiceClient:
+    """Persistent connection to a ``demi_tpu serve`` daemon."""
+
+    def __init__(self, addr: str, timeout: float = 60.0):
+        host, _, port = addr.rpartition(":")
+        # Bounded connect retry under the launch supervisor: a client
+        # racing the daemon's startup mirrors the fleet worker's
+        # connect discipline.
+        self._sock = SUPERVISOR.run(
+            lambda _attempt: socket.create_connection(
+                (host or "127.0.0.1", int(port)), timeout=timeout
+            ),
+            label="service.connect",
+        )
+        self._f = self._sock.makefile("rwb")
+
+    def close(self) -> None:
+        try:
+            self._f.close()
+            self._sock.close()
+        except OSError:
+            pass
+
+    def __enter__(self) -> "ServiceClient":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # -- wire ----------------------------------------------------------------
+    def request(self, msg: Dict[str, Any]) -> Dict[str, Any]:
+        self._f.write((json.dumps(msg) + "\n").encode())
+        self._f.flush()
+        line = self._f.readline()
+        if not line:
+            raise ServiceError("service closed the connection")
+        reply = json.loads(line)
+        if reply.get("op") == "error":
+            raise ServiceError(
+                reply.get("error", "unknown error"),
+                refused=bool(reply.get("refused")),
+            )
+        return reply
+
+    # -- verbs ---------------------------------------------------------------
+    def submit(
+        self,
+        tenant: str,
+        workload: Optional[dict] = None,
+        *,
+        lanes: int = 256,
+        chunk: Optional[int] = None,
+        base_key: int = 0,
+        max_frames: Optional[int] = None,
+        weight: float = 1.0,
+        wildcards: bool = True,
+    ) -> Dict[str, Any]:
+        return self.request({
+            "op": "submit",
+            "tenant": tenant,
+            "workload": workload or {},
+            "lanes": lanes,
+            "chunk": chunk,
+            "base_key": base_key,
+            "max_frames": max_frames,
+            "weight": weight,
+            "wildcards": wildcards,
+        })
+
+    def jobs(self, tenant: Optional[str] = None) -> List[Dict[str, Any]]:
+        return self.request({"op": "jobs", "tenant": tenant})["jobs"]
+
+    def poll(self, job: str) -> Dict[str, Any]:
+        return self.request({"op": "poll", "job": job})
+
+    def fetch(self, job: str) -> List[Dict[str, Any]]:
+        """A job's violation frames (status + structural-JSON
+        minimization artifacts for done ones)."""
+        reply = self.request({"op": "fetch", "job": job})
+        return unpack_payload(reply["frames"])
+
+    def stats(self) -> Dict[str, Any]:
+        """Tenant-labeled merged metrics snapshot."""
+        return self.request({"op": "stats"})["snapshot"]
+
+    def status(self) -> Dict[str, Any]:
+        return self.request({"op": "status"})
+
+    def shutdown(self, drain: bool = True) -> Dict[str, Any]:
+        return self.request({"op": "shutdown", "drain": drain})
+
+    # -- polling helper ------------------------------------------------------
+    def wait(
+        self, job: str, timeout: float = 600.0, poll_s: float = 0.2
+    ) -> Dict[str, Any]:
+        """Poll until the job leaves the running states; returns its
+        final summary (raises on timeout)."""
+        import time
+
+        deadline = time.monotonic() + timeout
+        while True:
+            state = self.poll(job)
+            if state.get("status") in ("done", "refused"):
+                return state
+            if time.monotonic() > deadline:
+                raise ServiceError(
+                    f"timed out waiting for job {job!r}: {state}"
+                )
+            time.sleep(poll_s)

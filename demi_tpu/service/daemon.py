@@ -1,0 +1,690 @@
+"""The exploration service engine: many tenants' fuzz→minimize jobs
+multiplexed through shared device launches.
+
+``ExplorationService`` is the in-process core (the TCP daemon in
+``server.py`` is a thin wire over it; bench ``--config 14`` drives it
+directly so the A/B measures batching, not sockets). One engine thread
+owns ALL device work and loops over scheduling quanta:
+
+  1. **Fill**: keep up to ``depth`` mixed sweep chunks in flight per
+     group (``ServiceGroup.dispatch`` — tenants' seed streams interleave
+     into shared launches in deficit-WRR order).
+  2. **Minimize turn**: while the oldest chunk's device work is
+     unfinished (work-conserving — harvesting early would only block),
+     step queued violation frames' gamut generators level by level, the
+     serving tenant re-picked per level by the fair scheduler; once the
+     chunk IS ready, the group's launch-budget split bounds how many
+     more minimizer lanes may dispatch before the fuzz tier gets its
+     harvest (exactly ``StreamingPipeline.run``'s turn policy, applied
+     per group).
+  3. **Harvest**: oldest chunk (plus any already-retired), routing each
+     lane's verdict to its owning job and namespace-keyed frame queue.
+
+Frames minimize through REPLAY ORACLES SHARED ACROSS TENANTS, pooled by
+(handler fingerprint, bucketed shape) — ``bucketed_replay_config`` is
+the same rule solo streaming runs use, so N same-workload tenants
+compile each shape once instead of N times, and one tenant's
+speculative padding rides serve another tenant's identical-shape level
+the way speculation already serves the next level today. Verdicts are
+pure functions of lane record bytes, so per-tenant results stay
+bit-identical to a dedicated solo run: shared batching changes WHEN a
+frame's levels run, never what they compute.
+
+Durability: tenants, jobs, the namespaced queue, and every done frame's
+artifacts checkpoint atomically (persist/CheckpointStore) at chunk and
+frame boundaries; SIGTERM drains — checkpoint mid-queue, exit 3 — and
+``demi_tpu serve --resume`` continues with no job lost and no frame
+minimized twice (namespace-keyed dedup + per-stage gamut resume).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from .. import obs
+from ..pipeline.queue import ViolationQueue
+from .batching import ServiceGroup, workload_key
+from .jobs import (
+    JobSpec,
+    ServiceJob,
+    ServiceRefusal,
+    Tenant,
+    build_service_workload,
+)
+from .scheduler import pick_tenant
+
+#: Checkpoint-section name under the state dir's CheckpointStore.
+SECTION = "service"
+
+
+class ExplorationService:
+    """See module doc. Thread contract: ``handle_request``/``submit``
+    and the read verbs are safe from server threads (one lock guards
+    the control surface); all DEVICE work happens on whichever single
+    thread calls ``run_until_idle``/``quantum``."""
+
+    def __init__(
+        self,
+        state_dir: Optional[str] = None,
+        *,
+        split: float = 0.5,
+        depth: int = 2,
+        default_chunk: int = 64,
+        stage_budget_seconds: Optional[float] = None,
+        resume: bool = False,
+    ):
+        import threading
+
+        self.state_dir = state_dir
+        self.split = float(split)
+        self.depth = max(1, int(depth))
+        self.default_chunk = int(default_chunk)
+        self.stage_budget_seconds = stage_budget_seconds
+        self._lock = threading.RLock()
+        self.tenants: Dict[str, Tenant] = {}
+        self.jobs: Dict[str, ServiceJob] = {}
+        self.groups: Dict[str, ServiceGroup] = {}
+        self.queue = ViolationQueue()
+        # Shared replay-oracle pool: (fingerprint, bucketed shape) ->
+        # DeviceReplayChecker. Fingerprint in the key is the isolation
+        # boundary — same-shape different-handler tenants never share.
+        self._checkers: Dict[tuple, Any] = {}
+        # One active frame (generator) per JOB — a job minimizes one
+        # frame at a time, like its solo run; fairness interleaves
+        # ACROSS jobs at level granularity.
+        self._active: Dict[str, tuple] = {}
+        self._fp_cache: Dict[str, str] = {}
+        self._next_job = 0
+        self.incarnation = 0
+        self._resumed = False
+        self._shutdown = False
+        self._drain = False
+        self.state: Dict[str, Any] = {
+            "chunks": 0,
+            "frames_done": 0,
+            "checker_hits": 0,
+            "refusals": 0,
+            "elapsed_s": 0.0,
+        }
+        self._t0 = time.perf_counter()
+        self.boundary_hook: Optional[Callable[[str], bool]] = None
+        self._store = None
+        if state_dir is not None:
+            from ..persist import CheckpointStore
+
+            self._store = CheckpointStore(state_dir)
+            if resume:
+                self._restore()
+
+    # -- clocks --------------------------------------------------------------
+    def _elapsed(self) -> float:
+        """Run-spanning serialized busy clock: prior incarnations'
+        elapsed plus this one's — what per-tenant ttf-MCS is measured
+        against."""
+        return self.state["elapsed_s"] + (time.perf_counter() - self._t0)
+
+    # -- admission (server-thread safe) --------------------------------------
+    def _workload_fp(self, workload: Optional[dict]) -> str:
+        key = workload_key(workload, "")
+        fp = self._fp_cache.get(key)
+        if fp is None:
+            _a, _c, _cfg, _g, fp = build_service_workload(workload)
+            self._fp_cache[key] = fp
+        return fp
+
+    def submit(
+        self,
+        tenant: str,
+        workload: Optional[dict] = None,
+        *,
+        lanes: int = 256,
+        chunk: Optional[int] = None,
+        base_key: int = 0,
+        max_frames: Optional[int] = None,
+        weight: float = 1.0,
+        wildcards: bool = True,
+    ) -> Dict[str, Any]:
+        """Admit one job. Registers the tenant on first contact (its
+        fingerprint pinned to this workload's); REFUSES a submission
+        whose workload builds to a different fingerprint than the
+        tenant's pinned one — same-shape bug variants must never share
+        a tenant's oracles or artifacts."""
+        fp = self._workload_fp(workload)  # build outside the lock
+        with self._lock:
+            t = self.tenants.get(tenant)
+            if t is None:
+                t = Tenant(tenant, fp, weight)
+                self.tenants[tenant] = t
+                obs.journal.emit(
+                    "service.tenant", tenant=tenant, event="register",
+                    fp=fp, weight=t.weight,
+                )
+            elif t.fp != fp:
+                self.state["refusals"] += 1
+                t.note("refusals")
+                obs.journal.emit(
+                    "service.tenant", tenant=tenant, event="refuse",
+                    fp=fp, pinned=t.fp,
+                )
+                raise ServiceRefusal(
+                    f"tenant {tenant!r} is pinned to handler fingerprint "
+                    f"{t.fp} but the submitted workload builds {fp} — "
+                    "same-shape bug variants cannot share a tenant"
+                )
+            job_id = f"j{self._next_job}"
+            self._next_job += 1
+            t.jobs_submitted += 1
+            spec = JobSpec(
+                tenant=tenant,
+                job_id=job_id,
+                workload=dict(workload or {}),
+                lanes=int(lanes),
+                chunk=int(chunk or self.default_chunk),
+                base_key=int(base_key),
+                max_frames=max_frames,
+                wildcards=wildcards,
+            )
+            job = ServiceJob(spec=spec, tenant=t)
+            self.jobs[job_id] = job
+            obs.journal.emit(
+                "service.job", tenant=tenant, job=job_id, event="submit",
+                lanes=spec.lanes, chunk=spec.chunk,
+                base_key=spec.base_key, max_frames=spec.max_frames,
+            )
+            return job.summary(self.queue)
+
+    # -- engine --------------------------------------------------------------
+    def _adopt_queued(self) -> None:
+        with self._lock:
+            queued = [
+                j for j in self.jobs.values() if j.status == "queued"
+            ]
+        for job in queued:
+            key = workload_key(job.spec.workload, job.tenant.fp)
+            group = self.groups.get(key)
+            if group is None:
+                group = ServiceGroup(
+                    key, job.spec.workload,
+                    split=self.split, chunk=job.spec.chunk,
+                )
+                self.groups[key] = group
+            group.jobs.append(job)
+            job.status = "running"
+
+    def _boundary(self, kind: str) -> bool:
+        if self.boundary_hook is not None and self.boundary_hook(kind):
+            self._drain = True
+        return self._drain
+
+    def quantum(self) -> bool:
+        """One scheduling quantum over every group; True when any
+        device or minimizer work happened."""
+        self._adopt_queued()
+        progressed = False
+        for group in list(self.groups.values()):
+            progressed |= self._group_quantum(group)
+            if self._drain:
+                break
+        return progressed
+
+    def _group_quantum(self, group: ServiceGroup) -> bool:
+        from ..pipeline.orchestrator import _handle_ready
+
+        progressed = False
+        while len(group.pending) < self.depth and group.fillable():
+            if not group.dispatch():
+                break
+            progressed = True
+        allowance = (
+            group.budget.turn_allowance(len(group.pending[0][1]))
+            if group.pending
+            else None
+        )
+        mark = group.budget.lanes_dispatched("minimize")
+        while not self._drain:
+            if (
+                allowance is not None
+                and _handle_ready(group.pending[0][0])
+                and group.budget.lanes_dispatched("minimize") - mark
+                >= allowance
+            ):
+                break
+            if not self._step_minimize(group):
+                break
+            progressed = True
+        if self._drain:
+            return progressed
+        if group.pending:
+            group.harvest_oldest(self)
+            progressed = True
+            while (
+                group.pending
+                and _handle_ready(group.pending[0][0])
+                and not self._drain
+            ):
+                group.harvest_oldest(self)
+            self._boundary("chunk")
+        return progressed
+
+    # -- minimize tier -------------------------------------------------------
+    def _minimizable(self, group: ServiceGroup) -> List[ServiceJob]:
+        out = []
+        for job in group.jobs:
+            if job.status != "running":
+                continue
+            if job.spec.job_id in self._active or self.queue.depth_of(
+                job.namespace
+            ):
+                out.append(job)
+        return out
+
+    def _step_minimize(self, group: ServiceGroup) -> bool:
+        """Advance ONE minimizer level for the fair scheduler's pick;
+        False when no job in the group has minimizer work."""
+        cands = self._minimizable(group)
+        if not cands:
+            return False
+        tenants = {j.tenant.name: j.tenant for j in cands}.values()
+        tenant = pick_tenant(tenants)
+        job = next(j for j in cands if j.tenant is tenant)
+        active = self._active.get(job.spec.job_id)
+        if active is None:
+            frame = self.queue.next_queued(job.namespace)
+            fr, gen = self._start_frame(group, job, frame)
+            if gen is None:
+                with self._lock:
+                    self.queue.mark_skipped(frame.seed, job.namespace)
+                self._job_done_check(job)
+                return True
+            active = (group, frame, fr, gen, time.perf_counter())
+            self._active[job.spec.job_id] = active
+        _g, frame, fr, gen, started = active
+        m0 = group.budget.lanes_dispatched("minimize")
+        try:
+            next(gen)
+        except StopIteration as stop:
+            # Retire the active slot BEFORE finishing: the done-check
+            # inside _finish_frame must see the job minimizer-idle.
+            self._active.pop(job.spec.job_id, None)
+            self._finish_frame(
+                group, job, frame, stop.value,
+                time.perf_counter() - started,
+            )
+        # Per-tenant account: the minimizer lanes this level dispatched
+        # through the shared oracles, floored at 1 so host-only levels
+        # still rotate fairness.
+        delta = max(1, group.budget.lanes_dispatched("minimize") - m0)
+        tenant.budget.note_dispatch("minimize", delta)
+        tenant.budget.note_harvest("minimize", delta)
+        self._boundary("level")
+        return True
+
+    def _frame_dir(self, job: ServiceJob, seed: int) -> Optional[str]:
+        if self.state_dir is None:
+            return None
+        import os
+
+        return os.path.join(
+            self.state_dir, "tenants", job.spec.tenant,
+            job.spec.job_id, "frames", f"seed-{seed}",
+        )
+
+    def _frame_checker(self, group: ServiceGroup, job, trace, externals):
+        """The pooled replay oracle for this frame: bucketed exactly
+        like a solo run's (one shared rule — verdict parity), keyed
+        under the tenant's fingerprint (isolation), compiled once per
+        (fingerprint, shape) across ALL tenants (the savings)."""
+        from ..device.batch_oracle import DeviceReplayChecker
+        from ..pipeline.orchestrator import bucketed_replay_config
+
+        cfg, shape = bucketed_replay_config(group.app, trace, externals)
+        job.checker_shapes.add(shape)
+        key = (group.fp, shape)
+        checker = self._checkers.get(key)
+        if checker is None:
+            checker = DeviceReplayChecker(group.app, cfg, group.config)
+            checker.launch_budget = group.budget
+            self._checkers[key] = checker
+        else:
+            self.state["checker_hits"] += 1
+            job.tenant.note("checker_hits")
+        return checker
+
+    def _start_frame(self, group: ServiceGroup, job: ServiceJob, frame):
+        from ..pipeline.orchestrator import lift_violating_seed
+        from ..runner import FuzzResult, run_the_gamut_streaming
+
+        group.budget.note_dispatch("minimize", 1)
+        try:
+            host = lift_violating_seed(
+                group.app, group.cfg, group.config, group.gen,
+                frame.seed, job.spec.base_key,
+                trace_kernel=group.lift_kernel(),
+            )
+        finally:
+            group.budget.note_harvest("minimize", 1)
+            job.lifted = True
+        if host.violation is None:
+            obs.counter("pipe.lift_no_violation").force_inc()
+            return None, None
+        externals = list(host.trace.original_externals)
+        fr = FuzzResult(
+            program=externals,
+            trace=host.trace,
+            violation=host.violation,
+            executions=0,
+        )
+        gen = run_the_gamut_streaming(
+            group.config, fr,
+            wildcards=job.spec.wildcards,
+            app=group.app,
+            checkpoint_dir=self._frame_dir(job, frame.seed),
+            resume=self._resumed,
+            stage_budget_seconds=self.stage_budget_seconds,
+            launch_budget=group.budget,
+            checker=self._frame_checker(
+                group, job, host.trace, externals
+            ),
+        )
+        return fr, gen
+
+    def _finish_frame(
+        self, group: ServiceGroup, job: ServiceJob, frame, gamut_result,
+        wall_s: float,
+    ) -> None:
+        from ..pipeline.orchestrator import _frame_result_payload
+
+        payload = _frame_result_payload(gamut_result, frame.code, wall_s)
+        with self._lock:
+            self.queue.mark_done(frame.seed, payload, job.namespace)
+            job.frames_done += 1
+            job.tenant.frames_done += 1
+            self.state["frames_done"] += 1
+            if job.ttf_mcs_s is None:
+                job.ttf_mcs_s = round(self._elapsed(), 6)
+        t = job.tenant
+        t.note("frames_done")
+        t.note("mcs_externals", len(gamut_result.mcs_externals))
+        t.note_gauge("queue_depth", self.queue.depth_of(job.namespace))
+        obs.journal.emit(
+            "service.frame",
+            round=self.state["frames_done"],
+            tenant=job.spec.tenant,
+            job=job.spec.job_id,
+            seed=frame.seed,
+            code=frame.code,
+            wall_s=round(wall_s, 6),
+            mcs_externals=len(gamut_result.mcs_externals),
+            stages=len(gamut_result.stages),
+            queue_depth=self.queue.depth,
+            tenant_frames=t.frames_done,
+            ttf_mcs_s=job.ttf_mcs_s,
+        )
+        self._job_done_check(job)
+        if not self._boundary("frame"):
+            self._maybe_checkpoint()
+
+    # -- harvest routing (ServiceGroup callbacks) ----------------------------
+    def _offer_frame(self, job: ServiceJob, seed: int, code: int) -> None:
+        with self._lock:
+            frame = self.queue.offer(seed, code, namespace=job.namespace)
+            if frame is None:
+                return  # resume re-retirement: already queued/minimized
+            job.enqueued += 1
+            job.tenant.violations += 1
+            job.tenant.note("violations")
+            if (
+                job.spec.max_frames is not None
+                and self.queue.enqueued_of(job.namespace)
+                > job.spec.max_frames
+            ):
+                # Beyond the job's minimization cap: counted and
+                # journaled, never minimized — the solo pipeline's
+                # first-K rule, per namespace.
+                self.queue.mark_skipped(seed, job.namespace)
+        obs.journal.emit(
+            "service.enqueue",
+            round=job.enqueued,
+            tenant=job.spec.tenant,
+            job=job.spec.job_id,
+            seed=int(seed),
+            code=int(code),
+            queue_depth=self.queue.depth_of(job.namespace),
+            minimize=frame.status == "queued",
+        )
+
+    def _chunk_harvested(self, group, entries, per_tenant) -> None:
+        self.state["chunks"] += 1
+        for job in {j.spec.job_id: j for j, _ in entries}.values():
+            self._job_done_check(job)
+        obs.journal.emit(
+            "service.chunk",
+            round=self.state["chunks"],
+            lanes=len(entries),
+            tenants=per_tenant,
+            mixed=len(per_tenant) > 1,
+            rides=group.rides,
+            mixed_chunks=group.mixed_chunks,
+            queue_depth=self.queue.depth,
+            chunks=group.chunks,
+            solo_equiv_chunks=group.solo_equiv_chunks(),
+            checker_shapes=len(self._checkers),
+            checker_hits=self.state["checker_hits"],
+            tenants_active=len(self.tenants),
+        )
+        self._maybe_checkpoint()
+
+    def _job_done_check(self, job: ServiceJob) -> None:
+        if job.status != "running":
+            return
+        if (
+            job.sweep_done
+            and job.spec.job_id not in self._active
+            and self.queue.depth_of(job.namespace) == 0
+        ):
+            job.status = "done"
+            job.tenant.note("jobs_done")
+            obs.journal.emit(
+                "service.job",
+                tenant=job.spec.tenant, job=job.spec.job_id, event="done",
+                frames_done=job.frames_done, violations=job.violations,
+                lanes=job.seeds_done, ttf_mcs_s=job.ttf_mcs_s,
+            )
+
+    # -- drive ---------------------------------------------------------------
+    def all_done(self) -> bool:
+        with self._lock:
+            return bool(self.jobs) and all(
+                j.status in ("done", "refused") for j in self.jobs.values()
+            )
+
+    def idle(self) -> bool:
+        with self._lock:
+            return all(
+                j.status in ("done", "refused") for j in self.jobs.values()
+            )
+
+    def run_until_idle(
+        self, boundary_hook: Optional[Callable[[str], bool]] = None
+    ) -> Dict[str, Any]:
+        """Drive quanta until every submitted job is done (the
+        in-process entry bench config 14 and the tests use).
+        ``boundary_hook(kind)`` returning True drains gracefully —
+        checkpoint-consistent state, queued work stays queued."""
+        if boundary_hook is not None:
+            self.boundary_hook = boundary_hook
+        with obs.span("service.run", jobs=len(self.jobs)):
+            while not self._drain and not self.idle():
+                if not self.quantum() and not self._drain:
+                    break  # nothing runnable (all refused or empty)
+        self.state["elapsed_s"] = round(self._elapsed(), 6)
+        self._t0 = time.perf_counter()
+        return self.summary()
+
+    def request_drain(self) -> None:
+        self._drain = True
+
+    # -- persist -------------------------------------------------------------
+    def checkpoint_state(self) -> Dict[str, Any]:
+        with self._lock:
+            self.state["elapsed_s"] = round(self._elapsed(), 6)
+            self._t0 = time.perf_counter()
+            return {
+                "next_job": self._next_job,
+                "incarnation": self.incarnation,
+                "state": dict(self.state),
+                "tenants": {
+                    name: t.to_json() for name, t in self.tenants.items()
+                },
+                "jobs": [j.to_json() for j in self.jobs.values()],
+                "queue": self.queue.checkpoint_state(),
+            }
+
+    def restore_state(self, payload: Dict[str, Any]) -> None:
+        with self._lock:
+            self._next_job = int(payload.get("next_job", 0))
+            self.incarnation = int(payload.get("incarnation", 0)) + 1
+            self.state.update(payload.get("state", {}))
+            self.tenants = {
+                name: Tenant.from_json(obj)
+                for name, obj in payload.get("tenants", {}).items()
+            }
+            self.jobs = {}
+            for obj in payload.get("jobs", []):
+                tenant = self.tenants[obj["spec"]["tenant"]]
+                job = ServiceJob.from_json(obj, tenant)
+                # Running jobs re-adopt into fresh groups; their
+                # in-flight chunks died with the process.
+                if job.status == "running":
+                    job.status = "queued"
+                self.jobs[job.spec.job_id] = job
+            self.queue.restore_state(payload.get("queue", {}))
+            self._resumed = True
+
+    def checkpoint(self) -> Optional[str]:
+        if self._store is None:
+            return None
+        return self._store.save(
+            {SECTION: self.checkpoint_state()},
+            meta={"command": "serve", "incarnation": self.incarnation},
+        )
+
+    def _maybe_checkpoint(self) -> None:
+        # Chunk/frame boundaries are the durable points: cheap (the
+        # payload is a few KB of JSON + artifact frames), and exactly
+        # the boundaries the resume contract re-enters at.
+        if self._store is not None:
+            self.checkpoint()
+
+    def _restore(self) -> None:
+        ckpt = self._store.load_latest()
+        if ckpt is None:
+            raise ServiceRefusal(
+                f"serve --resume: no loadable checkpoint under "
+                f"{self.state_dir!r}"
+            )
+        self.restore_state(ckpt.sections[SECTION])
+
+    # -- reporting -----------------------------------------------------------
+    def savings(self) -> Dict[str, Any]:
+        """The shared-launch economics vs dedicated solo runs. Compile
+        counts follow the solo streaming pipeline's own inventory: one
+        sweep kernel + one lift kernel (if any frame lifted) + one
+        compiled checker per bucketed shape PER RUN; the service pays
+        per GROUP / per (fp, shape) instead."""
+        with self._lock:
+            chunks = sum(g.chunks for g in self.groups.values())
+            solo_chunks = sum(
+                g.solo_equiv_chunks() for g in self.groups.values()
+            )
+            solo_compiles = sum(
+                1 + (1 if j.lifted else 0) + len(j.checker_shapes)
+                for j in self.jobs.values()
+                if j.status != "refused"
+            )
+            compiles = (
+                len(self.groups)
+                + sum(1 for g in self.groups.values() if g.lift_built)
+                + len(self._checkers)
+            )
+            launches: Dict[str, int] = {}
+            for g in self.groups.values():
+                for k, v in g.budget.launches.items():
+                    launches[k] = launches.get(k, 0) + v
+            return {
+                "groups": len(self.groups),
+                "chunks": chunks,
+                "solo_equiv_chunks": solo_chunks,
+                "chunk_launches_saved": max(0, solo_chunks - chunks),
+                "mixed_chunks": sum(
+                    g.mixed_chunks for g in self.groups.values()
+                ),
+                "rides": sum(g.rides for g in self.groups.values()),
+                "checker_shapes": len(self._checkers),
+                "checker_hits": self.state["checker_hits"],
+                "compiled_executables": compiles,
+                "solo_equiv_compiles": solo_compiles,
+                "launches": launches,
+            }
+
+    def merged_snapshot(self) -> Dict[str, Any]:
+        """Every tenant's private registry relabeled (``tenant=``) and
+        merged — the per-tenant accounting artifact ``demi_tpu stats``
+        / ``--prom`` render like any other labeled series."""
+        from ..obs.metrics import merge_snapshots
+
+        with self._lock:
+            snaps = [t.labeled_snapshot() for t in self.tenants.values()]
+        return merge_snapshots(*snaps) if snaps else merge_snapshots()
+
+    def summary(self) -> Dict[str, Any]:
+        with self._lock:
+            tenants = {
+                name: {
+                    "fp": t.fp,
+                    "weight": t.weight,
+                    "frames_done": t.frames_done,
+                    "violations": t.violations,
+                    "lanes": t.lanes_done,
+                    "account": round(t.account, 3),
+                    "launches": dict(t.budget.launches),
+                }
+                for name, t in sorted(self.tenants.items())
+            }
+            jobs = [
+                j.summary(self.queue) for j in self.jobs.values()
+            ]
+        return {
+            "tenants": tenants,
+            "jobs": jobs,
+            "frames_done": self.state["frames_done"],
+            "chunks": self.state["chunks"],
+            "refusals": self.state["refusals"],
+            "queue": {
+                "enqueued": self.queue.enqueued,
+                "done": self.queue.done,
+                "depth": self.queue.depth,
+            },
+            "savings": self.savings(),
+            "elapsed_s": round(
+                self.state["elapsed_s"]
+                if self.idle()
+                else self._elapsed(),
+                3,
+            ),
+            "incarnation": self.incarnation,
+            "drained": self._drain,
+        }
+
+    # -- artifacts -----------------------------------------------------------
+    def job_frames(self, job_id: str) -> List[Dict[str, Any]]:
+        with self._lock:
+            job = self.jobs.get(job_id)
+            if job is None:
+                raise ServiceRefusal(f"unknown job {job_id!r}")
+            return [
+                f.to_json()
+                for f in self.queue.frames.values()
+                if f.namespace == job.namespace
+            ]

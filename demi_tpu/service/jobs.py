@@ -1,0 +1,323 @@
+"""Tenants and jobs: the admission-layer data model of the exploration
+service (demi_tpu/service).
+
+A **tenant** is a named account. Its handler/invariant fingerprint is
+PINNED by its first admitted job (``persist.handler_fingerprint`` — the
+same identity the fleet's config handshake and the checkpoint
+cross-restore check use): a later submission whose workload builds to a
+different fingerprint is REFUSED, so two same-shape bug variants can
+never share compiled oracles, frames, or artifacts through one tenant
+name. Each tenant carries a ``LaunchBudget`` account (the fair
+scheduler's currency), a private ``MetricsRegistry`` whose series merge
+into service snapshots under a ``tenant=`` label
+(``obs.relabel_snapshot`` — the ``worker=`` pattern applied to tenants),
+and cumulative accounting counters.
+
+A **job** is one fuzz→minimize run over an app spec + seed range:
+``JobSpec`` is the durable submission (CLI-args-shaped workload dict,
+lane count, chunk, rng base key, minimization cap), ``ServiceJob`` the
+live state machine (queued → running → done, or refused). A job's
+violation frames live in the service's shared ``ViolationQueue`` under
+the ``<tenant>/<job>`` namespace, so identical seeds across jobs never
+dedup each other.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+from ..obs.metrics import MetricsRegistry, relabel_snapshot
+from ..pipeline.budget import DEFAULT_SPLIT, LaunchBudget
+
+
+class ServiceRefusal(ValueError):
+    """Admission refusal (fingerprint mismatch, unknown tenant/job)."""
+
+
+def build_service_workload(workload: Optional[dict]):
+    """(app, DeviceConfig, SchedulerConfig, program_gen, fingerprint)
+    from a CLI-args-shaped workload dict — the ONE builder the service,
+    the solo-parity A/B, and every client-side dry run share (the fleet
+    discipline: a submission means the same thing wherever it builds).
+
+    Two program modes, both deterministic per seed:
+
+      - ``commands`` (raft only): a FIXED program — start events + N
+        client commands + quiescence (the deep multi-violation shape
+        bench configs 12/13/14 explore); seeds vary rng schedules only.
+      - otherwise: per-seed fuzzer programs
+        (``fuzzer.generate_fuzz_test(seed=base+s)`` — the sweep CLI's
+        own seeding rule).
+    """
+    from ..apps.common import dsl_start_events, make_host_invariant
+    from ..config import SchedulerConfig
+    from ..external_events import WaitQuiescence
+    from ..parallel.distributed import DEFAULT_WORKLOAD, build_workload
+    from ..persist.checkpoint import handler_fingerprint
+
+    w = {**DEFAULT_WORKLOAD, **(workload or {})}
+    app, cfg, fuzzer = build_workload(w, record=False)
+    commands = int(w.get("commands", 0) or 0)
+    if commands:
+        if w.get("app") != "raft":
+            raise ServiceRefusal("workload 'commands' is raft-only")
+        from ..apps.raft import T_CLIENT
+        from ..external_events import MessageConstructor, Send
+
+        program = dsl_start_events(app) + [
+            Send(
+                app.actor_name(i % app.num_actors),
+                MessageConstructor(
+                    lambda v=10 + i: (T_CLIENT, 0, v, 0, 0, 0, 0)
+                ),
+            )
+            for i in range(commands)
+        ] + [WaitQuiescence()]
+        gen = lambda s: program  # noqa: E731
+    else:
+        base = int(w.get("seed", 0) or 0)
+        gen = lambda s: fuzzer.generate_fuzz_test(seed=base + s)  # noqa: E731
+    config = SchedulerConfig(invariant_check=make_host_invariant(app))
+    return app, cfg, config, gen, handler_fingerprint(app)
+
+
+def artifact_signature(payload: Dict[str, Any]) -> tuple:
+    """Eid-insensitive canonical signature of a done frame's
+    structural-JSON artifacts (the ``_frame_result_payload`` shape) —
+    the payload twin of ``pipeline.frame_signature``: per-process
+    identity counters stripped, everything else byte-compared. The
+    service-vs-solo A/B (bench ``--config 14``) compares THESE, so a
+    GamutResult and a fetched wire artifact hash identically."""
+    import json as _json
+
+    exts = []
+    for rec in payload.get("mcs", []):
+        rec = dict(rec)
+        rec.pop("eid", None)
+        rec.pop("block", None)
+        exts.append(_json.dumps(rec, sort_keys=True))
+    events = []
+    for rec in payload.get("final_trace", []):
+        rec = dict(rec)
+        rec.pop("id", None)
+        events.append(_json.dumps(rec, sort_keys=True))
+    return (tuple(exts), tuple(events))
+
+
+class Tenant:
+    """One registered tenant: pinned fingerprint, fair-share weight,
+    LaunchBudget account, and a private labeled-at-merge registry."""
+
+    def __init__(self, name: str, fp: str, weight: float = 1.0):
+        self.name = name
+        self.fp = fp
+        self.weight = max(1e-3, float(weight))
+        self.budget = LaunchBudget(DEFAULT_SPLIT)
+        self.registry = MetricsRegistry()
+        self.frames_done = 0
+        self.violations = 0
+        self.lanes_done = 0
+        self.jobs_submitted = 0
+
+    # -- scheduling ----------------------------------------------------------
+    @property
+    def account(self) -> float:
+        """Weighted work charged so far — the deficit-WRR sort key: the
+        scheduler always serves the tenant with the LEAST charged work
+        per weight unit, so a weight-2 tenant absorbs twice the lanes
+        of a weight-1 tenant before yielding the device."""
+        charged = self.budget.lanes_dispatched(
+            "fuzz"
+        ) + self.budget.lanes_dispatched("minimize")
+        return charged / self.weight
+
+    # -- accounting ----------------------------------------------------------
+    def note(self, name: str, n: float = 1) -> None:
+        # force_inc: tenant accounting is client-facing truth, one write
+        # per round boundary, never gated on DEMI_OBS.
+        self.registry.counter(f"service.{name}").force_inc(n)
+
+    def note_gauge(self, name: str, v: float) -> None:
+        self.registry.gauge(f"service.{name}").force_set(v)
+
+    def labeled_snapshot(self) -> Dict[str, Any]:
+        """This tenant's series with ``tenant=<name>`` folded into every
+        key — ready for ``obs.merge_snapshots``."""
+        return relabel_snapshot(self.registry.snapshot(), tenant=self.name)
+
+    # -- persist -------------------------------------------------------------
+    def to_json(self) -> Dict[str, Any]:
+        return {
+            "name": self.name,
+            "fp": self.fp,
+            "weight": self.weight,
+            "frames_done": self.frames_done,
+            "violations": self.violations,
+            "lanes_done": self.lanes_done,
+            "jobs_submitted": self.jobs_submitted,
+            "dispatched": dict(self.budget.dispatched),
+            "harvested": dict(self.budget.harvested),
+            "launches": dict(self.budget.launches),
+            "registry": self.registry.snapshot(),
+        }
+
+    @classmethod
+    def from_json(cls, obj: Dict[str, Any]) -> "Tenant":
+        t = cls(obj["name"], obj["fp"], obj.get("weight", 1.0))
+        t.frames_done = int(obj.get("frames_done", 0))
+        t.violations = int(obj.get("violations", 0))
+        t.lanes_done = int(obj.get("lanes_done", 0))
+        t.jobs_submitted = int(obj.get("jobs_submitted", 0))
+        t.budget.dispatched = {
+            k: int(v) for k, v in obj.get("dispatched", {}).items()
+        }
+        t.budget.harvested = {
+            k: int(v) for k, v in obj.get("harvested", {}).items()
+        }
+        t.budget.launches = {
+            k: int(v) for k, v in obj.get("launches", {}).items()
+        }
+        snap = obj.get("registry")
+        if snap:
+            t.registry.load(snap)
+        return t
+
+
+@dataclass
+class JobSpec:
+    """The durable submission: everything needed to (re)build and run
+    the job in any process — pure data, JSON round-trippable."""
+
+    tenant: str
+    job_id: str
+    workload: Dict[str, Any]
+    lanes: int
+    chunk: int = 64
+    base_key: int = 0
+    max_frames: Optional[int] = None
+    wildcards: bool = True
+
+    def to_json(self) -> Dict[str, Any]:
+        return {
+            "tenant": self.tenant,
+            "job_id": self.job_id,
+            "workload": dict(self.workload),
+            "lanes": int(self.lanes),
+            "chunk": int(self.chunk),
+            "base_key": int(self.base_key),
+            "max_frames": self.max_frames,
+            "wildcards": bool(self.wildcards),
+        }
+
+    @classmethod
+    def from_json(cls, obj: Dict[str, Any]) -> "JobSpec":
+        return cls(
+            tenant=obj["tenant"],
+            job_id=obj["job_id"],
+            workload=dict(obj.get("workload", {})),
+            lanes=int(obj["lanes"]),
+            chunk=int(obj.get("chunk", 64)),
+            base_key=int(obj.get("base_key", 0)),
+            max_frames=obj.get("max_frames"),
+            wildcards=bool(obj.get("wildcards", True)),
+        )
+
+
+@dataclass
+class ServiceJob:
+    """Live job state. Sweep progress splits into ``seeds_dispatched``
+    (volatile — lanes handed to in-flight chunks) and ``seeds_done``
+    (durable — lanes harvested): a resume restarts dispatch at
+    ``seeds_done``, re-executing any chunk the kill swallowed (pure
+    round inputs, so re-execution is bit-identical and the namespaced
+    queue dedups the re-offered violations)."""
+
+    spec: JobSpec
+    tenant: Tenant
+    status: str = "queued"  # queued | running | done | refused
+    error: Optional[str] = None
+    seeds_done: int = 0
+    seeds_dispatched: int = 0
+    enqueued: int = 0
+    violations: int = 0
+    codes: Dict[int, int] = field(default_factory=dict)
+    frames_done: int = 0
+    ttf_mcs_s: Optional[float] = None
+    submitted_t: float = field(default_factory=lambda: round(time.time(), 3))
+    # Bucketed checker shapes this job's frames used — the solo-run
+    # compile-count equivalent the savings accounting compares against.
+    checker_shapes: set = field(default_factory=set)
+    lifted: bool = False
+
+    @property
+    def namespace(self) -> str:
+        return f"{self.spec.tenant}/{self.spec.job_id}"
+
+    @property
+    def sweep_done(self) -> bool:
+        return self.seeds_done >= self.spec.lanes
+
+    def summary(self, queue=None) -> Dict[str, Any]:
+        out = {
+            "job": self.spec.job_id,
+            "tenant": self.spec.tenant,
+            "status": self.status,
+            "lanes": self.spec.lanes,
+            "chunk": self.spec.chunk,
+            "base_key": self.spec.base_key,
+            "max_frames": self.spec.max_frames,
+            "seeds_done": self.seeds_done,
+            "violations": self.violations,
+            "enqueued": self.enqueued,
+            "frames_done": self.frames_done,
+            "ttf_mcs_s": self.ttf_mcs_s,
+        }
+        if self.error:
+            out["error"] = self.error
+        if queue is not None:
+            out["queue_depth"] = queue.depth_of(self.namespace)
+        return out
+
+    # -- persist -------------------------------------------------------------
+    def to_json(self) -> Dict[str, Any]:
+        return {
+            "spec": self.spec.to_json(),
+            "status": self.status,
+            "error": self.error,
+            "seeds_done": int(self.seeds_done),
+            "enqueued": int(self.enqueued),
+            "violations": int(self.violations),
+            "codes": {str(k): int(v) for k, v in self.codes.items()},
+            "frames_done": int(self.frames_done),
+            "ttf_mcs_s": self.ttf_mcs_s,
+            "submitted_t": self.submitted_t,
+            "checker_shapes": sorted(
+                list(s) for s in self.checker_shapes
+            ),
+            "lifted": self.lifted,
+        }
+
+    @classmethod
+    def from_json(cls, obj: Dict[str, Any], tenant: Tenant) -> "ServiceJob":
+        job = cls(spec=JobSpec.from_json(obj["spec"]), tenant=tenant)
+        job.status = obj.get("status", "queued")
+        job.error = obj.get("error")
+        job.seeds_done = int(obj.get("seeds_done", 0))
+        # In-flight chunks died with the process: re-dispatch from the
+        # durable harvest cursor.
+        job.seeds_dispatched = job.seeds_done
+        job.enqueued = int(obj.get("enqueued", 0))
+        job.violations = int(obj.get("violations", 0))
+        job.codes = {
+            int(k): int(v) for k, v in obj.get("codes", {}).items()
+        }
+        job.frames_done = int(obj.get("frames_done", 0))
+        job.ttf_mcs_s = obj.get("ttf_mcs_s")
+        job.submitted_t = obj.get("submitted_t", job.submitted_t)
+        job.checker_shapes = {
+            tuple(s) for s in obj.get("checker_shapes", [])
+        }
+        job.lifted = bool(obj.get("lifted", False))
+        return job

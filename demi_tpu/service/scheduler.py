@@ -1,0 +1,47 @@
+"""Fair scheduling: deficit-weighted round-robin over tenant accounts.
+
+The service charges every device lane a tenant consumes — sweep lanes
+at chunk fill, minimizer lanes at level step — to that tenant's
+``LaunchBudget`` account. The scheduler's whole policy is one total
+order: serve the eligible tenant with the LEAST charged-work-per-weight
+(``Tenant.account``), deterministic tie-break by name. That is classic
+deficit round robin with weights folded into the deficit: a weight-2
+tenant is picked until it has absorbed twice a weight-1 tenant's lanes,
+interleaved at chunk/level granularity, never starving anyone (every
+eligible tenant's account eventually becomes the minimum because only
+the served tenant's account grows).
+
+Chunk filling uses the same order plus a proportional share bound
+(``fill_share``) so one mixed chunk carries lanes from several tenants
+instead of letting the minimum-account tenant claim every lane of the
+launch — the "ride another tenant's padded lanes" mechanism at the
+sweep tier.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Optional
+
+from .jobs import Tenant
+
+
+def pick_tenant(tenants: Iterable[Tenant]) -> Optional[Tenant]:
+    """The next tenant to serve: least weighted charged work, name as
+    the deterministic tie-break. None on an empty set."""
+    best: Optional[Tenant] = None
+    for t in tenants:
+        if best is None or (t.account, t.name) < (best.account, best.name):
+            best = t
+    return best
+
+
+def fill_share(chunk: int, tenant: Tenant, tenants: Iterable[Tenant]) -> int:
+    """Lanes of a ``chunk``-lane launch this tenant may claim in one
+    fill turn: its weight's proportion of the chunk among the tenants
+    currently contending, floored at 1 so a tiny weight still makes
+    progress. The fill loop re-picks after every turn, so leftover
+    capacity (a tenant with fewer remaining lanes than its share) flows
+    to the others — the chunk leaves full whenever any tenant has lanes
+    left."""
+    total = sum(t.weight for t in tenants) or tenant.weight
+    return max(1, round(chunk * tenant.weight / total))

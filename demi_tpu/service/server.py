@@ -1,0 +1,271 @@
+"""`demi_tpu serve`: the exploration service daemon on the fleet wire.
+
+The wire IS the fleet's: line-delimited JSON messages over a plain TCP
+socket (one request, one reply, persistent connections welcome), with
+bulk payloads — fetched artifact frames — riding the persist/ zlib+b64
+codec (``pack_payload``/``unpack_payload``) instead of a second
+protocol. Client verbs:
+
+  - ``submit``: admit one tenant job (workload spec + seed range);
+  - ``jobs`` / ``poll``: list a tenant's (or all) jobs / one job's
+    progress;
+  - ``fetch``: a job's violation frames with their minimization
+    artifacts (the structural-JSON payload persist/ checkpoints);
+  - ``stats`` / ``status``: the tenant-labeled merged metrics snapshot
+    / the service summary with the shared-launch savings block;
+  - ``shutdown``: stop the daemon (``drain=true`` checkpoints first).
+
+The request handlers run on server threads and only touch the engine's
+locked control surface; ALL device work stays on the daemon's main
+thread, which also owns the SIGTERM contract: first signal →
+checkpoint mid-queue at the next boundary and exit 3 (the persist/
+preemption convention), ``demi_tpu serve --resume`` continues with no
+job lost and no frame minimized twice.
+"""
+
+from __future__ import annotations
+
+import json
+import socketserver
+import threading
+import time
+import zlib
+from typing import Any, Dict, Optional
+
+from .. import obs
+from .daemon import ExplorationService
+from .jobs import ServiceRefusal
+
+#: SIGTERM-drain exit status (the persist/ preemption convention).
+EXIT_PREEMPTED = 3
+
+
+def pack_payload(obj: Any) -> Dict[str, Any]:
+    """Bulk-message codec: canonical JSON, zlib, base64 — the persist/
+    frame treatment applied to wire payloads (artifact lists compress
+    ~10x; the framing stays one JSON line)."""
+    from ..persist.checkpoint import _b64
+
+    raw = json.dumps(obj, sort_keys=True, separators=(",", ":")).encode()
+    return {"z": _b64(zlib.compress(raw, 1)), "n": len(raw)}
+
+
+def unpack_payload(obj: Dict[str, Any]) -> Any:
+    """Inverse of ``pack_payload``."""
+    from ..persist.checkpoint import _unb64
+
+    return json.loads(zlib.decompress(_unb64(obj["z"])))
+
+
+class _ServiceHandler(socketserver.StreamRequestHandler):
+    def handle(self):
+        daemon = self.server.daemon  # type: ignore[attr-defined]
+        try:
+            while True:
+                line = self.rfile.readline()
+                if not line:
+                    break
+                try:
+                    msg = json.loads(line)
+                except ValueError:
+                    self._send({"op": "error", "error": "bad json"})
+                    continue
+                self._send(daemon.handle_request(msg))
+        except OSError:
+            pass  # dead peer: nothing to clean up, requests are stateless
+
+    def _send(self, obj: Dict[str, Any]) -> None:
+        self.wfile.write((json.dumps(obj) + "\n").encode())
+        self.wfile.flush()
+
+
+class ServiceDaemon:
+    """TCP front end + engine drive loop around one
+    ``ExplorationService``."""
+
+    def __init__(
+        self,
+        state_dir: Optional[str],
+        *,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        split: float = 0.5,
+        depth: int = 2,
+        default_chunk: int = 64,
+        stage_budget_seconds: Optional[float] = None,
+        resume: bool = False,
+        drain_when_idle: bool = False,
+    ):
+        self.service = ExplorationService(
+            state_dir,
+            split=split,
+            depth=depth,
+            default_chunk=default_chunk,
+            stage_budget_seconds=stage_budget_seconds,
+            resume=resume,
+        )
+        self.host = host
+        self.port = port
+        self.drain_when_idle = drain_when_idle
+        self._server: Optional[socketserver.ThreadingTCPServer] = None
+        self._shutdown_requested = False
+        self._drain_requested = False
+        self._journal_attached_here = False
+
+    # -- wire ----------------------------------------------------------------
+    def handle_request(self, msg: Dict[str, Any]) -> Dict[str, Any]:
+        svc = self.service
+        op = msg.get("op")
+        try:
+            if op == "submit":
+                job = svc.submit(
+                    str(msg.get("tenant", "anon")),
+                    msg.get("workload") or {},
+                    lanes=int(msg.get("lanes", 256)),
+                    chunk=msg.get("chunk"),
+                    base_key=int(msg.get("base_key", 0)),
+                    max_frames=msg.get("max_frames"),
+                    weight=float(msg.get("weight", 1.0)),
+                    wildcards=bool(msg.get("wildcards", True)),
+                )
+                return {"op": "ok", **job}
+            if op == "jobs":
+                tenant = msg.get("tenant")
+                with svc._lock:
+                    jobs = [
+                        j.summary(svc.queue)
+                        for j in svc.jobs.values()
+                        if tenant is None or j.spec.tenant == tenant
+                    ]
+                return {"op": "jobs", "jobs": jobs}
+            if op == "poll":
+                with svc._lock:
+                    job = svc.jobs.get(str(msg.get("job")))
+                    if job is None:
+                        return {
+                            "op": "error",
+                            "error": f"unknown job {msg.get('job')!r}",
+                        }
+                    return {"op": "job", **job.summary(svc.queue)}
+            if op == "fetch":
+                frames = svc.job_frames(str(msg.get("job")))
+                return {
+                    "op": "artifacts",
+                    "job": msg.get("job"),
+                    "count": len(frames),
+                    "frames": pack_payload(frames),
+                }
+            if op == "stats":
+                return {"op": "stats", "snapshot": svc.merged_snapshot()}
+            if op == "status":
+                return {"op": "status", **svc.summary()}
+            if op == "shutdown":
+                self._drain_requested = bool(msg.get("drain", True))
+                self._shutdown_requested = True
+                return {"op": "ok", "drain": self._drain_requested}
+            return {"op": "error", "error": f"unknown op {op!r}"}
+        except ServiceRefusal as exc:
+            return {"op": "error", "error": str(exc), "refused": True}
+        except Exception as exc:  # the wire must answer, not hang
+            return {"op": "error", "error": f"{type(exc).__name__}: {exc}"}
+
+    # -- lifecycle -----------------------------------------------------------
+    def serve(self) -> str:
+        """Bind + start the request threads; returns ``host:port``."""
+
+        class _Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = _Server((self.host, self.port), _ServiceHandler)
+        self._server.daemon = self  # type: ignore[attr-defined]
+        threading.Thread(
+            target=self._server.serve_forever, daemon=True
+        ).start()
+        addr = self._server.server_address
+        return f"{addr[0]}:{addr[1]}"
+
+    def close(self) -> None:
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None
+
+    def run(self, poll_s: float = 0.05) -> int:
+        """The daemon main loop (call on the MAIN thread — it owns the
+        device and the SIGTERM handler). Returns the process exit
+        status: 0 on clean shutdown, EXIT_PREEMPTED after a
+        signal-requested drain."""
+        from ..persist.supervisor import PreemptionGuard
+
+        svc = self.service
+        if svc.state_dir is not None and not obs.journal.attached():
+            obs.journal.attach(svc.state_dir, incarnation=svc.incarnation)
+            self._journal_attached_here = True
+        rc = 0
+        with PreemptionGuard() as guard:
+            svc.boundary_hook = lambda kind: (
+                guard.requested or self._shutdown_requested
+            )
+            while True:
+                progressed = svc.quantum()
+                if guard.requested:
+                    svc.checkpoint()
+                    rc = EXIT_PREEMPTED
+                    break
+                if self._shutdown_requested:
+                    if self._drain_requested:
+                        svc.checkpoint()
+                    break
+                if not progressed:
+                    if self.drain_when_idle and svc.all_done():
+                        svc.checkpoint()
+                        break
+                    time.sleep(poll_s)
+        if self._journal_attached_here:
+            obs.journal.detach()
+            self._journal_attached_here = False
+        return rc
+
+
+def run_service(
+    state_dir: Optional[str],
+    *,
+    host: str = "127.0.0.1",
+    port: int = 0,
+    split: float = 0.5,
+    depth: int = 2,
+    default_chunk: int = 64,
+    stage_budget_seconds: Optional[float] = None,
+    resume: bool = False,
+    drain_when_idle: bool = False,
+    announce=None,
+) -> int:
+    """`demi_tpu serve` body: construct, announce the bound address as
+    one JSON line (clients and tests parse it), run to exit status, and
+    print the final summary."""
+    daemon = ServiceDaemon(
+        state_dir,
+        host=host,
+        port=port,
+        split=split,
+        depth=depth,
+        default_chunk=default_chunk,
+        stage_budget_seconds=stage_budget_seconds,
+        resume=resume,
+        drain_when_idle=drain_when_idle,
+    )
+    addr = daemon.serve()
+    line = json.dumps(
+        {"op": "listening", "addr": addr, "state_dir": state_dir}
+    )
+    if announce is not None:
+        announce(line)
+    else:
+        print(line, flush=True)
+    try:
+        rc = daemon.run()
+    finally:
+        daemon.close()
+    print(json.dumps(daemon.service.summary()), flush=True)
+    return rc

@@ -83,7 +83,8 @@ def main(argv=None) -> int:
     p.add_argument("--seed", type=int, default=20260730)
     p.add_argument(
         "--mode", default="continuous",
-        choices=("continuous", "round-pin", "kill-resume"),
+        choices=("continuous", "round-pin", "kill-resume",
+                 "service-kill-resume"),
         help="continuous: per-seed verdict parity across continuous-driver "
              "variants; round-pin: fuzzed round-delivery lanes recorded and "
              "replayed through the sequential replay kernel "
@@ -91,7 +92,11 @@ def main(argv=None) -> int:
              "sequential schedule); kill-resume: SIGKILL a checkpointed "
              "DPOR soak mid-run and verify the resumed run converges to "
              "the uninterrupted run's violation set (bit-parity on "
-             "explored/interleavings/first-found)",
+             "explored/interleavings/first-found); service-kill-resume: "
+             "SIGKILL a `demi_tpu serve` daemon mid-queue (two tenants' "
+             "jobs in flight) and verify `serve --resume --drain` "
+             "converges every tenant's artifact set exactly (no frame "
+             "lost, none minimized twice)",
     )
     args = p.parse_args(argv)
 
@@ -99,6 +104,8 @@ def main(argv=None) -> int:
         return _round_pin_soak(args)
     if args.mode == "kill-resume":
         return _kill_resume_soak(args)
+    if args.mode == "service-kill-resume":
+        return _service_kill_resume_soak(args)
 
     import numpy as np
 
@@ -436,6 +443,177 @@ def _kill_resume_soak(args) -> int:
         finally:
             shutil.rmtree(workdir, ignore_errors=True)
     print("KILL-RESUME SOAK OK", flush=True)
+    return 0
+
+
+def _service_kill_resume_soak(args) -> int:
+    """Service preemption-tolerance soak (demi_tpu/service): per cycle,
+    run a two-tenant job mix on an in-process service to completion
+    (the reference artifact sets), then serve the SAME mix from a
+    `demi_tpu serve` daemon, SIGKILL the daemon mid-queue — no handler
+    runs, a checkpoint write may be torn — and `serve --resume --drain`
+    it to completion. Every tenant's fetched artifact set must converge
+    EXACTLY to the reference (eid-insensitive signatures): no violation
+    frame lost, none minimized twice (the namespaced-queue dedup), and
+    the durable per-job frame counters must agree. Runs at tiny shapes
+    (DEMI_SOAK_SKR_LANES overrides)."""
+    import json
+    import os
+    import shutil
+    import signal
+    import subprocess
+    import tempfile
+
+    from ..service import ExplorationService, artifact_signature
+
+    cycles = args.rounds if args.rounds is not None else 3
+    lanes = int(os.environ.get("DEMI_SOAK_SKR_LANES", "12"))
+    chunk = int(os.environ.get("DEMI_SOAK_SKR_CHUNK", "8"))
+    max_frames = int(os.environ.get("DEMI_SOAK_SKR_FRAMES", "2"))
+    workload = {
+        "app": "broadcast", "nodes": 4, "bug": "x", "num_events": 8,
+        "max_messages": 96, "pool": 64,
+    }
+    tenants = [("acme", 0), ("umbrella", 1)]
+    env = dict(os.environ, JAX_PLATFORMS=os.environ.get(
+        "JAX_PLATFORMS", "cpu"
+    ))
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)
+    )))
+
+    def sig_sets(frame_lists):
+        return {
+            name: {
+                int(f["seed"]): artifact_signature(f["result"])
+                for f in frames
+                if f["status"] == "done"
+            }
+            for name, frames in frame_lists.items()
+        }
+
+    # Reference: in-process, uninterrupted.
+    ref = ExplorationService(None, default_chunk=chunk)
+    ref_jobs = {}
+    for name, base in tenants:
+        job = ref.submit(
+            name, workload, lanes=lanes, chunk=chunk, base_key=base,
+            max_frames=max_frames, wildcards=False,
+        )
+        ref_jobs[name] = job["job"]
+    ref.run_until_idle()
+    want = sig_sets({
+        name: ref.job_frames(jid) for name, jid in ref_jobs.items()
+    })
+    want_counts = {
+        name: ref.jobs[jid].frames_done for name, jid in ref_jobs.items()
+    }
+
+    t0 = time.time()
+    for cycle in range(cycles):
+        if args.rounds is None and time.time() - t0 >= args.seconds:
+            break
+        workdir = tempfile.mkdtemp(prefix="demi_skr_")
+        try:
+            state = os.path.join(workdir, "state")
+            proc = subprocess.Popen(
+                [sys.executable, "-m", "demi_tpu", "serve",
+                 "--state-dir", state, "--chunk", str(chunk)],
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                text=True, env=env, cwd=repo,
+            )
+            addr = json.loads(proc.stdout.readline())["addr"]
+            for name, base in tenants:
+                sub = subprocess.run(
+                    [sys.executable, "-m", "demi_tpu", "submit",
+                     "--addr", addr, "--tenant", name,
+                     "--app", "broadcast", "--nodes", "4", "--bug", "x",
+                     "--num-events", "8", "--max-messages", "96",
+                     "--pool", "64", "--lanes", str(lanes),
+                     "--chunk", str(chunk), "--base-key", str(base),
+                     "--max-frames", str(max_frames), "--no-wildcards"],
+                    capture_output=True, text=True, env=env, timeout=180,
+                    cwd=repo,
+                )
+                if sub.returncode != 0:
+                    print(f"SERVICE-KILL-RESUME: submit failed\n"
+                          f"{sub.stdout}\n{sub.stderr}", flush=True)
+                    return 2
+            # Kill once at least one checkpoint generation exists, plus
+            # a cycle-dependent delay so the SIGKILL lands in different
+            # phases (mid-sweep, mid-minimize, mid-checkpoint-write).
+            deadline = time.time() + 300
+            while time.time() < deadline:
+                gens = [
+                    e for e in (
+                        os.listdir(state) if os.path.isdir(state) else []
+                    )
+                    if e.startswith("ckpt-") and not e.endswith(".tmp")
+                ]
+                if gens or proc.poll() is not None:
+                    break
+                time.sleep(0.05)
+            time.sleep(0.2 * cycle)
+            if proc.poll() is None:
+                proc.send_signal(signal.SIGKILL)
+            proc.communicate(timeout=60)
+            res = subprocess.run(
+                [sys.executable, "-m", "demi_tpu", "serve",
+                 "--state-dir", state, "--resume", "--drain",
+                 "--chunk", str(chunk)],
+                capture_output=True, text=True, env=env, timeout=600,
+                cwd=repo,
+            )
+            if res.returncode != 0:
+                print(f"SERVICE-KILL-RESUME: resume failed rc="
+                      f"{res.returncode}\n{res.stdout}\n{res.stderr}",
+                      flush=True)
+                return 2
+            summary = json.loads(res.stdout.strip().splitlines()[-1])
+            by_tenant = {
+                j["tenant"]: j for j in summary["jobs"]
+            }
+            # Fetch-equivalent: the resumed daemon exited; read the
+            # artifacts from its final checkpoint (the same frames a
+            # `jobs --fetch` would have returned).
+            from ..persist import CheckpointStore
+
+            ckpt = CheckpointStore(state).load_latest()
+            frames = ckpt.sections["service"]["queue"]["frames"]
+            got_lists = {name: [] for name, _ in tenants}
+            for f in frames:
+                tenant = f.get("ns", "").split("/")[0]
+                if tenant in got_lists:
+                    got_lists[tenant].append(f)
+            got = sig_sets(got_lists)
+            for name, _ in tenants:
+                if got.get(name) != want.get(name):
+                    print(
+                        f"SERVICE-KILL-RESUME DIVERGENCE cycle={cycle} "
+                        f"tenant={name}: want "
+                        f"{sorted(want.get(name, {}))} got "
+                        f"{sorted(got.get(name, {}))}",
+                        flush=True,
+                    )
+                    return 2
+                if by_tenant[name]["frames_done"] != want_counts[name]:
+                    print(
+                        f"SERVICE-KILL-RESUME FRAME COUNT cycle={cycle} "
+                        f"tenant={name}: want {want_counts[name]} got "
+                        f"{by_tenant[name]['frames_done']} (a frame was "
+                        "lost or minimized twice)",
+                        flush=True,
+                    )
+                    return 2
+            print(
+                f"service-kill-resume cycle {cycle} ok "
+                f"(frames={ {n: by_tenant[n]['frames_done'] for n, _ in tenants} }, "
+                f"{time.time() - t0:.0f}s)",
+                flush=True,
+            )
+        finally:
+            shutil.rmtree(workdir, ignore_errors=True)
+    print("SERVICE-KILL-RESUME SOAK OK", flush=True)
     return 0
 
 

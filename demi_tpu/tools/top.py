@@ -36,13 +36,35 @@ def _fmt(v: Optional[float], spec: str = ".2f", unit: str = "") -> str:
     return f"{v:{spec}}{unit}"
 
 
+def _recent(records: List[Dict[str, Any]], window: int) -> list:
+    """The sliding window, with explicit empty/zero semantics:
+    ``--window 0`` (or negative) means the WHOLE stream — the naive
+    ``records[-window:]`` slice silently returns everything for 0 but
+    drops the first ``|window|`` records for negatives, which is how
+    the rate math used to see a window it was never asked for."""
+    if window <= 0:
+        return list(records)
+    return records[-window:]
+
+
+def _ratio(num: float, den: Optional[float]) -> Optional[float]:
+    """num/den with every degenerate denominator (None, 0, negative —
+    an empty window, a zero-round journal, same-tick timestamps)
+    rendered as "no rate yet" instead of a ZeroDivisionError. The ONE
+    guard every panel's rate math goes through, so a freshly attached
+    service or fleet dir with no rounds renders ``--once`` cleanly."""
+    if den is None or den <= 0:
+        return None
+    return num / den
+
+
 def _rate(records: List[Dict[str, Any]], window: int) -> Optional[float]:
     """Rounds/sec over the last ``window`` records, by journaled
     per-round wall seconds (robust to gaps from kills/resumes, unlike
     wall-clock deltas across records)."""
-    recent = records[-window:]
+    recent = _recent(records, window)
     secs = sum(r.get("wall_s") or 0.0 for r in recent)
-    return len(recent) / secs if secs > 0 else None
+    return _ratio(len(recent), secs)
 
 
 def _bar(frac: Optional[float], width: int = 20) -> str:
@@ -168,16 +190,19 @@ def render_frame(
         "dpor.round": "dpor", "minimize.level": "minimize",
         "minimize.stage": "minimize", "pipeline.enqueue": "pipeline",
         "pipeline.frame": "pipeline", "fleet.round": "fleet",
-        "fleet.worker": "fleet",
+        "fleet.worker": "fleet", "service.chunk": "service",
+        "service.frame": "service", "service.enqueue": "service",
+        "service.job": "service", "service.tenant": "service",
     }
-    recent = records[-window:]
+    recent = _recent(records, window)
     counts: Dict[str, int] = {}
     for r in recent:
         tier = tier_of.get(r.get("kind"))
         if tier:
             counts[tier] = counts.get(tier, 0) + 1
     active_tiers = [t for t in ("fuzz", "sweep", "dpor", "minimize",
-                                "pipeline", "fleet") if counts.get(t)]
+                                "pipeline", "fleet", "service")
+                    if counts.get(t)]
     if len(active_tiers) > 1:
         total = sum(counts[t] for t in active_tiers)
         lines.append(
@@ -192,12 +217,13 @@ def render_frame(
     if dpor:
         last = dpor[-1]
         rps = _rate(dpor, window)
-        host = sum(r.get("host_s") or 0.0 for r in dpor[-window:])
-        dev = sum(r.get("device_s") or 0.0 for r in dpor[-window:])
-        share = host / (host + dev) if (host + dev) > 0 else None
-        fresh = sum(r.get("fresh") or 0 for r in dpor[-window:])
-        redundant = sum(r.get("redundant") or 0 for r in dpor[-window:])
-        pruned = sum(r.get("distance_pruned") or 0 for r in dpor[-window:])
+        recent_d = _recent(dpor, window)
+        host = sum(r.get("host_s") or 0.0 for r in recent_d)
+        dev = sum(r.get("device_s") or 0.0 for r in recent_d)
+        share = _ratio(host, host + dev)
+        fresh = sum(r.get("fresh") or 0 for r in recent_d)
+        redundant = sum(r.get("redundant") or 0 for r in recent_d)
+        pruned = sum(r.get("distance_pruned") or 0 for r in recent_d)
         lines.append("")
         lines.append(f"DPOR  round {last.get('round')}  "
                      f"rounds/sec {_fmt(rps)}  "
@@ -208,7 +234,7 @@ def render_frame(
                      f"explored {last.get('explored')}  "
                      f"interleavings {last.get('interleavings')}")
         denom = max(1, fresh + redundant + pruned)
-        lines.append(f"  admissions (last {min(window, len(dpor))} rounds): "
+        lines.append(f"  admissions (last {len(recent_d)} rounds): "
                      f"{fresh} fresh / {redundant} redundant / "
                      f"{pruned} pruned "
                      f"[{_bar(fresh / denom)}]")
@@ -257,7 +283,7 @@ def render_frame(
             f"{outstanding if outstanding is not None else '—'}"
         )
         if fleet:
-            recent_f = fleet[-window:]
+            recent_f = _recent(fleet, window)
             # Aggregate interleavings/sec over the recent window: total
             # leased lanes over the wall span those rounds landed in
             # (concurrent workers overlap, so per-round busy seconds
@@ -268,7 +294,7 @@ def render_frame(
                 if len(recent_f) > 1
                 else None
             )
-            agg = lanes / span if span and span > 0 else None
+            agg = _ratio(lanes, span)
             lines.append(
                 f"  global class frontier {fleet[-1].get('classes')}"
                 f"  explored {fleet[-1].get('explored')}"
@@ -297,13 +323,14 @@ def render_frame(
         last = sweep[-1]
         lanes = sum(r.get("lanes") or 0 for r in sweep)
         viol = sum(r.get("violations") or 0 for r in sweep)
-        secs = sum(r.get("wall_s") or 0.0 for r in sweep[-window:])
-        recent_lanes = sum(r.get("lanes") or 0 for r in sweep[-window:])
+        recent_s = _recent(sweep, window)
+        secs = sum(r.get("wall_s") or 0.0 for r in recent_s)
+        recent_lanes = sum(r.get("lanes") or 0 for r in recent_s)
         lines.append("")
         lines.append(f"SWEEP  chunk {last.get('round')}  "
                      f"lanes {lanes}  violations {viol}  "
                      f"schedules/sec "
-                     f"{_fmt(recent_lanes / secs if secs > 0 else None, '.1f')}")
+                     f"{_fmt(_ratio(recent_lanes, secs), '.1f')}")
 
     levels = [r for r in records if r.get("kind") == "minimize.level"]
     stages = [r for r in records if r.get("kind") == "minimize.stage"]
@@ -339,9 +366,7 @@ def render_frame(
             None,
         )
         span_s = (t_last - t0) if (t0 and t_last) else None
-        mph = (
-            len(frames) * 3600.0 / span_s if span_s and frames else None
-        )
+        mph = _ratio(len(frames) * 3600.0, span_s) if frames else None
         lines.append(
             f"PIPELINE  enqueued {len(enq)}  minimized {len(frames)}  "
             f"queue depth {depth if depth is not None else '—'}"
@@ -358,6 +383,75 @@ def render_frame(
                 f"{last.get('deliveries')} deliveries  "
                 f"{_fmt(last.get('wall_s'), '.2f', 's')}"
             )
+
+    svc_chunks = [r for r in records if r.get("kind") == "service.chunk"]
+    svc_frames = [r for r in records if r.get("kind") == "service.frame"]
+    svc_enq = [r for r in records if r.get("kind") == "service.enqueue"]
+    svc_tenants = [r for r in records if r.get("kind") == "service.tenant"]
+    svc_jobs = [r for r in records if r.get("kind") == "service.job"]
+    if svc_chunks or svc_frames or svc_tenants or svc_jobs or svc_enq:
+        lines.append("")
+        names = {r.get("tenant") for r in svc_tenants + svc_jobs
+                 + svc_frames + svc_enq if r.get("tenant")}
+        last_c = svc_chunks[-1] if svc_chunks else {}
+        depth = (
+            (svc_frames + svc_enq + svc_chunks)[-1].get("queue_depth")
+            if (svc_frames or svc_enq or svc_chunks) else None
+        )
+        refusals = sum(
+            1 for r in svc_tenants if r.get("event") == "refuse"
+        )
+        lines.append(
+            f"SERVICE  tenants {len(names) or last_c.get('tenants_active', 0)}"
+            f"  jobs {len({r.get('job') for r in svc_jobs if r.get('job')})}"
+            f"  queue depth {depth if depth is not None else '—'}"
+            + (f"  refusals {refusals}" if refusals else "")
+        )
+        # Shared-launch savings: the service.chunk records carry the
+        # cumulative economics (actual vs solo-equivalent launches,
+        # pooled checker shapes). Zero-round windows (a freshly
+        # attached service with submissions but no harvests yet) just
+        # omit the line.
+        if svc_chunks:
+            chunks = last_c.get("chunks")
+            solo = last_c.get("solo_equiv_chunks")
+            saved = (
+                max(0, solo - chunks)
+                if chunks is not None and solo is not None
+                else None
+            )
+            lines.append(
+                f"  shared launches: {chunks} chunks vs {solo} solo"
+                + (f" (saved {saved})" if saved is not None else "")
+                + f"  mixed {last_c.get('mixed_chunks', 0)}"
+                  f"  rides {last_c.get('rides', 0)}"
+                + f"  checker shapes {last_c.get('checker_shapes', '—')}"
+                  f" ({last_c.get('checker_hits', 0)} cross-frame hits)"
+            )
+        # Per-tenant MCS counts + recent-window rate, from the frame
+        # records (guarded: an empty window or same-tick stamps render
+        # as "—", never a divide-by-zero).
+        if svc_frames:
+            per: Dict[str, int] = {}
+            for r in svc_frames:
+                tname = str(r.get("tenant"))
+                per[tname] = per.get(tname, 0) + 1
+            total_f = sum(per.values())
+            lines.append(
+                "  MCSes by tenant: " + "  ".join(
+                    f"{t} [{_bar(_ratio(n, total_f), 10)}] {n}"
+                    for t, n in sorted(per.items())
+                )
+            )
+            recent_fr = _recent(svc_frames, window)
+            span = (
+                (recent_fr[-1].get("t") or 0)
+                - (recent_fr[0].get("t") or 0)
+                if len(recent_fr) > 1
+                else None
+            )
+            mph = _ratio(len(recent_fr) * 3600.0, span)
+            lines.append(f"  MCSes/hour (window) {_fmt(mph, '.1f')}")
 
     lines.append("")
     lines.append(f"last record: {time.strftime('%H:%M:%S', time.localtime(t_last))}"

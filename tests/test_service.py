@@ -1,0 +1,312 @@
+"""Exploration-service units: the fast, kernel-free half of the
+service suite (demi_tpu/service) — queue namespacing, admission data
+model, fair-scheduler math, wire codecs, Prometheus label escaping, and
+the `top` SERVICE panel / zero-window rate guards.
+
+Everything here runs in milliseconds (no kernel compiles, no sockets):
+the device-integration half — shared-batching parity vs solo runs, the
+TCP round-trip, SIGTERM drain + resume, the config-14 bench smoke —
+lives in tests/test_zzz_service.py, NAMED to collect after every
+existing tier-1 file: the 870s tier-1 cap truncates the suite tail, so
+new heavy tests must never push seed tests past the cap (dots-vs-seed
+is the metric)."""
+
+import json
+
+import pytest
+
+from demi_tpu.pipeline.queue import ViolationQueue
+from demi_tpu.service.jobs import JobSpec, ServiceJob, Tenant
+from demi_tpu.service.scheduler import fill_share, pick_tenant
+
+
+# ---------------------------------------------------------------------------
+# ViolationQueue tenant/job namespacing (the dedup-fix satellite)
+# ---------------------------------------------------------------------------
+
+def test_violation_queue_namespaces_do_not_cross_dedup():
+    """Two jobs submitting the SAME seed must both keep their frames —
+    the pre-namespace queue deduped them against each other, which the
+    multi-tenant service cannot tolerate."""
+    q = ViolationQueue()
+    a = q.offer(7, 2, namespace="acme/j0")
+    b = q.offer(7, 3, namespace="bob/j1")
+    assert a is not None and b is not None
+    assert a.code == 2 and b.code == 3
+    # Within one namespace the dedup still holds (resume re-retirement).
+    assert q.offer(7, 2, namespace="acme/j0") is None
+    assert q.depth_of("acme/j0") == 1
+    assert q.depth_of("bob/j1") == 1
+    assert q.depth == 2
+
+
+def test_violation_queue_default_namespace_keeps_solo_behavior():
+    """Solo streaming runs live in the default namespace: plain-seed
+    keys, plain-seed access — the PR-12 behavior and checkpoint shape,
+    bit-for-bit (frames[7] stays a valid key)."""
+    q = ViolationQueue()
+    assert q.offer(7, 2) is not None
+    assert q.offer(7, 2) is None
+    q.mark_done(7, {"mcs": []})
+    assert q.frames[7].status == "done"
+    state = json.loads(json.dumps(q.checkpoint_state()))
+    # The default namespace serializes WITHOUT an ns field — an old
+    # checkpoint restores into the same keys.
+    assert "ns" not in state["frames"][0]
+    q2 = ViolationQueue()
+    q2.restore_state(state)
+    assert q2.frames[7].status == "done"
+
+
+def test_violation_queue_namespaced_roundtrip_and_filters():
+    q = ViolationQueue()
+    q.offer(1, 2, namespace="t/a")
+    q.offer(1, 2, namespace="t/b")
+    q.offer(2, 4, namespace="t/a")
+    q.mark_done(1, {"mcs": [1]}, namespace="t/a")
+    q.mark_skipped(2, namespace="t/a")
+    state = json.loads(json.dumps(q.checkpoint_state()))
+    q2 = ViolationQueue()
+    q2.restore_state(state)
+    assert q2.enqueued == 3
+    assert q2.enqueued_of("t/a") == 2
+    assert q2.depth_of("t/a") == 0
+    assert q2.depth_of("t/b") == 1
+    assert [f.seed for f in q2.done_frames("t/a")] == [1]
+    assert q2.done_frames("t/b") == []
+    nxt = q2.next_queued("t/b")
+    assert nxt is not None and nxt.namespace == "t/b"
+    assert q2.next_queued("t/a") is None
+
+
+# ---------------------------------------------------------------------------
+# Fair scheduler: deficit-weighted round robin
+# ---------------------------------------------------------------------------
+
+def test_pick_tenant_weighted_deficit_order():
+    a = Tenant("a", "fp", weight=1.0)
+    b = Tenant("b", "fp", weight=2.0)
+    # Equal accounts: deterministic name tie-break.
+    assert pick_tenant([b, a]).name == "a"
+    # Charge a; b (still zero) wins.
+    a.budget.note_dispatch("fuzz", 16)
+    assert pick_tenant([a, b]).name == "b"
+    # b absorbs twice the lanes before its weighted account catches up.
+    b.budget.note_dispatch("fuzz", 16)
+    assert pick_tenant([a, b]).name == "b"
+    b.budget.note_dispatch("fuzz", 17)
+    assert pick_tenant([a, b]).name == "a"
+    # Minimizer lanes charge the same account.
+    a.budget.note_dispatch("minimize", 64)
+    assert pick_tenant([a, b]).name == "b"
+
+
+def test_fill_share_proportional_with_floor():
+    a = Tenant("a", "fp", weight=1.0)
+    b = Tenant("b", "fp", weight=3.0)
+    assert fill_share(16, a, [a, b]) == 4
+    assert fill_share(16, b, [a, b]) == 12
+    # Tiny weights still make progress (the floor).
+    c = Tenant("c", "fp", weight=0.001)
+    assert fill_share(16, c, [c, b]) == 1
+    # Sole contender takes the chunk.
+    assert fill_share(16, a, [a]) == 16
+
+
+# ---------------------------------------------------------------------------
+# Admission data model
+# ---------------------------------------------------------------------------
+
+def test_jobspec_and_tenant_roundtrip():
+    spec = JobSpec(
+        tenant="acme", job_id="j3", workload={"app": "raft", "nodes": 3},
+        lanes=48, chunk=16, base_key=2, max_frames=4, wildcards=False,
+    )
+    spec2 = JobSpec.from_json(json.loads(json.dumps(spec.to_json())))
+    assert spec2 == spec
+
+    t = Tenant("acme", "fp0", weight=2.0)
+    t.budget.note_dispatch("fuzz", 8)
+    t.frames_done = 3
+    t.note("violations", 5)
+    t2 = Tenant.from_json(json.loads(json.dumps(t.to_json())))
+    assert t2.fp == "fp0" and t2.weight == 2.0 and t2.frames_done == 3
+    assert t2.budget.lanes_dispatched("fuzz") == 8
+    snap = t2.labeled_snapshot()
+    assert snap["counters"]["service.violations"] == {"tenant=acme": 5}
+
+    job = ServiceJob(spec=spec, tenant=t)
+    job.seeds_done = 20
+    job.seeds_dispatched = 36  # in-flight lanes die with the process
+    job.codes = {5: 2}
+    job.checker_shapes = {(128, 128, 16)}
+    job2 = ServiceJob.from_json(
+        json.loads(json.dumps(job.to_json())), t
+    )
+    assert job2.seeds_done == 20
+    assert job2.seeds_dispatched == 20  # re-dispatch from the cursor
+    assert job2.codes == {5: 2}
+    assert job2.checker_shapes == {(128, 128, 16)}
+    assert job2.namespace == "acme/j3"
+
+
+def test_tenant_merged_snapshot_labels():
+    """relabel_snapshot with tenant= labels merges like the fleet's
+    worker= labels: distinct tenants stay distinct series."""
+    from demi_tpu.obs.metrics import merge_snapshots
+
+    a = Tenant("acme", "fp")
+    b = Tenant("bob", "fp")
+    a.note("frames_done", 2)
+    b.note("frames_done", 5)
+    merged = merge_snapshots(a.labeled_snapshot(), b.labeled_snapshot())
+    series = merged["counters"]["service.frames_done"]
+    assert series == {"tenant=acme": 2, "tenant=bob": 5}
+
+
+# ---------------------------------------------------------------------------
+# Prometheus label-value escaping (exposition-format satellite)
+# ---------------------------------------------------------------------------
+
+def test_prom_label_escaping_backslash_quote_newline():
+    """Tenant names are user-supplied strings: backslash, double-quote,
+    and newline must all escape per the Prometheus text exposition
+    format (backslash first, so escapes never double up)."""
+    from demi_tpu.obs.timeseries import _esc, prom_text
+
+    assert _esc('a\\b') == 'a\\\\b'
+    assert _esc('a"b') == 'a\\"b'
+    assert _esc('a\nb') == 'a\\nb'
+    assert _esc('\\n') == '\\\\n'  # literal backslash-n, not a newline
+    snap = {
+        "counters": {
+            "service.frames_done": {'tenant=ev\nil"\\': 3},
+        },
+        "gauges": {}, "histograms": {},
+    }
+    text = prom_text(snap)
+    line = [
+        ln for ln in text.splitlines()
+        if ln.startswith("demi_service_frames_done_total{")
+    ]
+    assert len(line) == 1, text
+    # One physical line: the newline in the label value is escaped.
+    assert line[0] == (
+        'demi_service_frames_done_total{tenant="ev\\nil\\"\\\\"} 3'
+    )
+
+
+def test_wire_payload_roundtrip():
+    from demi_tpu.service.server import pack_payload, unpack_payload
+
+    frames = [{"seed": 3, "result": {"mcs": [{"x": 1}]}, "ns": "a/j0"}]
+    packed = json.loads(json.dumps(pack_payload(frames)))
+    assert unpack_payload(packed) == frames
+
+
+def test_artifact_signature_strips_identity_counters():
+    from demi_tpu.service import artifact_signature
+
+    p1 = {
+        "mcs": [{"type": "send", "eid": 5, "to": "n0"}],
+        "final_trace": [{"kind": "deliver", "id": 9, "src": "n1"}],
+    }
+    p2 = {
+        "mcs": [{"type": "send", "eid": 77, "to": "n0"}],
+        "final_trace": [{"kind": "deliver", "id": 1, "src": "n1"}],
+    }
+    assert artifact_signature(p1) == artifact_signature(p2)
+    p3 = {
+        "mcs": [{"type": "send", "eid": 5, "to": "n1"}],
+        "final_trace": [{"kind": "deliver", "id": 9, "src": "n1"}],
+    }
+    assert artifact_signature(p1) != artifact_signature(p3)
+
+
+# ---------------------------------------------------------------------------
+# `demi_tpu top`: zero-round windows + the SERVICE panel (satellite)
+# ---------------------------------------------------------------------------
+
+def _write_journal(tmp_path, records):
+    d = tmp_path / "run"
+    d.mkdir(exist_ok=True)
+    with open(d / "journal.jsonl", "w") as f:
+        for r in records:
+            f.write(json.dumps(r) + "\n")
+    return str(d)
+
+
+def test_top_renders_zero_round_service_dir(tmp_path):
+    """A freshly attached service dir — submissions journaled, no
+    chunks or frames yet — must render --once without a divide-by-zero
+    or a blank crash, including at --window 0."""
+    from demi_tpu.tools.top import render_frame
+
+    root = _write_journal(tmp_path, [
+        {"seq": 0, "t": 100.0, "inc": 0, "kind": "service.tenant",
+         "tenant": "acme", "event": "register", "fp": "f" * 16},
+        {"seq": 1, "t": 100.0, "inc": 0, "kind": "service.job",
+         "tenant": "acme", "job": "j0", "event": "submit", "lanes": 8},
+    ])
+    for window in (30, 1, 0, -5):
+        frame = render_frame(root, window=window)
+        assert "SERVICE" in frame
+        assert "tenants 1" in frame
+        assert "jobs 1" in frame
+
+
+def test_top_service_panel_savings_and_tenant_bars(tmp_path):
+    from demi_tpu.tools.top import render_frame
+
+    root = _write_journal(tmp_path, [
+        {"seq": 0, "t": 100.0, "inc": 0, "kind": "service.chunk",
+         "round": 3, "lanes": 16, "tenants": {"acme": 10, "bob": 6},
+         "mixed": True, "rides": 6, "mixed_chunks": 2, "queue_depth": 1,
+         "chunks": 3, "solo_equiv_chunks": 5, "checker_shapes": 1,
+         "checker_hits": 2, "tenants_active": 2},
+        # Same-tick frames: the window rate must render as "—", not
+        # divide by a zero span.
+        {"seq": 1, "t": 100.0, "inc": 0, "kind": "service.frame",
+         "round": 1, "tenant": "acme", "job": "j0", "seed": 1, "code": 2,
+         "queue_depth": 1, "mcs_externals": 2},
+        {"seq": 2, "t": 100.0, "inc": 0, "kind": "service.frame",
+         "round": 2, "tenant": "bob", "job": "j1", "seed": 1, "code": 2,
+         "queue_depth": 0, "mcs_externals": 2},
+    ])
+    frame = render_frame(root, window=30)
+    assert "SERVICE  tenants 2" in frame
+    assert "3 chunks vs 5 solo (saved 2)" in frame
+    assert "MCSes by tenant" in frame and "acme" in frame and "bob" in frame
+    assert "MCSes/hour (window) —" in frame
+    # window 0 = whole stream; still guarded.
+    assert "SERVICE" in render_frame(root, window=0)
+
+
+def test_top_rate_guards_zero_and_negative_windows():
+    from demi_tpu.tools.top import _rate, _ratio, _recent
+
+    recs = [{"wall_s": 0.0}, {"wall_s": 0.0}]
+    assert _rate(recs, 30) is None  # zero-second window: no rate
+    assert _rate([], 30) is None
+    assert _rate(recs, 0) is None
+    assert _ratio(5, 0) is None
+    assert _ratio(5, -1.0) is None
+    assert _ratio(5, None) is None
+    assert _ratio(6, 2) == 3
+    assert _recent(recs, 0) == recs      # 0 = whole stream
+    assert _recent(recs, -3) == recs     # negatives too, not a tail-drop
+    assert _recent(recs, 1) == recs[-1:]
+
+
+def test_top_once_empty_dir(tmp_path):
+    from demi_tpu.tools.top import render_frame
+
+    frame = render_frame(str(tmp_path), window=30)
+    assert "no journal records yet" in frame
+
+
+def test_service_refusal_is_value_error():
+    from demi_tpu.service import ServiceRefusal
+
+    with pytest.raises(ValueError):
+        raise ServiceRefusal("nope")
