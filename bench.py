@@ -1732,7 +1732,7 @@ def bench_config11(jax):
         "journal_contiguous": contiguous,
         "journal_schema_ok": schema_ok,
         "timeseries_samples": len(ts_rows),
-        "prom_renders": prom.startswith("# TYPE") or prom == "\n",
+        "prom_renders": prom.startswith(("# HELP", "# TYPE")) or prom == "\n",
         "explored": len(journaled.explored),
         "explored_match": journaled.explored == plain.explored,
         "violations_match": (
@@ -2333,6 +2333,109 @@ def bench_config14(jax):
     }
 
 
+def bench_config15(jax):
+    """Pod-wide tracing + health-plane overhead (demi_tpu/obs
+    distributed): the SAME 2-worker fleet run twice — once with the full
+    observability plane ON (DEMI_OBS spans, round journal, span
+    sidecars, per-connection clock sync, straggler scan, byte-footprint
+    gauges) and once with everything OFF. The acceptance bar is < 1% of
+    per-round busy time — the number that lets fleet tracing default ON
+    wherever a journal dir exists (the config-11 discipline applied to
+    the distributed plane). Also asserts:
+
+      - tracing changes NOTHING about the search (explored-set digest,
+        class digest, violation codes bit-identical across the A/B);
+      - `trace stitch` over the traced run's dir produces ONE Perfetto
+        timeline containing the coordinator and every worker process,
+        with clock-aligned non-negative span durations.
+
+    Knobs: DEMI_BENCH_CONFIG15_ROUNDS / _BATCH / _WORKERS / _MSGS."""
+    import tempfile
+
+    from demi_tpu import obs
+    from demi_tpu.fleet import run_fleet
+    from demi_tpu.obs import distributed as dtrace
+
+    nodes = 3
+    rounds = int(os.environ.get("DEMI_BENCH_CONFIG15_ROUNDS", 8))
+    batch = int(os.environ.get("DEMI_BENCH_CONFIG15_BATCH", 16))
+    workers = int(os.environ.get("DEMI_BENCH_CONFIG15_WORKERS", 2))
+    msgs = int(os.environ.get("DEMI_BENCH_CONFIG15_MSGS", 48))
+    workload = {
+        "app": "raft", "nodes": nodes, "bug": "multivote",
+        "max_messages": msgs, "pool": 64, "num_events": 8,
+    }
+
+    def run(journal_dir):
+        # The obs switch rides the coordinator's config message, so the
+        # spawned workers inherit it; busy seconds (worker-side lease
+        # execution, compile excluded by the warm-up) are the honest
+        # denominator — wall would mostly measure process spawn.
+        if journal_dir is not None:
+            obs.enable()
+        try:
+            s = run_fleet(
+                workload, workers=workers, batch=batch, rounds=rounds,
+                journal_dir=journal_dir, timeout=900.0,
+            )
+        finally:
+            if journal_dir is not None:
+                obs.disable()
+        rps = (
+            s["rounds"] / s["busy_seconds"]
+            if s.get("busy_seconds") else None
+        )
+        return s, rps
+
+    plain, rps_off = run(None)
+    with tempfile.TemporaryDirectory() as tmp:
+        traced, rps_on = run(tmp)
+        # Observing the fleet must not change the fleet.
+        assert traced["explored_sha"] == plain["explored_sha"], (
+            "tracing changed the explored set"
+        )
+        assert traced.get("classes_sha") == plain.get("classes_sha"), (
+            "tracing changed the class ledger"
+        )
+        assert traced["violation_codes"] == plain["violation_codes"], (
+            "tracing changed the violation codes"
+        )
+        stitched = dtrace.stitch(
+            [tmp], os.path.join(tmp, "trace-stitched.json")
+        )
+        procs = stitched["processes"]
+        assert "coordinator" in procs, procs
+        worker_procs = [p for p in procs if p.startswith("worker-")]
+        assert len(worker_procs) == workers, procs
+        assert stitched["spans"] > 0, stitched
+    overhead_pct = None
+    if rps_off and rps_on:
+        overhead_pct = round(
+            max(0.0, (1.0 / rps_on - 1.0 / rps_off) * rps_off) * 100, 3
+        )
+    return {
+        "app": f"raft{nodes}",
+        "workers": workers,
+        "batch": batch,
+        "rounds": traced["rounds"],
+        "explored_match": traced["explored_sha"] == plain["explored_sha"],
+        "violations_match": (
+            traced["violation_codes"] == plain["violation_codes"]
+        ),
+        "stitched_processes": procs,
+        "stitched_spans": stitched["spans"],
+        "stitched_journal_records": stitched["journal_records"],
+        "stragglers": traced.get("stragglers", 0),
+        "rounds_per_busy_sec_plain": (
+            round(rps_off, 2) if rps_off is not None else None
+        ),
+        "rounds_per_busy_sec_traced": (
+            round(rps_on, 2) if rps_on is not None else None
+        ),
+        "tracing_overhead_pct": overhead_pct,
+    }
+
+
 def bench_config5_rehearsal(jax, total_lanes=None):
     """Config-5 machinery rehearsal at >=1e5 lanes (VERDICT r3 #6): the
     64-actor *reliable* flood runs ~1 lane/sec on CPU, so the full config
@@ -2511,7 +2614,7 @@ def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--config", default=None,
                         help="run only one section: 2, 3, 4, 5, 6, 7, 8, "
-                             "9, 10, 11, 12, 13, 14, or 'rehearsal'")
+                             "9, 10, 11, 12, 13, 14, 15, or 'rehearsal'")
     args = parser.parse_args()
     if args.config is not None and args.config != "rehearsal":
         args.config = int(args.config)
@@ -2719,6 +2822,24 @@ def main():
         out["vs_baseline"] = round((out["value"] or 0) / 1.15, 3)
         emit(out)
         return
+    if args.config == 15:
+        out["metric"] = (
+            "distributed tracing + health-plane overhead % "
+            "(2-worker fleet, spans + journal + clock sync)"
+        )
+        out["unit"] = "%"
+        out["config15"] = bench_config15(jax)
+        out["value"] = out["config15"].get("tracing_overhead_pct")
+        # Target: the pod tracing plane costs < 1% of per-round busy
+        # time (smaller is better; a measured zero is the BEST result —
+        # floor the denominator, like configs 10/11).
+        out["vs_baseline"] = (
+            round(1.0 / max(out["value"], 0.01), 3)
+            if out["value"] is not None
+            else None
+        )
+        emit(out)
+        return
     if args.config == "rehearsal":
         out["metric"] = (
             "schedules/sec (config-5 machinery rehearsal, >=1e5 lanes)"
@@ -2750,6 +2871,7 @@ def main():
     config12 = bench_config12(jax)
     config13 = bench_config13(jax)
     config14 = bench_config14(jax)
+    config15 = bench_config15(jax)
     rehearsal = bench_config5_rehearsal(jax)
     out.update(
         {
@@ -2784,6 +2906,7 @@ def main():
             "config12": config12,
             "config13": config13,
             "config14": config14,
+            "config15": config15,
             "config5_rehearsal": rehearsal,
         }
     )

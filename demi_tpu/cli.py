@@ -1603,6 +1603,7 @@ def cmd_fleet(args) -> int:
             max_outstanding=1 if args.serialize_leases else None,
             devices_per_worker=args.devices_per_worker,
             lease_timeout=args.lease_timeout,
+            straggler_factor=args.straggler_factor,
         )
     print(json.dumps(summary))
     _obs_end(args)
@@ -1895,6 +1896,21 @@ def cmd_top(args) -> int:
         args.dir, once=args.once, interval=args.interval,
         window=args.window,
     )
+
+
+def cmd_trace(args) -> int:
+    """Stitch N processes' span sidecars + journals into one clock-
+    aligned Perfetto timeline (obs/distributed.py). Point it at the
+    directories a fleet/service run exported into — typically one
+    shared journal dir — and load the output in ui.perfetto.dev."""
+    from .obs import distributed as dtrace
+
+    if args.action == "stitch":
+        summary = dtrace.stitch(args.dirs, args.output)
+        print(json.dumps(summary))
+        return 0 if summary.get("spans") else 1
+    print(f"unknown trace action {args.action!r}", file=sys.stderr)
+    return 2
 
 
 def _service_workload(args) -> dict:
@@ -2374,6 +2390,13 @@ def main(argv: Optional[list] = None) -> int:
         help="revoke and re-lease a round not returned within S seconds "
              "(re-execution is bit-identical — round inputs are pure)",
     )
+    p.add_argument(
+        "--straggler-factor", type=float, default=4.0,
+        dest="straggler_factor", metavar="K",
+        help="early re-lease a round outstanding longer than K× the "
+             "median completed lease wall (journaled as fleet.straggler; "
+             "0 disables; re-execution is bit-identical)",
+    )
     strict_io_flags(p)
     p.set_defaults(fn=cmd_fleet)
 
@@ -2548,6 +2571,23 @@ def main(argv: Optional[list] = None) -> int:
         help="sliding window (records) for the rate numbers",
     )
     p.set_defaults(fn=cmd_top)
+
+    p = sub.add_parser(
+        "trace",
+        help="distributed-trace tooling: `trace stitch <dirs...>` merges "
+             "every process's span sidecar (spans-*.jsonl) and journal "
+             "into ONE clock-aligned Perfetto timeline",
+    )
+    p.add_argument("action", choices=["stitch"],
+                   help="stitch: merge span sidecars + journals")
+    p.add_argument("dirs", nargs="+",
+                   help="directories holding spans-*.jsonl sidecars "
+                        "(journal records in the same dirs become "
+                        "instant events)")
+    p.add_argument("-o", "--output", default="trace-stitched.json",
+                   help="Perfetto JSON output path "
+                        "(default trace-stitched.json)")
+    p.set_defaults(fn=cmd_trace)
 
     p = sub.add_parser("report", help="markdown report of a saved experiment")
     p.add_argument("-e", "--experiment", required=True)

@@ -51,6 +51,8 @@ from typing import Any, Dict, List, NamedTuple, Optional, Tuple
 import numpy as np
 
 from .. import obs
+from ..obs import distributed as dtrace
+from ..obs import spans as ospans
 from .ledger import ClassLedger, ClassStore
 
 
@@ -138,11 +140,15 @@ class _FleetHandler(socketserver.StreamRequestHandler):
                     reply = co.submit(worker, msg)
                 elif op == "bye":
                     co.worker_bye(worker, msg)
-                    self._send({"op": "ok"})
+                    self._send({"op": "ok", "t_server_us": dtrace.wall_us()})
                     worker = None  # clean exit — nothing to revoke
                     break
                 else:
                     reply = {"op": "error", "error": f"unknown op {op!r}"}
+                # Every reply is server-timestamped so the worker's
+                # per-connection ClockSync can feed its NTP midpoint
+                # from the verbs that already exist.
+                reply["t_server_us"] = dtrace.wall_us()
                 self._send(reply)
         except (OSError, ValueError):
             pass  # dead peer / torn frame: the finally-revoke handles it
@@ -180,6 +186,8 @@ class FleetCoordinator:
         max_outstanding: Optional[int] = None,
         min_ready: int = 1,
         journal_dir: Optional[str] = None,
+        straggler_factor: float = 4.0,
+        span_dir: Optional[str] = None,
     ):
         from ..analysis import SleepSets, StaticIndependence, sleep_cap
         from ..device.dpor_sweep import DeviceDPOR
@@ -233,6 +241,19 @@ class FleetCoordinator:
         if journal_dir and not obs.journal.attached():
             obs.journal.attach(journal_dir)
             self._journal_attached_here = True
+        # Distributed-trace root: every lease and config reply carries a
+        # context derived from it, and finalize() exports the
+        # coordinator's spans next to the journal for `trace stitch`.
+        self.trace = dtrace.TraceContext.root("coordinator")
+        self.span_dir = span_dir or journal_dir or (
+            obs.journal.JOURNAL.root if obs.journal.attached() else None
+        )
+        # Straggler policy: an outstanding lease older than
+        # ``straggler_factor`` x the median completed lease wall is
+        # re-leased early (0 disables). Safe for bit-identity: the first
+        # result in wins and the duplicate is dropped, exactly the
+        # revoke/re-lease path.
+        self.straggler_factor = float(straggler_factor)
 
         self._lock = threading.Lock()
         self.done = threading.Event()
@@ -242,13 +263,18 @@ class FleetCoordinator:
         self._planned = 0
         self._processed = 0
         self._next_lease_id = 0
-        self._outstanding: Dict[int, Tuple[Lease, str, float]] = {}
+        # lease_id -> (lease, worker, deadline, issue monotonic time)
+        self._outstanding: Dict[int, Tuple[Lease, str, float, float]] = {}
         self._requeue: List[Lease] = []
         self._results: Dict[int, Tuple[Lease, Any, float, str]] = {}
         self._found: Optional[Tuple[np.ndarray, int]] = None
         self._stop = False
         self._violating_rounds = 0
         self._releases = 0  # revoked-and-re-leased rounds
+        self._stragglers = 0  # early re-leases from straggler detection
+        self._lease_walls: List[float] = []  # completed issue->result walls
+        self._lease_spans: Dict[int, str] = {}  # lease_id -> span id
+        self._lease_issue_ts: Dict[int, int] = {}  # lease_id -> span-us
         self.workers: Dict[str, Dict[str, Any]] = {}
         self._started = False
         self.wall_t0 = 0.0
@@ -300,6 +326,11 @@ class FleetCoordinator:
             "sleep": self.dpor.sleep is not None,
             "sleep_cap": self.sleep_cap,
             "obs": obs.enabled(),
+            # Distributed tracing: the root context this pod's spans
+            # hang under, and where the worker should export its span
+            # sidecar for `demi_tpu trace stitch`.
+            "trace": self.trace.to_wire(),
+            "span_dir": self.span_dir,
         }
 
     def worker_bye(self, worker: Optional[str], msg: Dict[str, Any]) -> None:
@@ -312,6 +343,11 @@ class FleetCoordinator:
         with self._lock:
             if worker in self.workers:
                 self.workers[worker]["alive"] = False
+        if worker is not None:
+            obs.journal.emit(
+                "fleet.worker", worker=worker, event="bye",
+                clock_offset_us=msg.get("clock_offset_us"),
+            )
 
     def worker_gone(self, worker: str) -> None:
         """Connection died (crash, preemption, kill): revoke the
@@ -321,11 +357,11 @@ class FleetCoordinator:
             if worker in self.workers:
                 self.workers[worker]["alive"] = False
             revoked = [
-                lid for lid, (_l, w, _d) in self._outstanding.items()
-                if w == worker
+                lid for lid, entry in self._outstanding.items()
+                if entry[1] == worker
             ]
             for lid in revoked:
-                lease, _w, _d = self._outstanding.pop(lid)
+                lease = self._outstanding.pop(lid)[0]
                 self._requeue.append(lease)
                 self._releases += 1
             alive = sum(1 for w in self.workers.values() if w["alive"])
@@ -340,14 +376,52 @@ class FleetCoordinator:
     def _check_expired_locked(self) -> None:
         now = time.monotonic()
         expired = [
-            lid for lid, (_l, _w, deadline) in self._outstanding.items()
-            if deadline < now
+            lid for lid, entry in self._outstanding.items()
+            if entry[2] < now
         ]
         for lid in expired:
-            lease, _w, _d = self._outstanding.pop(lid)
+            lease = self._outstanding.pop(lid)[0]
             self._requeue.append(lease)
             self._releases += 1
             obs.counter("fleet.leases_expired").force_inc()
+        self._check_stragglers_locked(now)
+
+    def _check_stragglers_locked(self, now: float) -> None:
+        """Early re-lease for stragglers: an outstanding lease whose
+        wall already exceeds ``straggler_factor`` x the median completed
+        lease wall goes back to the queue (journaled as
+        ``fleet.straggler``) WITHOUT waiting for the full lease timeout.
+        The canonical-order merge stays bit-identical because this is
+        the existing revoke path: whichever result arrives first is
+        accepted and the other is dropped as a duplicate — round inputs
+        are pure, so both results are the same bytes."""
+        if self.straggler_factor <= 0 or len(self._lease_walls) < 5:
+            return
+        walls = sorted(self._lease_walls)
+        median = walls[len(walls) // 2]
+        # Floor the limit: sub-100ms medians on warm CPU rounds must not
+        # turn ordinary scheduling jitter into a re-lease storm.
+        limit = max(self.straggler_factor * median, 0.25)
+        slow = [
+            (lid, entry) for lid, entry in self._outstanding.items()
+            if now - entry[3] > limit
+        ]
+        for lid, (lease, w, _deadline, t_issue) in slow:
+            del self._outstanding[lid]
+            self._requeue.append(lease)
+            self._releases += 1
+            self._stragglers += 1
+            obs.counter("fleet.stragglers").force_inc()
+            obs.journal.emit(
+                "fleet.straggler",
+                worker=w,
+                lease=lid,
+                round=lease.round_no,
+                wall_s=round(now - t_issue, 6),
+                median_s=round(median, 6),
+                factor=self.straggler_factor,
+                leases_outstanding=len(self._outstanding),
+            )
 
     def _finished_locked(self) -> bool:
         if self.done.is_set():
@@ -453,13 +527,21 @@ class FleetCoordinator:
     def _issue_locked(self, lease: Lease, worker: str) -> Dict[str, Any]:
         from ..persist.checkpoint import pack_array
 
+        now = time.monotonic()
         self._outstanding[lease.lease_id] = (
-            lease, worker, time.monotonic() + self.lease_timeout
+            lease, worker, now + self.lease_timeout, now
         )
+        # One span id per lease (kept across re-issues): the worker's
+        # fleet.execute child span links to it via parent_span, and the
+        # coordinator records the covering fleet.lease span at drain.
+        sid = self._lease_spans.setdefault(lease.lease_id, dtrace.new_id(4))
+        self._lease_issue_ts.setdefault(lease.lease_id, ospans.now_us())
         msg = {
             "op": "lease",
             "lease": lease.lease_id,
             "round": lease.round_no,
+            "trace": {"id": self.trace.trace_id, "span": sid,
+                      "actor": "coordinator"},
             "prescs": pack_array(lease.prescs),
             "keys": pack_array(lease.keys),
         }
@@ -492,6 +574,9 @@ class FleetCoordinator:
                 return {"op": "ok", "late": True}
             entry = self._outstanding.pop(lid, None)
             lease = entry[0] if entry is not None else None
+            lease_wall = (
+                time.monotonic() - entry[3] if entry is not None else None
+            )
             if lease is None:
                 # Revoked but not yet re-served? The result is the same
                 # pure computation — accept it and cancel the re-lease.
@@ -514,6 +599,16 @@ class FleetCoordinator:
             ws["rounds"] += 1
             ws["busy_s"] += busy
             ws["interleavings"] += len(lease.batch)
+            if lease_wall is not None:
+                # Per-worker lease latency: the straggler median's input
+                # and the per-WORKER top panel's series.
+                self._lease_walls.append(lease_wall)
+                if len(self._lease_walls) > 512:
+                    del self._lease_walls[:-256]
+                obs.histogram("fleet.lease_seconds").observe(
+                    lease_wall, worker=w
+                )
+            obs.counter("fleet.lease_rounds").inc(worker=w)
             self._drain_locked()
         return {"op": "ok"}
 
@@ -538,6 +633,38 @@ class FleetCoordinator:
             self.dpor._account_device(busy)
             self.dpor._account_host(host_s)
             self.dpor.round_index += 1
+            # Coordinator half of the distributed lease span: issue to
+            # drain, on a per-lease track (issue and drain happen on
+            # different handler threads, so the stack-disciplined
+            # context manager cannot cover it). The worker's
+            # fleet.execute child links back via parent_span.
+            sid = self._lease_spans.pop(lease.lease_id, None)
+            issue_ts = self._lease_issue_ts.pop(lease.lease_id, None)
+            if obs.enabled() and issue_ts is not None:
+                ospans.record_span(
+                    "fleet.lease", issue_ts,
+                    ospans.now_us() - issue_ts,
+                    0x4000 | (lease.lease_id & 0x3FFF),
+                    worker=worker, lease=lease.lease_id,
+                    round=lease.round_no, trace_id=self.trace.trace_id,
+                    span_id=sid, parent_span=self.trace.span_id,
+                )
+            # Per-node ledger/frontier byte footprints (packed int32
+            # wire form): the fleet-frontier growth alarm for runs where
+            # prescription counts reach millions.
+            frontier_bytes = ledger_bytes = None
+            if obs.enabled() or obs.journal.JOURNAL is not None:
+                row_bytes = 4 * self.cfg.rec_width
+                frontier_bytes = row_bytes * (
+                    sum(len(p) for p in self._gen)
+                    + sum(len(p) for p in self._pending)
+                )
+                obs.gauge("fleet.frontier_bytes").force_set(frontier_bytes)
+                if self.dpor.sleep is not None:
+                    ledger_bytes = row_bytes * sum(
+                        len(c) for c in self.dpor.sleep.classes
+                    )
+                    obs.gauge("fleet.ledger_bytes").force_set(ledger_bytes)
             if obs.journal.JOURNAL is not None:
                 lr = self.dpor._last_round
                 obs.journal.emit(
@@ -569,6 +696,8 @@ class FleetCoordinator:
                         1 for w in self.workers.values() if w["alive"]
                     ),
                     leases_outstanding=len(self._outstanding),
+                    frontier_bytes=frontier_bytes,
+                    ledger_bytes=ledger_bytes,
                 )
             if hit is not None:
                 if self._found is None:
@@ -584,7 +713,7 @@ class FleetCoordinator:
         ledger, and return the run summary."""
         with self._lock:
             leftovers = sorted(
-                [l for l, _w, _d in self._outstanding.values()]
+                [entry[0] for entry in self._outstanding.values()]
                 + self._requeue,
                 key=lambda l: l.round_no,
             )
@@ -593,6 +722,10 @@ class FleetCoordinator:
             self._outstanding.clear()
             self._requeue.clear()
         wall_s = time.perf_counter() - self.wall_t0 if self._started else 0.0
+        if obs.enabled() and self.span_dir:
+            # The stitcher's coordinator input (offset 0: the
+            # coordinator IS the fleet's reference clock).
+            dtrace.export_process(self.span_dir, "coordinator")
         if self._journal_attached_here:
             obs.journal.detach()
             self._journal_attached_here = False
@@ -663,6 +796,7 @@ class FleetCoordinator:
                 round(aggregate, 2) if aggregate is not None else None
             ),
             "leases_reissued": self._releases,
+            "stragglers": self._stragglers,
         }
         if sleep is not None:
             summary["classes"] = len(sleep.classes)
@@ -697,6 +831,7 @@ def run_fleet(
     devices_per_worker: int = 1,
     seed_prescription=None,
     lease_timeout: float = 120.0,
+    straggler_factor: float = 4.0,
     worker_env: Optional[Dict[str, Dict[str, str]]] = None,
     timeout: float = 900.0,
 ) -> Dict[str, Any]:
@@ -721,7 +856,7 @@ def run_fleet(
         warm_start=warm_start, stop_on_violation=stop_on_violation,
         target_code=target_code, lease_timeout=lease_timeout,
         max_outstanding=max_outstanding, min_ready=workers,
-        journal_dir=journal_dir,
+        journal_dir=journal_dir, straggler_factor=straggler_factor,
     )
     if seed_prescription is not None:
         co.dpor.seed(tuple(tuple(r) for r in seed_prescription))

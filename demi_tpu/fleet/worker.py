@@ -48,6 +48,7 @@ def _recv(f) -> Optional[Dict[str, Any]]:
 
 
 def run_worker(addr: str, worker_id: str) -> int:
+    from ..obs import distributed as dtrace
     from ..persist.supervisor import SUPERVISOR
 
     host, _, port = addr.rpartition(":")
@@ -58,12 +59,27 @@ def run_worker(addr: str, worker_id: str) -> int:
         label="fleet.connect",
     )
     f = sock.makefile("rwb")
-    _send(f, {"op": "hello", "worker": worker_id})
-    cfg_msg = _recv(f)
+    # Per-connection clock sync: every request is sender-stamped, every
+    # coordinator reply is server-stamped, and the NTP midpoint of the
+    # tightest exchange estimates (coordinator clock - local clock) —
+    # what `trace stitch` shifts this worker's spans by.
+    sync = dtrace.ClockSync()
+
+    def rpc(msg: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+        msg["t_sent_us"] = dtrace.wall_us()
+        _send(f, msg)
+        reply = _recv(f)
+        if reply is not None:
+            sync.observe(msg["t_sent_us"], reply.get("t_server_us"))
+        return reply
+
+    cfg_msg = rpc({"op": "hello", "worker": worker_id})
     if cfg_msg is None or cfg_msg.get("op") != "config":
         print(f"fleet worker {worker_id}: bad config {cfg_msg!r}",
               file=sys.stderr)
         return 4
+    trace_parent = dtrace.TraceContext.from_wire(cfg_msg.get("trace"))
+    span_dir = cfg_msg.get("span_dir")
 
     import jax
     import numpy as np
@@ -152,8 +168,7 @@ def run_worker(addr: str, worker_id: str) -> int:
     die_after = int(os.environ.get("DEMI_FLEET_DIE_AFTER", "0") or 0)
     served = 0
     while True:
-        _send(f, {"op": "next", "worker": worker_id})
-        msg = _recv(f)
+        msg = rpc({"op": "next", "worker": worker_id})
         if msg is None or msg.get("op") == "shutdown":
             break
         if msg.get("op") == "wait":
@@ -173,14 +188,25 @@ def run_worker(addr: str, worker_id: str) -> int:
         keys = unpack_array(msg["keys"])
         sleeps = unpack_array(msg["sleeps"]) if "sleeps" in msg else None
         sfrom = unpack_array(msg["sfrom"]) if "sfrom" in msg else None
+        # Child span under the propagated lease context: the stitched
+        # timeline shows this execute inside the coordinator's
+        # fleet.lease span, linked by trace_id/parent_span.
+        lease_ctx = (
+            dtrace.TraceContext.from_wire(msg.get("trace")) or trace_parent
+        )
+        span_args = lease_ctx.span_args() if lease_ctx is not None else {}
         t0 = time.perf_counter()
-        res = execute(prescs, keys, sleeps, sfrom)
+        with obs.span(
+            "fleet.execute", worker=worker_id, lease=msg["lease"],
+            round=msg.get("round"), **span_args,
+        ):
+            res = execute(prescs, keys, sleeps, sfrom)
         busy = time.perf_counter() - t0
         obs.counter("fleet.worker_rounds").inc(worker=worker_id)
         obs.gauge("fleet.worker_busy_seconds").set(
             round(busy, 6), worker=worker_id
         )
-        _send(f, {
+        ack = rpc({
             "op": "result",
             "worker": worker_id,
             "lease": msg["lease"],
@@ -190,10 +216,21 @@ def run_worker(addr: str, worker_id: str) -> int:
                 for field in type(res)._fields
             },
         })
-        ack = _recv(f)
         if ack is None:
             break
+    if obs.enabled() and span_dir:
+        # Span sidecar for `demi_tpu trace stitch`, clock-shifted onto
+        # the coordinator's timeline by the measured offset.
+        try:
+            dtrace.export_process(
+                span_dir, f"worker-{worker_id}",
+                clock_offset_us=sync.offset_us(),
+            )
+        except OSError:
+            pass
     bye: Dict[str, Any] = {"op": "bye", "worker": worker_id}
+    if sync.samples:
+        bye["clock_offset_us"] = round(sync.offset_us(), 3)
     if obs.enabled():
         bye["obs"] = obs.REGISTRY.snapshot()
     try:

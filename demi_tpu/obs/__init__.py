@@ -27,17 +27,22 @@ The continuous half (telemetry OVER TIME, not just at exit):
   - ``profiler``: per-launch wall attribution (trunk vs lane vs
     harvest; dispatch vs block) keyed by launch shape, persisted in
     TuningCache-compatible evidence form (``--profile-rounds N`` adds a
-    jax.profiler trace window).
+    jax.profiler trace window);
+  - ``distributed``: pod-wide tracing — trace contexts propagated over
+    the fleet/service wire, per-connection clock-offset estimation, and
+    the ``demi_tpu trace stitch`` merger that joins N processes' span
+    files + journals into one clock-aligned Perfetto timeline.
 
 Measured overhead of journal + time series always-on: < 1% of round
 wall on the deep raft frontier (``bench --config 11``).
 """
 
-from . import journal, profiler, timeseries  # noqa: F401
+from . import distributed, journal, profiler, timeseries  # noqa: F401
 from .metrics import (  # noqa: F401
     REGISTRY,
     MetricsRegistry,
     counter,
+    describe,
     disable,
     enable,
     enabled,
@@ -47,7 +52,7 @@ from .metrics import (  # noqa: F401
     relabel_snapshot,
     timed,
 )
-from .spans import TRACER, Tracer, span  # noqa: F401
+from .spans import TRACER, Tracer, record_span, span  # noqa: F401
 
 __all__ = [
     "REGISTRY",
@@ -55,7 +60,9 @@ __all__ = [
     "TRACER",
     "Tracer",
     "counter",
+    "describe",
     "disable",
+    "distributed",
     "enable",
     "enabled",
     "gauge",
@@ -63,6 +70,7 @@ __all__ = [
     "journal",
     "merge_snapshots",
     "profiler",
+    "record_span",
     "relabel_snapshot",
     "span",
     "timed",

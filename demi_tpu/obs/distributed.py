@@ -1,0 +1,334 @@
+"""Pod-wide distributed tracing: trace contexts on the wire, NTP-style
+clock-offset estimation, and the cross-process stitcher behind
+``demi_tpu trace stitch``.
+
+Every per-process observability surface (spans, journal, Prometheus)
+stays exactly as it was; this module adds the three pieces that join
+them across processes:
+
+  - **TraceContext** — (trace id, span id, actor identity) propagated
+    over the existing line-JSON verbs: the fleet coordinator's hello
+    config and every lease carry one, the service client attaches one
+    to each submitted job, and the receiving side opens child spans
+    under the propagated parent (``trace_id`` / ``parent_span`` span
+    args), so a lease executed on worker w1 links back to the
+    coordinator span that issued it.
+
+  - **ClockSync** — a per-connection clock-offset estimator riding the
+    verbs that already exist: each request stamps ``t_sent_us`` (sender
+    wall µs), each reply stamps ``t_server_us`` (receiver wall µs), and
+    the NTP midpoint ``offset = t_server - (t_sent + t_recv)/2`` from
+    the minimum-RTT exchange estimates how far the peer's clock is
+    ahead.  Workers accumulate one per coordinator connection; the
+    offset is written into the span-file meta so the stitcher can shift
+    that process onto the coordinator's clock.
+
+  - **stitch** — merges N processes' span JSONL sidecars (written by
+    ``export_process``: one meta line carrying pid / process name /
+    wall-clock epoch anchor / clock offset, then one finished span per
+    line) plus any round journals in the same directories into ONE
+    clock-aligned Perfetto ``trace_event`` document: per-process
+    ``process_name`` metadata events, absolute-µs timestamps, journal
+    records as instant events.  Loadable in ui.perfetto.dev.
+
+Timestamp model: spans record µs from a per-process ``perf_counter``
+epoch; ``spans.epoch_unix_us()`` anchors that epoch to the wall clock,
+and the per-process clock offset (measured against the coordinator)
+aligns wall clocks across hosts — so
+
+    aligned_us = epoch_unix_us + span.ts + clock_offset_us
+
+places every span of every process on the coordinator's timeline.  On
+one host the offsets measure ~0 and the anchors already agree; across
+hosts the midpoint estimate bounds the error by half the minimum RTT.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+import socket
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from . import journal as _journal
+from . import spans as _spans
+
+
+def wall_us() -> int:
+    """Wall-clock microseconds (unix epoch) — the wire timestamp unit."""
+    return time.time_ns() // 1000
+
+
+def new_id(nbytes: int = 8) -> str:
+    return os.urandom(nbytes).hex()
+
+
+class TraceContext:
+    """One hop of a distributed trace: which trace, which parent span,
+    and who is speaking. Serialized as a small dict on the line-JSON
+    verbs (``to_wire`` / ``from_wire``); ``child`` derives the context a
+    callee propagates further."""
+
+    __slots__ = ("trace_id", "span_id", "parent_span", "actor")
+
+    def __init__(self, trace_id: str, span_id: str, actor: str,
+                 parent_span: str = ""):
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.actor = actor
+        self.parent_span = parent_span
+
+    @classmethod
+    def root(cls, actor: str) -> "TraceContext":
+        return cls(new_id(8), new_id(4), actor)
+
+    def child(self, actor: str) -> "TraceContext":
+        return TraceContext(self.trace_id, new_id(4), actor,
+                            parent_span=self.span_id)
+
+    def to_wire(self) -> Dict[str, str]:
+        wire = {"id": self.trace_id, "span": self.span_id,
+                "actor": self.actor}
+        if self.parent_span:
+            wire["parent"] = self.parent_span
+        return wire
+
+    @classmethod
+    def from_wire(cls, wire: Optional[Dict[str, Any]]) -> Optional["TraceContext"]:
+        if not wire or not isinstance(wire, dict):
+            return None
+        return cls(
+            str(wire.get("id", "")), str(wire.get("span", "")),
+            str(wire.get("actor", "")), parent_span=str(wire.get("parent", "")),
+        )
+
+    def span_args(self) -> Dict[str, str]:
+        """The args a child span opened under this context carries —
+        the link the stitched timeline is greppable by."""
+        args = {"trace_id": self.trace_id, "parent_span": self.span_id}
+        if self.actor:
+            args["parent_actor"] = self.actor
+        return args
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return (f"TraceContext(trace={self.trace_id!r}, "
+                f"span={self.span_id!r}, actor={self.actor!r})")
+
+
+class ClockSync:
+    """Per-connection NTP-style offset estimator over request/response
+    pairs.  ``observe`` feeds one exchange; the estimate kept is the
+    midpoint offset of the minimum-RTT exchange seen so far (the sample
+    with the tightest error bound: |error| <= rtt/2)."""
+
+    def __init__(self):
+        self.samples = 0
+        self._best_rtt_us: Optional[float] = None
+        self._offset_us = 0.0
+
+    def observe(self, t_sent_us: Optional[float],
+                t_server_us: Optional[float],
+                t_recv_us: Optional[float] = None) -> None:
+        if not t_sent_us or not t_server_us:
+            return
+        if t_recv_us is None:
+            t_recv_us = wall_us()
+        rtt = max(0.0, float(t_recv_us) - float(t_sent_us))
+        offset = float(t_server_us) - (float(t_sent_us) + float(t_recv_us)) / 2.0
+        self.samples += 1
+        if self._best_rtt_us is None or rtt <= self._best_rtt_us:
+            self._best_rtt_us = rtt
+            self._offset_us = offset
+
+    def offset_us(self) -> float:
+        """Best estimate of (peer clock − local clock), microseconds."""
+        return self._offset_us
+
+    def rtt_us(self) -> Optional[float]:
+        return self._best_rtt_us
+
+
+# ---------------------------------------------------------------------------
+# Per-process span export (the stitcher's input format)
+# ---------------------------------------------------------------------------
+
+_SAFE_RE = re.compile(r"[^A-Za-z0-9_.-]")
+
+
+def export_process(root: str, process: str, clock_offset_us: float = 0.0,
+                   tracer: Optional[_spans.Tracer] = None) -> str:
+    """Write this process's finished spans to
+    ``<root>/spans-<process>.jsonl``: one meta header line (pid, process
+    name, host, wall-clock epoch anchor, clock offset vs the trace
+    root), then one span per line with the B/E operation ids the
+    stitcher tie-breaks zero-width spans by. Returns the path."""
+    tracer = tracer or _spans.TRACER
+    os.makedirs(root, exist_ok=True)
+    path = os.path.join(root, f"spans-{_SAFE_RE.sub('_', process)}.jsonl")
+    meta = {
+        "meta": {
+            "process": process,
+            "pid": os.getpid(),
+            "host": socket.gethostname(),
+            "epoch_unix_us": _spans.epoch_unix_us(),
+            "clock_offset_us": round(float(clock_offset_us), 3),
+            "dropped_spans": tracer.dropped,
+        }
+    }
+    with open(path, "w") as f:
+        f.write(json.dumps(meta, separators=(",", ":")) + "\n")
+        for s in list(tracer.spans):
+            f.write(json.dumps(s, separators=(",", ":")) + "\n")
+    return path
+
+
+def read_process(path: str) -> Tuple[Dict[str, Any], List[Dict[str, Any]]]:
+    """Parse one ``spans-*.jsonl`` sidecar (torn tail lines skipped —
+    a crashed process's partial flush must not fail the whole stitch)."""
+    meta: Dict[str, Any] = {}
+    spans: List[Dict[str, Any]] = []
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if "meta" in rec and isinstance(rec["meta"], dict):
+                    meta = rec["meta"]
+                elif "ts" in rec:
+                    spans.append(rec)
+    except OSError:
+        pass
+    return meta, spans
+
+
+# ---------------------------------------------------------------------------
+# Stitcher
+# ---------------------------------------------------------------------------
+
+def _span_files(target: str) -> List[str]:
+    if os.path.isdir(target):
+        return sorted(glob.glob(os.path.join(target, "spans-*.jsonl")))
+    return [target] if os.path.exists(target) else []
+
+
+def stitch_doc(targets: Sequence[str]) -> Dict[str, Any]:
+    """Merge every ``spans-*.jsonl`` (and any round journal) under the
+    given directories/files into one clock-aligned Perfetto trace_event
+    document. See the module doc for the timestamp model."""
+    events: List[Tuple[Tuple, Dict[str, Any]]] = []
+    meta_events: List[Dict[str, Any]] = []
+    used_pids: Dict[int, str] = {}
+    processes: List[Dict[str, Any]] = []
+    n_spans = 0
+    n_journal = 0
+
+    def alloc_pid(want: int, process: str) -> int:
+        if want and used_pids.get(want, process) == process:
+            used_pids[want] = process
+            return want
+        # Synthetic pids live above 100000 so they can't collide with a
+        # real pid read from a later file.
+        pid = 1 + max(100000, *used_pids) if used_pids else 100001
+        used_pids[pid] = process
+        return pid
+
+    seen_dirs: List[str] = []
+    span_paths: List[str] = []
+    for target in targets:
+        if os.path.isdir(target) and target not in seen_dirs:
+            seen_dirs.append(target)
+        for path in _span_files(target):
+            if path not in span_paths:
+                span_paths.append(path)
+
+    for idx, path in enumerate(span_paths):
+        meta, spans = read_process(path)
+        process = str(meta.get("process")
+                      or os.path.basename(path)[len("spans-"):-len(".jsonl")])
+        pid = alloc_pid(int(meta.get("pid") or 0), process)
+        shift = (float(meta.get("epoch_unix_us") or 0)
+                 + float(meta.get("clock_offset_us") or 0.0))
+        meta_events.extend(
+            _spans.process_metadata_events(pid, process, sort_index=idx)
+        )
+        processes.append({
+            "process": process, "pid": pid, "spans": len(spans),
+            "clock_offset_us": float(meta.get("clock_offset_us") or 0.0),
+            "dropped_spans": int(meta.get("dropped_spans") or 0),
+        })
+        n_spans += len(spans)
+        for s in spans:
+            b_ts = int(round(s["ts"] + shift))
+            e_ts = int(round(s["ts"] + s.get("dur", 0) + shift))
+            base = {"name": s["name"], "pid": pid, "tid": s.get("tid", 0),
+                    "cat": "demi"}
+            events.append((
+                (b_ts, idx, s.get("op_b", 0), 0),
+                {**base, "ph": "B", "ts": b_ts, "args": s.get("args", {})},
+            ))
+            events.append((
+                (e_ts, idx, s.get("op_e", 1), 1),
+                {**base, "ph": "E", "ts": e_ts},
+            ))
+
+    # Journal records become instant events on their own track — the
+    # round/chunk/frame cadence drawn against the span timeline.
+    for jdx, d in enumerate(seen_dirs):
+        records = _journal.read_records(d)
+        if not records:
+            continue
+        name = f"journal:{os.path.basename(os.path.normpath(d)) or d}"
+        jpid = alloc_pid(0, name)
+        meta_events.extend(_spans.process_metadata_events(
+            jpid, name, sort_index=len(span_paths) + jdx
+        ))
+        processes.append({"process": name, "pid": jpid,
+                          "journal_records": len(records)})
+        for rec in records:
+            ts = int(round(float(rec.get("t", 0.0)) * 1e6))
+            args = {k: v for k, v in rec.items()
+                    if k not in ("t", "seq", "inc", "kind")}
+            events.append((
+                (ts, len(span_paths) + jdx, rec.get("seq", 0), 0),
+                {"name": rec.get("kind", "journal"), "ph": "i", "s": "p",
+                 "pid": jpid, "tid": 0, "cat": "demi.journal", "ts": ts,
+                 "args": args},
+            ))
+        n_journal += len(records)
+
+    events.sort(key=lambda pair: pair[0])
+    return {
+        "traceEvents": meta_events + [e for _k, e in events],
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "producer": "demi_tpu.obs.distributed",
+            "processes": processes,
+            "spans": n_spans,
+            "journal_records": n_journal,
+        },
+    }
+
+
+def stitch(targets: Sequence[str], out_path: str) -> Dict[str, Any]:
+    """``demi_tpu trace stitch``: write the merged document and return a
+    summary ({"out", "processes", "spans", "journal_records",
+    "events"})."""
+    doc = stitch_doc(targets)
+    with open(out_path, "w") as f:
+        json.dump(doc, f)
+    other = doc["otherData"]
+    return {
+        "out": out_path,
+        "processes": [p["process"] for p in other["processes"]],
+        "spans": other["spans"],
+        "journal_records": other["journal_records"],
+        "events": len(doc["traceEvents"]),
+    }

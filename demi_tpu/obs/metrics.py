@@ -395,3 +395,48 @@ def histogram(name: str) -> Histogram:
 
 def timed(name: str, **labels) -> _Timed:
     return REGISTRY.timed(name, **labels)
+
+
+# ---------------------------------------------------------------------------
+# Metric descriptions (Prometheus HELP text)
+# ---------------------------------------------------------------------------
+
+#: Curated HELP text for the well-known metric families; anything not
+#: listed gets a name-derived description (see ``description``). Call
+#: sites registering a new metric can ``describe(...)`` it here.
+_DESCRIPTIONS: Dict[str, str] = {
+    "dpor.rounds": "DPOR frontier rounds executed",
+    "dpor.violations_found": "violating interleavings found by DPOR search",
+    "dpor.host_seconds": "host-side derivation wall seconds",
+    "dpor.host_share": "fraction of round wall spent on the host half",
+    "dpor.round_seconds": "wall seconds per DPOR round",
+    "fleet.worker_rounds": "leased rounds executed, per worker",
+    "fleet.worker_busy_seconds": "device-busy seconds of the last lease, per worker",
+    "fleet.lease_seconds": "lease wall seconds issue-to-result, per worker",
+    "fleet.leases_expired": "leases revoked at the deadline and re-queued",
+    "fleet.leases_revoked": "leases revoked from dead workers and re-queued",
+    "fleet.stragglers": "leases re-leased early by straggler detection",
+    "fleet.frontier_bytes": "coordinator frontier footprint, packed int32 bytes",
+    "fleet.ledger_bytes": "coordinator class-ledger footprint, packed int32 bytes",
+    "service.slo.queue_age_s": "violation-frame age from enqueue to finish, per tenant",
+    "service.slo.ttf_mcs_s": "time from job submit to its first MCS, per tenant",
+    "service.slo.launch_utilization": "tenant share of the fleet's device launches",
+    "persist.corrupt_fallbacks": "corrupt persisted segments skipped at load",
+    "obs.journal_write_errors": "round-journal appends that failed and detached it",
+}
+
+
+def describe(name: str, text: str) -> None:
+    """Register HELP text for a metric name (rendered by
+    ``timeseries.prom_text``)."""
+    _DESCRIPTIONS[name] = text
+
+
+def description(name: str) -> str:
+    """HELP text for a metric: registry-supplied if described, else
+    derived from the name (dots/underscores become spaces — enough for
+    Grafana's metric browser to read sensibly)."""
+    text = _DESCRIPTIONS.get(name)
+    if text:
+        return text
+    return name.replace("_", " ").replace(".", " ") + " (demi_tpu)"

@@ -33,6 +33,11 @@ from . import metrics as _metrics
 _lock = threading.Lock()
 _local = threading.local()
 _EPOCH_NS = time.perf_counter_ns()
+# Wall-clock anchor of the span timebase: the unix microsecond that span
+# ts 0 corresponds to. Captured in the same instant as _EPOCH_NS so
+# cross-process stitching (obs/distributed.py) can place every process's
+# spans on one absolute timeline: wall_us = _EPOCH_UNIX_US + span.ts.
+_EPOCH_UNIX_US = time.time_ns() // 1000
 # Global operation counter ticked at every span enter AND exit: within a
 # thread it orders B/E events exactly as they happened, which is the only
 # tie-break that stays correct for zero-width (sub-microsecond) spans.
@@ -41,6 +46,26 @@ _ops = itertools.count()
 
 def _now_us() -> int:
     return (time.perf_counter_ns() - _EPOCH_NS) // 1000
+
+
+def now_us() -> int:
+    """Current time in the span timebase (µs since the process epoch)."""
+    return _now_us()
+
+
+def epoch_unix_us() -> int:
+    """Unix µs corresponding to span timestamp 0 in this process."""
+    return _EPOCH_UNIX_US
+
+
+def record_span(name: str, ts: int, dur: int, tid: int, **args: Any) -> None:
+    """Record one already-finished span directly into TRACER — for spans
+    whose begin and end happen on different threads (a fleet lease is
+    issued on one handler thread and drained on another), where the
+    stack-disciplined ``span(...)`` context manager cannot apply. The
+    B/E operation ids are allocated here, so the export tie-break still
+    orders the pair correctly against zero-width neighbours."""
+    TRACER.record(name, ts, max(0, dur), tid, next(_ops), next(_ops), args)
 
 
 class Tracer:
@@ -108,9 +133,17 @@ class Tracer:
         events.sort(key=lambda e: e.pop("_ord"))
         return events
 
-    def export_perfetto(self, path: str) -> None:
+    def export_perfetto(self, path: str, process: str = None) -> None:
+        """Write the Chrome trace_event document. With ``process`` set,
+        the event stream is prefixed with a ``process_name`` metadata
+        ("M") event so multi-process viewers label this pid — the
+        single-process export stays metadata-free (its event count is a
+        pinned contract)."""
+        events = self.to_trace_events()
+        if process is not None:
+            events = process_metadata_events(os.getpid(), process) + events
         doc = {
-            "traceEvents": self.to_trace_events(),
+            "traceEvents": events,
             "displayTimeUnit": "ms",
             "otherData": {
                 "producer": "demi_tpu.obs",
@@ -119,6 +152,22 @@ class Tracer:
         }
         with open(path, "w") as f:
             json.dump(doc, f)
+
+
+def process_metadata_events(pid: int, process: str,
+                            sort_index: int = None) -> List[Dict[str, Any]]:
+    """Perfetto process-metadata ("M") events naming one pid's track —
+    what makes a stitched multi-process timeline readable."""
+    events: List[Dict[str, Any]] = [{
+        "name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+        "cat": "__metadata", "args": {"name": process},
+    }]
+    if sort_index is not None:
+        events.append({
+            "name": "process_sort_index", "ph": "M", "pid": pid, "tid": 0,
+            "cat": "__metadata", "args": {"sort_index": sort_index},
+        })
+    return events
 
 
 #: The process-wide tracer (CLI --trace-out exports it on exit).

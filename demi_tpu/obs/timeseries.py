@@ -221,25 +221,39 @@ def _prom_labels(key: str, extra=()) -> str:
     ) + "}"
 
 
+def _help_line(pname: str, name: str) -> str:
+    """``# HELP`` per the text exposition format — backslash and
+    newline escaped (HELP text, unlike label values, keeps its
+    double-quotes)."""
+    text = _metrics.description(name).replace("\\", "\\\\").replace(
+        "\n", "\\n"
+    )
+    return f"# HELP {pname} {text}"
+
+
 def prom_text(snapshot: Dict[str, Any]) -> str:
     """Render a registry snapshot (``MetricsRegistry.snapshot()`` shape)
-    in the Prometheus text exposition format: counters as ``_total``,
-    gauges as-is, histograms as cumulative ``_bucket{le=...}`` series
-    plus ``_sum``/``_count`` — the format `demi_tpu stats --prom` prints
-    and ``--metrics-port`` serves (pinned by tests/test_obs.py)."""
+    in the Prometheus text exposition format: ``HELP``/``TYPE`` headers
+    per family, counters as ``_total``, gauges as-is, histograms as
+    cumulative ``_bucket{le=...}`` series plus ``_sum``/``_count`` — the
+    format `demi_tpu stats --prom` prints and ``--metrics-port`` serves
+    (pinned by tests/test_obs.py)."""
     lines: List[str] = []
     for name, series in sorted(snapshot.get("counters", {}).items()):
         pname = _prom_name(name) + "_total"
+        lines.append(_help_line(pname, name))
         lines.append(f"# TYPE {pname} counter")
         for key, v in sorted(series.items()):
             lines.append(f"{pname}{_prom_labels(key)} {_num(v)}")
     for name, series in sorted(snapshot.get("gauges", {}).items()):
         pname = _prom_name(name)
+        lines.append(_help_line(pname, name))
         lines.append(f"# TYPE {pname} gauge")
         for key, v in sorted(series.items()):
             lines.append(f"{pname}{_prom_labels(key)} {_num(v)}")
     for name, series in sorted(snapshot.get("histograms", {}).items()):
         pname = _prom_name(name)
+        lines.append(_help_line(pname, name))
         lines.append(f"# TYPE {pname} histogram")
         for key, rec in sorted(series.items()):
             bounds = rec.get("le") or list(_metrics._BUCKETS)

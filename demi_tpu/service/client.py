@@ -15,6 +15,7 @@ import json
 import socket
 from typing import Any, Dict, List, Optional
 
+from ..obs import distributed as dtrace
 from ..persist.supervisor import SUPERVISOR
 from .server import unpack_payload
 
@@ -42,6 +43,11 @@ class ServiceClient:
             label="service.connect",
         )
         self._f = self._sock.makefile("rwb")
+        # Distributed tracing: one trace per client connection; each
+        # submitted job carries a child context the daemon's spans hang
+        # under. The clock sync feeds off every request/reply pair.
+        self.trace = dtrace.TraceContext.root("client")
+        self.clock = dtrace.ClockSync()
 
     def close(self) -> None:
         try:
@@ -58,12 +64,14 @@ class ServiceClient:
 
     # -- wire ----------------------------------------------------------------
     def request(self, msg: Dict[str, Any]) -> Dict[str, Any]:
+        msg.setdefault("t_sent_us", dtrace.wall_us())
         self._f.write((json.dumps(msg) + "\n").encode())
         self._f.flush()
         line = self._f.readline()
         if not line:
             raise ServiceError("service closed the connection")
         reply = json.loads(line)
+        self.clock.observe(msg["t_sent_us"], reply.get("t_server_us"))
         if reply.get("op") == "error":
             raise ServiceError(
                 reply.get("error", "unknown error"),
@@ -94,6 +102,7 @@ class ServiceClient:
             "max_frames": max_frames,
             "weight": weight,
             "wildcards": wildcards,
+            "trace": self.trace.child("client").to_wire(),
         })
 
     def jobs(self, tenant: Optional[str] = None) -> List[Dict[str, Any]]:

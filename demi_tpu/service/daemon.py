@@ -43,6 +43,8 @@ import time
 from typing import Any, Callable, Dict, List, Optional
 
 from .. import obs
+from ..obs import distributed as dtrace
+from ..obs import spans as ospans
 from ..pipeline.queue import ViolationQueue
 from .batching import ServiceGroup, workload_key
 from .jobs import (
@@ -98,6 +100,12 @@ class ExplorationService:
         self._next_job = 0
         self.incarnation = 0
         self._resumed = False
+        # Distributed tracing: the daemon's root context — client-
+        # submitted jobs link their own contexts under it in the
+        # stitched timeline — and per-frame enqueue wall times (the
+        # queue-age SLO's basis, keyed "namespace:seed").
+        self.trace = dtrace.TraceContext.root("service")
+        self._enqueue_t: Dict[str, float] = {}
         self._shutdown = False
         self._drain = False
         self.state: Dict[str, Any] = {
@@ -144,6 +152,7 @@ class ExplorationService:
         max_frames: Optional[int] = None,
         weight: float = 1.0,
         wildcards: bool = True,
+        trace: Optional[Dict[str, Any]] = None,
     ) -> Dict[str, Any]:
         """Admit one job. Registers the tenant on first contact (its
         fingerprint pinned to this workload's); REFUSES a submission
@@ -185,13 +194,26 @@ class ExplorationService:
                 max_frames=max_frames,
                 wildcards=wildcards,
             )
-            job = ServiceJob(spec=spec, tenant=t)
+            ctx = dtrace.TraceContext.from_wire(trace)
+            job = ServiceJob(spec=spec, tenant=t, trace=trace)
             self.jobs[job_id] = job
             obs.journal.emit(
                 "service.job", tenant=tenant, job=job_id, event="submit",
                 lanes=spec.lanes, chunk=spec.chunk,
                 base_key=spec.base_key, max_frames=spec.max_frames,
+                trace_id=ctx.trace_id if ctx is not None else None,
             )
+            if obs.enabled():
+                # Zero-width admission span under the client's
+                # propagated context — the stitched timeline's handoff
+                # point from client to daemon.
+                ts = ospans.now_us()
+                ospans.record_span(
+                    "service.submit", ts, 0, 0x7000 | (hash(job_id) & 0xFFF),
+                    tenant=tenant, job=job_id,
+                    **(ctx.span_args() if ctx is not None
+                       else self.trace.span_args()),
+                )
             return job.summary(self.queue)
 
     # -- engine --------------------------------------------------------------
@@ -407,6 +429,31 @@ class ExplorationService:
         t.note("frames_done")
         t.note("mcs_externals", len(gamut_result.mcs_externals))
         t.note_gauge("queue_depth", self.queue.depth_of(job.namespace))
+        # Per-tenant SLOs, labeled series riding merged_snapshot() into
+        # the Prometheus exposition: queue age (enqueue -> minimized)
+        # and time-to-first-MCS.
+        queue_age = None
+        enq_t = self._enqueue_t.pop(
+            f"{job.namespace}:{int(frame.seed)}", None
+        )
+        if enq_t is not None:
+            queue_age = round(max(0.0, time.time() - enq_t), 6)
+            t.note_gauge("slo.queue_age_s", queue_age)
+        if job.ttf_mcs_s is not None:
+            t.note_gauge("slo.ttf_mcs_s", job.ttf_mcs_s)
+        if obs.enabled():
+            # Minimization span for the stitched timeline, linked to the
+            # submitting client's trace when the job carried one.
+            ctx = dtrace.TraceContext.from_wire(job.trace)
+            dur = int(wall_s * 1e6)
+            ospans.record_span(
+                "service.frame", max(0, ospans.now_us() - dur), dur,
+                0x7000 | (hash(job.namespace) & 0xFFF),
+                tenant=job.spec.tenant, job=job.spec.job_id,
+                seed=int(frame.seed),
+                **(ctx.span_args() if ctx is not None
+                   else self.trace.span_args()),
+            )
         obs.journal.emit(
             "service.frame",
             round=self.state["frames_done"],
@@ -420,6 +467,7 @@ class ExplorationService:
             queue_depth=self.queue.depth,
             tenant_frames=t.frames_done,
             ttf_mcs_s=job.ttf_mcs_s,
+            queue_age_s=queue_age,
         )
         self._job_done_check(job)
         if not self._boundary("frame"):
@@ -431,6 +479,7 @@ class ExplorationService:
             frame = self.queue.offer(seed, code, namespace=job.namespace)
             if frame is None:
                 return  # resume re-retirement: already queued/minimized
+            self._enqueue_t[f"{job.namespace}:{int(seed)}"] = time.time()
             job.enqueued += 1
             job.tenant.violations += 1
             job.tenant.note("violations")
@@ -458,6 +507,19 @@ class ExplorationService:
         self.state["chunks"] += 1
         for job in {j.spec.job_id: j for j, _ in entries}.values():
             self._job_done_check(job)
+        # Launch-budget utilization SLO: each tenant's share of the
+        # lanes dispatched so far (labeled gauge -> Prometheus).
+        with self._lock:
+            charged = {
+                name: sum(t.budget.dispatched.values())
+                for name, t in self.tenants.items()
+            }
+        total = sum(charged.values())
+        if total > 0:
+            for name, c in charged.items():
+                self.tenants[name].note_gauge(
+                    "slo.launch_utilization", round(c / total, 6)
+                )
         obs.journal.emit(
             "service.chunk",
             round=self.state["chunks"],

@@ -250,6 +250,9 @@ class ServiceJob:
     # compile-count equivalent the savings accounting compares against.
     checker_shapes: set = field(default_factory=set)
     lifted: bool = False
+    # Distributed-trace context of the submitting client (volatile —
+    # a resumed job starts a fresh trace hop).
+    trace: Optional[Dict[str, Any]] = None
 
     @property
     def namespace(self) -> str:

@@ -33,6 +33,7 @@ import zlib
 from typing import Any, Dict, Optional
 
 from .. import obs
+from ..obs import distributed as dtrace
 from .daemon import ExplorationService
 from .jobs import ServiceRefusal
 
@@ -70,7 +71,11 @@ class _ServiceHandler(socketserver.StreamRequestHandler):
                 except ValueError:
                     self._send({"op": "error", "error": "bad json"})
                     continue
-                self._send(daemon.handle_request(msg))
+                reply = daemon.handle_request(msg)
+                # Server-stamped replies feed the client's per-
+                # connection ClockSync (the fleet wire's NTP midpoint).
+                reply.setdefault("t_server_us", dtrace.wall_us())
+                self._send(reply)
         except OSError:
             pass  # dead peer: nothing to clean up, requests are stateless
 
@@ -127,6 +132,7 @@ class ServiceDaemon:
                     max_frames=msg.get("max_frames"),
                     weight=float(msg.get("weight", 1.0)),
                     wildcards=bool(msg.get("wildcards", True)),
+                    trace=msg.get("trace"),
                 )
                 return {"op": "ok", **job}
             if op == "jobs":
@@ -222,6 +228,13 @@ class ServiceDaemon:
                         svc.checkpoint()
                         break
                     time.sleep(poll_s)
+        if obs.enabled() and svc.state_dir is not None:
+            # Span sidecar next to the journal: `demi_tpu trace stitch
+            # <state_dir>` joins the daemon onto the pod timeline.
+            try:
+                dtrace.export_process(svc.state_dir, "service")
+            except OSError:
+                pass
         if self._journal_attached_here:
             obs.journal.detach()
             self._journal_attached_here = False

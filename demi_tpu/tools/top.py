@@ -164,6 +164,19 @@ def render_frame(
     list; a one-shot call reads the journal fully."""
     if records is None:
         records = _journal.read_records(root)
+    # Narrow-terminal mode: below 60 columns the fixed 20/10-char bars
+    # plus their labels wrap, which turns the frame into soup — shrink
+    # the bars proportionally and hard-truncate every emitted line to
+    # the terminal width. Wide terminals keep today's exact layout.
+    narrow = width < 60
+    barw = 20 if not narrow else max(4, width // 4)
+    miniw = 10 if not narrow else max(3, width // 8)
+
+    def _done(ls: List[str]) -> str:
+        if narrow:
+            ls = [ln[:width] for ln in ls]
+        return "\n".join(ls) + "\n"
+
     lines: List[str] = []
     title = f"demi_tpu top — {root}"
     lines.append(title)
@@ -171,7 +184,7 @@ def render_frame(
     if not records:
         lines.append("(no journal records yet — is the run writing to "
                       f"{os.path.join(root, _journal.JOURNAL_NAME)}?)")
-        return "\n".join(lines) + "\n"
+        return _done(lines)
 
     t0 = records[0].get("t")
     t_last = records[-1].get("t")
@@ -190,7 +203,8 @@ def render_frame(
         "dpor.round": "dpor", "minimize.level": "minimize",
         "minimize.stage": "minimize", "pipeline.enqueue": "pipeline",
         "pipeline.frame": "pipeline", "fleet.round": "fleet",
-        "fleet.worker": "fleet", "service.chunk": "service",
+        "fleet.worker": "fleet", "fleet.straggler": "fleet",
+        "service.chunk": "service",
         "service.frame": "service", "service.enqueue": "service",
         "service.job": "service", "service.tenant": "service",
     }
@@ -208,7 +222,7 @@ def render_frame(
         lines.append(
             "tiers (last %d records, interleaved): " % len(recent)
             + "  ".join(
-                f"{t} [{_bar(counts[t] / total, 10)}] {counts[t]}"
+                f"{t} [{_bar(counts[t] / total, miniw)}] {counts[t]}"
                 for t in active_tiers
             )
         )
@@ -228,7 +242,7 @@ def render_frame(
         lines.append(f"DPOR  round {last.get('round')}  "
                      f"rounds/sec {_fmt(rps)}  "
                      f"batch {last.get('batch')}  depth {last.get('depth')}")
-        lines.append(f"  host share   [{_bar(share)}] {_fmt(share, '.1%')}"
+        lines.append(f"  host share   [{_bar(share, barw)}] {_fmt(share, '.1%')}"
                      f"  ({host:.2f}s host / {dev:.2f}s device)")
         lines.append(f"  frontier {last.get('frontier')}  "
                      f"explored {last.get('explored')}  "
@@ -237,7 +251,7 @@ def render_frame(
         lines.append(f"  admissions (last {len(recent_d)} rounds): "
                      f"{fresh} fresh / {redundant} redundant / "
                      f"{pruned} pruned "
-                     f"[{_bar(fresh / denom)}]")
+                     f"[{_bar(fresh / denom, barw)}]")
         extras = []
         if last.get("redundancy_ratio") is not None:
             extras.append(f"redundancy ratio {last['redundancy_ratio']}")
@@ -310,13 +324,49 @@ def render_frame(
             if per:
                 lines.append(
                     "  rounds by worker: " + "  ".join(
-                        f"{w} [{_bar(n / total_r, 10)}] {n}"
+                        f"{w} [{_bar(n / total_r, miniw)}] {n}"
                         for w, n in sorted(per.items())
                     )
+                )
+            # Per-worker lease health: mean lease wall over the window
+            # (the fleet.round records carry the coordinator-side wall
+            # per lease) — the at-a-glance straggler scan.
+            per_wall: Dict[str, List[float]] = {}
+            for r in recent_f:
+                if r.get("wall_s") is not None:
+                    per_wall.setdefault(
+                        str(r.get("worker")), []
+                    ).append(r["wall_s"])
+            if per_wall:
+                lines.append(
+                    "  lease wall by worker: " + "  ".join(
+                        f"{w} {sum(v) / len(v):.3f}s×{len(v)}"
+                        for w, v in sorted(per_wall.items())
+                    )
+                )
+            # Per-node byte footprint gauges from the round records.
+            fb = fleet[-1].get("frontier_bytes")
+            lb = fleet[-1].get("ledger_bytes")
+            if fb is not None or lb is not None:
+                lines.append(
+                    "  footprint: frontier "
+                    f"{_fmt(None if fb is None else fb / 1024.0, '.1f', ' KiB')}"
+                    "  class ledger "
+                    f"{_fmt(None if lb is None else lb / 1024.0, '.1f', ' KiB')}"
                 )
             warm = fleet[-1].get("warm_skips")
             if warm:
                 lines.append(f"  warm-start skips {warm}")
+        strag = [r for r in records
+                 if r.get("kind") == "fleet.straggler"]
+        if strag:
+            last_s = strag[-1]
+            lines.append(
+                f"  stragglers re-leased {len(strag)}  last: worker "
+                f"{last_s.get('worker')} wall "
+                f"{_fmt(last_s.get('wall_s'), '.2f', 's')} vs median "
+                f"{_fmt(last_s.get('median_s'), '.2f', 's')}"
+            )
 
     sweep = [r for r in records if r.get("kind") == "sweep.chunk"]
     if sweep:
@@ -439,7 +489,7 @@ def render_frame(
             total_f = sum(per.values())
             lines.append(
                 "  MCSes by tenant: " + "  ".join(
-                    f"{t} [{_bar(_ratio(n, total_f), 10)}] {n}"
+                    f"{t} [{_bar(_ratio(n, total_f), miniw)}] {n}"
                     for t, n in sorted(per.items())
                 )
             )
@@ -452,11 +502,28 @@ def render_frame(
             )
             mph = _ratio(len(recent_fr) * 3600.0, span)
             lines.append(f"  MCSes/hour (window) {_fmt(mph, '.1f')}")
+            # Per-tenant SLO line: time-to-first-MCS (first frame that
+            # reported one) and the freshest queue age per tenant.
+            slo: Dict[str, Dict[str, Any]] = {}
+            for r in svc_frames:
+                d = slo.setdefault(str(r.get("tenant")), {})
+                if r.get("ttf_mcs_s") is not None and "ttf" not in d:
+                    d["ttf"] = r["ttf_mcs_s"]
+                if r.get("queue_age_s") is not None:
+                    d["age"] = r["queue_age_s"]
+            if any(slo.values()):
+                lines.append(
+                    "  SLO by tenant: " + "  ".join(
+                        f"{t} ttf-mcs {_fmt(d.get('ttf'), '.2f', 's')}"
+                        f" queue-age {_fmt(d.get('age'), '.2f', 's')}"
+                        for t, d in sorted(slo.items())
+                    )
+                )
 
     lines.append("")
     lines.append(f"last record: {time.strftime('%H:%M:%S', time.localtime(t_last))}"
                  if t_last else "")
-    return "\n".join(lines) + "\n"
+    return _done(lines)
 
 
 def run_top(

@@ -261,3 +261,138 @@ def test_fleet_journal_and_top_panel(tmp_path):
     assert "leases outstanding 1" in frame
     assert "rounds by worker" in frame
     assert "warm-start skips 2" in frame
+
+
+def test_straggler_early_release_is_journaled(tmp_path):
+    """Straggler policy unit: with >=5 completed lease walls, an
+    outstanding lease older than factor x median (floored at 0.25s) is
+    revoked back to the queue, counted, and journaled as
+    fleet.straggler — while a young lease survives the same scan."""
+    import time as _time
+
+    from demi_tpu.fleet.coordinator import FleetCoordinator, Lease
+    from demi_tpu.obs import journal
+
+    app, cfg, program = build_fleet_workload(WORKLOAD)
+    co = FleetCoordinator(
+        app, cfg, program, workload=WORKLOAD, batch_size=8,
+        max_rounds=2, journal_dir=str(tmp_path), straggler_factor=4.0,
+    )
+    try:
+        co._lease_walls = [0.01, 0.012, 0.009, 0.011, 0.01]
+        now = _time.monotonic()
+        slow = Lease(7, 3, [("x",)], 1, None, None, None, None)
+        young = Lease(8, 4, [("y",)], 1, None, None, None, None)
+        co._outstanding[7] = (slow, "w0", now + 120.0, now - 1.0)
+        co._outstanding[8] = (young, "w1", now + 120.0, now - 0.01)
+        with co._lock:
+            co._check_expired_locked()
+        assert co._stragglers == 1
+        assert [le.lease_id for le in co._requeue] == [7]
+        assert 7 not in co._outstanding and 8 in co._outstanding
+        # The deadline-expiry path was NOT what fired.
+        assert co._releases == 1
+    finally:
+        co.close()
+        if co._journal_attached_here:
+            obs.journal.detach()
+    recs = journal.read_records(str(tmp_path), kind="fleet.straggler")
+    assert len(recs) == 1
+    rec = recs[0]
+    assert rec["worker"] == "w0" and rec["round"] == 3 and rec["lease"] == 7
+    assert rec["wall_s"] >= 0.25  # the re-lease floor
+    assert rec["median_s"] == pytest.approx(0.01)
+    assert rec["factor"] == 4.0
+
+
+def test_fleet_tracing_stitch_smoke(tmp_path):
+    """Tier-1 smoke for `demi_tpu trace stitch`: a 2-worker fleet run
+    with telemetry on exports span sidecars for the coordinator and
+    every worker next to the journal; the stitcher merges them into ONE
+    valid Perfetto document — per-process metadata, globally monotonic
+    clock-aligned timestamps, bracket-valid B/E per (pid, tid) — with
+    each worker's fleet.execute span linked to (and inside) the
+    coordinator's fleet.lease span for the same round."""
+    import json as _json
+
+    from demi_tpu.obs import distributed as dtrace
+
+    d = str(tmp_path / "run")
+    obs.REGISTRY.reset()
+    obs.TRACER.clear()
+    obs.enable()
+    try:
+        s = run_fleet(
+            WORKLOAD, workers=2, batch=8, rounds=3,
+            journal_dir=d, timeout=420.0,
+        )
+    finally:
+        obs.disable()
+        obs.REGISTRY.reset()
+        obs.TRACER.clear()
+    assert s["rounds"] >= 1
+
+    out = str(tmp_path / "pod.json")
+    summary = dtrace.stitch([d], out)
+    procs = set(summary["processes"])
+    assert "coordinator" in procs
+    assert {"worker-w0", "worker-w1"} <= procs
+    assert summary["spans"] > 0 and summary["journal_records"] > 0
+
+    doc = _json.loads(open(out).read())
+    events = doc["traceEvents"]
+    named = {
+        e["args"]["name"] for e in events
+        if e.get("ph") == "M" and e["name"] == "process_name"
+    }
+    assert {"coordinator", "worker-w0", "worker-w1"} <= named
+    be = [e for e in events if e.get("ph") in ("B", "E")]
+    last = -1
+    stacks = {}
+    for e in be:
+        assert e["ts"] >= last  # clock-aligned merge is ts-monotonic
+        last = e["ts"]
+        st = stacks.setdefault((e["pid"], e["tid"]), [])
+        if e["ph"] == "B":
+            st.append(e["name"])
+        else:
+            assert st and st.pop() == e["name"]
+    assert all(not st for st in stacks.values())
+    assert any(e.get("ph") == "i" for e in events)  # journal records
+
+    # Parent/child linkage + containment, from the sidecars (they carry
+    # span intervals directly). Same-host wall anchors agree to ~ms;
+    # the slack absorbs scheduling noise, not clock skew.
+    meta_c, spans_c = dtrace.read_process(
+        os.path.join(d, "spans-coordinator.jsonl")
+    )
+    shift_c = meta_c["epoch_unix_us"] + meta_c["clock_offset_us"]
+    leases = {
+        sp["args"]["round"]: sp for sp in spans_c
+        if sp["name"] == "fleet.lease"
+    }
+    assert leases
+    trace_ids = {sp["args"]["trace_id"] for sp in leases.values()}
+    assert len(trace_ids) == 1  # one pod-wide trace root
+    slack = 250_000.0  # us
+    execs = 0
+    for w in ("w0", "w1"):
+        meta_w, spans_w = dtrace.read_process(
+            os.path.join(d, f"spans-worker-{w}.jsonl")
+        )
+        shift_w = meta_w["epoch_unix_us"] + meta_w["clock_offset_us"]
+        for sp in spans_w:
+            if sp["name"] != "fleet.execute":
+                continue
+            rnd = sp["args"]["round"]
+            if rnd not in leases:
+                continue
+            execs += 1
+            lease = leases[rnd]
+            assert sp["args"]["trace_id"] == lease["args"]["trace_id"]
+            assert sp["args"]["parent_span"] == lease["args"]["span_id"]
+            b = lease["ts"] + shift_c
+            e_ = lease["ts"] + lease["dur"] + shift_c
+            assert sp["ts"] + shift_w >= b - slack
+            assert sp["ts"] + sp["dur"] + shift_w <= e_ + slack
+    assert execs >= 1

@@ -3,6 +3,7 @@ merge, span nesting, Perfetto export validity, and device LaneStats
 agreement with host-side sweep accounting."""
 
 import json
+import os
 
 import numpy as np
 import pytest
@@ -805,3 +806,241 @@ def test_launch_profiler_ledger_and_tuningcache_evidence(tmp_path):
     p2.enabled = False
     p2.dispatch("x", 8, 1.0)
     assert p2.evidence()["launches"] == []
+
+
+# ---------------------------------------------------------------------------
+# Distributed tracing (obs/distributed.py)
+# ---------------------------------------------------------------------------
+
+def test_trace_context_wire_round_trip():
+    from demi_tpu.obs import distributed as dtrace
+
+    root = dtrace.TraceContext.root("coordinator")
+    child = root.child("worker")
+    assert child.trace_id == root.trace_id
+    assert child.parent_span == root.span_id
+    # Wire form survives a JSON hop (what the lease/submit verbs carry).
+    back = dtrace.TraceContext.from_wire(json.loads(json.dumps(child.to_wire())))
+    assert back.trace_id == root.trace_id
+    assert back.span_id == child.span_id
+    assert back.parent_span == root.span_id
+    assert back.actor == "worker"
+    args = back.span_args()
+    assert args["trace_id"] == root.trace_id
+    assert args["parent_span"] == child.span_id
+    # Absent/garbage wire contexts degrade to None, never raise.
+    assert dtrace.TraceContext.from_wire(None) is None
+    assert dtrace.TraceContext.from_wire({}) is None
+
+
+def test_clock_sync_keeps_min_rtt_midpoint():
+    from demi_tpu.obs import distributed as dtrace
+
+    sync = dtrace.ClockSync()
+    assert sync.offset_us() == 0.0
+    # Loose exchange: rtt 4000us, midpoint offset +1000us.
+    sync.observe(10_000, 13_000, t_recv_us=14_000)
+    assert sync.offset_us() == pytest.approx(1000.0)
+    # Tighter exchange wins: rtt 1000us, offset +2500us.
+    sync.observe(20_000, 23_000, t_recv_us=21_000)
+    assert sync.offset_us() == pytest.approx(2500.0)
+    assert sync.rtt_us() == pytest.approx(1000.0)
+    # A looser later sample must not override the best estimate.
+    sync.observe(30_000, 99_000, t_recv_us=40_000)
+    assert sync.offset_us() == pytest.approx(2500.0)
+    assert sync.samples == 3
+    # Un-stamped replies (an old peer) are ignored.
+    sync.observe(None, None)
+    assert sync.samples == 3
+
+
+def test_export_stitch_clock_aligned_multiprocess(telemetry, tmp_path):
+    """Two span sidecars (one with a synthetic clock offset) plus a
+    journal stitch into ONE Perfetto doc: per-process metadata events,
+    globally monotonic timestamps, bracket-valid B/E per (pid, tid),
+    journal records as instant events, offsets applied exactly."""
+    from demi_tpu.obs import distributed as dtrace
+    from demi_tpu.obs import journal
+
+    d = str(tmp_path)
+    with obs.span("fleet.lease", round=1):
+        with obs.span("admit"):
+            pass
+    dtrace.export_process(d, "coordinator")
+    obs.TRACER.clear()
+    with obs.span("fleet.execute", round=1):
+        pass
+    raw_exec_ts = obs.TRACER.spans[0]["ts"]
+    dtrace.export_process(d, "worker-w0", clock_offset_us=250.0)
+    j = journal.RoundJournal(d)
+    j.emit("dpor.round", round=1, wall_s=0.01)
+    j.close()
+
+    out = str(tmp_path / "stitched.json")
+    summary = dtrace.stitch([d], out)
+    assert {"coordinator", "worker-w0"} <= set(summary["processes"])
+    assert any(p.startswith("journal:") for p in summary["processes"])
+    assert summary["spans"] == 3
+    assert summary["journal_records"] == 1
+
+    doc = json.loads(open(out).read())
+    events = doc["traceEvents"]
+    named = {
+        e["args"]["name"] for e in events
+        if e["ph"] == "M" and e["name"] == "process_name"
+    }
+    assert {"coordinator", "worker-w0"} <= named
+    # Distinct processes from ONE test process get distinct pids.
+    pids = {e["pid"] for e in events if e["ph"] in ("B", "E")}
+    assert len(pids) == 2
+    be = [e for e in events if e["ph"] in ("B", "E")]
+    last = -1
+    stacks = {}
+    for e in be:
+        assert e["ts"] >= last
+        last = e["ts"]
+        st = stacks.setdefault((e["pid"], e["tid"]), [])
+        if e["ph"] == "B":
+            st.append(e["name"])
+        else:
+            assert st and st.pop() == e["name"]
+    assert all(not st for st in stacks.values())
+    # The worker's clock offset is applied to its aligned timestamps.
+    exec_b = next(
+        e for e in be if e["name"] == "fleet.execute" and e["ph"] == "B"
+    )
+    assert exec_b["ts"] == int(round(
+        raw_exec_ts + obs_spans.epoch_unix_us() + 250.0
+    ))
+    inst = [e for e in events if e["ph"] == "i"]
+    assert len(inst) == 1
+    assert inst[0]["s"] == "p" and inst[0]["name"] == "dpor.round"
+
+
+def test_prom_text_help_lines(telemetry):
+    """Satellite: every TYPE line is preceded by a HELP line — curated
+    text for described metrics, name-derived fallback otherwise."""
+    from demi_tpu.obs.timeseries import prom_text
+
+    obs.counter("dpor.rounds").inc(3)
+    obs.gauge("custom.thing").set(1.0)
+    obs.describe("custom.described", "words chosen by the caller")
+    obs.counter("custom.described").inc()
+    obs.histogram("dpor.round_seconds").observe(0.5)
+    lines = prom_text(obs.REGISTRY.snapshot()).splitlines()
+    assert (
+        "# HELP demi_dpor_rounds_total DPOR frontier rounds executed"
+        in lines
+    )
+    assert (
+        "# HELP demi_custom_described_total words chosen by the caller"
+        in lines
+    )
+    assert "# HELP demi_custom_thing custom thing (demi_tpu)" in lines
+    assert any(
+        line.startswith("# HELP demi_dpor_round_seconds ") for line in lines
+    )
+    for i, line in enumerate(lines):
+        if line.startswith("# TYPE"):
+            pname = line.split()[2]
+            assert lines[i - 1].startswith(f"# HELP {pname} "), (
+                lines[i - 1], line,
+            )
+
+
+def test_truncate_from_across_rotated_segments(tmp_path):
+    """Satellite: resume truncation when the drop point lies in the
+    ROTATED segment — rewrite_segments must rewrite BOTH files, and the
+    journal stays contiguous + seq-monotonic after re-emitting."""
+    from demi_tpu.obs import journal
+
+    j = journal.RoundJournal(str(tmp_path), max_bytes=700)
+    for i in range(10):
+        j.emit("dpor.round", round=i + 1, pad="x" * 40)
+    j.close()
+    # The tiny bound forced exactly one rotation: both segments hold
+    # records, and rounds > 4 live in BOTH files.
+    assert os.path.exists(j.path + ".1")
+    rot_rounds = [
+        rec["round"] for _, rec in journal._read_lines(j.path + ".1")
+    ]
+    live_rounds = [
+        rec["round"] for _, rec in journal._read_lines(j.path)
+    ]
+    assert rot_rounds and live_rounds
+    assert max(rot_rounds) > 4 and max(live_rounds) > 4
+
+    dropped = j.truncate_from("dpor.round", 4)
+    assert dropped == 6  # rounds 5..10, split across the two segments
+    # The rotated segment itself was rewritten, not just the live file.
+    assert all(
+        rec["round"] <= 4 for _, rec in journal._read_lines(j.path + ".1")
+    )
+    rounds = [
+        r["round"] for r in journal.read_records(str(tmp_path), "dpor.round")
+    ]
+    assert rounds == [1, 2, 3, 4]
+    for r in (5, 6):
+        j.emit("dpor.round", round=r)
+    j.close()
+    recs = journal.read_records(str(tmp_path))
+    ok, rounds = journal.contiguous_rounds(recs, "dpor.round")
+    assert ok and rounds == [1, 2, 3, 4, 5, 6]
+    seqs = [r["seq"] for r in recs]
+    assert seqs == sorted(seqs) and len(set(seqs)) == len(seqs)
+    # rewrite_segments is the shared machinery: an arbitrary filter
+    # applied across both segments reports exactly what it dropped.
+    dropped = journal.rewrite_segments(
+        j.path, lambda rec: rec.get("round", 0) % 2 == 0
+    )
+    assert dropped == 3  # rounds 1, 3 from .1 / live split, plus 5
+    rounds = [
+        r["round"] for r in journal.read_records(str(tmp_path), "dpor.round")
+    ]
+    assert rounds == [2, 4, 6]
+
+
+def test_top_narrow_terminal_clamps_width(tmp_path):
+    """Satellite: render_frame below 60 columns shrinks the bars and
+    truncates every line to the terminal width; wide frames keep the
+    full layout, including the fleet health + tenant SLO lines."""
+    from demi_tpu.obs import journal
+    from demi_tpu.tools.top import render_frame
+
+    d = str(tmp_path / "run")
+    j = journal.RoundJournal(d)
+    for i in range(6):
+        j.emit(
+            "dpor.round", round=i + 1, wall_s=0.05, host_s=0.02,
+            device_s=0.03, frontier=4, depth=2, fresh=3, redundant=1,
+            distance_pruned=0, violations=[], explored=5 + i,
+            interleavings=8 * (i + 1), batch=8,
+        )
+    for i in range(3):
+        j.emit(
+            "fleet.round", round=i + 1, worker=f"w{i % 2}", wall_s=0.04,
+            batch=8, classes=5, explored=9, frontier=3, workers_alive=2,
+            leases_outstanding=0, frontier_bytes=2048, ledger_bytes=1024,
+        )
+    j.emit(
+        "fleet.straggler", worker="w0", lease=7, round=9, wall_s=1.5,
+        median_s=0.05, factor=4.0, leases_outstanding=0,
+    )
+    j.emit(
+        "service.frame", tenant="acme", job="j1", seed=1, wall_s=0.2,
+        ttf_mcs_s=1.25, queue_age_s=0.4, queue_depth=0,
+        mcs_externals=2, deliveries=3,
+    )
+    j.close()
+
+    wide = render_frame(d, window=10, width=72)
+    assert "stragglers re-leased 1" in wide
+    assert "lease wall by worker" in wide
+    assert "footprint: frontier 2.0 KiB" in wide
+    assert "class ledger 1.0 KiB" in wide
+    assert "SLO by tenant: acme ttf-mcs 1.25s queue-age 0.40s" in wide
+    assert any(len(line) > 40 for line in wide.splitlines())
+
+    narrow = render_frame(d, window=10, width=40)
+    assert all(len(line) <= 40 for line in narrow.splitlines())
+    assert "FLEET" in narrow and "DPOR" in narrow
