@@ -71,8 +71,11 @@ Extra keys reported for the record:
 Modes: `python bench.py` runs everything; `--config 2` / `--config 3` /
 `--config 4` / `--config 5` / `--config 6` / `--config 7` /
 `--config 8` / `--config 9` / `--config 10` / `--config 11` /
-`--config 12` / `--config rehearsal` run a single section (same
-one-line JSON with that key populated).
+`--config 12` / `--config 13` / `--config 14` / `--config 15` /
+`--config 16` / `--config rehearsal` run a single section (same
+one-line JSON with that key populated). Config 16 A/Bs the
+digest-range-sharded coordinator host half (fleet/shard.py) at 1/2/4
+admission shards, asserting bit-identity at every point.
 
 DEMI_AUTOTUNE=1 lets the measurement-guided tuner (demi_tpu/tune) pick
 the rehearsal drive's (kernel variant, batch, segment) from short
@@ -2436,6 +2439,260 @@ def bench_config15(jax):
     }
 
 
+def bench_config16(jax):
+    """Sharded coordinator host half (demi_tpu/fleet/shard): the
+    config-13 deep seeded raft frontier drained at 1/2/4 admission
+    shards — the per-round racing scan + static/sleep filter + digest
+    dedup partitioned by prescription content-digest range and run
+    concurrently, with a serial canonical merge that keeps every
+    explored/class/violation set, the frontier, and the first-found
+    record bit-identical to the 1-shard pipeline.
+
+    Headline: **host-half rounds/sec vs shard count** under the
+    uncontended shared-core convention (DEMI_HOST_SHARD_SERIALIZE=1:
+    each shard's scan+dedup timed sequentially and billed as
+    ``busy/n`` — capacity, not time-slicing contention; the serial
+    merge always counts at wall; at 1 shard the metric is the plain
+    measured wall). Hard contracts, asserted per point:
+
+      - full search identity (explored set AND log order, frontier
+        order, digest sets, class ledger, violation codes, wakeup
+        guides, first-found bytes) bit-identical to 1 shard;
+      - an N→M re-sharded resume: one 2-shard checkpoint restored into
+        1/2/4 shards, each continued — all three final states (and the
+        source instance's own continuation) bit-identical;
+      - a kill-mid-lease fleet run (2 workers x 2 host shards, one
+        worker dies after its first lease) bit-identical to the
+        single-process baseline, with at least one lease re-issued.
+
+    Knobs: DEMI_BENCH_CONFIG16_ROUNDS / _BATCH / _SHARDS ("1,2,4") /
+    _BUDGET / _SEEDS / _DEPTH_CAP / _MSGS / _STRICT / _FLEET /
+    _FLEET_ROUNDS."""
+    import hashlib
+
+    from demi_tpu.analysis import SleepSets, StaticIndependence, sleep_cap
+    from demi_tpu.device.dpor_sweep import (
+        DeviceDPOR,
+        make_dpor_kernel,
+        steering_prescription,
+    )
+    from demi_tpu.fleet import build_fleet_workload, run_fleet, set_digest
+    from demi_tpu.schedulers import RandomScheduler
+    from demi_tpu.apps.common import make_host_invariant
+    from demi_tpu.config import SchedulerConfig
+    from demi_tpu.fleet.shard import HostHalfTimer
+
+    nodes, commands = 3, 3
+    rounds = int(os.environ.get("DEMI_BENCH_CONFIG16_ROUNDS", 10))
+    batch = int(os.environ.get("DEMI_BENCH_CONFIG16_BATCH", 16))
+    shard_counts = [
+        int(s)
+        for s in os.environ.get(
+            "DEMI_BENCH_CONFIG16_SHARDS", "1,2,4"
+        ).split(",")
+    ]
+    budget = int(os.environ.get("DEMI_BENCH_CONFIG16_BUDGET", 240))
+    seeds = int(os.environ.get("DEMI_BENCH_CONFIG16_SEEDS", 40))
+    depth_cap = int(os.environ.get("DEMI_BENCH_CONFIG16_DEPTH_CAP", 120))
+    msgs = int(os.environ.get("DEMI_BENCH_CONFIG16_MSGS", 160))
+    strict = os.environ.get("DEMI_BENCH_CONFIG16_STRICT", "1") != "0"
+    fleet_on = os.environ.get("DEMI_BENCH_CONFIG16_FLEET", "1") != "0"
+    fleet_rounds = int(os.environ.get("DEMI_BENCH_CONFIG16_FLEET_ROUNDS", 6))
+
+    workload = {
+        "app": "raft", "nodes": nodes, "bug": "multivote",
+        "commands": commands, "max_messages": msgs, "pool": 256,
+        "num_events": 12,
+    }
+    app, cfg, program = build_fleet_workload(workload)
+    config = SchedulerConfig(invariant_check=make_host_invariant(app))
+
+    # Seed a deep violating schedule (the config-13 frontier shape).
+    fr, best = None, -1
+    for seed in range(seeds):
+        r = RandomScheduler(
+            config, seed=seed, max_messages=budget,
+            invariant_check_interval=1,
+        ).execute(program)
+        if r.violation is None:
+            continue
+        depth = len(r.trace.deliveries())
+        if depth <= depth_cap and depth > best:
+            fr, best = r, depth
+    if fr is None:  # pragma: no cover - multivote violates reliably
+        return {"error": "no violation found to seed the frontier"}
+    trace = fr.trace
+    trace.set_original_externals(list(program))
+    presc = steering_prescription(app, cfg, trace, program)
+
+    rel = StaticIndependence.for_app(app)
+    cap = sleep_cap()
+    # Shared sleep-mode kernel (cap > 0 builds the sleep variant): every
+    # instance in the A/B compiles nothing after the first.
+    kernel = make_dpor_kernel(
+        app, cfg, sleep_cap=cap, commute_matrix=rel.device_matrix(),
+    )
+
+    def make(n):
+        return DeviceDPOR(
+            app, cfg, program, batch_size=batch, prefix_fork=False,
+            double_buffer=False, kernel=kernel,
+            sleep_sets=SleepSets(independence=rel, prune=False, cap=cap),
+            host_shards=n,
+        )
+
+    def identity(d, found):
+        # Full bit-identity, not just coverage: log ORDER, frontier
+        # ORDER, digest sets, and the found record's bytes all count.
+        return (
+            frozenset(d.explored), tuple(d._explored_log),
+            tuple(d.frontier), frozenset(d._explored_digests),
+            frozenset(d._suppressed_digests),
+            tuple(sorted(d.violation_codes)),
+            frozenset(d.sleep.classes), d.interleavings,
+            None if found is None else found[0][: found[1]].tobytes(),
+        )
+
+    def close_sharder(d):
+        sharder = getattr(d, "_sharder", None)
+        if sharder is not None:
+            sharder.close()
+
+    # -- the A/B curve: uncontended host-half rounds/sec per shard count
+    prev_serialize = os.environ.get("DEMI_HOST_SHARD_SERIALIZE")
+    os.environ["DEMI_HOST_SHARD_SERIALIZE"] = "1"
+    curve = []
+    ident1 = rate1 = None
+    try:
+        for n in shard_counts:
+            d = make(n)
+            d.seed(presc)
+            # Warm-up round: compiles the kernel and seeds the frontier
+            # outside the timed window (the timer only bills the host
+            # half, but the first round's allocations are noise too).
+            d.explore(max_rounds=1, stop_on_violation=False)
+            timer = HostHalfTimer(d)
+            found = d.explore(max_rounds=rounds, stop_on_violation=False)
+            rate = timer.rounds_per_sec()
+            ident = identity(d, found)
+            close_sharder(d)
+            if ident1 is None:
+                ident1, rate1 = ident, rate
+            bit_match = ident == ident1
+            assert bit_match, (
+                f"host shards={n} diverged from the 1-shard pipeline"
+            )
+            curve.append({
+                "shards": n,
+                "rounds": timer.rounds,
+                "host_seconds": round(timer.uncontended_seconds(), 4),
+                "host_rounds_per_sec": round(rate, 2),
+                "host_x": round(rate / rate1, 3) if rate1 else None,
+                "bit_match": bit_match,
+            })
+    finally:
+        if prev_serialize is None:
+            os.environ.pop("DEMI_HOST_SHARD_SERIALIZE", None)
+        else:
+            os.environ["DEMI_HOST_SHARD_SERIALIZE"] = prev_serialize
+    scaling = {str(pt["shards"]): pt["host_x"] for pt in curve}
+    if strict:
+        for pt in curve:
+            # Acceptance floors: >=1.6x at 2 shards, >=2.5x at 4 — the
+            # parallel sections dominate the host half and the serial
+            # merge stays cheap (dups skip in bulk).
+            floor = {2: 1.6, 4: 2.5}.get(pt["shards"])
+            if floor is not None and pt["host_x"] is not None:
+                assert pt["host_x"] >= floor, (
+                    f"host-shard scaling at {pt['shards']} below target",
+                    pt["host_x"], floor,
+                )
+
+    # -- N -> M re-sharded resume: one 2-shard checkpoint restored into
+    # every shard count; all continuations must land bit-identical
+    # (checkpoints serialize digests FLAT, so restore re-partitions).
+    r1 = max(1, rounds // 2)
+    r2 = max(1, rounds - r1)
+    src = make(2)
+    src.seed(presc)
+    src.explore(max_rounds=r1, stop_on_violation=False)
+    payload = src.checkpoint_state()
+    reshard_ident = None
+    for n in shard_counts:
+        dm = make(n)
+        dm.restore_state(payload)
+        found = dm.explore(max_rounds=r2, stop_on_violation=False)
+        ident = identity(dm, found)
+        close_sharder(dm)
+        if reshard_ident is None:
+            reshard_ident = ident
+        assert ident == reshard_ident, (
+            f"2->{n} re-sharded resume diverged"
+        )
+    found = src.explore(max_rounds=r2, stop_on_violation=False)
+    assert identity(src, found) == reshard_ident, (
+        "re-sharded resumes diverged from the source instance"
+    )
+    close_sharder(src)
+
+    # -- kill-mid-lease fleet parity at 2 host shards: the sharded
+    # coordinator host half under re-lease churn must still match the
+    # single-process baseline bit-for-bit.
+    fleet_block = None
+    if fleet_on:
+        base = make(1)
+        base.seed(presc)
+        bfound = base.explore(max_rounds=fleet_rounds, stop_on_violation=False)
+        s = run_fleet(
+            workload, workers=2, batch=batch, rounds=fleet_rounds,
+            seed_prescription=presc, max_outstanding=1, host_shards=2,
+            worker_env={"w0": {"DEMI_FLEET_DIE_AFTER": "1"}},
+            timeout=900.0,
+        )
+        base_found_sha = (
+            hashlib.sha256(
+                bfound[0][: bfound[1]].tobytes()
+            ).hexdigest()[:16]
+            if bfound is not None
+            else None
+        )
+        fleet_block = {
+            "workers": 2,
+            "host_shards": 2,
+            "rounds": s["rounds"],
+            "leases_reissued": s["leases_reissued"],
+            "worker_returncodes": s["worker_returncodes"],
+            "coverage_match": (
+                s["explored_sha"] == set_digest(base.explored)
+                and s["classes_sha"] == set_digest(base.sleep.classes)
+            ),
+            "violations_match": (
+                s["violation_codes"] == sorted(base.violation_codes)
+            ),
+            "first_found_match": s["first_found_sha"] == base_found_sha,
+        }
+        assert fleet_block["coverage_match"], (
+            "sharded fleet coverage diverged under kill-mid-lease"
+        )
+        assert fleet_block["violations_match"]
+        assert fleet_block["first_found_match"]
+        assert 17 in s["worker_returncodes"], s["worker_returncodes"]
+        assert s["leases_reissued"] >= 1, s["leases_reissued"]
+
+    return {
+        "app": f"raft{nodes}",
+        "batch": batch,
+        "rounds": rounds,
+        "seed_deliveries": best,
+        "sleep_cap": cap,
+        "curve": curve,
+        "scaling": scaling,
+        "bit_identical": all(pt["bit_match"] for pt in curve),
+        "reshard_resume_match": True,
+        **({"fleet": fleet_block} if fleet_block is not None else {}),
+    }
+
+
 def bench_config5_rehearsal(jax, total_lanes=None):
     """Config-5 machinery rehearsal at >=1e5 lanes (VERDICT r3 #6): the
     64-actor *reliable* flood runs ~1 lane/sec on CPU, so the full config
@@ -2614,7 +2871,8 @@ def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--config", default=None,
                         help="run only one section: 2, 3, 4, 5, 6, 7, 8, "
-                             "9, 10, 11, 12, 13, 14, 15, or 'rehearsal'")
+                             "9, 10, 11, 12, 13, 14, 15, 16, or "
+                             "'rehearsal'")
     args = parser.parse_args()
     if args.config is not None and args.config != "rehearsal":
         args.config = int(args.config)
@@ -2840,6 +3098,26 @@ def main():
         )
         emit(out)
         return
+    if args.config == 16:
+        out["metric"] = (
+            "host-half rounds/sec scaling vs admission shard count "
+            "(digest-range-sharded coordinator host half, seeded raft "
+            "frontier, bit-identical at every point)"
+        )
+        out["unit"] = "x"
+        out["config16"] = bench_config16(jax)
+        scaling = out["config16"].get("scaling") or {}
+        # The headline is the scaling factor at the largest measured
+        # shard count (>=2.5x at 4 shards is the acceptance bar).
+        tops = [v for v in scaling.values() if v is not None]
+        out["value"] = tops[-1] if tops else None
+        out["vs_baseline"] = (
+            round((out["value"] or 0) / 2.5, 3)
+            if out["value"] is not None
+            else None
+        )
+        emit(out)
+        return
     if args.config == "rehearsal":
         out["metric"] = (
             "schedules/sec (config-5 machinery rehearsal, >=1e5 lanes)"
@@ -2872,6 +3150,7 @@ def main():
     config13 = bench_config13(jax)
     config14 = bench_config14(jax)
     config15 = bench_config15(jax)
+    config16 = bench_config16(jax)
     rehearsal = bench_config5_rehearsal(jax)
     out.update(
         {
@@ -2907,6 +3186,7 @@ def main():
             "config13": config13,
             "config14": config14,
             "config15": config15,
+            "config16": config16,
             "config5_rehearsal": rehearsal,
         }
     )

@@ -1466,6 +1466,11 @@ def cmd_dpor(args) -> int:
     _obs_begin(args)
     os.environ["DEMI_DEVICE_IMPL"] = getattr(args, "impl", "xla")
     _strict_io_begin(args)
+    if getattr(args, "host_shards", 0):
+        # DeviceDPOROracle builds its DeviceDPOR internally; the env var
+        # is the documented channel (DEMI_HOST_SHARDS) and the flag just
+        # sets it for this process.
+        os.environ["DEMI_HOST_SHARDS"] = str(args.host_shards)
     if getattr(args, "prefix_fork", False):
         os.environ["DEMI_PREFIX_FORK"] = "1"
     if getattr(args, "async_min", False):
@@ -1515,6 +1520,27 @@ def cmd_dpor(args) -> int:
             ),
         )
         double_buffer = inflight_decision.enabled
+    host_shard_decision = None
+    if (
+        autotune
+        and not getattr(args, "host_shards", 0)
+        and not os.environ.get("DEMI_HOST_SHARDS")
+    ):
+        # Measured host-shard axis: how many digest-range shards the
+        # admission pipeline fans out over (bit-identical at any count,
+        # so the only question is rounds/sec). A cache hit costs no
+        # measurements; the decision reaches DeviceDPOROracle through
+        # the same env channel as the explicit flag.
+        from .tune import calibrate_host_shards, make_host_shard_measure
+
+        host_shard_decision = calibrate_host_shards(
+            app, cfg, batch=args.batch,
+            measure=make_host_shard_measure(
+                app, cfg, program, batch=args.batch
+            ),
+        )
+        if host_shard_decision.shards > 1:
+            os.environ["DEMI_HOST_SHARDS"] = str(host_shard_decision.shards)
     oracle = DeviceDPOROracle(
         app, cfg, config, batch_size=args.batch, max_rounds=args.rounds,
         autotune=autotune, double_buffer=double_buffer,
@@ -1542,6 +1568,8 @@ def cmd_dpor(args) -> int:
         summary["autotune"] = oracle.tuner_summaries()
     if inflight_decision is not None:
         summary["inflight_decision"] = inflight_decision.to_json()
+    if host_shard_decision is not None:
+        summary["host_shard_decision"] = host_shard_decision.to_json()
     if oracle.fork_stats is not None:
         summary["prefix_fork"] = oracle.fork_stats
     if oracle.supports_async:
@@ -1604,6 +1632,7 @@ def cmd_fleet(args) -> int:
             devices_per_worker=args.devices_per_worker,
             lease_timeout=args.lease_timeout,
             straggler_factor=args.straggler_factor,
+            host_shards=getattr(args, "host_shards", 0) or None,
         )
     print(json.dumps(summary))
     _obs_end(args)
@@ -2331,6 +2360,16 @@ def main(argv: Optional[list] = None) -> int:
              "cache (profile=launch) for the launch-economy cost model",
     )
     p.add_argument(
+        "--host-shards", type=int, default=0, dest="host_shards",
+        metavar="N",
+        help="partition the host-half admission pipeline (scan, "
+             "filters, digest dedup) into N digest-range shards run "
+             "concurrently, with a canonical merge that keeps results "
+             "bit-identical to 1 shard; DEMI_HOST_SHARDS=N does the "
+             "same; under --autotune the measured host_shards axis "
+             "decides; default 1",
+    )
+    p.add_argument(
         "--profile-trace", default=None, dest="profile_trace",
         metavar="DIR",
         help="jax.profiler trace output dir for --profile-rounds "
@@ -2383,6 +2422,13 @@ def main(argv: Optional[list] = None) -> int:
         help="at most one lease in flight (uncontended per-worker "
              "timing on a shared-core host — what bench config 13 "
              "measures); default overlaps leases across workers",
+    )
+    p.add_argument(
+        "--host-shards", type=int, default=0, dest="host_shards",
+        metavar="N",
+        help="digest-range shards for the coordinator's host-half "
+             "admission pipeline (bit-identical at any N; "
+             "DEMI_HOST_SHARDS=N does the same; default 1)",
     )
     p.add_argument(
         "--lease-timeout", type=float, default=120.0, dest="lease_timeout",

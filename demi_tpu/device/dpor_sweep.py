@@ -596,6 +596,19 @@ def _resolve_host_path(explicit: Optional[str] = None) -> str:
     return explicit
 
 
+def _resolve_host_shards(explicit: Optional[int] = None) -> int:
+    """Resolve the admission shard count without importing the fleet
+    package on every construction: explicit arg wins, then
+    ``DEMI_HOST_SHARDS``, default 1 (the sequential pipeline — zero
+    sharded machinery is built at 1)."""
+    if explicit is not None:
+        return max(1, int(explicit))
+    try:
+        return max(1, int(os.environ.get("DEMI_HOST_SHARDS", "1") or 1))
+    except ValueError:
+        return 1
+
+
 class DeviceDPOROracle:
     """TestOracle over DeviceDPOR: systematic batched search for a target
     violation on a given external program; positives lift to full host
@@ -1053,6 +1066,18 @@ def _dpor_restore_state(dpor: "DeviceDPOR", state: tuple) -> None:
     dpor._suppressed = set(state[12])
     dpor._suppressed_digests = set(state[13])
     dpor.violation_codes = set(state[14])
+    if getattr(dpor, "_sharder", None) is not None:
+        # Snapshots hold the digest sets FLAT; a sharded instance
+        # re-partitions them by digest range on restore (also how an
+        # N-shard checkpoint restores into M shards).
+        from ..fleet.shard import DigestShards
+
+        dpor._explored_digests = DigestShards(
+            dpor._host_shards, dpor._explored_digests
+        )
+        dpor._suppressed_digests = DigestShards(
+            dpor._host_shards, dpor._suppressed_digests
+        )
     dpor._guides = dict(state[16])
     # The explored log rolls back with the set; the durable-checkpoint
     # pack cache re-validates itself against it (prefix + last-entry
@@ -1135,6 +1160,7 @@ class DeviceDPOR:
         static_independence=None,
         sleep_sets=None,
         key_mode: Optional[str] = None,
+        host_shards: Optional[int] = None,
     ):
         assert cfg.record_trace and cfg.record_parents
         self.app = app
@@ -1373,6 +1399,34 @@ class DeviceDPOR:
         self._explored_digests: Set[bytes] = {prescription_digest(tuple())}
         # Adaptive (n_presc, n_rows) buffer hint for the batch scan.
         self._batch_size_hint: Optional[Tuple[int, int]] = None
+        # Persistent scan output buffers for the unsharded batch path:
+        # the adaptive size hint lives per INSTANCE (native.ScanBuffers)
+        # instead of per call, so a steady-state round reallocates
+        # nothing.
+        from ..native import ScanBuffers
+
+        self._scan_buffers = ScanBuffers()
+        # Digest-range-sharded admission (fleet/shard.py; host_shards >
+        # 1 via the constructor, --host-shards, or DEMI_HOST_SHARDS):
+        # the round's scan/filter/dedup pipeline runs as N concurrent
+        # digest-range shards, then a serial canonical merge
+        # (_admit_stream) applies fresh admissions in the sequential
+        # round order — explored/class/violation sets, frontier, and
+        # first-found record stay bit-identical at any shard count.
+        # The digest sets become DigestShards (a drop-in set facade
+        # partitioned by range) so each shard's dedup thread owns a
+        # disjoint slice. Composes with sleep sets, static pruning,
+        # prefix-fork, and double-buffering: sharding only touches how
+        # one harvested round's candidates are scanned and deduped.
+        self._host_shards = _resolve_host_shards(host_shards)
+        self._sharder = None
+        if self._host_shards > 1:
+            from ..fleet.shard import DigestShards, ShardedAdmission
+
+            self._sharder = ShardedAdmission(self._host_shards)
+            self._explored_digests = DigestShards(
+                self._host_shards, self._explored_digests
+            )
         self.original: Optional[Tuple] = None
         self.max_distance: Optional[int] = None
         self.interleavings = 0
@@ -1383,6 +1437,10 @@ class DeviceDPOR:
         self._sleep_rows: Dict[Tuple, Tuple[Tuple[int, ...], ...]] = {}
         self._suppressed: Set[Tuple] = set()
         self._suppressed_digests: Set[bytes] = set()
+        if self._sharder is not None:
+            from ..fleet.shard import DigestShards
+
+            self._suppressed_digests = DigestShards(self._host_shards)
         # Wakeup-sequence guides (sleep mode only): a reversal's
         # EXECUTION follows the full bounded wakeup sequence — prefix,
         # flipped record, then the source lane's remaining deliveries in
@@ -1852,12 +1910,16 @@ class DeviceDPOR:
         # Local fresh/redundant/pruned counts: the tuner's per-round
         # signal, needed whether or not telemetry is on (the obs
         # counters still carry the cross-round totals).
-        if self.host_path == "vectorized":
-            fresh_n, redundant_n, pruned_n = self._derive_batch(
+        if self.host_path != "vectorized":
+            fresh_n, redundant_n, pruned_n = self._derive_legacy(
+                traces, lens, len(batch), frontier, batch=batch, res=res
+            )
+        elif self._sharder is not None:
+            fresh_n, redundant_n, pruned_n = self._derive_sharded(
                 traces, lens, len(batch), frontier, batch=batch, res=res
             )
         else:
-            fresh_n, redundant_n, pruned_n = self._derive_legacy(
+            fresh_n, redundant_n, pruned_n = self._derive_batch(
                 traces, lens, len(batch), frontier, batch=batch, res=res
             )
         # Round-local stats for the journal record (obs/journal.py):
@@ -1870,6 +1932,10 @@ class DeviceDPOR:
             "distance_pruned": int(pruned_n),
             "violations": round_codes,
         }
+        if self._sharder is not None:
+            # Per-shard scan/dedup stats for the fleet.host_shard
+            # journal records + the top FLEET panel's utilization bars.
+            self._last_round["host_shards"] = self._sharder.last_stats
         if redundant_n:
             obs.counter("dpor.prescriptions_redundant").inc(redundant_n)
         if pruned_n:
@@ -1922,7 +1988,7 @@ class DeviceDPOR:
 
     def _sleep_class_check(
         self, presc: Tuple, rows, own_pos, flip, branch: int,
-        lane_presc: Tuple, wake_row,
+        lane_presc: Tuple, wake_row, ckey=None,
     ):
         """The class-dedup half of sleep-set admission for ONE fresh
         candidate (shared by both host paths — parity by construction).
@@ -1935,7 +2001,8 @@ class DeviceDPOR:
         flip), and appends the flip to the node's wakeup ledger."""
         sleep = self.sleep
         recw = self.cfg.rec_width
-        ckey = sleep.class_key(rows, own_pos, recw)
+        if ckey is None:
+            ckey = sleep.class_key(rows, own_pos, recw)
         if sleep.prune and sleep.class_seen(ckey):
             sleep.note_pruned(klass=1, tier="device")
             # Warm-start accounting: a hit satisfied by PRIOR-run /
@@ -2019,9 +2086,11 @@ class DeviceDPOR:
     ) -> Tuple[int, int, int]:
         """Vectorized prescription derivation: one batch-native racing
         call for the whole round, content-digest dedup over the packed
-        rows, tuples materialized only for admitted candidates. Returns
-        (fresh, redundant, pruned) counts."""
+        rows, tuples materialized only for admitted candidates (the
+        shared ``_admit_stream`` loop). Returns (fresh, redundant,
+        pruned) counts."""
         from ..native import digest_keys, racing_prescriptions_batch
+        from ..obs.profiler import PROFILER
 
         recw = self.cfg.rec_width
         sleep_ctx = (
@@ -2029,12 +2098,18 @@ class DeviceDPOR:
             if batch is not None and res is not None
             else None
         )
+        t0 = time.perf_counter() if PROFILER.enabled else 0.0
         rows, offsets, lanes, digests = racing_prescriptions_batch(
             traces[:n_lanes], lens[:n_lanes], recw,
             size_hint=self._batch_size_hint,
             independence=self.static_independence,
             sleep=self.sleep, sleep_ctx=sleep_ctx,
+            buffers=self._scan_buffers,
         )
+        if PROFILER.enabled:
+            PROFILER.host_scan(
+                "dpor-host-scan", n_lanes, time.perf_counter() - t0
+            )
         # Adaptive buffer sizing: the next round's scan allocates for
         # this round's volume (+ slack) instead of a blind worst case.
         self._batch_size_hint = (
@@ -2042,10 +2117,87 @@ class DeviceDPOR:
             max(256, (len(rows) * 5) // 4),
         )
         keys = digest_keys(digests)
+        return self._admit_stream(
+            rows, offsets, lanes, keys, traces, lens, batch, sleep_ctx,
+            frontier,
+        )
+
+    def _derive_sharded(
+        self, traces, lens, n_lanes: int, frontier: List[Tuple],
+        batch: Optional[List[Tuple]] = None, res=None,
+    ) -> Tuple[int, int, int]:
+        """Digest-range-sharded derivation (host_shards > 1): the lane
+        scan + static/sleep filters + pre-round digest dedup run as N
+        concurrent shards (fleet/shard.py — phases A/B compute only
+        order-independent facts), then the canonical merge
+        (``_admit_stream`` with the precomputed duplicate verdicts)
+        applies admissions serially in the exact sequential order.
+        Outputs are bit-identical to ``_derive_batch`` at any shard
+        count (tests/test_host_shards.py, bench config 16)."""
+        from ..obs.profiler import PROFILER
+
+        recw = self.cfg.rec_width
+        sleep_ctx = (
+            self._sleep_ctx(batch, res)
+            if batch is not None and res is not None
+            else None
+        )
+        if self.static_independence is not None:
+            # Build the lazily-cached device matrix once, on this
+            # thread, before the shard threads read it concurrently.
+            self.static_independence.device_matrix()
+        t0 = time.perf_counter() if PROFILER.enabled else 0.0
+        scan = self._sharder.scan_round(
+            traces, lens, n_lanes, recw,
+            independence=self.static_independence,
+            sleep=self.sleep, sleep_ctx=sleep_ctx,
+            explored=self._explored_digests,
+            suppressed=self._suppressed_digests,
+        )
+        if PROFILER.enabled:
+            PROFILER.host_scan(
+                "dpor-host-scan", n_lanes, time.perf_counter() - t0,
+                shape=f"b={n_lanes} shards={self._host_shards}",
+            )
+        # Same global adaptive hint as the sequential path (checkpoint
+        # payloads stay identical across shard counts); the per-shard
+        # ScanBuffers carry their own capacities independently.
+        self._batch_size_hint = (
+            max(64, (len(scan.keys) * 5) // 4),
+            max(256, (len(scan.rows) * 5) // 4),
+        )
+        # Phase C: class-key canonicalization (the host half's dominant
+        # cost on class-tracked runs) precomputed per owning shard —
+        # the merge below only looks keys up.
+        class_keys = self._sharder.class_round(
+            scan, traces, lens, recw, self.sleep
+        )
+        return self._admit_stream(
+            scan.rows, scan.offsets, scan.lanes, scan.keys, traces, lens,
+            batch, sleep_ctx, frontier,
+            known_dup=scan.known_dup, shard_ids=scan.shard_ids,
+            shard_stats=scan.stats, class_keys=class_keys,
+        )
+
+    def _admit_stream(
+        self, rows, offsets, lanes, keys, traces, lens,
+        batch: Optional[List[Tuple]], sleep_ctx, frontier: List[Tuple],
+        known_dup=None, shard_ids=None, shard_stats=None, class_keys=None,
+    ) -> Tuple[int, int, int]:
+        """The canonical admission loop over one round's candidate
+        stream, in stream (= lane-major scan) order: digest dedup,
+        sleep-class check, distance gate, frontier admission. Shared by
+        the sequential and sharded paths — the sharded path passes
+        ``known_dup`` (membership against the PRE-round sets, computed
+        per digest-range shard) and this loop then tracks only the keys
+        added DURING the merge (``round_new``), which together decide
+        exactly what the sequential live-set membership check decides,
+        in the same order."""
+        recw = self.cfg.rec_width
         fresh_n = redundant_n = pruned_n = 0
         explored_digests = self._explored_digests
         offs = offsets.tolist()
-        lane_of = lanes.tolist()
+        lane_of = np.asarray(lanes).tolist()
         # Fresh prescriptions materialize with SHARED per-lane row
         # tuples: a prescription's prefix is by construction the first
         # (mlen - 1) delivery rows of its lane in position order, so one
@@ -2064,11 +2216,26 @@ class DeviceDPOR:
                 lane_deliv[b] = cached
             return cached
 
-        for k, key in enumerate(keys):
-            if key in explored_digests:
-                redundant_n += 1
-                continue
-            if key in self._suppressed_digests:
+        if known_dup is None:
+            candidates = range(len(keys))
+            round_new = None
+        else:
+            # Known duplicates (vs the pre-round sets) skip in bulk —
+            # the merge's per-candidate work is O(fresh), which is what
+            # keeps the serial fraction small at high shard counts.
+            redundant_n += int(np.count_nonzero(known_dup))
+            candidates = np.flatnonzero(~known_dup).tolist()
+            round_new = set()
+        for k in candidates:
+            key = keys[k]
+            if round_new is None:
+                if key in explored_digests or key in self._suppressed_digests:
+                    redundant_n += 1
+                    continue
+            elif key in round_new:
+                # Same-round duplicate: an earlier merge step already
+                # explored or class-suppressed this digest — exactly
+                # the sequential live-set hit.
                 redundant_n += 1
                 continue
             lo, hi = offs[k], offs[k + 1]
@@ -2089,13 +2256,24 @@ class DeviceDPOR:
                     list(pos[: m - 1]) + [None], flipped, m - 1,
                     batch[b] if batch is not None else tuple(),
                     wake_row,
+                    ckey=(
+                        class_keys.get(k)
+                        if class_keys is not None
+                        else None
+                    ),
                 )
                 if verdict == "class":
                     self._suppressed_digests.add(key)
+                    if round_new is not None:
+                        round_new.add(key)
                     redundant_n += 1
                     continue
             if self._admit(presc, key, frontier):
                 fresh_n += 1
+                if round_new is not None:
+                    round_new.add(key)
+                if shard_stats is not None:
+                    shard_stats[shard_ids[k]]["fresh"] += 1
                 if commit is not None:
                     commit()
                 if self.sleep is not None:

@@ -188,6 +188,7 @@ class FleetCoordinator:
         journal_dir: Optional[str] = None,
         straggler_factor: float = 4.0,
         span_dir: Optional[str] = None,
+        host_shards: Optional[int] = None,
     ):
         from ..analysis import SleepSets, StaticIndependence, sleep_cap
         from ..device.dpor_sweep import DeviceDPOR
@@ -228,6 +229,7 @@ class FleetCoordinator:
             prefix_fork=False, double_buffer=False,
             sleep_sets=sleep_obj,
             static_independence=rel if static_prune else False,
+            host_shards=host_shards,
         )
         self.store: Optional[ClassStore] = (
             ClassStore(class_store_dir, self.fp) if class_store_dir else None
@@ -304,6 +306,9 @@ class FleetCoordinator:
             self._server.shutdown()
             self._server.server_close()
             self._server = None
+        sharder = getattr(self.dpor, "_sharder", None)
+        if sharder is not None:
+            sharder.close()
 
     # -- worker lifecycle --------------------------------------------------
     def worker_hello(self, worker: str) -> Dict[str, Any]:
@@ -699,6 +704,23 @@ class FleetCoordinator:
                     frontier_bytes=frontier_bytes,
                     ledger_bytes=ledger_bytes,
                 )
+                # Per-shard host-half attribution: one record per
+                # admission shard per round, the FLEET panel's shard
+                # utilization series (balance skew across digest ranges
+                # shows up here before it shows up as host_s drift).
+                for st in lr.get("host_shards") or ():
+                    obs.journal.emit(
+                        "fleet.host_shard",
+                        round=self.dpor.round_index,
+                        shard=st.get("shard"),
+                        lanes=st.get("lanes"),
+                        rows=st.get("rows"),
+                        candidates=st.get("candidates"),
+                        dup=st.get("dup"),
+                        fresh=st.get("fresh"),
+                        wall_s=st.get("wall_s"),
+                        scan_s=st.get("scan_s"),
+                    )
             if hit is not None:
                 if self._found is None:
                     self._found = (np.asarray(hit[0]).copy(), int(hit[1]))
@@ -834,6 +856,7 @@ def run_fleet(
     straggler_factor: float = 4.0,
     worker_env: Optional[Dict[str, Dict[str, str]]] = None,
     timeout: float = 900.0,
+    host_shards: Optional[int] = None,
 ) -> Dict[str, Any]:
     """Run a fleet on this host: serve leases in-process, spawn
     ``workers`` worker processes (each with its own JAX runtime and
@@ -857,6 +880,7 @@ def run_fleet(
         target_code=target_code, lease_timeout=lease_timeout,
         max_outstanding=max_outstanding, min_ready=workers,
         journal_dir=journal_dir, straggler_factor=straggler_factor,
+        host_shards=host_shards,
     )
     if seed_prescription is not None:
         co.dpor.seed(tuple(tuple(r) for r in seed_prescription))

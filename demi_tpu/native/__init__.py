@@ -1,4 +1,5 @@
 from .analysis import (
+    ScanBuffers,
     analysis_native_available,
     digest_keys,
     prescription_digest,
@@ -15,6 +16,7 @@ from .codec import (
 )
 
 __all__ = [
+    "ScanBuffers",
     "analysis_native_available",
     "native_available",
     "pack_records",

@@ -256,12 +256,55 @@ def racing_pair_scan(recs: np.ndarray) -> np.ndarray:
 # Batch-native prescription assembly (one call per frontier round)
 # ---------------------------------------------------------------------------
 
+class ScanBuffers:
+    """Reusable output buffers (+ their adaptive capacities) for ONE
+    caller of ``racing_prescriptions_batch`` — one instance per
+    (DeviceDPOR instance, admission shard), NOT per call, so concurrent
+    shard scans each grow a private hint instead of regrowing and
+    contending on one shared ``size_hint``, and a steady-state round
+    allocates nothing.
+
+    Capacities only grow (an overflowed round ratchets them up); the
+    arrays returned by the scan are VIEWS over these buffers, valid
+    until the owner's next scan — exactly the lifetime the frontier
+    round's admission loop needs."""
+
+    __slots__ = ("cap_presc", "cap_rows", "width",
+                 "rows", "offsets", "lanes", "digests")
+
+    def __init__(self, size_hint: Optional[Tuple[int, int]] = None):
+        self.cap_presc = 0 if size_hint is None else max(64, int(size_hint[0]))
+        self.cap_rows = 0 if size_hint is None else max(256, int(size_hint[1]))
+        self.width = 0
+        self.rows = None
+        self.offsets = None
+        self.lanes = None
+        self.digests = None
+
+    def ensure(self, cap_presc: int, cap_rows: int, w: int):
+        """Arrays of at least the requested capacities (allocating only
+        on growth or a record-width change). The native scan writes
+        ``offsets[0..n]`` itself, so reuse needs no re-zeroing."""
+        if self.rows is None or w != self.width or cap_rows > self.cap_rows:
+            self.cap_rows = max(cap_rows, self.cap_rows)
+            self.width = w
+            self.rows = np.empty((self.cap_rows, w), np.int32)
+        if self.offsets is None or cap_presc > self.cap_presc:
+            self.cap_presc = max(cap_presc, self.cap_presc)
+            self.offsets = np.zeros(self.cap_presc + 1, np.int64)
+            self.lanes = np.empty(self.cap_presc, np.int32)
+            self.digests = np.empty((self.cap_presc, 2), np.uint64)
+        return self.rows, self.offsets, self.lanes, self.digests
+
+
 def racing_prescriptions_batch(
     records: np.ndarray, lens: np.ndarray, rec_width: int,
     size_hint: Optional[Tuple[int, int]] = None,
     independence=None,
     sleep=None,
     sleep_ctx: Optional[Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]] = None,
+    buffers: Optional[ScanBuffers] = None,
+    shard: Optional[int] = None,
 ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
     """Batch racing analysis over one round's stacked lane records.
 
@@ -309,7 +352,31 @@ def racing_prescriptions_batch(
     ``demi_racing_prescriptions_sleep``; the NumPy twin
     (``_apply_sleep_filter``) is bit-identical and serves audit runs.
     Applied AFTER the static filter (the shared counter contract);
-    counts report via ``sleep.note_pruned``."""
+    counts report via ``sleep.note_pruned``.
+
+    ``buffers`` (a ``ScanBuffers`` or None) supplies caller-owned output
+    buffers whose capacities persist across calls — the per-(instance,
+    shard) home of the adaptive size hint. Returned arrays then view the
+    caller's buffers and stay valid until that caller's next scan.
+    ``shard`` labels the ``native.scan_seconds`` wall counter so the
+    sharded admission pipeline's per-shard scan cost is attributable
+    (distinct labels write distinct series keys — safe from concurrent
+    shard threads)."""
+    from time import perf_counter
+
+    _t_scan = perf_counter()
+
+    def _note_scan_seconds():
+        from .. import obs
+
+        dt = perf_counter() - _t_scan
+        if shard is not None:
+            obs.counter("native.scan_seconds").inc(
+                round(dt, 9), shard=str(shard)
+            )
+        else:
+            obs.counter("native.scan_seconds").inc(round(dt, 9))
+
     records = np.ascontiguousarray(
         np.asarray(records)[:, :, :rec_width], np.int32
     )
@@ -340,11 +407,15 @@ def racing_prescriptions_batch(
     from ..persist.supervisor import SUPERVISOR
 
     if SUPERVISOR.degraded("native.analysis"):
-        return numpy_path()
+        out = numpy_path()
+        _note_scan_seconds()
+        return out
     lib = _load_native()
     if lib is None:
         note_fallback("no native library")
-        return numpy_path()
+        out = numpy_path()
+        _note_scan_seconds()
+        return out
     lens = np.ascontiguousarray(lens)
     # The native per-pair filter serves the hot path; audit runs (which
     # must materialize every pruned prescription) post-filter the
@@ -376,9 +447,15 @@ def racing_prescriptions_batch(
     if size_hint is not None:
         cap_presc = max(64, int(size_hint[0]))
         cap_rows = max(256, int(size_hint[1]))
+    elif buffers is not None and buffers.cap_presc:
+        # The caller's persistent buffers ARE the size hint: their
+        # capacities ratcheted up on every past overflow, so a
+        # steady-state round reuses them without a single allocation.
+        cap_presc, cap_rows = buffers.cap_presc, buffers.cap_rows
     else:
         cap_presc = max(64, 4 * int(lens.sum()))
         cap_rows = max(256, cap_presc * max(8, rmax // 4))
+
     def native_scan(_attempt: int):
         return _native_scan_loop()
 
@@ -391,10 +468,16 @@ def racing_prescriptions_batch(
 
     def _native_scan_once():
         nonlocal cap_presc, cap_rows
-        rows = np.empty((cap_rows, w), np.int32)
-        offsets = np.zeros(cap_presc + 1, np.int64)
-        lanes = np.empty(cap_presc, np.int32)
-        digests = np.empty((cap_presc, 2), np.uint64)
+        if buffers is not None:
+            rows, offsets, lanes, digests = buffers.ensure(
+                cap_presc, cap_rows, w
+            )
+            cap_presc, cap_rows = buffers.cap_presc, buffers.cap_rows
+        else:
+            rows = np.empty((cap_rows, w), np.int32)
+            offsets = np.zeros(cap_presc + 1, np.int64)
+            lanes = np.empty(cap_presc, np.int32)
+            digests = np.empty((cap_presc, 2), np.uint64)
         total_rows = ctypes.c_int64(0)
         if native_sleep:
             pruned = np.zeros(3, np.int64)
@@ -461,6 +544,7 @@ def racing_prescriptions_batch(
         fallback=lambda: ("host", numpy_path()),
     )
     if result[0] == "host":
+        _note_scan_seconds()
         return result[1]
     out, pruned = result[1]
     if native_filter:
@@ -475,6 +559,7 @@ def racing_prescriptions_batch(
         sleep.note_pruned(sleep=int(pruned[2]), tier="device")
     elif sleep_on:
         out = _apply_sleep_filter(*out, sleep=sleep, sleep_ctx=sleep_ctx)
+    _note_scan_seconds()
     return out
 
 

@@ -234,7 +234,8 @@ JOURNAL: Optional[RoundJournal] = None
 #: execution instead of per round.
 _SAMPLED_KINDS = frozenset(
     ("dpor.round", "sweep.chunk", "minimize.level", "minimize.stage",
-     "pipeline.frame", "fleet.round", "service.chunk", "service.frame")
+     "pipeline.frame", "fleet.round", "fleet.host_shard", "service.chunk",
+     "service.frame")
 )
 
 

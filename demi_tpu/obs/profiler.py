@@ -107,6 +107,18 @@ class LaunchProfiler:
             return
         self._note(kernel, "block", shape or f"b={batch}", seconds, batch)
 
+    def host_scan(
+        self, kernel: str, batch: int, seconds: float, shape: str = ""
+    ) -> None:
+        """Host-half analysis wall per launch shape — the racing scan +
+        filter + dedup section of a frontier round. Device launches
+        alone undercount a round's cost (ROADMAP item 5's cost-model
+        evidence gap); persisting this kind under the same
+        ``profile=launch`` TuningCache key closes it."""
+        if not self.enabled:
+            return
+        self._note(kernel, "host", shape or f"b={batch}", seconds, batch)
+
     # -- evidence -----------------------------------------------------------
     def evidence(self) -> Dict[str, Any]:
         """TuningCache-compatible decision dict: the measured launch

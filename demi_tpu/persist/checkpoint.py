@@ -703,6 +703,20 @@ def restore_device_dpor(dpor, payload: Dict[str, Any]) -> None:
     dpor._suppressed_digests = _unpack_digests(
         payload["suppressed_digests"]
     )
+    if getattr(dpor, "_sharder", None) is not None:
+        # Checkpoints carry the digest sets FLAT (shard-count-free), so
+        # a sharded instance re-partitions them by digest range here —
+        # which is also the whole N→M re-shard story: restore an
+        # N-shard run's checkpoint into an M-shard explorer and the
+        # ranges re-cut themselves (tests/test_host_shards.py).
+        from ..fleet.shard import DigestShards
+
+        dpor._explored_digests = DigestShards(
+            dpor._host_shards, dpor._explored_digests
+        )
+        dpor._suppressed_digests = DigestShards(
+            dpor._host_shards, dpor._suppressed_digests
+        )
     dpor.violation_codes = set(payload["violation_codes"])
     dpor._guides = {
         log[i]: np.asarray(rows, np.int32)

@@ -204,6 +204,7 @@ def render_frame(
         "minimize.stage": "minimize", "pipeline.enqueue": "pipeline",
         "pipeline.frame": "pipeline", "fleet.round": "fleet",
         "fleet.worker": "fleet", "fleet.straggler": "fleet",
+        "fleet.host_shard": "fleet",
         "service.chunk": "service",
         "service.frame": "service", "service.enqueue": "service",
         "service.job": "service", "service.tenant": "service",
@@ -342,6 +343,34 @@ def render_frame(
                     "  lease wall by worker: " + "  ".join(
                         f"{w} {sum(v) / len(v):.3f}s×{len(v)}"
                         for w, v in sorted(per_wall.items())
+                    )
+                )
+            # Per-shard host-half utilization: the coordinator's
+            # admission pipeline fans out over digest-range shards
+            # (fleet/shard.py) and emits one fleet.host_shard record
+            # per shard per round — the bars show each shard's share
+            # of the window's host busy seconds, so a skewed digest
+            # range (or a starving shard) is visible at a glance.
+            shard_recs = _recent(
+                [r for r in records if r.get("kind") == "fleet.host_shard"],
+                window,
+            )
+            if shard_recs:
+                per_shard: Dict[str, List[float]] = {}
+                per_fresh: Dict[str, int] = {}
+                per_dup: Dict[str, int] = {}
+                for r in shard_recs:
+                    s = str(r.get("shard"))
+                    per_shard.setdefault(s, []).append(r.get("wall_s") or 0.0)
+                    per_fresh[s] = per_fresh.get(s, 0) + (r.get("fresh") or 0)
+                    per_dup[s] = per_dup.get(s, 0) + (r.get("dup") or 0)
+                busy_all = sum(sum(v) for v in per_shard.values()) or 1.0
+                lines.append(
+                    "  host shards: " + "  ".join(
+                        f"s{s} [{_bar(sum(v) / busy_all, miniw)}] "
+                        f"{sum(v):.3f}s {per_fresh.get(s, 0)}f/"
+                        f"{per_dup.get(s, 0)}d"
+                        for s, v in sorted(per_shard.items())
                     )
                 )
             # Per-node byte footprint gauges from the round records.

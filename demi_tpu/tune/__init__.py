@@ -23,15 +23,18 @@ from .cache import TuningCache, default_cache_path, workload_key  # noqa: F401
 from .calibrate import (  # noqa: F401
     DPOR_INFLIGHT_AXIS,
     FORK_BUCKET_AXIS,
+    HOST_SHARD_AXIS,
     VIOLATION_BONUS_AXIS,
     VIOLATION_BONUS_DEFAULT_KEY,
     BonusDecision,
     ForkDecision,
+    HostShardDecision,
     InflightDecision,
     SplitDecision,
     SweepDecision,
     calibrate_dpor_inflight,
     calibrate_fork,
+    calibrate_host_shards,
     calibrate_pipeline_split,
     calibrate_sweep,
     calibrate_weight_bonus,
@@ -42,6 +45,7 @@ from .calibrate import (  # noqa: F401
     make_bonus_measure,
     make_dpor_inflight_measure,
     make_fork_measure,
+    make_host_shard_measure,
     make_pipeline_split_measure,
     median_rate,
     sweep_axes,
@@ -61,6 +65,8 @@ __all__ = [
     "ExplorationController",
     "FORK_BUCKET_AXIS",
     "ForkDecision",
+    "HOST_SHARD_AXIS",
+    "HostShardDecision",
     "InflightDecision",
     "SplitDecision",
     "SweepDecision",
@@ -71,6 +77,7 @@ __all__ = [
     "autotune_enabled",
     "calibrate_dpor_inflight",
     "calibrate_fork",
+    "calibrate_host_shards",
     "calibrate_pipeline_split",
     "calibrate_sweep",
     "calibrate_weight_bonus",
@@ -82,6 +89,7 @@ __all__ = [
     "make_bonus_measure",
     "make_dpor_inflight_measure",
     "make_fork_measure",
+    "make_host_shard_measure",
     "make_pipeline_split_measure",
     "median_rate",
     "record_decision",

@@ -629,6 +629,147 @@ def calibrate_dpor_inflight(
     return decision
 
 
+#: Host-shard candidates for the admission pipeline (fleet/shard.py):
+#: how many digest-range shards the per-round scan + filter + dedup is
+#: partitioned into. 1 = the sequential host half. The sweet spot is a
+#: property of the host (cores, GIL pressure of the NumPy twin vs the
+#: GIL-released native scan) and of the workload's rows-per-round, so
+#: the decision is measured and cached per workload shape + platform.
+HOST_SHARD_AXIS = (1, 2, 4)
+
+
+@dataclass
+class HostShardDecision:
+    """One host-shard calibration outcome for a workload shape: the
+    chosen shard count plus measured host-half rounds/sec per point."""
+
+    shards: int
+    rate: float  # host-half rounds/sec of the chosen point
+    source: str  # "calibrated" | "cached" | "default"
+    rates: Dict[str, float] = field(default_factory=dict)
+    key: Optional[str] = None
+    calibration_seconds: float = 0.0
+
+    def to_json(self) -> Dict[str, Any]:
+        return {
+            "shards": int(self.shards),
+            "rate": round(self.rate, 2),
+            "source": self.source,
+            "rates": {k: round(v, 2) for k, v in self.rates.items()},
+            "key": self.key,
+            "calibration_seconds": round(self.calibration_seconds, 2),
+        }
+
+    @classmethod
+    def from_json(
+        cls, obj: Dict[str, Any], source: str
+    ) -> "HostShardDecision":
+        return cls(
+            shards=int(obj.get("shards", 1)),
+            rate=float(obj.get("rate", 0.0)),
+            source=source,
+            rates=dict(obj.get("rates", {})),
+            key=obj.get("key"),
+        )
+
+
+def make_host_shard_measure(
+    app, device_cfg, program, *, batch: int = 16, rounds: int = 3,
+    reps: int = 2, target_code: Optional[int] = None,
+):
+    """Real measurement for one host-shard candidate: a fresh DeviceDPOR
+    per rep (exploration is stateful), one warm-up round, then
+    ``rounds`` timed frontier rounds under a HostHalfTimer; returns
+    median host-half rounds/sec. Device time is excluded — the axis only
+    moves the admission pipeline, so ranking on host seconds keeps the
+    decision stable across device-speed noise. Kernels are shared across
+    points/reps so the walk compiles once."""
+    from ..device.dpor_sweep import DeviceDPOR, make_dpor_kernel
+    from ..fleet.shard import HostHalfTimer
+
+    kernel = make_dpor_kernel(app, device_cfg)
+
+    def measure(params: Dict[str, Any]) -> float:
+        n = int(params["host_shards"])
+        rates = []
+        for _ in range(reps + 1):  # +1: the dropped warm-up rep
+            dpor = DeviceDPOR(
+                app, device_cfg, program, batch_size=batch,
+                kernel=kernel, sleep_sets=False, host_shards=n,
+            )
+            dpor.explore(target_code=target_code, max_rounds=1)
+            timer = HostHalfTimer(dpor)
+            dpor.explore(target_code=target_code, max_rounds=rounds)
+            rates.append(timer.rounds_per_sec())
+            sharder = getattr(dpor, "_sharder", None)
+            if sharder is not None:
+                sharder.close()
+        return median_rate(rates, drop_first=True)
+
+    return measure
+
+
+def calibrate_host_shards(
+    app,
+    cfg,
+    *,
+    batch: int,
+    platform: Optional[str] = None,
+    cache: Optional[TuningCache] = None,
+    measure: Optional[Callable[[Dict[str, Any]], float]] = None,
+    axis: Optional[Sequence[int]] = None,
+    extra_key: Optional[Dict[str, Any]] = None,
+) -> HostShardDecision:
+    """Calibrate the admission-pipeline shard count for one workload
+    shape + platform. Caching contract as ``calibrate_dpor_inflight``: a
+    cache hit costs no measurements; a miss requires ``measure`` (a real
+    one needs the workload's program — ``make_host_shard_measure``).
+    With no measure given the decision defaults to 1 shard (the
+    sequential host half — always correct, never slower than a
+    mispredicted fan-out). Persisted to the TuningCache, recorded as
+    ``tune.dpor.host_shards`` decisions."""
+    if platform is None:
+        import jax
+
+        platform = jax.devices()[0].platform
+    cache = cache or TuningCache()
+    key = workload_key(
+        app.name, app.num_actors, cfg, platform,
+        axis="host_shards", batch=batch, **(extra_key or {}),
+    )
+    cached = cache.get(key)
+    if cached is not None:
+        decision = HostShardDecision.from_json(cached, source="cached")
+        decision.key = key
+        _record_host_shard_decision(decision)
+        return decision
+
+    if measure is None:
+        decision = HostShardDecision(
+            shards=1, rate=0.0, source="default", key=key,
+        )
+        _record_host_shard_decision(decision)
+        return decision
+    candidates = list(axis) if axis is not None else list(HOST_SHARD_AXIS)
+    start = {"host_shards": candidates[0]}
+    t0 = time.perf_counter()
+    params, rate, rates = coordinate_descent(
+        {"host_shards": candidates}, measure, start,
+        order=("host_shards",),
+    )
+    decision = HostShardDecision(
+        shards=int(params["host_shards"]),
+        rate=rate,
+        source="calibrated",
+        rates=rates,
+        key=key,
+        calibration_seconds=time.perf_counter() - t0,
+    )
+    _record_host_shard_decision(decision)
+    cache.put(key, decision.to_json())
+    return decision
+
+
 @dataclass
 class SplitDecision:
     """One streaming budget-split calibration outcome: the minimizer's
@@ -949,6 +1090,12 @@ def _record_inflight_decision(decision: InflightDecision) -> None:
     record_decision("dpor.inflight", int(decision.enabled))
     record_decision("dpor.inflight_rate", decision.rate)
     record_decision("dpor.inflight_source", decision.source)
+
+
+def _record_host_shard_decision(decision: HostShardDecision) -> None:
+    record_decision("dpor.host_shards", int(decision.shards))
+    record_decision("dpor.host_shards_rate", decision.rate)
+    record_decision("dpor.host_shards_source", decision.source)
 
 
 def _record_fork_decision(decision: ForkDecision) -> None:

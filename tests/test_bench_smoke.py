@@ -307,6 +307,50 @@ def test_bench_config13_smoke():
     assert record["value"] == section["curve"][-1]["scaling_x"]
 
 
+def test_bench_config16_smoke():
+    record = _run_bench(
+        "16",
+        {
+            # Tiny shard curve: shallow seed scan, two rounds, two
+            # shard counts. The >=1.6x/2.5x scaling floors need the
+            # default shapes, so strict is off; every identity
+            # contract — bit-identical state at each shard count and
+            # the N->M re-sharded resume — is still asserted
+            # internally by the bench and re-checked here. The fleet
+            # parity leg is skipped (each fleet run pays a worker
+            # subprocess jax startup + compile; tests/test_fleet.py
+            # covers 2 workers x 2 host shards directly).
+            "DEMI_BENCH_CONFIG16_ROUNDS": "2",
+            "DEMI_BENCH_CONFIG16_SHARDS": "1,2",
+            "DEMI_BENCH_CONFIG16_BUDGET": "120",
+            "DEMI_BENCH_CONFIG16_SEEDS": "4",
+            "DEMI_BENCH_CONFIG16_BATCH": "8",
+            "DEMI_BENCH_CONFIG16_STRICT": "0",
+            "DEMI_BENCH_CONFIG16_FLEET": "0",
+        },
+    )
+    assert record["metric"].startswith("host-half rounds/sec scaling")
+    section = record["config16"]
+    assert "error" not in section, section
+    for key in ("app", "batch", "rounds", "seed_deliveries", "sleep_cap",
+                "curve", "scaling", "bit_identical",
+                "reshard_resume_match"):
+        assert key in section, key
+    assert len(section["curve"]) == 2
+    for pt in section["curve"]:
+        for key in ("shards", "rounds", "host_seconds",
+                    "host_rounds_per_sec", "host_x", "bit_match"):
+            assert key in pt, key
+        assert pt["bit_match"] is True
+        assert pt["host_seconds"] > 0
+    assert section["curve"][0]["shards"] == 1
+    assert section["curve"][0]["host_x"] == 1.0
+    assert section["bit_identical"] is True
+    assert section["reshard_resume_match"] is True
+    assert "fleet" not in section  # skipped leg stays absent, not null
+    assert record["value"] == section["curve"][-1]["host_x"]
+
+
 def test_cli_lint_zoo_clean_subprocess():
     """Tier-1 CI contract at the real entry point: `demi_tpu lint` over
     the bundled zoo exits 0 with zero findings — run as a subprocess so

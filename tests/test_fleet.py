@@ -396,3 +396,105 @@ def test_fleet_tracing_stitch_smoke(tmp_path):
             assert sp["ts"] + shift_w >= b - slack
             assert sp["ts"] + sp["dur"] + shift_w <= e_ + slack
     assert execs >= 1
+
+
+def test_late_result_after_requeue_is_accepted(tmp_path):
+    """Late-result acceptance unit: a lease whose deadline fires moves
+    to the requeue; when the original worker then answers LATE, the
+    result is accepted iff the round is still un-reserved — the
+    re-lease is cancelled, and a second copy of the same answer is
+    dropped as a duplicate."""
+    import time as _time
+
+    from demi_tpu.fleet.coordinator import FleetCoordinator
+    from demi_tpu.persist.checkpoint import pack_array
+
+    app, cfg, program = build_fleet_workload(WORKLOAD)
+    co = FleetCoordinator(
+        app, cfg, program, workload=WORKLOAD, batch_size=8,
+        max_rounds=2, journal_dir=str(tmp_path),
+    )
+    try:
+        assert co.worker_hello("w0")["op"] == "config"
+        # Freeze the starting generation as serve() would, without
+        # opening the socket server.
+        co._gen = list(co.dpor.frontier)
+        msg = co.next_lease("w0")
+        assert msg["op"] == "lease"
+        lid = msg["lease"]
+        lease, worker, _deadline, t_issue = co._outstanding[lid]
+        # Execute the round in-process with the coordinator's own
+        # kernel: the result bytes a (slow) worker would have sent.
+        if lease.sleeps is not None:
+            res = co.dpor.kernel(
+                co.dpor._progs(len(lease.batch)), lease.prescs,
+                lease.keys, lease.sleeps, lease.sfrom,
+            )
+        else:
+            res = co.dpor.kernel(
+                co.dpor._progs(len(lease.batch)), lease.prescs, lease.keys
+            )
+        result_msg = {
+            "op": "result", "lease": lid, "worker": "w0", "busy_s": 0.01,
+            "res": {
+                f: pack_array(np.asarray(getattr(res, f)))
+                for f in type(res)._fields
+            },
+        }
+        # Fire the deadline: the lease is revoked to the requeue.
+        co._outstanding[lid] = (
+            lease, worker, _time.monotonic() - 1.0, t_issue
+        )
+        with co._lock:
+            co._check_expired_locked()
+        assert lid not in co._outstanding
+        assert [le.lease_id for le in co._requeue] == [lid]
+        assert co._releases == 1
+        # The late answer lands while the round is still un-reserved:
+        # accepted, and the pending re-lease is cancelled.
+        ack = co.submit("w0", result_msg)
+        assert ack.get("op") == "ok" and not ack.get("duplicate")
+        assert not co._requeue
+        # The accepted round drained straight through the canonical
+        # merge: the coordinator's host half processed it.
+        assert co._processed == 1
+        assert co.dpor.round_index == 1
+        assert co.workers["w0"]["rounds"] == 1
+        # The same bytes again (e.g. from the re-leased worker racing
+        # in) are recognized as already served and dropped.
+        dup = co.submit("w1", result_msg)
+        assert dup == {"op": "ok", "duplicate": True}
+        assert co._processed == 1
+        assert co.workers.get("w1", {}).get("rounds", 0) == 0
+    finally:
+        co.close()
+        if co._journal_attached_here:
+            obs.journal.detach()
+
+
+def test_fleet_parity_two_workers_two_host_shards():
+    """2 workers x 2 coordinator admission shards, one worker killed
+    while holding a lease: coverage, class set, violation codes, and
+    the first-found record are bit-identical to the 1-worker x 1-shard
+    sequential baseline — the digest-range shard merge composes with
+    lease revocation and re-execution."""
+    base, found = _baseline()
+    s = run_fleet(
+        WORKLOAD, workers=2, batch=8, rounds=4,
+        host_shards=2, max_outstanding=1,
+        worker_env={"w0": {"DEMI_FLEET_DIE_AFTER": "1"}},
+        timeout=420.0,
+    )
+    assert s["explored_sha"] == set_digest(base.explored)
+    assert s["classes_sha"] == set_digest(base.sleep.classes)
+    assert s["violation_codes"] == sorted(base.violation_codes)
+    assert s["explored"] == len(base.explored)
+    assert s["frontier"] == len(base.frontier)
+    bfound = (
+        hashlib.sha256(found[0][: found[1]].tobytes()).hexdigest()[:16]
+        if found is not None
+        else None
+    )
+    assert s["first_found_sha"] == bfound
+    assert 17 in s["worker_returncodes"]
+    assert s["leases_reissued"] >= 1
