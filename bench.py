@@ -72,10 +72,14 @@ Modes: `python bench.py` runs everything; `--config 2` / `--config 3` /
 `--config 4` / `--config 5` / `--config 6` / `--config 7` /
 `--config 8` / `--config 9` / `--config 10` / `--config 11` /
 `--config 12` / `--config 13` / `--config 14` / `--config 15` /
-`--config 16` / `--config rehearsal` run a single section (same
-one-line JSON with that key populated). Config 16 A/Bs the
-digest-range-sharded coordinator host half (fleet/shard.py) at 1/2/4
-admission shards, asserting bit-identity at every point.
+`--config 16` / `--config 17` / `--config rehearsal` run a single
+section (same one-line JSON with that key populated). Config 16 A/Bs
+the digest-range-sharded coordinator host half (fleet/shard.py) at
+1/2/4 admission shards, asserting bit-identity at every point. Config
+17 measures differential exploration (analysis/delta.py): after a
+one-handler edit, re-verification re-explores only the change cone
+(>=3x fewer classes than scratch), with violations and the full-scratch
+audit bit-identical.
 
 DEMI_AUTOTUNE=1 lets the measurement-guided tuner (demi_tpu/tune) pick
 the rehearsal drive's (kernel variant, batch, segment) from short
@@ -2693,6 +2697,217 @@ def bench_config16(jax):
     }
 
 
+def bench_config17(jax):
+    """Differential exploration (analysis/delta.py): re-verification
+    cost proportional to the change cone. The config-13 deep seeded
+    raft frontier is explored once and its class ledger published with
+    an effect-signature manifest; then ONE raft handler is edited
+    (``refactor:heartbeat`` — behavior- and effect-identical, code
+    digest moves) and the edited app re-verifies two ways:
+
+      - **scratch**: full re-exploration (today's cost of any edit);
+      - **delta**: ``delta_warm_start`` diffs the stored manifest vs
+        the edited app's, transfers every stored class whose
+        reversal-chain tag footprint avoids the change cone (never
+        re-executed), and re-seeds only the cone classes onto the
+        frontier via their stored guides.
+
+    Headline: **re-explored classes, scratch / delta** — the floor is
+    >=3x (the cone must be a minority of the frontier). Hard contracts,
+    all asserted: the delta run's effective violation-code set AND
+    per-code canonical witness digests bit-identical to scratch; the
+    audit (full scratch class set vs the delta run's transferred +
+    re-explored + pending set) bit-identical — zero unsoundly skipped
+    classes; and an ``opaque`` edit (a while-loop the static effects
+    analyzer cannot see through) degrades to FULL scratch
+    re-exploration, also bit-identical.
+
+    Knobs: DEMI_BENCH_CONFIG17_ROUNDS / _BATCH / _BUDGET / _SEEDS /
+    _DEPTH_CAP / _MSGS / _STRICT / _EDIT / _FLOOR."""
+    import tempfile
+
+    from demi_tpu.analysis import SleepSets, StaticIndependence, sleep_cap
+    from demi_tpu.analysis.delta import (
+        build_run_ledger,
+        delta_warm_start,
+        effective_violations,
+    )
+    from demi_tpu.apps.common import make_host_invariant
+    from demi_tpu.config import SchedulerConfig
+    from demi_tpu.device.dpor_sweep import DeviceDPOR, steering_prescription
+    from demi_tpu.fleet import build_fleet_workload, set_digest
+    from demi_tpu.fleet.ledger import ClassStore
+    from demi_tpu.persist.checkpoint import handler_fingerprint
+    from demi_tpu.schedulers import RandomScheduler
+
+    nodes, commands = 3, 3
+    rounds = int(os.environ.get("DEMI_BENCH_CONFIG17_ROUNDS", 12))
+    batch = int(os.environ.get("DEMI_BENCH_CONFIG17_BATCH", 16))
+    budget = int(os.environ.get("DEMI_BENCH_CONFIG17_BUDGET", 240))
+    seeds = int(os.environ.get("DEMI_BENCH_CONFIG17_SEEDS", 40))
+    depth_cap = int(os.environ.get("DEMI_BENCH_CONFIG17_DEPTH_CAP", 120))
+    msgs = int(os.environ.get("DEMI_BENCH_CONFIG17_MSGS", 160))
+    strict = os.environ.get("DEMI_BENCH_CONFIG17_STRICT", "1") != "0"
+    edit = os.environ.get(
+        "DEMI_BENCH_CONFIG17_EDIT", "refactor:heartbeat"
+    )
+    floor = float(os.environ.get("DEMI_BENCH_CONFIG17_FLOOR", "3.0"))
+
+    base_workload = {
+        "app": "raft", "nodes": nodes, "bug": "multivote",
+        "commands": commands, "max_messages": msgs, "pool": 256,
+        "num_events": 12,
+    }
+    app1, cfg, program = build_fleet_workload(base_workload)
+    config = SchedulerConfig(invariant_check=make_host_invariant(app1))
+
+    # Seed a deep violating schedule (config-13 shape).
+    fr, best = None, -1
+    for seed in range(seeds):
+        r = RandomScheduler(
+            config, seed=seed, max_messages=budget,
+            invariant_check_interval=1,
+        ).execute(program)
+        if r.violation is None:
+            continue
+        depth = len(r.trace.deliveries())
+        if depth <= depth_cap and depth > best:
+            fr, best = r, depth
+    if fr is None:  # pragma: no cover - multivote violates reliably
+        return {"error": "no violation found to seed the frontier"}
+    trace = fr.trace
+    trace.set_original_externals(list(program))
+    presc = steering_prescription(app1, cfg, trace, program)
+
+    cap = sleep_cap()
+
+    def run(workload, store_dir=None, delta=False):
+        """One exploration of a (possibly edited) workload: sleep-set
+        pruning on, guides retained, content lane keys (the sleep-mode
+        default) so a re-seeded prescription's execution is a pure
+        function of its content — what makes delta-vs-scratch equality
+        exact, not statistical."""
+        app, cfg_w, program_w = build_fleet_workload(workload)
+        sl = SleepSets(
+            independence=StaticIndependence.for_app(app), prune=True,
+            cap=cap, retain_guides=True,
+        )
+        d = DeviceDPOR(
+            app, cfg_w, program_w, batch_size=batch, prefix_fork=False,
+            double_buffer=False, sleep_sets=sl,
+        )
+        # Closed seeded exploration: padding lanes never admit races, so
+        # every class descends from the seed and carries an exact
+        # trunk-divergence index — the scratch and delta legs verify the
+        # SAME class universe and the transfer test is prescription-
+        # granular instead of saturating on random-lane lineage.
+        d.pad_exploration = False
+        d.seed(presc)
+        stats = None
+        if delta:
+            store = ClassStore(store_dir, handler_fingerprint(app))
+            stats = delta_warm_start(d, store, app)
+        t0 = time.perf_counter()
+        d.explore(max_rounds=rounds, stop_on_violation=False)
+        wall = time.perf_counter() - t0
+        return d, app, stats, wall
+
+    # v1: explore the original app, publish classes + manifest + guides.
+    store = tempfile.mkdtemp(prefix="demi_delta_store_")
+    d1, _, _, wall1 = run(base_workload)
+    ClassStore(store, handler_fingerprint(app1)).publish(
+        build_run_ledger(d1, app1)
+    )
+
+    def executed(d):
+        # explored counts admissions; subtract what never left the
+        # frontier (and the root + seeded original) to get the classes
+        # this run actually re-executed.
+        return max(0, len(d.explored) - len(d.frontier) - 2)
+
+    # v2 (the one-handler edit), scratch vs differential.
+    workload2 = {**base_workload, "handler_edit": edit}
+    ds, _, _, wall_scratch = run(workload2)
+    dd, app2, stats, wall_delta = run(workload2, store_dir=store, delta=True)
+    assert stats is not None and not stats["full"], stats
+
+    scratch_codes, scratch_wits = effective_violations(ds)
+    delta_codes, delta_wits = effective_violations(dd, stats)
+    violations_match = delta_codes == scratch_codes
+    witnesses_match = delta_wits == scratch_wits
+    reexplored_scratch = executed(ds)
+    reexplored_delta = executed(dd)
+    reduction_x = round(
+        reexplored_scratch / max(1, reexplored_delta), 3
+    )
+    # The audit: the differential run's class set (transferred +
+    # re-explored + pending) must equal the full scratch exploration's
+    # — zero unsoundly skipped classes.
+    audit_sound = (
+        set_digest(dd.sleep.classes) == set_digest(ds.sleep.classes)
+        and violations_match
+        and witnesses_match
+    )
+    assert violations_match, (delta_codes, scratch_codes)
+    assert witnesses_match, (delta_wits, scratch_wits)
+    assert audit_sound
+    if strict:
+        assert reduction_x >= floor, (
+            f"delta reduction {reduction_x}x below the {floor}x floor",
+            reexplored_scratch, reexplored_delta, stats,
+        )
+
+    # Unknown-effects leg: an opaque edit (analyzer bails) must degrade
+    # to a FULL scratch re-exploration — nothing transferred, coverage
+    # still bit-identical to scratch.
+    opaque_edit = "opaque:" + (edit.partition(":")[2] or "request_vote")
+    workload3 = {**base_workload, "handler_edit": opaque_edit}
+    d3, _, stats3, wall_opaque = run(workload3, store_dir=store, delta=True)
+    unknown_degrades = (
+        stats3 is not None
+        and bool(stats3["full"])
+        and stats3["transferred"] == 0
+        and len(d3.explored) == len(ds.explored)
+        and set_digest(d3.sleep.classes) == set_digest(ds.sleep.classes)
+    )
+    assert unknown_degrades, stats3
+
+    return {
+        "app": f"raft{nodes}",
+        "batch": batch,
+        "rounds": rounds,
+        "seed_deliveries": best,
+        "sleep_cap": cap,
+        "edit": edit,
+        "changed_tags": stats["changed_tags"],
+        "cone_tags": stats["cone_tags"],
+        "cone_size": len(stats["cone_tags"]),
+        "stored_classes": stats["stored_classes"],
+        "transferred": stats["transferred"],
+        "reseeded": stats["reseeded"],
+        "pending": stats["pending"],
+        "skipped_launches": stats["skipped_launches"],
+        "reexplored_scratch": reexplored_scratch,
+        "reexplored_delta": reexplored_delta,
+        "reduction_x": reduction_x,
+        "violation_codes": delta_codes,
+        "violations_match": violations_match,
+        "witnesses_match": witnesses_match,
+        "audit_sound": audit_sound,
+        "unknown_degrades": unknown_degrades,
+        "opaque_reason": (stats3 or {}).get("reason"),
+        "walls": {
+            "v1_seconds": round(wall1, 3),
+            "scratch_seconds": round(wall_scratch, 3),
+            "delta_seconds": round(wall_delta, 3),
+            "opaque_seconds": round(wall_opaque, 3),
+            "wall_reduction_x": round(
+                wall_scratch / max(1e-9, wall_delta), 3
+            ),
+        },
+    }
+
+
 def bench_config5_rehearsal(jax, total_lanes=None):
     """Config-5 machinery rehearsal at >=1e5 lanes (VERDICT r3 #6): the
     64-actor *reliable* flood runs ~1 lane/sec on CPU, so the full config
@@ -2871,7 +3086,7 @@ def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--config", default=None,
                         help="run only one section: 2, 3, 4, 5, 6, 7, 8, "
-                             "9, 10, 11, 12, 13, 14, 15, 16, or "
+                             "9, 10, 11, 12, 13, 14, 15, 16, 17, or "
                              "'rehearsal'")
     args = parser.parse_args()
     if args.config is not None and args.config != "rehearsal":
@@ -3118,6 +3333,24 @@ def main():
         )
         emit(out)
         return
+    if args.config == 17:
+        out["metric"] = (
+            "re-explored classes, scratch/delta (differential "
+            "exploration after a one-handler raft edit, seeded "
+            "frontier; violations + audit bit-identical, unknown "
+            "effects degrade to full)"
+        )
+        out["unit"] = "x"
+        out["config17"] = bench_config17(jax)
+        out["value"] = out["config17"].get("reduction_x")
+        # Target: >=3x fewer re-explored classes than scratch.
+        out["vs_baseline"] = (
+            round(out["value"] / 3.0, 3)
+            if out["value"] is not None
+            else None
+        )
+        emit(out)
+        return
     if args.config == "rehearsal":
         out["metric"] = (
             "schedules/sec (config-5 machinery rehearsal, >=1e5 lanes)"
@@ -3151,6 +3384,7 @@ def main():
     config14 = bench_config14(jax)
     config15 = bench_config15(jax)
     config16 = bench_config16(jax)
+    config17 = bench_config17(jax)
     rehearsal = bench_config5_rehearsal(jax)
     out.update(
         {
@@ -3187,6 +3421,7 @@ def main():
             "config14": config14,
             "config15": config15,
             "config16": config16,
+            "config17": config17,
             "config5_rehearsal": rehearsal,
         }
     )

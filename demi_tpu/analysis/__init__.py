@@ -21,6 +21,15 @@ arbitrary user modules):
      nondeterminism during strict replay.
 """
 
+from .delta import (
+    DeltaPlan,
+    build_run_ledger,
+    compute_delta,
+    delta_warm_start,
+    effect_manifest,
+    effective_violations,
+    split_transfer,
+)
 from .effects import (
     ActorEffects,
     AppEffects,
@@ -28,17 +37,20 @@ from .effects import (
     analyze_actor_class,
     analyze_dsl_app,
     effects_commute,
+    fn_digest,
 )
 from .independence import StaticIndependence, static_prune_enabled
 from .sleep import (
     BIG_ORDINAL,
     SleepSets,
     canonical_class_key,
+    class_tag_mask,
     np_wake_ordinals,
     rows_content_equal,
     rows_independent,
     sleep_cap,
     sleep_sets_enabled,
+    tag_bit,
 )
 from .lint import (
     DEFAULT_TARGETS,
@@ -58,12 +70,22 @@ __all__ = [
     "AppEffects",
     "BIG_ORDINAL",
     "DEFAULT_TARGETS",
+    "DeltaPlan",
     "EffectSet",
     "LintFinding",
     "RULES",
     "SleepSets",
     "StaticIndependence",
+    "build_run_ledger",
     "canonical_class_key",
+    "class_tag_mask",
+    "compute_delta",
+    "delta_warm_start",
+    "effect_manifest",
+    "effective_violations",
+    "fn_digest",
+    "split_transfer",
+    "tag_bit",
     "np_wake_ordinals",
     "rows_content_equal",
     "rows_independent",

@@ -38,7 +38,9 @@ import ast
 import inspect
 import textwrap
 from dataclasses import dataclass, field
-from typing import Any, Callable, Dict, FrozenSet, List, Optional, Tuple
+from typing import (
+    Any, Callable, Dict, FrozenSet, List, Optional, Sequence, Tuple
+)
 
 #: UNKNOWN field set — conflicts with everything.
 UNKNOWN = None
@@ -772,12 +774,24 @@ class _Interp:
 
 @dataclass
 class AppEffects:
-    """Per-message-tag effects of one DSLApp's handler."""
+    """Per-message-tag effects of one DSLApp's handler.
+
+    ``tag_code`` / ``shared_code`` are bytecode digests attributing the
+    handler's code to tags: ``tag_code[t]`` digests the branch function
+    tag ``t`` dispatches to (folded recursively over its closure, same
+    visibility as ``persist.checkpoint.handler_fingerprint``);
+    ``shared_code`` digests the dispatcher itself minus the branch
+    functions. Differential exploration (``analysis/delta.py``) diffs
+    these between versions to localize a change to tags; a change that
+    only moves ``shared_code`` contaminates every tag. Neither field
+    enters ``to_json`` — the golden effect sets stay version-stable."""
 
     per_tag: Dict[int, EffectSet] = field(default_factory=dict)
     default: EffectSet = field(default_factory=EffectSet.unknown)
     n_tags: int = 0
     failure: Optional[str] = None
+    tag_code: Dict[int, str] = field(default_factory=dict)
+    shared_code: str = ""
 
     @classmethod
     def unknown(cls, n_tags: int = 0, reason: str = "") -> "AppEffects":
@@ -794,6 +808,51 @@ class AppEffects:
             "per_tag": {str(t): e.to_json() for t, e in sorted(self.per_tag.items())},
             "failure": self.failure,
         }
+
+
+def fn_digest(fn: Optional[Callable]) -> str:
+    """Bytecode digest of one function, folded recursively over its
+    closure exactly like ``handler_fingerprint`` folds the whole app —
+    the two see the same changes, so a delta plan never claims
+    attribution the fingerprint layer cannot detect."""
+    if fn is None:
+        return ""
+    import hashlib
+
+    from ..persist.checkpoint import _code_digest
+
+    h = hashlib.sha256()
+    _code_digest(h, fn)
+    return h.hexdigest()[:16]
+
+
+def _shared_digest(handler: Callable, branch_fns: Sequence[Callable]) -> str:
+    """Digest of the dispatcher minus its branch functions: the
+    handler's own bytecode plus every closure cell that is not a branch
+    function (or a sequence wholly of branch functions). An edit that
+    moves this digest cannot be attributed to a tag, so the delta plan
+    degrades to a full cone — unattributed change is never skipped."""
+    import hashlib
+
+    from ..persist.checkpoint import _code_digest
+
+    h = hashlib.sha256()
+    h.update(handler.__code__.co_code)
+    bset = {id(f) for f in branch_fns}
+    for cell in handler.__closure__ or ():
+        try:
+            v = cell.cell_contents
+        except ValueError:
+            continue
+        if callable(v) and id(v) in bset:
+            continue
+        if (
+            isinstance(v, (list, tuple)) and v
+            and all(callable(x) and id(x) in bset for x in v)
+        ):
+            continue
+        _code_digest(h, v)
+    return h.hexdigest()[:16]
 
 
 def _effect_from_result(val: AbsVal) -> EffectSet:
@@ -921,9 +980,12 @@ def _analyze_dsl_handler(handler: Callable, n_tags: int) -> AppEffects:
         for r in frame.returns[1:]:
             merged = _merge_vals(merged, r, frozenset())
         eff = _effect_from_result(merged)
+        # No dispatch to attribute code to: the whole handler is shared,
+        # so any edit contaminates every tag (sound, not localized).
         return AppEffects(
             per_tag={t: eff for t in range(0, n_tags + 1)},
             default=eff, n_tags=n_tags,
+            shared_code=fn_digest(handler),
         )
 
     # Execute the preamble: every statement up to (excluding) the one
@@ -980,18 +1042,32 @@ def _analyze_dsl_handler(handler: Callable, n_tags: int) -> AppEffects:
     for be in branch_effects[1:]:
         union_all = union_all.union(be)
 
+    branch_digests = [fn_digest(fn) for fn in branch_fns]
+    import hashlib as _hl
+
+    union_digest = _hl.sha256(
+        ("|".join(branch_digests)).encode()
+    ).hexdigest()[:16]
+
     tag_to_idx = _tag_index_fn(switch.args[0], frame, interp, msg_p)
     per_tag: Dict[int, EffectSet] = {}
+    tag_code: Dict[int, str] = {}
     for t in range(0, n_tags + 1):
         if tag_to_idx is None:
             per_tag[t] = union_all
+            tag_code[t] = union_digest
             continue
         idx = tag_to_idx(t)
         if idx is None or not (0 <= idx < len(branch_effects)):
             per_tag[t] = union_all
+            tag_code[t] = union_digest
         else:
             per_tag[t] = branch_effects[idx]
-    return AppEffects(per_tag=per_tag, default=union_all, n_tags=n_tags)
+            tag_code[t] = branch_digests[idx]
+    return AppEffects(
+        per_tag=per_tag, default=union_all, n_tags=n_tags,
+        tag_code=tag_code, shared_code=_shared_digest(handler, branch_fns),
+    )
 
 
 # ---------------------------------------------------------------------------

@@ -110,6 +110,45 @@ def rows_content_equal(row_a, row_b, rec_width: int) -> bool:
     return _rows_fungible(row_a, row_b, rec_width)
 
 
+def tag_bit(tag: int) -> int:
+    """Bit position of one delivery tag in the compact per-class tag
+    bitmask. Tags >= 63 saturate into bit 63 (several tags sharing a
+    bit can only ENLARGE the apparent footprint — a transfer decision
+    made on a saturated mask is conservative, never unsound)."""
+    return 1 << min(max(int(tag), 0), 63)
+
+
+#: Bit 62 of a reversal-chain mask (``class_meta`` field 3): a planted
+#: trunk class — zero reversals, always re-executed on a differential
+#: warm start so the shared prefix is revalidated for every transferred
+#: descendant. Real delivery tags never reach bit 62 in practice; a tag
+#: that saturates onto it could only force an extra re-execution
+#: (conservative, never unsound).
+TRUNK_BIT = 1 << 62
+
+
+def guide_row_tag(row) -> int:
+    """Delivery tag of one raw guide/trace record. Guide rows keep the
+    device record layout ``(kind, src, dst, tag, ...)`` — tag at index
+    3 — unlike canonical KEY rows ``(kind, dst, tag, ...)``."""
+    return int(row[3]) if len(row) > 3 else 0
+
+
+def class_tag_mask(key: tuple) -> int:
+    """Delivery-tag footprint of one canonical class key as a 64-bit
+    mask. Key rows are ``(kind, dst, tag, ...)`` (see
+    ``canonical_class_key``: content index 2 is the record's tag), so
+    the mask names exactly which handler tags the class's prescribed
+    deliveries exercise — the admission-time evidence differential
+    exploration (``analysis/delta.py``) tests against a change cone.
+    The root class ``()`` has mask 0."""
+    m = 0
+    for row in key:
+        if len(row) > 2:
+            m |= tag_bit(row[2])
+    return m
+
+
 def canonical_class_key(
     rows, own_pos: Optional[Sequence[int]], rec_width: int, matrix=None
 ) -> tuple:
@@ -205,6 +244,7 @@ class SleepSets:
         cap: Optional[int] = None,
         prune: bool = True,
         audit: bool = False,
+        retain_guides: bool = False,
     ):
         self.independence = independence
         self.matrix = (
@@ -213,6 +253,11 @@ class SleepSets:
         self.cap = sleep_cap() if cap is None else int(cap)
         self.prune = bool(prune)
         self.audit = bool(audit)
+        # Store-backed runs keep each class's admission guide so a later
+        # differential run can re-execute the class bit-identically
+        # (content lane keys make the replay position-independent).
+        # Off by default: plain explorations pay only the tag mask.
+        self.retain_guides = bool(retain_guides)
         # Distinct Mazurkiewicz classes among admitted prescriptions —
         # the optimal-DPOR lower bound `bench --config 9` reports
         # explored counts against.
@@ -223,6 +268,24 @@ class SleepSets:
         # evidence `bench --config 13` asserts on.
         self.warm: Set[tuple] = set()
         self.warm_hits = 0
+        # Per-class metadata: key -> (tag_mask, plen, guide_rows,
+        # dmask). ``tag_mask`` is always present (one int per class —
+        # the memory-parsimonious footprint record);
+        # ``plen``/``guide_rows`` only when ``retain_guides`` (plen =
+        # identity-prescription length; the identity prescription is
+        # ``guide_rows[:plen]``), else ``(-1, None)``. ``dmask`` is the
+        # reversal-chain tag mask: every explored class is the run's
+        # seed trunk plus a chain of race reversals (one per ancestry
+        # generation), and ``dmask`` ORs ``tag_bit`` of BOTH rows of
+        # every reversed pair along that chain — recorded at admission,
+        # when the pair is exact knowledge. ``TRUNK_BIT`` marks a
+        # planted trunk class (zero reversals — it must always be
+        # re-executed, revalidating the shared prefix for everyone
+        # else); -1 means unknown lineage. Differential exploration
+        # (analysis/delta.py) tests its change cone against ``dmask``.
+        self.class_meta: Dict[
+            tuple, Tuple[int, int, Optional[tuple], int]
+        ] = {}
         self.pruned_total: Dict[str, int] = {"sleep": 0, "class": 0}
         self.pruned_prescriptions: List[Tuple[Tuple[int, ...], ...]] = []
         # Wakeup ledger: per branch node (exact prefix bytes), the flip
@@ -246,8 +309,36 @@ class SleepSets:
     def class_seen(self, key: tuple) -> bool:
         return key in self.classes
 
-    def note_class(self, key: tuple) -> None:
+    def note_class(
+        self,
+        key: tuple,
+        guide=None,
+        plen: Optional[int] = None,
+        dmask: Optional[int] = None,
+    ) -> None:
+        """Record one admitted class. ``guide``/``plen``/``dmask``
+        (optional) are the admission's replay guide,
+        identity-prescription length, and reversal-chain tag mask; they
+        are retained only under ``retain_guides``. The class's
+        delivery-tag mask is always derived from the key itself, so a
+        stored mask can never disagree with the key it describes."""
         self.classes.add(key)
+        cur = self.class_meta.get(key)
+        if cur is not None and cur[2] is not None:
+            return
+        g: Optional[tuple] = None
+        pl = -1
+        dm = -1
+        if self.retain_guides and guide is not None and plen is not None:
+            g = tuple(
+                tuple(int(x) for x in row) for row in np.asarray(guide)
+            )
+            pl = int(plen)
+            if dmask is not None:
+                dm = int(dmask)
+        if cur is not None and g is None:
+            return
+        self.class_meta[key] = (class_tag_mask(key), pl, g, dm)
 
     def note_warm(self, key: tuple) -> None:
         """Count a class-dedup hit that was satisfied by warm-start
@@ -291,10 +382,13 @@ class SleepSets:
                 new += 1
         return new
 
-    def seed_covered(self, payload) -> int:
+    def seed_covered(self, payload, meta=None) -> int:
         """Warm start: merge ``payload`` AND mark those classes as
         covered by prior work — candidates in them are suppressed like
-        any seen class, and each skip counts in ``warm_hits``."""
+        any seen class, and each skip counts in ``warm_hits``.
+        ``meta`` (optional ``key -> (mask, plen, guide, dmask)``) carries
+        the stored per-class records forward so a re-publish keeps
+        them."""
         if isinstance(payload, dict):
             from ..persist.checkpoint import unpack_prescriptions
 
@@ -302,7 +396,24 @@ class SleepSets:
         else:
             keys = [tuple(k) for k in payload]
         self.warm.update(keys)
-        return self.merge_classes(keys)
+        new = self.merge_classes(keys)
+        if meta:
+            self.adopt_meta({k: meta[k] for k in keys if k in meta})
+        return new
+
+    def adopt_meta(
+        self, meta: Dict[tuple, Tuple[int, int, Optional[tuple], int]]
+    ) -> None:
+        """Fold stored per-class metadata into this ledger (only for
+        classes already present). A stored guide wins over a guide-less
+        local record; an existing guide is kept."""
+        for k, m in meta.items():
+            if k not in self.classes:
+                continue
+            cur = self.class_meta.get(k)
+            if cur is None or (cur[2] is None and m[2] is not None):
+                dm = int(m[3]) if len(m) > 3 else -1
+                self.class_meta[k] = (int(m[0]), int(m[1]), m[2], dm)
 
     # -- wakeup ledger / sleep assignment ---------------------------------
     def node_flips(self, node_key: bytes) -> List[Tuple[int, ...]]:

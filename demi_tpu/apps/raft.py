@@ -92,6 +92,63 @@ LEADER_HINT = 6  # believed current leader (-1 unknown) for client routing
 LOG_START = 7  # LOG_CAP x (term, value) interleaved
 
 
+def _edit_refactor(fn):
+    """Behavior- and effect-identical rewrite of one handler branch: a
+    plain delegating wrapper. The branch's code digest moves; its
+    read/write field sets do not — the differential explorer's
+    happy path (cone = the edited tag only)."""
+
+    def branch(actor_id, state, snd, msg):
+        return fn(actor_id, state, snd, msg)
+
+    return branch
+
+
+def _edit_opaque(fn):
+    """An edit the static effects analyzer cannot see through: a
+    ``while`` loop makes the AST interpreter bail, degrading the app's
+    effects to unknown (the differential explorer must then fall back
+    to full re-exploration). The loop body runs exactly once, so the
+    branch stays JAX-traceable and behavior-identical."""
+
+    def branch(actor_id, state, snd, msg):
+        first = True
+        while first:
+            first = False
+            out = fn(actor_id, state, snd, msg)
+        return out
+
+    return branch
+
+
+_EDIT_WRAPPERS = {"refactor": _edit_refactor, "opaque": _edit_opaque}
+
+_EDIT_TAGS = {
+    "election": T_ELECTION,
+    "heartbeat": T_HEARTBEAT,
+    "request_vote": T_REQ_VOTE,
+    "vote_reply": T_VOTE_REPLY,
+    "append": T_APPEND,
+    "append_reply": T_APPEND_REPLY,
+    "client": T_CLIENT,
+}
+
+
+def _parse_handler_edit(spec: str):
+    """``"refactor"`` / ``"opaque"`` with an optional ``:tag`` suffix
+    (name or 1-based tag int; default: the RequestVote tag, whose
+    field sets the static analyzer fully resolves)."""
+    kind, _, target = str(spec).partition(":")
+    if kind not in _EDIT_WRAPPERS:
+        raise ValueError(f"unknown handler_edit kind {kind!r}")
+    tag = T_REQ_VOTE
+    if target:
+        tag = _EDIT_TAGS.get(target) or int(target)
+    if not 1 <= tag <= 7:
+        raise ValueError(f"handler_edit tag {tag} out of range 1..7")
+    return _EDIT_WRAPPERS[kind], tag
+
+
 def state_width(n: int, log_cap: int) -> int:
     # + next_index[n] + match_index[n] + heard-from bitmask
     return LOG_START + 2 * log_cap + 2 * n + 1
@@ -102,6 +159,7 @@ def make_raft_app(
     log_cap: int = 8,
     bug: Optional[str] = None,
     name: str = "r",
+    handler_edit: Optional[str] = None,
 ) -> DSLApp:
     n = num_actors
     assert n >= 2, "raft fixture requires >= 2 nodes"
@@ -432,6 +490,18 @@ def make_raft_app(
         )
         return state, out
 
+    # Branch table built at make-scope (a closure cell of ``handler``)
+    # so ``handler_edit`` can swap an entry, and so a per-branch edit
+    # moves ``handler_fingerprint`` without touching the shared
+    # dispatch prologue's digest.
+    branches = [
+        on_election, on_heartbeat, on_request_vote, on_vote_reply,
+        on_append, on_append_reply, on_client,
+    ]
+    if handler_edit:
+        wrap, edit_tag = _parse_handler_edit(handler_edit)
+        branches[edit_tag - 1] = wrap(branches[edit_tag - 1])
+
     def handler(actor_id, state, snd, msg):
         # Membership discovery: remember every peer we've received from
         # (self counts; external/timer senders are masked off). Only the
@@ -445,10 +515,6 @@ def make_raft_app(
             state[HEARD] | peer_bit | (jnp.int32(1) << actor_id),
         )
         tag = jnp.clip(msg[0], 1, 7) - 1
-        branches = [
-            on_election, on_heartbeat, on_request_vote, on_vote_reply,
-            on_append, on_append_reply, on_client,
-        ]
         return jax.lax.switch(
             tag, branches, actor_id, state, snd, msg
         )

@@ -33,7 +33,10 @@ def build_app(args) -> DSLApp:
     if args.app == "broadcast":
         return make_broadcast_app(args.nodes, reliable=args.bug is None)
     if args.app == "raft":
-        return make_raft_app(args.nodes, bug=args.bug)
+        return make_raft_app(
+            args.nodes, bug=args.bug,
+            handler_edit=getattr(args, "handler_edit", None),
+        )
     if args.app == "spark":
         from .apps.spark_dag import make_spark_app
 
@@ -1613,32 +1616,83 @@ def cmd_fleet(args) -> int:
         "kill_weight": args.kill_weight,
         "partition_weight": args.partition_weight,
         "pool": args.pool,
+        "handler_edit": getattr(args, "handler_edit", None),
     }
+    delta = bool(getattr(args, "delta", False)) or bool(
+        getattr(args, "diff_audit", False)
+    )
+    fleet_kwargs = dict(
+        workers=args.workers,
+        batch=args.batch,
+        rounds=args.rounds,
+        # --class-store implies the global class dedup (a covered
+        # class must suppress, or the warm start cannot skip it);
+        # --sleep-sets turns the same pruning on without a store.
+        prune=bool(args.sleep_sets) or args.class_store is not None or delta,
+        class_store_dir=args.class_store,
+        warm_start=args.class_store is not None and not delta,
+        delta=delta,
+        stop_on_violation=args.stop_on_violation,
+        journal_dir=getattr(args, "journal", None),
+        max_outstanding=1 if args.serialize_leases else None,
+        devices_per_worker=args.devices_per_worker,
+        lease_timeout=args.lease_timeout,
+        straggler_factor=args.straggler_factor,
+        host_shards=getattr(args, "host_shards", 0) or None,
+    )
     with obs.span("cli.fleet", app=args.app, workers=args.workers):
-        summary = run_fleet(
-            workload,
-            workers=args.workers,
-            batch=args.batch,
-            rounds=args.rounds,
-            # --class-store implies the global class dedup (a covered
-            # class must suppress, or the warm start cannot skip it);
-            # --sleep-sets turns the same pruning on without a store.
-            prune=bool(args.sleep_sets) or args.class_store is not None,
-            class_store_dir=args.class_store,
-            warm_start=args.class_store is not None,
-            stop_on_violation=args.stop_on_violation,
-            journal_dir=getattr(args, "journal", None),
-            max_outstanding=1 if args.serialize_leases else None,
-            devices_per_worker=args.devices_per_worker,
-            lease_timeout=args.lease_timeout,
-            straggler_factor=args.straggler_factor,
-            host_shards=getattr(args, "host_shards", 0) or None,
+        summary = run_fleet(workload, **fleet_kwargs)
+    audit_ok = True
+    if getattr(args, "diff_audit", False):
+        # Soundness audit: a full scratch exploration of the SAME
+        # (changed) app must agree with the differential run on the
+        # class set, the effective violation-code set, and the per-code
+        # canonical witness digests. Needs a round budget that drains
+        # the frontier on both sides, or equality is meaningless.
+        scratch_kwargs = dict(
+            fleet_kwargs, class_store_dir=None, warm_start=False,
+            delta=False, journal_dir=None,
         )
+        with obs.span("cli.fleet_audit", app=args.app):
+            scratch = run_fleet(workload, **scratch_kwargs)
+        audit = {
+            "classes_match": summary.get("classes_sha")
+            == scratch.get("classes_sha"),
+            "codes_match": summary.get("violation_codes_effective")
+            == scratch.get("violation_codes_effective"),
+            "witnesses_match": summary.get("witness_shas")
+            == scratch.get("witness_shas"),
+            "scratch_explored": scratch.get("explored"),
+            "delta_explored": summary.get("explored"),
+        }
+        audit["sound"] = bool(
+            audit["classes_match"]
+            and audit["codes_match"]
+            and audit["witnesses_match"]
+        )
+        audit_ok = audit["sound"]
+        summary["audit"] = audit
     print(json.dumps(summary))
     _obs_end(args)
+    if not audit_ok:
+        return 2
     if args.stop_on_violation:
         return 0 if summary.get("violation_found") else 1
     return 0
+
+
+def cmd_store(args) -> int:
+    """Class-store maintenance. ``compact`` merges a store's
+    accumulated per-run segments into one deduped segment per workload
+    fingerprint (atomic tmp+fsync+rename publish; old segments removed
+    only after the merged segment is durable; corrupt segments skipped
+    with ``persist.corrupt_fallbacks`` and left in place)."""
+    from .fleet.ledger import compact_store
+
+    if args.action == "compact":
+        print(json.dumps(compact_store(args.dir)))
+        return 0
+    raise SystemExit(f"unknown store action {args.action!r}")
 
 
 def cmd_shiviz(args) -> int:
@@ -2073,6 +2127,16 @@ def main(argv: Optional[list] = None) -> int:
         p.add_argument(
             "--partition-weight", type=float, default=0.0, dest="partition_weight"
         )
+        p.add_argument(
+            "--handler-edit", default=None, dest="handler_edit",
+            metavar="KIND[:TAG]",
+            help="apply a synthetic handler edit before building the app "
+                 "(raft only): 'refactor[:tag]' = behavior- and "
+                 "effect-identical rewrite of one branch, "
+                 "'opaque[:tag]' = an edit the static effects analyzer "
+                 "cannot see through (differential exploration then "
+                 "degrades to full re-exploration)",
+        )
 
     def obs_flags(p):
         p.add_argument(
@@ -2405,6 +2469,21 @@ def main(argv: Optional[list] = None) -> int:
              "--class-store); off = observe mode, classes tracked only",
     )
     p.add_argument(
+        "--delta", action="store_true",
+        help="differential warm start against --class-store: diff the "
+             "stored effect-signature manifest vs the current app, "
+             "transfer every stored class whose delivery-tag footprint "
+             "avoids the contaminated cone, re-explore only inside it "
+             "(unknown effects degrade soundly to full scratch)",
+    )
+    p.add_argument(
+        "--diff-audit", action="store_true", dest="diff_audit",
+        help="after the --delta run, full-explore the same app from "
+             "scratch and assert the skip set was sound (class set, "
+             "violation codes, canonical witness digests bit-identical; "
+             "exit 2 on mismatch). Implies --delta",
+    )
+    p.add_argument(
         "--stop-on-violation", action="store_true",
         dest="stop_on_violation",
         help="stop the fleet at the first violating round (default: "
@@ -2445,6 +2524,20 @@ def main(argv: Optional[list] = None) -> int:
     )
     strict_io_flags(p)
     p.set_defaults(fn=cmd_fleet)
+
+    p = sub.add_parser(
+        "store",
+        help="class-store maintenance: `store compact DIR` merges "
+             "accumulated per-run segments into one deduped segment "
+             "per workload fingerprint (long-lived stores otherwise "
+             "grow one file per run forever)",
+    )
+    p.add_argument("action", choices=["compact"],
+                   help="maintenance action")
+    p.add_argument("dir",
+                   help="store root (one fingerprint subdir per "
+                        "workload) or a single fingerprint directory")
+    p.set_defaults(fn=cmd_store)
 
     p = sub.add_parser(
         "serve",

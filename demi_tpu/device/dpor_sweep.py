@@ -1429,6 +1429,15 @@ class DeviceDPOR:
             )
         self.original: Optional[Tuple] = None
         self.max_distance: Optional[int] = None
+        # Closed seeded exploration (analysis/delta.py): when False, the
+        # prescription-free PADDING lanes still run (the kernel batch
+        # shape is compiled) but their harvested races are not admitted
+        # to the frontier — every explored class then descends from a
+        # seeded prescription and carries an exact trunk-divergence
+        # index in its meta, which is what differential re-verification
+        # transfers on. Default True keeps the classic behavior: pads
+        # diversify the frontier with random exploration.
+        self.pad_exploration: bool = True
         self.interleavings = 0
         # Sleep-set side state: per-prescription sleep rows (frontier
         # entries stay plain tuples — selection, dedup, and every parity
@@ -1450,12 +1459,24 @@ class DeviceDPOR:
         # classic DPOR's re-derivations into raw-redundant hits). Keyed
         # by the identity tuple; ``_pack`` substitutes the guide rows.
         self._guides: Dict[Tuple, np.ndarray] = {}
+        # Admitted prescription -> canonical class key (sleep mode):
+        # lives exactly as long as the guide (popped once executed), so
+        # per-round violation witnesses and the published ledger's
+        # pending set can attribute lanes to classes.
+        self._class_of: Dict[Tuple, tuple] = {}
         if self.sleep is not None:
             self.sleep.note_class(())  # the root schedule's class
+            self._class_of[()] = ()
         # Distinct violation codes observed across all lanes of all
         # rounds (always tracked — one np.unique per round): the
         # violation-set preservation surface the sleep-set A/B asserts.
         self.violation_codes: Set[int] = set()
+        # Per-code canonical first-found witness: the violating lane
+        # record with the smallest trace digest seen so far —
+        # {"sha", "class", "trace"}. Min-digest (not chronology) makes
+        # the record order-free, so a differential re-exploration and a
+        # scratch run converge on identical witnesses (analysis/delta).
+        self.violation_witnesses: Dict[int, Dict[str, object]] = {}
         # Continuous observability (obs/journal.py): rounds executed so
         # far (1-based after the first round; checkpointed + restored so
         # a resumed journal stays generation-contiguous) and the last
@@ -1489,13 +1510,23 @@ class DeviceDPOR:
             if self.sleep is not None and prescription:
                 # Seeded rows carry no source-lane positions: creation
                 # edges onto them never fire (class splits, never
-                # falsely merges — see canonical_class_key).
-                self.sleep.note_class(
-                    self.sleep.class_key(
-                        np.asarray(prescription, np.int32), None,
-                        self.cfg.rec_width,
-                    )
+                # falsely merges — see canonical_class_key). The seed's
+                # guide is the prescription itself.
+                ckey = self.sleep.class_key(
+                    np.asarray(prescription, np.int32), None,
+                    self.cfg.rec_width,
                 )
+                # TRUNK_BIT: the seed IS the trunk (zero reversals) —
+                # differential exploration always re-executes it (trunk
+                # revalidation, analysis/delta.py), and its descendants
+                # start their reversal chains from an empty mask.
+                from ..analysis.sleep import TRUNK_BIT
+
+                self.sleep.note_class(
+                    ckey, guide=prescription, plen=len(prescription),
+                    dmask=TRUNK_BIT,
+                )
+                self._class_of[prescription] = ckey
 
     def checkpoint_state(self) -> dict:
         """JSON-able snapshot of everything a round mutates (frontier,
@@ -1896,6 +1927,29 @@ class DeviceDPOR:
         # the preservation surface the sleep-set A/B asserts against.
         round_codes = [int(c) for c in np.unique(violations) if c != 0]
         self.violation_codes.update(round_codes)
+        if self.sleep is not None and round_codes:
+            # Canonical per-code first-found witness: keep the violating
+            # lane whose trace digest is smallest. Min-digest (not
+            # chronology) is order-free, so a differential re-run that
+            # executes the same prescriptions in different rounds
+            # converges on the SAME witness as scratch (analysis/delta).
+            import hashlib as _hl
+
+            for code in round_codes:
+                for b in np.flatnonzero(violations == code):
+                    b = int(b)
+                    tr = traces[b][: int(lens[b])]
+                    sha = _hl.sha256(tr.tobytes()).hexdigest()[:16]
+                    cur = self.violation_witnesses.get(code)
+                    if cur is not None and str(cur["sha"]) <= sha:
+                        continue
+                    self.violation_witnesses[code] = {
+                        "sha": sha,
+                        "class": self._class_of.get(
+                            batch[b] if b < len(batch) else ()
+                        ),
+                        "trace": np.array(tr, copy=True),
+                    }
         hit_mask = (
             violations != 0
             if target_code is None
@@ -1963,6 +2017,9 @@ class DeviceDPOR:
             for p in batch:
                 self._guides.pop(p, None)
                 self._sleep_rows.pop(p, None)
+                # Executed ⇒ no longer pending; witness capture above
+                # already consumed the class attribution for this round.
+                self._class_of.pop(p, None)
         return hit
 
     def _admit(
@@ -2012,8 +2069,36 @@ class DeviceDPOR:
                 sleep.note_pruned_prescription(presc)
             return "class", None
 
-        def commit():
-            sleep.note_class(ckey)
+        def commit(guide=None):
+            # Reversal-chain tag mask: this child is its parent's class
+            # plus ONE race reversal — the flip moved before the row it
+            # displaced (``guide[branch + 1]``, when the lane's tail
+            # survived divergence tolerance). Its footprint is the
+            # parent's chain mask (trunk marker dropped) plus both rows
+            # of the reversed pair — recorded here, at admission, when
+            # the pair is exact knowledge. Unknown parent lineage
+            # (root-descended pads, no recorded mask) stays -1 —
+            # differential exploration then falls back to the
+            # conservative full-key mask.
+            from ..analysis.sleep import TRUNK_BIT, guide_row_tag, tag_bit
+
+            pmeta = sleep.class_meta.get(self._class_of.get(lane_presc))
+            pmask = (
+                int(pmeta[3])
+                if pmeta is not None and len(pmeta) > 3 else -1
+            )
+            if guide is None or pmask < 0:
+                dmask = -1
+            else:
+                dmask = (pmask & ~TRUNK_BIT) | tag_bit(
+                    guide_row_tag(flip)
+                )
+                if branch + 1 < len(guide):
+                    dmask |= tag_bit(guide_row_tag(guide[branch + 1]))
+            sleep.note_class(
+                ckey, guide=guide, plen=len(presc), dmask=dmask
+            )
+            self._class_of[presc] = ckey
             node_key = np.ascontiguousarray(
                 np.asarray(presc[:-1], np.int32).reshape(len(presc) - 1, -1)
             ).tobytes() if len(presc) > 1 else b""
@@ -2240,6 +2325,15 @@ class DeviceDPOR:
                 continue
             lo, hi = offs[k], offs[k + 1]
             b = lane_of[k]
+            if (
+                not self.pad_exploration
+                and batch is not None
+                and not batch[b]
+            ):
+                # Closed seeded exploration: padding-lane races are
+                # observed but never admitted (see pad_exploration).
+                pruned_n += 1
+                continue
             flipped = tuple(rows[hi - 1].tolist())
             deliv, pos = deliveries_of(b)
             m = hi - lo
@@ -2274,12 +2368,11 @@ class DeviceDPOR:
                     round_new.add(key)
                 if shard_stats is not None:
                     shard_stats[shard_ids[k]]["fresh"] += 1
-                if commit is not None:
-                    commit()
                 if self.sleep is not None:
-                    self._guides[presc] = self._make_guide(
-                        deliv, m - 1, flipped, None
-                    )
+                    guide = self._make_guide(deliv, m - 1, flipped, None)
+                    self._guides[presc] = guide
+                    if commit is not None:
+                        commit(guide)
             else:
                 pruned_n += 1
         return fresh_n, redundant_n, pruned_n
@@ -2306,6 +2399,14 @@ class DeviceDPOR:
         fresh_n = redundant_n = pruned_n = 0
         sleep_pruned = 0
         for lane in range(n_lanes):
+            if (
+                not self.pad_exploration
+                and batch is not None
+                and not batch[lane]
+            ):
+                # Closed seeded exploration (see pad_exploration): skip
+                # the padding lane's harvest wholesale.
+                continue
             metas, positions = racing_prescriptions_meta(
                 traces[lane], int(lens[lane]), recw,
                 independence=self.static_independence,
@@ -2364,8 +2465,6 @@ class DeviceDPOR:
                         continue
                 if self._admit(presc, None, frontier):
                     fresh_n += 1
-                    if commit is not None:
-                        commit()
                     if self.sleep is not None:
                         if lane_deliv is None:
                             recs = traces[lane, : int(lens[lane]), :recw]
@@ -2375,9 +2474,14 @@ class DeviceDPOR:
                         # flip_ord=None: the one guide rule both host
                         # paths share (see _make_guide) — the meta's
                         # exact ordinal resolves to the same row.
-                        self._guides[presc] = self._make_guide(
+                        guide = self._make_guide(
                             lane_deliv, branch, presc[-1], None
                         )
+                        self._guides[presc] = guide
+                        if commit is not None:
+                            commit(guide)
+                    elif commit is not None:
+                        commit()
                 else:
                     pruned_n += 1
         if sleep_pruned:

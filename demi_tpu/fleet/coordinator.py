@@ -180,6 +180,7 @@ class FleetCoordinator:
         static_prune: bool = False,
         class_store_dir: Optional[str] = None,
         warm_start: bool = False,
+        delta: bool = False,
         stop_on_violation: bool = False,
         target_code: Optional[int] = None,
         lease_timeout: float = 120.0,
@@ -217,7 +218,13 @@ class FleetCoordinator:
         self.sleep_cap = sleep_cap() if sleep else 0
         rel = StaticIndependence.for_app(app) if (sleep or static_prune) else None
         sleep_obj: Any = (
-            SleepSets(independence=rel, prune=prune, cap=self.sleep_cap)
+            SleepSets(
+                independence=rel, prune=prune, cap=self.sleep_cap,
+                # Guides are retained only when a store is in play: they
+                # are what makes a published class re-seedable by a
+                # later differential run.
+                retain_guides=class_store_dir is not None,
+            )
             if sleep
             else False
         )
@@ -234,15 +241,27 @@ class FleetCoordinator:
         self.store: Optional[ClassStore] = (
             ClassStore(class_store_dir, self.fp) if class_store_dir else None
         )
-        self.warm = ClassLedger()
-        if warm_start and self.store is not None and self.dpor.sleep is not None:
-            self.warm = self.store.load()
-            if self.warm.classes:
-                self.dpor.sleep.seed_covered(self.warm.classes)
+        # Journal is attached before the warm/delta block so the
+        # ``dpor.delta`` record lands in it.
         self._journal_attached_here = False
         if journal_dir and not obs.journal.attached():
             obs.journal.attach(journal_dir)
             self._journal_attached_here = True
+        self.warm = ClassLedger()
+        self.delta_stats: Optional[Dict[str, Any]] = None
+        if self.store is not None and self.dpor.sleep is not None:
+            if delta:
+                from ..analysis.delta import delta_warm_start
+
+                self.delta_stats = delta_warm_start(
+                    self.dpor, self.store, app
+                )
+            elif warm_start:
+                self.warm = self.store.load()
+                if self.warm.classes:
+                    self.dpor.sleep.seed_covered(
+                        self.warm.classes, meta=self.warm.meta
+                    )
         # Distributed-trace root: every lease and config reply carries a
         # context derived from it, and finalize() exports the
         # coordinator's spans next to the journal for `trace stitch`.
@@ -753,9 +772,10 @@ class FleetCoordinator:
             self._journal_attached_here = False
         store_info = None
         if self.store is not None and self.dpor.sleep is not None:
-            ledger = ClassLedger(
-                classes=self.dpor.sleep.classes,
-                violation_codes=self.dpor.violation_codes,
+            from ..analysis.delta import build_run_ledger
+
+            ledger = build_run_ledger(
+                self.dpor, self.app, inherited=self.delta_stats
             )
             self.store.publish(ledger)
             store_info = {
@@ -825,6 +845,19 @@ class FleetCoordinator:
             summary["classes_sha"] = set_digest(sleep.classes)
             summary["warm_skips"] = sleep.warm_hits
             summary["warm_covered"] = len(self.warm.classes)
+            # Effective verdict (live + warm-inherited, min-sha merged):
+            # emitted for scratch runs too, so a --diff-audit scratch
+            # leg compares the same keys.
+            from ..analysis.delta import effective_violations
+
+            codes, shas = effective_violations(self.dpor, self.delta_stats)
+            summary["violation_codes_effective"] = codes
+            summary["witness_shas"] = shas
+        if self.delta_stats is not None:
+            summary["delta"] = {
+                k: v for k, v in self.delta_stats.items()
+                if k != "inherited_witnesses"
+            }
         if store_info is not None:
             summary["store"] = store_info
         return summary
@@ -846,6 +879,7 @@ def run_fleet(
     prune: bool = False,
     class_store_dir: Optional[str] = None,
     warm_start: bool = False,
+    delta: bool = False,
     stop_on_violation: bool = False,
     target_code: Optional[int] = None,
     journal_dir: Optional[str] = None,
@@ -876,7 +910,8 @@ def run_fleet(
         app, cfg, program,
         workload=workload, batch_size=batch, max_rounds=rounds,
         sleep=sleep, prune=prune, class_store_dir=class_store_dir,
-        warm_start=warm_start, stop_on_violation=stop_on_violation,
+        warm_start=warm_start, delta=delta,
+        stop_on_violation=stop_on_violation,
         target_code=target_code, lease_timeout=lease_timeout,
         max_outstanding=max_outstanding, min_ready=workers,
         journal_dir=journal_dir, straggler_factor=straggler_factor,

@@ -41,7 +41,8 @@ import json
 import os
 import sys
 import zlib
-from typing import Any, Dict, Iterable, List, Optional, Set
+from collections import OrderedDict
+from typing import Any, Dict, Iterable, List, Optional, Set, Tuple
 
 from .. import obs
 
@@ -50,10 +51,54 @@ def _warn(msg: str) -> None:
     print(f"demi_tpu.fleet: {msg}", file=sys.stderr)
 
 
+def _meta_rank(m: Tuple[int, int, Optional[tuple], int]):
+    """Total order over per-class meta records so merging two records
+    for the same key is a deterministic, commutative, associative min:
+    a record WITH a guide beats one without; ties break on
+    (plen, guide, dmask, mask)."""
+    mask, plen, guide = m[0], m[1], m[2]
+    dmask = int(m[3]) if len(m) > 3 else -1
+    return (
+        0 if guide is not None else 1,
+        plen if guide is not None else 0,
+        guide or (),
+        dmask,
+        mask,
+    )
+
+
+def _better_meta(a, b):
+    return a if _meta_rank(a) <= _meta_rank(b) else b
+
+
+def _better_witness(a: Dict[str, Any], b: Dict[str, Any]) -> Dict[str, Any]:
+    """Canonical (min-digest) first-found record: order-free, so a
+    differential run and a scratch run converge on the same witness for
+    each code no matter which round found it first."""
+    return a if str(a.get("sha", "")) <= str(b.get("sha", "")) else b
+
+
 class ClassLedger:
     """A mergeable set of Mazurkiewicz class keys + observed violation
     codes (see module doc). Keys are the canonical tuples
-    ``analysis.canonical_class_key`` produces."""
+    ``analysis.canonical_class_key`` produces.
+
+    PR 18 widens the record for differential exploration while keeping
+    every merge a deterministic, commutative, associative fold:
+
+    - ``meta``: per-class ``(tag_mask, plen, guide_rows, dmask)`` — the
+      delivery-tag footprint (always) plus the admission replay guide
+      and reversal-chain tag mask (store-backed runs), keyed like
+      ``SleepSets.class_meta``;
+    - ``pending``: classes admitted but never executed by budget end —
+      a delta run must not execute what scratch never executed, or the
+      class sets diverge;
+    - ``manifest``: the per-tag effect-signature manifest
+      (``analysis.delta.effect_manifest``) of the app version that
+      published the segment;
+    - ``witnesses``: per violation code, the canonical (min-digest)
+      first-found record ``{"sha", "class", "trace"}``.
+    """
 
     def __init__(
         self,
@@ -64,6 +109,10 @@ class ClassLedger:
             tuple(tuple(r) for r in k) for k in classes
         }
         self.violation_codes: Set[int] = {int(c) for c in violation_codes}
+        self.meta: Dict[tuple, Tuple[int, int, Optional[tuple]]] = {}
+        self.pending: Set[tuple] = set()
+        self.manifest: Optional[Dict[str, Any]] = None
+        self.witnesses: Dict[int, Dict[str, Any]] = {}
 
     def __len__(self) -> int:
         return len(self.classes)
@@ -80,8 +129,27 @@ class ClassLedger:
 
     def merge(self, other: "ClassLedger") -> "ClassLedger":
         """In-place set union (associative + commutative); returns self."""
+        executed = (self.classes - self.pending) | (
+            other.classes - other.pending
+        )
         self.classes |= other.classes
         self.violation_codes |= other.violation_codes
+        self.pending = (self.pending | other.pending) - executed
+        for k, m in other.meta.items():
+            cur = self.meta.get(k)
+            self.meta[k] = m if cur is None else _better_meta(cur, m)
+        if self.manifest is None:
+            self.manifest = other.manifest
+        elif other.manifest is not None and other.manifest != self.manifest:
+            a = json.dumps(self.manifest, sort_keys=True)
+            b = json.dumps(other.manifest, sort_keys=True)
+            if b < a:
+                self.manifest = other.manifest
+        for code, w in other.witnesses.items():
+            cur = self.witnesses.get(code)
+            self.witnesses[code] = (
+                w if cur is None else _better_witness(cur, w)
+            )
         return self
 
     @classmethod
@@ -94,31 +162,117 @@ class ClassLedger:
     # -- wire / disk form --------------------------------------------------
     def to_payload(self) -> Dict[str, Any]:
         """Deterministic JSON-able payload: sorted class keys as one
-        delta-encoded zlib frame (the persist/ codec) + sorted codes.
-        Equal ledgers produce equal payload bytes — the property the
-        content-addressed store's dedup rests on."""
-        from ..persist.checkpoint import pack_prescriptions
+        delta-encoded zlib frame (the persist/ codec) + sorted codes,
+        with masks/plens/guides aligned to the sorted class order and
+        witnesses sorted by code. Equal ledgers produce equal payload
+        bytes — the property the content-addressed store's dedup rests
+        on."""
+        import numpy as np
 
+        from ..analysis.sleep import class_tag_mask
+        from ..persist.checkpoint import pack_array, pack_prescriptions
+
+        keys = sorted(self.classes)
+        index = {k: i for i, k in enumerate(keys)}
+        masks: List[int] = []
+        plens: List[int] = []
+        guides: List[tuple] = []
+        dmasks: List[int] = []
+        for k in keys:
+            m = self.meta.get(k, (class_tag_mask(k), -1, None, -1))
+            mask, plen, guide = m[0], m[1], m[2]
+            masks.append(int(mask))
+            plens.append(int(plen) if guide is not None else -1)
+            guides.append(guide or ())
+            dmasks.append(
+                int(m[3]) if len(m) > 3 and guide is not None else -1
+            )
+        witnesses = []
+        for code in sorted(self.witnesses):
+            w = self.witnesses[code]
+            tr = w.get("trace")
+            witnesses.append({
+                "code": int(code),
+                "sha": str(w.get("sha", "")),
+                "class": index.get(w.get("class"), -1),
+                "trace": (
+                    pack_array(np.asarray(tr)) if tr is not None else None
+                ),
+            })
         return {
-            "classes": pack_prescriptions(sorted(self.classes)),
+            "classes": pack_prescriptions(keys),
             "violation_codes": sorted(self.violation_codes),
+            "masks": masks,
+            "plens": plens,
+            "dmasks": dmasks,
+            "guides": pack_prescriptions(guides),
+            "pending": sorted(index[k] for k in self.pending),
+            "manifest": self.manifest,
+            "witnesses": witnesses,
         }
 
     @classmethod
     def from_payload(cls, payload: Dict[str, Any]) -> "ClassLedger":
-        from ..persist.checkpoint import unpack_prescriptions
+        from ..persist.checkpoint import unpack_array, unpack_prescriptions
 
-        return cls(
+        led = cls(
             classes=unpack_prescriptions(payload["classes"]),
             violation_codes=payload.get("violation_codes", ()),
         )
+        keys = sorted(led.classes)
+        masks = payload.get("masks")
+        if masks is not None and len(masks) == len(keys):
+            plens = payload.get("plens", [-1] * len(keys))
+            dmasks = payload.get("dmasks", [-1] * len(keys))
+            try:
+                guides = unpack_prescriptions(payload["guides"])
+            except Exception:
+                guides = [()] * len(keys)
+            for i, k in enumerate(keys):
+                plen = int(plens[i])
+                guide = (
+                    tuple(tuple(int(x) for x in r) for r in guides[i])
+                    if plen >= 0 and i < len(guides) else None
+                )
+                led.meta[k] = (
+                    int(masks[i]),
+                    plen if guide is not None else -1,
+                    guide,
+                    int(dmasks[i])
+                    if guide is not None and i < len(dmasks) else -1,
+                )
+        led.pending = {
+            keys[i] for i in payload.get("pending", ()) if 0 <= i < len(keys)
+        }
+        led.manifest = payload.get("manifest")
+        for w in payload.get("witnesses", ()):
+            idx = int(w.get("class", -1))
+            tr = w.get("trace")
+            led.witnesses[int(w["code"])] = {
+                "sha": str(w.get("sha", "")),
+                "class": keys[idx] if 0 <= idx < len(keys) else None,
+                "trace": unpack_array(tr) if tr is not None else None,
+            }
+        return led
+
+
+#: Parsed-segment cache shared by every ClassStore in the process. The
+#: key is the segment FILENAME, which is the sha256 of its bytes — a
+#: content address is directory-independent and can never go stale (a
+#: changed segment is a different file), so cache hits skip the
+#: read + re-hash + inflate + parse entirely. Bounded FIFO.
+_PARSED_CACHE: "OrderedDict[str, ClassLedger]" = OrderedDict()
+_PARSED_CACHE_CAP = 256
 
 
 class ClassStore:
     """Content-addressed, cross-run persistent ledger store (see module
     doc). One directory per workload fingerprint, so raft-with-bug-A can
     never warm-start raft-with-bug-B (the persist/ handler-fingerprint
-    discriminator reused)."""
+    discriminator reused). Differential exploration reads ACROSS
+    fingerprint directories (``sibling_fps``/``load_fp``): a changed
+    app's store is empty under its own fingerprint, and the delta plan
+    decides what transfers from a prior version's directory."""
 
     def __init__(self, root: str, workload_fp: str):
         self.root = root
@@ -126,7 +280,7 @@ class ClassStore:
         self.dir = os.path.join(root, workload_fp)
         self.stats: Dict[str, int] = {
             "segments_loaded": 0, "segments_corrupt": 0,
-            "segments_published": 0,
+            "segments_published": 0, "cache_hits": 0,
         }
 
     def segments(self) -> List[str]:
@@ -137,6 +291,66 @@ class ClassStore:
         except OSError:
             return []
 
+    def sibling_fps(self) -> List[str]:
+        """Other workload-fingerprint directories under the same root
+        that hold at least one segment — the candidate prior versions a
+        delta plan may transfer classes from."""
+        try:
+            entries = sorted(os.listdir(self.root))
+        except OSError:
+            return []
+        out = []
+        for e in entries:
+            if e == self.workload_fp:
+                continue
+            d = os.path.join(self.root, e)
+            if not os.path.isdir(d):
+                continue
+            if any(n.endswith(".seg") for n in os.listdir(d)):
+                out.append(e)
+        return out
+
+    def load_fp(self, fp: str) -> ClassLedger:
+        """Load a sibling fingerprint's ledger, folding its load stats
+        into this store's counters."""
+        sib = ClassStore(self.root, fp)
+        led = sib.load()
+        for k, v in sib.stats.items():
+            self.stats[k] = self.stats.get(k, 0) + v
+        return led
+
+    def _load_segment(self, name: str) -> Optional[ClassLedger]:
+        """Parse ONE segment, via the process-wide parsed cache (keyed
+        by the segment's content-hash filename). Returns None for a
+        corrupt segment (counted + warned, never raised)."""
+        cached = _PARSED_CACHE.get(name)
+        if cached is not None:
+            _PARSED_CACHE.move_to_end(name)
+            self.stats["cache_hits"] += 1
+            obs.counter("fleet.store_cache").inc()
+            return cached
+        path = os.path.join(self.dir, name)
+        try:
+            with open(path, "rb") as f:
+                data = f.read()
+            if hashlib.sha256(data).hexdigest() != name[:-len(".seg")]:
+                raise ValueError("content digest != segment address")
+            payload = json.loads(zlib.decompress(data))
+            parsed = ClassLedger.from_payload(payload)
+        except Exception as exc:
+            self.stats["segments_corrupt"] += 1
+            obs.counter("persist.corrupt_fallbacks").force_inc()
+            _warn(
+                f"class-store segment {path!r} unusable ({exc}); "
+                "skipping — coverage degrades to the remaining "
+                "segments"
+            )
+            return None
+        _PARSED_CACHE[name] = parsed
+        while len(_PARSED_CACHE) > _PARSED_CACHE_CAP:
+            _PARSED_CACHE.popitem(last=False)
+        return parsed
+
     def load(self) -> ClassLedger:
         """Merge every valid segment (any order — union is order-free).
         A segment whose bytes no longer hash to its own filename, or
@@ -144,25 +358,60 @@ class ClassStore:
         store degrades to the good segments, never crashes."""
         merged = ClassLedger()
         for name in self.segments():
-            path = os.path.join(self.dir, name)
-            try:
-                with open(path, "rb") as f:
-                    data = f.read()
-                if hashlib.sha256(data).hexdigest() != name[:-len(".seg")]:
-                    raise ValueError("content digest != segment address")
-                payload = json.loads(zlib.decompress(data))
-                merged.merge(ClassLedger.from_payload(payload))
-            except Exception as exc:
-                self.stats["segments_corrupt"] += 1
-                obs.counter("persist.corrupt_fallbacks").force_inc()
-                _warn(
-                    f"class-store segment {path!r} unusable ({exc}); "
-                    "skipping — coverage degrades to the remaining "
-                    "segments"
-                )
+            parsed = self._load_segment(name)
+            if parsed is None:
                 continue
+            merged.merge(parsed)
             self.stats["segments_loaded"] += 1
         return merged
+
+    def compact(self) -> Dict[str, Any]:
+        """Merge this fingerprint's accumulated segments into ONE
+        deduped segment. The merged segment is published first (atomic
+        tmp + fsync + rename, like any publish) and the directory entry
+        fsynced; only then are the merged-in old segments removed —
+        a crash at any point leaves a loadable store. Corrupt segments
+        are skipped (counted under ``persist.corrupt_fallbacks``) and
+        left in place for forensics."""
+        names = self.segments()
+        corrupt_before = self.stats["segments_corrupt"]
+        merged = ClassLedger()
+        good: List[str] = []
+        for name in names:
+            parsed = self._load_segment(name)
+            if parsed is None:
+                continue
+            merged.merge(parsed)
+            good.append(name)
+        path = self.publish(merged)
+        keep = os.path.basename(path) if path else None
+        removed = 0
+        if keep is not None:
+            try:
+                dfd = os.open(self.dir, os.O_RDONLY)
+                try:
+                    os.fsync(dfd)
+                finally:
+                    os.close(dfd)
+            except OSError:
+                pass
+            for name in good:
+                if name == keep:
+                    continue
+                try:
+                    os.unlink(os.path.join(self.dir, name))
+                    removed += 1
+                except OSError:
+                    pass
+        return {
+            "fp": self.workload_fp,
+            "segments_before": len(names),
+            "segments_removed": removed,
+            "segments_corrupt": self.stats["segments_corrupt"]
+            - corrupt_before,
+            "classes": len(merged),
+            "merged_segment": keep,
+        }
 
     def publish(self, ledger: ClassLedger) -> Optional[str]:
         """Write one segment holding ``ledger`` (atomic: tmp + fsync +
@@ -191,3 +440,27 @@ class ClassStore:
         self.stats["segments_published"] += 1
         obs.counter("fleet.store_segments_published").force_inc()
         return path
+
+
+def compact_store(path: str) -> List[Dict[str, Any]]:
+    """Compact a class store on disk (the ``demi_tpu store compact``
+    CLI): ``path`` may be a store ROOT (one fingerprint subdirectory
+    per workload — each is compacted) or a single fingerprint directory
+    (contains ``.seg`` files directly). Returns one result dict per
+    compacted fingerprint."""
+    path = os.path.abspath(path)
+    try:
+        entries = sorted(os.listdir(path))
+    except OSError:
+        return []
+    if any(e.endswith(".seg") for e in entries):
+        root, fp = os.path.split(path)
+        return [ClassStore(root, fp).compact()]
+    out = []
+    for e in entries:
+        d = os.path.join(path, e)
+        if os.path.isdir(d) and any(
+            n.endswith(".seg") for n in os.listdir(d)
+        ):
+            out.append(ClassStore(path, e).compact())
+    return out

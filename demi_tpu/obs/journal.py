@@ -233,9 +233,9 @@ JOURNAL: Optional[RoundJournal] = None
 #: grow the (unrotated within one flush window) time-series export per
 #: execution instead of per round.
 _SAMPLED_KINDS = frozenset(
-    ("dpor.round", "sweep.chunk", "minimize.level", "minimize.stage",
-     "pipeline.frame", "fleet.round", "fleet.host_shard", "service.chunk",
-     "service.frame")
+    ("dpor.round", "dpor.delta", "sweep.chunk", "minimize.level",
+     "minimize.stage", "pipeline.frame", "fleet.round", "fleet.host_shard",
+     "service.chunk", "service.frame")
 )
 
 

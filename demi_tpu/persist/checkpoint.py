@@ -603,8 +603,33 @@ def device_dpor_payload(dpor) -> Dict[str, Any]:
         }
     sleep = None
     if dpor.sleep is not None:
+        class_keys = sorted(dpor.sleep.classes)
+        masks: List[int] = []
+        plens: List[int] = []
+        class_dmasks: List[int] = []
+        class_guides: List[list] = []
+        for k in class_keys:
+            m = dpor.sleep.class_meta.get(k)
+            if m is None:
+                masks.append(-1)  # recompute lazily on restore
+                plens.append(-1)
+                class_dmasks.append(-1)
+                class_guides.append([])
+            else:
+                masks.append(int(m[0]))
+                plens.append(int(m[1]) if m[2] is not None else -1)
+                class_dmasks.append(
+                    int(m[3]) if len(m) > 3 and m[2] is not None else -1
+                )
+                class_guides.append(
+                    [list(r) for r in m[2]] if m[2] is not None else []
+                )
         sleep = {
-            "classes": _pack_rows(sorted(dpor.sleep.classes)),
+            "classes": _pack_rows(class_keys),
+            "class_masks": masks,
+            "class_plens": plens,
+            "class_dmasks": class_dmasks,
+            "class_guides": _pack_rows(class_guides),
             "node_flip_keys": [
                 _b64(k) for k in sorted(dpor.sleep._node_flips)
             ],
@@ -616,6 +641,20 @@ def device_dpor_payload(dpor) -> Dict[str, Any]:
         }
     sleep_keys = sorted(dpor._sleep_rows, key=log_index)
     guide_keys = sorted(dpor._guides, key=log_index)
+    class_of_keys = sorted(dpor._class_of, key=log_index)
+    witnesses = []
+    for code in sorted(dpor.violation_witnesses):
+        w = dpor.violation_witnesses[code]
+        ck = w.get("class")
+        witnesses.append({
+            "code": int(code),
+            "sha": str(w.get("sha", "")),
+            "class": None if ck is None else [list(r) for r in ck],
+            "trace": (
+                pack_array(np.asarray(w["trace"]))
+                if w.get("trace") is not None else None
+            ),
+        })
     return {
         "workload": device_dpor_workload(dpor),
         "explored": explored,
@@ -646,6 +685,13 @@ def device_dpor_payload(dpor) -> Dict[str, Any]:
         "guides_vals": _pack_rows(
             [np.asarray(dpor._guides[p]).tolist() for p in guide_keys]
         ),
+        "class_of_keys": _pack_ints(
+            log_index(p) for p in class_of_keys
+        ),
+        "class_of_vals": _pack_rows(
+            [[list(r) for r in dpor._class_of[p]] for p in class_of_keys]
+        ),
+        "violation_witnesses": witnesses,
         "sleep_state": sleep,
         "batch_size_hint": (
             None if dpor._batch_size_hint is None
@@ -729,13 +775,62 @@ def restore_device_dpor(dpor, payload: Dict[str, Any]) -> None:
         None if payload.get("batch_size_hint") is None
         else tuple(payload["batch_size_hint"])
     )
+    dpor._class_of = {}
+    if "class_of_keys" in payload:
+        dpor._class_of = {
+            log[i]: tuple(tuple(r) for r in rows)
+            for i, rows in zip(
+                _unpack_ints(payload["class_of_keys"]),
+                _unpack_rows(payload["class_of_vals"]),
+            )
+        }
+    dpor.violation_witnesses = {}
+    for w in payload.get("violation_witnesses", ()):
+        ck = w.get("class")
+        dpor.violation_witnesses[int(w["code"])] = {
+            "sha": str(w.get("sha", "")),
+            "class": (
+                None if ck is None else tuple(tuple(r) for r in ck)
+            ),
+            "trace": (
+                unpack_array(w["trace"])
+                if w.get("trace") is not None else None
+            ),
+        }
     if payload["tuner"] is not None and dpor.tuner is not None:
         dpor.tuner.rounds = payload["tuner"]["rounds"]
         dpor.tuner.round_batch = payload["tuner"]["round_batch"]
         dpor.tuner.max_distance = payload["tuner"]["max_distance"]
     if payload["sleep_state"] is not None and dpor.sleep is not None:
         sleep = payload["sleep_state"]
-        dpor.sleep.classes = set(_unpack_rows(sleep["classes"]))
+        class_keys = _unpack_rows(sleep["classes"])
+        dpor.sleep.classes = set(class_keys)
+        dpor.sleep.class_meta = {}
+        if "class_masks" in sleep:
+            sorted_keys = sorted(dpor.sleep.classes)
+            masks = sleep["class_masks"]
+            plens = sleep.get("class_plens", [-1] * len(sorted_keys))
+            dmasks = sleep.get("class_dmasks", [-1] * len(sorted_keys))
+            guides = _unpack_rows(sleep["class_guides"])
+            for i, k in enumerate(sorted_keys):
+                mask = int(masks[i])
+                if mask < 0:
+                    # No meta was recorded for this class (e.g. merged
+                    # from a worker ledger): leave it absent so a
+                    # re-checkpoint round-trips bit-identically.
+                    continue
+                plen = int(plens[i])
+                guide = (
+                    tuple(tuple(int(x) for x in r) for r in guides[i])
+                    if plen >= 0 and i < len(guides) else None
+                )
+                dpor.sleep.class_meta[k] = (
+                    mask,
+                    plen if guide is not None else -1,
+                    guide,
+                    int(dmasks[i])
+                    if guide is not None and i < len(dmasks) else -1,
+                )
         dpor.sleep._node_flips = {
             _unb64(k): [tuple(r) for r in rows]
             for k, rows in zip(

@@ -40,7 +40,7 @@ minimized twice (namespace-keyed dedup + per-stage gamut resume).
 from __future__ import annotations
 
 import time
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from .. import obs
 from ..obs import distributed as dtrace
@@ -113,6 +113,7 @@ class ExplorationService:
             "frames_done": 0,
             "checker_hits": 0,
             "refusals": 0,
+            "versions": 0,
             "elapsed_s": 0.0,
         }
         self._t0 = time.perf_counter()
@@ -133,13 +134,19 @@ class ExplorationService:
         return self.state["elapsed_s"] + (time.perf_counter() - self._t0)
 
     # -- admission (server-thread safe) --------------------------------------
-    def _workload_fp(self, workload: Optional[dict]) -> str:
+    def _workload_fp(self, workload: Optional[dict]) -> Tuple[str, dict]:
+        """(fingerprint, effect-signature manifest) of a workload —
+        both cached per canonical workload key: the manifest is what a
+        version bump diffs to compute the new version's change cone."""
         key = workload_key(workload, "")
-        fp = self._fp_cache.get(key)
-        if fp is None:
-            _a, _c, _cfg, _g, fp = build_service_workload(workload)
-            self._fp_cache[key] = fp
-        return fp
+        hit = self._fp_cache.get(key)
+        if hit is None:
+            from ..analysis.delta import effect_manifest
+
+            app, _c, _cfg, _g, fp = build_service_workload(workload)
+            hit = (fp, effect_manifest(app))
+            self._fp_cache[key] = hit
+        return hit
 
     def submit(
         self,
@@ -155,31 +162,42 @@ class ExplorationService:
         trace: Optional[Dict[str, Any]] = None,
     ) -> Dict[str, Any]:
         """Admit one job. Registers the tenant on first contact (its
-        fingerprint pinned to this workload's); REFUSES a submission
-        whose workload builds to a different fingerprint than the
-        tenant's pinned one — same-shape bug variants must never share
-        a tenant's oracles or artifacts."""
-        fp = self._workload_fp(workload)  # build outside the lock
+        fingerprint pinned to this workload's). A submission whose
+        workload builds a DIFFERENT fingerprint becomes a new tenant
+        *version*: the old fingerprint joins the lineage, the stored
+        effect-signature manifest diffs against the new one into a
+        delta plan (the change cone the differential explorer rides),
+        and the job runs under the new pin — oracles and artifacts
+        never cross versions because groups key on the job's own
+        fingerprint."""
+        fp, manifest = self._workload_fp(workload)  # build outside the lock
+        plan = None
         with self._lock:
             t = self.tenants.get(tenant)
             if t is None:
                 t = Tenant(tenant, fp, weight)
+                t.manifest = manifest
                 self.tenants[tenant] = t
                 obs.journal.emit(
                     "service.tenant", tenant=tenant, event="register",
                     fp=fp, weight=t.weight,
                 )
             elif t.fp != fp:
-                self.state["refusals"] += 1
-                t.note("refusals")
+                from ..analysis.delta import compute_delta
+
+                plan = compute_delta(t.manifest, manifest)
+                t.lineage.append(t.fp)
+                t.version += 1
+                t.fp = fp
+                t.manifest = manifest
+                self.state["versions"] = self.state.get("versions", 0) + 1
+                t.note("versions")
                 obs.journal.emit(
-                    "service.tenant", tenant=tenant, event="refuse",
-                    fp=fp, pinned=t.fp,
-                )
-                raise ServiceRefusal(
-                    f"tenant {tenant!r} is pinned to handler fingerprint "
-                    f"{t.fp} but the submitted workload builds {fp} — "
-                    "same-shape bug variants cannot share a tenant"
+                    "service.tenant", tenant=tenant, event="version",
+                    fp=fp, prev=t.lineage[-1], version=t.version,
+                    full=plan.full, reason=plan.reason,
+                    changed_tags=plan.changed_tags,
+                    cone_tags=plan.cone_tags,
                 )
             job_id = f"j{self._next_job}"
             self._next_job += 1
@@ -193,6 +211,7 @@ class ExplorationService:
                 base_key=int(base_key),
                 max_frames=max_frames,
                 wildcards=wildcards,
+                fp=fp,
             )
             ctx = dtrace.TraceContext.from_wire(trace)
             job = ServiceJob(spec=spec, tenant=t, trace=trace)
@@ -214,7 +233,11 @@ class ExplorationService:
                     **(ctx.span_args() if ctx is not None
                        else self.trace.span_args()),
                 )
-            return job.summary(self.queue)
+            reply = job.summary(self.queue)
+            reply["tenant_version"] = t.version
+            if plan is not None:
+                reply["delta"] = plan.to_json()
+            return reply
 
     # -- engine --------------------------------------------------------------
     def _adopt_queued(self) -> None:
@@ -223,7 +246,7 @@ class ExplorationService:
                 j for j in self.jobs.values() if j.status == "queued"
             ]
         for job in queued:
-            key = workload_key(job.spec.workload, job.tenant.fp)
+            key = workload_key(job.spec.workload, job.spec.fp or job.tenant.fp)
             group = self.groups.get(key)
             if group is None:
                 group = ServiceGroup(
@@ -705,6 +728,8 @@ class ExplorationService:
             tenants = {
                 name: {
                     "fp": t.fp,
+                    "version": t.version,
+                    "lineage": list(t.lineage),
                     "weight": t.weight,
                     "frames_done": t.frames_done,
                     "violations": t.violations,
@@ -723,6 +748,7 @@ class ExplorationService:
             "frames_done": self.state["frames_done"],
             "chunks": self.state["chunks"],
             "refusals": self.state["refusals"],
+            "versions": self.state.get("versions", 0),
             "queue": {
                 "enqueued": self.queue.enqueued,
                 "done": self.queue.done,

@@ -108,7 +108,14 @@ def artifact_signature(payload: Dict[str, Any]) -> tuple:
 
 class Tenant:
     """One registered tenant: pinned fingerprint, fair-share weight,
-    LaunchBudget account, and a private labeled-at-merge registry."""
+    LaunchBudget account, and a private labeled-at-merge registry.
+
+    The fingerprint pin is a *lineage*, not a wall: a submission that
+    builds a DIFFERENT fingerprint bumps ``version``, appends the old
+    fingerprint to ``lineage``, and re-pins — the daemon diffs the
+    stored effect-signature ``manifest`` against the new workload's
+    into a delta plan (analysis/delta.py), so re-verification of the
+    new version rides the change cone instead of starting over."""
 
     def __init__(self, name: str, fp: str, weight: float = 1.0):
         self.name = name
@@ -120,6 +127,9 @@ class Tenant:
         self.violations = 0
         self.lanes_done = 0
         self.jobs_submitted = 0
+        self.version = 0
+        self.lineage: List[str] = []  # prior fingerprints, oldest first
+        self.manifest: Optional[Dict[str, Any]] = None
 
     # -- scheduling ----------------------------------------------------------
     @property
@@ -157,6 +167,9 @@ class Tenant:
             "violations": self.violations,
             "lanes_done": self.lanes_done,
             "jobs_submitted": self.jobs_submitted,
+            "version": self.version,
+            "lineage": list(self.lineage),
+            "manifest": self.manifest,
             "dispatched": dict(self.budget.dispatched),
             "harvested": dict(self.budget.harvested),
             "launches": dict(self.budget.launches),
@@ -170,6 +183,9 @@ class Tenant:
         t.violations = int(obj.get("violations", 0))
         t.lanes_done = int(obj.get("lanes_done", 0))
         t.jobs_submitted = int(obj.get("jobs_submitted", 0))
+        t.version = int(obj.get("version", 0))
+        t.lineage = [str(x) for x in obj.get("lineage", [])]
+        t.manifest = obj.get("manifest")
         t.budget.dispatched = {
             k: int(v) for k, v in obj.get("dispatched", {}).items()
         }
@@ -198,6 +214,9 @@ class JobSpec:
     base_key: int = 0
     max_frames: Optional[int] = None
     wildcards: bool = True
+    # Fingerprint the workload built at submit time: a later tenant
+    # version bump must not re-group this job under the new pin.
+    fp: str = ""
 
     def to_json(self) -> Dict[str, Any]:
         return {
@@ -209,6 +228,7 @@ class JobSpec:
             "base_key": int(self.base_key),
             "max_frames": self.max_frames,
             "wildcards": bool(self.wildcards),
+            "fp": self.fp,
         }
 
     @classmethod
@@ -222,6 +242,7 @@ class JobSpec:
             base_key=int(obj.get("base_key", 0)),
             max_frames=obj.get("max_frames"),
             wildcards=bool(obj.get("wildcards", True)),
+            fp=str(obj.get("fp", "")),
         )
 
 

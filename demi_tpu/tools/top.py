@@ -204,7 +204,7 @@ def render_frame(
         "minimize.stage": "minimize", "pipeline.enqueue": "pipeline",
         "pipeline.frame": "pipeline", "fleet.round": "fleet",
         "fleet.worker": "fleet", "fleet.straggler": "fleet",
-        "fleet.host_shard": "fleet",
+        "fleet.host_shard": "fleet", "dpor.delta": "fleet",
         "service.chunk": "service",
         "service.frame": "service", "service.enqueue": "service",
         "service.job": "service", "service.tenant": "service",
@@ -281,6 +281,30 @@ def render_frame(
                          f"time-to-first {_fmt(ttfv, '.2f', 's')}")
         else:
             lines.append("  violations: none yet")
+
+    # Differential warm start: one dpor.delta record per run — what
+    # transferred vs what the change cone forced back onto the frontier.
+    delta_recs = [r for r in records if r.get("kind") == "dpor.delta"]
+    if delta_recs:
+        d = delta_recs[-1]
+        lines.append("")
+        if d.get("full"):
+            lines.append(
+                "DELTA  FULL re-exploration"
+                + (f" ({d.get('reason')})" if d.get("reason") else "")
+                + f"  stored {d.get('stored_classes', 0)} classes"
+            )
+        else:
+            stored = d.get("stored_classes", 0) or 0
+            moved = d.get("transferred", 0) or 0
+            lines.append(
+                f"DELTA  mode {d.get('mode', '—')}  "
+                f"cone tags {d.get('cone_tags', [])}  "
+                f"transferred {moved}/{stored} classes "
+                f"[{_bar(moved / stored if stored else 0.0, miniw)}]  "
+                f"reseeded {d.get('reseeded', 0)}  "
+                f"skipped launches {d.get('skipped_launches', 0)}"
+            )
 
     fleet = [r for r in records if r.get("kind") == "fleet.round"]
     fleet_w = [r for r in records if r.get("kind") == "fleet.worker"]

@@ -3,10 +3,11 @@ their JSON keys. bench drift previously had no coverage — a renamed or
 dropped key surfaced only on the next (scarce) TPU window.
 
 NOTE: the config-14 (multi-tenant service) smoke lives in
-tests/test_zzz_service.py, not here — the 870s tier-1 cap truncates
-the suite tail, so new heavy tests must collect AFTER every existing
-file instead of pushing seed tests past the cap (dots-vs-seed is the
-tier-1 metric)."""
+tests/test_zzz_service.py and the config-17 (differential exploration)
+smoke in tests/test_zzzz_bench_delta.py, not here — the 870s tier-1
+cap truncates the suite tail, so new heavy tests must collect AFTER
+every existing file instead of pushing seed tests past the cap
+(dots-vs-seed is the tier-1 metric)."""
 
 import json
 import os

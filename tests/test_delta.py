@@ -1,0 +1,240 @@
+"""Differential exploration units (analysis/delta.py, ISSUE 18): the
+manifest diff, the reversal-chain transfer split, ledger/store payload
+round-trips, the parsed-segment cache, and store compaction. The
+end-to-end scratch-vs-delta equality contract lives in bench
+``--config 17`` (smoked in tests/test_zzzz_bench_delta.py) — here each
+layer is pinned in isolation so a regression names its layer."""
+
+import copy
+import os
+
+import pytest
+
+from demi_tpu.analysis.delta import (
+    compute_delta,
+    effect_manifest,
+    split_transfer,
+)
+from demi_tpu.analysis.sleep import (
+    TRUNK_BIT,
+    class_tag_mask,
+    guide_row_tag,
+    tag_bit,
+)
+from demi_tpu.apps.raft import make_raft_app
+from demi_tpu.fleet.ledger import ClassLedger, ClassStore
+
+
+def _app(edit=None):
+    return make_raft_app(3, bug="multivote", handler_edit=edit)
+
+
+# -- manifest diff ---------------------------------------------------------
+
+def test_identical_manifest_empty_cone():
+    m = effect_manifest(_app())
+    plan = compute_delta(m, copy.deepcopy(m))
+    assert not plan.full
+    assert plan.changed_tags == [] and plan.cone_tags == []
+    assert plan.cone_mask == 0
+
+
+def test_refactor_edit_cones_exactly_one_tag():
+    plan = compute_delta(
+        effect_manifest(_app()),
+        effect_manifest(_app("refactor:heartbeat")),
+    )
+    assert not plan.full
+    assert plan.changed_tags == [2]
+    assert plan.cone_tags == [2]
+    assert plan.cone_mask == tag_bit(2)
+    assert plan.diff_fields == []  # effect sets equal, only code moved
+
+
+def test_opaque_edit_degrades_to_full():
+    plan = compute_delta(
+        effect_manifest(_app()),
+        effect_manifest(_app("opaque:heartbeat")),
+    )
+    assert plan.full
+    assert "unknown" in plan.reason
+
+
+def test_missing_and_mismatched_manifests_degrade_to_full():
+    m = effect_manifest(_app())
+    assert compute_delta(None, m).full
+    assert compute_delta(m, None).full
+    other = effect_manifest(make_raft_app(4, bug="multivote"))
+    assert compute_delta(m, other).full  # actor-count shape mismatch
+
+
+def test_fingerprint_moved_without_tag_change_degrades_to_full():
+    # Same per-tag signatures under a different whole-app fingerprint:
+    # SOMETHING moved that effects could not attribute — never transfer.
+    m = effect_manifest(_app())
+    m2 = copy.deepcopy(m)
+    m2["fp"] = "0" * len(m2["fp"])
+    plan = compute_delta(m, m2)
+    assert plan.full
+    assert "fingerprint" in plan.reason
+
+
+# -- reversal-chain transfer split ----------------------------------------
+
+def _key(tag):
+    # Canonical KEY rows are (kind, dst, tag, ...): one-delivery class.
+    return ((2, 0, tag, 9),)
+
+
+def _guide(tag):
+    # Guide rows keep the device layout (kind, src, dst, tag, ...).
+    return ((2, 1, 0, tag, 9),)
+
+
+def test_guide_row_tag_reads_device_layout():
+    assert guide_row_tag((2, 1, 0, 5, 9)) == 5
+    assert guide_row_tag((2, 1)) == 0
+
+
+def test_split_transfer_on_chain_masks():
+    led = ClassLedger()
+    cone_tag, free_tag = 3, 2
+    plan = compute_delta(
+        effect_manifest(_app()),
+        effect_manifest(_app("refactor:request_vote")),
+    )
+    assert plan.cone_mask == tag_bit(cone_tag)
+    trunk = _key(1)
+    clean = _key(4)
+    dirty = _key(5)
+    fallback = _key(cone_tag)
+    led.classes = {trunk, clean, dirty, fallback}
+    led.meta = {
+        # Planted trunk: zero reversals — always re-executed.
+        trunk: (class_tag_mask(trunk), 1, _guide(1), TRUNK_BIT),
+        # Chain reversed a (free_tag, free_tag) pair: avoids the cone.
+        clean: (class_tag_mask(clean), 1, _guide(4),
+                tag_bit(free_tag)),
+        # Chain touched the cone tag: re-explore.
+        dirty: (class_tag_mask(dirty), 1, _guide(5),
+                tag_bit(free_tag) | tag_bit(cone_tag)),
+        # Unknown lineage (-1): falls back to the full-key mask, whose
+        # one delivery IS the cone tag.
+        fallback: (class_tag_mask(fallback), 1, _guide(cone_tag), -1),
+    }
+    transfer, cone = split_transfer(led, plan)
+    assert set(transfer) == {clean}
+    assert set(cone) == {trunk, dirty, fallback}
+
+
+def test_split_transfer_full_plan_transfers_nothing():
+    led = ClassLedger()
+    led.classes = {_key(2), _key(4)}
+    plan = compute_delta(None, None)
+    assert plan.full
+    transfer, cone = split_transfer(led, plan)
+    assert transfer == [] and set(cone) == led.classes
+
+
+# -- ledger payload round-trip --------------------------------------------
+
+def test_ledger_payload_roundtrips_meta_pending_witnesses():
+    led = ClassLedger()
+    a, b, c = _key(1), _key(2), _key(3)
+    led.classes = {a, b, c}
+    led.violation_codes = {7}
+    led.meta = {
+        a: (class_tag_mask(a), 1, _guide(1), TRUNK_BIT),
+        b: (class_tag_mask(b), 1, _guide(2), tag_bit(2) | tag_bit(4)),
+        c: (class_tag_mask(c), -1, None, -1),  # no guide retained
+    }
+    led.pending = {b}
+    led.manifest = effect_manifest(_app())
+    led.witnesses = {7: {"sha": "ab" * 32, "class": a, "trace": None}}
+    back = ClassLedger.from_payload(led.to_payload())
+    assert back.classes == led.classes
+    assert back.violation_codes == {7}
+    assert back.meta[a] == led.meta[a]
+    assert back.meta[b] == led.meta[b]
+    assert back.meta[c][1] == -1 and back.meta[c][2] is None
+    assert back.meta[c][3] == -1  # guide-less record: dmask not kept
+    assert back.pending == {b}
+    assert back.manifest == led.manifest
+    assert back.witnesses[7]["sha"] == "ab" * 32
+    assert back.witnesses[7]["class"] == a
+    # Round-trip is a fixpoint: payload of the parse is bit-identical.
+    assert ClassLedger.from_payload(back.to_payload()).to_payload() == (
+        back.to_payload()
+    )
+
+
+# -- store cache + compaction ---------------------------------------------
+
+def _ledger(tags, code=None):
+    led = ClassLedger()
+    led.classes = {_key(t) for t in tags}
+    for k in led.classes:
+        led.meta[k] = (class_tag_mask(k), 1, _guide(k[0][2]), 0)
+    if code is not None:
+        led.violation_codes = {code}
+    return led
+
+
+def test_store_parsed_cache_counts_hits(tmp_path):
+    from demi_tpu import obs
+
+    obs.REGISTRY.reset()
+    obs.enable()
+    try:
+        store = ClassStore(str(tmp_path), "fp_cache_test")
+        # Distinctive content: no other test's segment shares the
+        # address, so the process-wide cache can't pre-hit.
+        store.publish(_ledger([1, 2, 61], code=41))
+        first = ClassStore(str(tmp_path), "fp_cache_test")
+        assert len(first.load()) == 3
+        assert first.stats["cache_hits"] == 0
+        before = obs.counter("fleet.store_cache").value()
+        warm = ClassStore(str(tmp_path), "fp_cache_test")
+        assert len(warm.load()) == 3
+        assert warm.stats["cache_hits"] == 1
+        assert obs.counter("fleet.store_cache").value() == before + 1
+    finally:
+        obs.disable()
+        obs.REGISTRY.reset()
+
+
+def test_store_compact_merges_and_removes(tmp_path):
+    store = ClassStore(str(tmp_path), "fp_compact_test")
+    store.publish(_ledger([1, 2], code=17))
+    store.publish(_ledger([3], code=23))
+    store.publish(_ledger([4, 5, 60]))
+    assert len(store.segments()) == 3
+    out = store.compact()
+    assert out["segments_before"] == 3
+    assert out["classes"] == 6
+    assert out["segments_corrupt"] == 0
+    segs = store.segments()
+    assert segs == [out["merged_segment"]]
+    merged = ClassStore(str(tmp_path), "fp_compact_test").load()
+    assert len(merged) == 6
+    assert merged.violation_codes == {17, 23}
+    # Compacting a compacted store is a no-op fixpoint.
+    again = store.compact()
+    assert again["segments_removed"] == 0
+    assert store.segments() == segs
+
+
+def test_store_compact_skips_corrupt_segment_in_place(tmp_path):
+    store = ClassStore(str(tmp_path), "fp_corrupt_test")
+    store.publish(_ledger([1, 59], code=31))
+    store.publish(_ledger([2]))
+    segs = store.segments()
+    bad = os.path.join(store.dir, segs[0])
+    with open(bad, "ab") as f:
+        f.write(b"garbage")  # bytes no longer match the content address
+    out = store.compact()
+    assert out["segments_corrupt"] == 1
+    # The corrupt segment stays for forensics; the good ones merged.
+    assert segs[0] in store.segments()
+    merged = ClassStore(str(tmp_path), "fp_corrupt_test").load()
+    assert len(merged) >= 1

@@ -124,8 +124,9 @@ def test_three_tenant_shared_batching_parity_vs_solo():
 
 def test_submit_poll_fetch_roundtrip_and_refusal_over_tcp(tmp_path):
     """The wire: submit → poll → fetch over a real TCP connection, a
-    fingerprint-mismatched second submission refused over the wire,
-    stats/status verbs, and shutdown."""
+    fingerprint-mismatched second submission becoming a versioned
+    tenant lineage riding a delta plan (not a refusal), stats/status
+    verbs, and shutdown."""
     daemon = ServiceDaemon(None, default_chunk=8)
     addr = daemon.serve()
     t = threading.Thread(target=daemon.run, daemon=True)
@@ -148,13 +149,18 @@ def test_submit_poll_fetch_roundtrip_and_refusal_over_tcp(tmp_path):
                 f["ns"] == "acme/j0" for f in frames
             ), "frames are namespaced"
 
-            # Same tenant, different handler fingerprint: refused (a
-            # reliable broadcast builds different handler bytecode).
-            with pytest.raises(ServiceError) as exc:
-                client.submit(
-                    "acme", {**WORKLOAD, "bug": None}, lanes=4,
-                )
-            assert exc.value.refused
+            # Same tenant, different handler fingerprint: a VERSION
+            # bump, not a refusal — the old fingerprint joins the
+            # lineage and the reply carries the delta plan the
+            # differential explorer rides (a reliable broadcast builds
+            # different handler bytecode).
+            v2 = client.submit(
+                "acme", {**WORKLOAD, "bug": None}, lanes=1, max_frames=0,
+                wildcards=False,
+            )
+            assert v2["tenant"] == "acme"
+            assert v2["tenant_version"] == 1
+            assert "delta" in v2  # the plan (possibly full) travels
             # A NEW tenant with the different workload is admitted
             # (isolation is per tenant, not global).
             other = client.submit(
@@ -162,6 +168,7 @@ def test_submit_poll_fetch_roundtrip_and_refusal_over_tcp(tmp_path):
                 wildcards=False,
             )
             assert other["tenant"] == "dave"
+            assert other["tenant_version"] == 0
 
             snap = client.stats()
             assert any(
@@ -170,7 +177,11 @@ def test_submit_poll_fetch_roundtrip_and_refusal_over_tcp(tmp_path):
                 for key in series
             )
             status = client.status()
-            assert status["refusals"] == 1
+            assert status["refusals"] == 0
+            assert status["versions"] == 1
+            assert status["tenants"]["acme"]["version"] == 1
+            assert status["tenants"]["acme"]["lineage"], \
+                "old fingerprint preserved in the lineage"
             assert status["savings"]["chunks"] >= 2
             client.shutdown(drain=False)
     finally:
